@@ -1,0 +1,21 @@
+"""distributed_tensorflow_tpu — a TPU-native distributed training framework.
+
+Brand-new framework with the capabilities of Rmeredith99/distributed_tensorflow
+(TF 1.4 parameter-server data parallelism; see SURVEY.md), re-designed for
+TPU: JAX/XLA compiled step functions, sync data parallelism via ICI
+all-reduce, and the pjit/Mesh generalization to tensor / sequence / pipeline
+parallelism.  No parameter server, no gRPC variable push — placement is
+declarative sharding and gradient sync is a compiled collective.
+
+Public surface (two tiers, mirroring the reference's two scripts):
+  * low-level: ``ops`` (functional layers) + ``optim`` + ``train.TrainSession``
+    — the analogue of reference example.py's graph + MonitoredTrainingSession.
+  * high-level: ``models.Sequential`` with ``compile``/``fit``
+    — the analogue of reference example2.py's Keras path.
+"""
+
+from . import data, models, ops, optim, parallel, summary, train, utils
+from .utils import flags
+from .utils.flags import FLAGS
+
+__version__ = "0.1.0"

@@ -1,0 +1,10 @@
+"""Data subsystem: synthetic tasks, dataset loaders, device-prefetch pipeline."""
+
+from . import datasets, pipeline, xor
+from .datasets import cifar10, mnist, synthetic_image_classes
+from .pipeline import Dataset, prefetch_to_device
+from .xor import get_data as xor_data
+
+__all__ = ["datasets", "pipeline", "xor", "cifar10", "mnist",
+           "synthetic_image_classes", "Dataset", "prefetch_to_device",
+           "xor_data"]

@@ -1,0 +1,8 @@
+"""Model zoo + high-level Sequential/compile/fit API."""
+
+from . import callbacks
+from .callbacks import Callback, EarlyStopping, History, TensorBoard
+from .sequential import Sequential
+
+__all__ = ["callbacks", "Callback", "EarlyStopping", "History",
+           "TensorBoard", "Sequential"]

@@ -1,0 +1,85 @@
+"""Keras-style callbacks for the high-level ``fit`` API.
+
+Parity target: the reference passes ``callbacks=[TensorBoard(log_dir=...)]``
+to ``model.fit`` (reference example2.py:6,197,200).  Callbacks see epoch
+boundaries; per-step observability belongs to ``train.hooks``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..summary import SummaryWriter
+
+__all__ = ["Callback", "TensorBoard", "History", "EarlyStopping"]
+
+
+class Callback:
+    def on_train_begin(self, model) -> None:
+        pass
+
+    def on_epoch_begin(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int, logs: Dict[str, float]) -> None:
+        pass
+
+    def on_train_end(self, model) -> None:
+        pass
+
+
+class TensorBoard(Callback):
+    """Writes epoch metrics as TB scalars (reference example2.py:197)."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._writer: Optional[SummaryWriter] = None
+
+    def on_train_begin(self, model) -> None:
+        self._writer = SummaryWriter(self.log_dir)
+
+    def on_epoch_end(self, model, epoch, logs) -> None:
+        if self._writer and logs:
+            self._writer.add_scalars(logs, epoch)
+            self._writer.flush()
+
+    def on_train_end(self, model) -> None:
+        if self._writer:
+            self._writer.close()
+
+
+class History(Callback):
+    """Accumulates per-epoch logs; ``fit`` returns it (Keras convention)."""
+
+    def __init__(self):
+        self.history: Dict[str, list] = {}
+        self.epochs: list = []
+
+    def on_epoch_end(self, model, epoch, logs) -> None:
+        self.epochs.append(epoch)
+        for k, v in logs.items():
+            self.history.setdefault(k, []).append(v)
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "val_loss", patience: int = 3,
+                 min_delta: float = 0.0, mode: str = "min"):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.best = float("inf")
+        self.wait = 0
+
+    def on_epoch_end(self, model, epoch, logs) -> None:
+        value = logs.get(self.monitor)
+        if value is None:
+            return
+        score = self.sign * float(value)
+        if score < self.best - self.min_delta:
+            self.best = score
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                model.stop_training = True

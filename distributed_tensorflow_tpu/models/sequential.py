@@ -1,0 +1,215 @@
+"""Sequential — the high-level ``compile``/``fit`` tier.
+
+Capability parity with the reference's Keras path (reference
+example2.py:148-200): ``Sequential`` container, ``add``, ``compile(loss,
+optimizer, metrics)``, ``fit(x, y, epochs, batch_size, validation_data,
+callbacks)``, ``evaluate``, ``predict`` — re-built on the framework's own
+compiled steps (no session binding: where the reference must smuggle the
+monitored session into Keras via ``K.set_session`` at example2.py:194-195,
+here ``fit`` simply drives the same jitted step the low-level API uses).
+
+Distribution: pass ``mesh=`` at compile time and the whole fit loop runs
+data-parallel over the mesh's ``data`` axis with batches prefetched to
+device already sharded — the high-level user never sees a collective.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..data.pipeline import Dataset, prefetch_to_device
+from ..ops import layers as layer_lib
+from ..ops import losses as loss_lib
+from ..ops import metrics as metric_lib
+from ..optim import optimizers as opt_lib
+from ..train import step as step_lib
+from ..train.session import TrainState
+from .callbacks import Callback, History
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Sequential"]
+
+
+class Sequential:
+    def __init__(self, layers: Sequence[layer_lib.Layer] = (),
+                 name: str = "sequential"):
+        self.name = name
+        self._layers: List[layer_lib.Layer] = list(layers)
+        self._stack: Optional[layer_lib.Stack] = None
+        self.state: Optional[TrainState] = None
+        self.stop_training = False
+        self._compiled = None
+
+    # -- construction ----------------------------------------------------
+    def add(self, layer: layer_lib.Layer) -> None:
+        """reference example2.py:151-156 ``model.add`` parity."""
+        self._layers.append(layer)
+        self._stack = None
+        self._compiled = None
+
+    @property
+    def stack(self) -> layer_lib.Stack:
+        if self._stack is None:
+            self._stack = layer_lib.Stack(self._layers, name=self.name)
+        return self._stack
+
+    # -- compile ---------------------------------------------------------
+    def compile(self, loss, optimizer="adam",
+                metrics: Sequence = (),
+                mesh=None, params_spec=None, seed: int = 0,
+                grad_clip_norm: Optional[float] = None) -> None:
+        """reference example2.py:165 parity: strings or callables/objects."""
+        loss_fn = loss_lib.get(loss)
+        opt = opt_lib.get(optimizer)
+        metric_fns = {}
+        for m in metrics:
+            fn = metric_lib.get(m)
+            metric_fns[getattr(fn, "__name__", str(m))] = fn
+        self._compiled = dict(
+            loss=loss_fn, optimizer=opt, metric_fns=metric_fns, mesh=mesh,
+            train_step=step_lib.make_train_step(
+                self.stack, loss_fn, opt, metric_fns=metric_fns, seed=seed,
+                mesh=mesh, params_spec=params_spec,
+                grad_clip_norm=grad_clip_norm),
+            eval_step=step_lib.make_eval_step(
+                self.stack, loss_fn, metric_fns=metric_fns, mesh=mesh),
+        )
+
+    def _require_compiled(self) -> dict:
+        if self._compiled is None:
+            raise RuntimeError("call model.compile(...) before fit/evaluate")
+        return self._compiled
+
+    def build(self, in_shape: Tuple[int, ...], seed: int = 0) -> TrainState:
+        """Initialize parameters for per-example feature shape ``in_shape``."""
+        c = self._require_compiled()
+        key = jax.random.PRNGKey(seed)
+        self.state = step_lib.init_train_state(self.stack, c["optimizer"],
+                                               key, in_shape)
+        if c["mesh"] is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            replicated = NamedSharding(c["mesh"], PartitionSpec())
+            self.state = jax.device_put(self.state, replicated)
+        return self.state
+
+    # -- training --------------------------------------------------------
+    def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
+            validation_data: Optional[Tuple] = None,
+            callbacks: Sequence[Callback] = (),
+            shuffle: bool = True, seed: int = 0,
+            verbose: int = 1) -> History:
+        """reference example2.py:197-200 parity (sync-DP underneath)."""
+        c = self._require_compiled()
+        if self.state is None:
+            self.build(tuple(np.shape(x)[1:]), seed=seed)
+
+        history = History()
+        callbacks = list(callbacks) + [history]
+        self.stop_training = False
+
+        if c["mesh"] is not None:
+            from ..parallel.mesh import round_batch_to_mesh
+            rounded = round_batch_to_mesh(batch_size, c["mesh"])
+            if rounded != batch_size:
+                log.info("batch_size %d -> %d (divisible by mesh data shards)",
+                         batch_size, rounded)
+                batch_size = rounded
+        dataset = Dataset([np.asarray(x), np.asarray(y)], batch_size,
+                          shuffle=shuffle, seed=seed)
+        sharding = None
+        if c["mesh"] is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sharding = NamedSharding(c["mesh"], PartitionSpec("data"))
+
+        for cb in callbacks:
+            cb.on_train_begin(self)
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for cb in callbacks:
+                cb.on_epoch_begin(self, epoch)
+            # Keep the last batch's metrics device-side; pull once per epoch.
+            last_metrics: Dict[str, Any] = {}
+            running: Dict[str, float] = {}
+            count = 0
+            for batch in prefetch_to_device(iter(dataset), sharding=sharding):
+                self.state, last_metrics = c["train_step"](self.state, batch)
+                count += 1
+                if count % 50 == 0 or count == len(dataset):
+                    for k, v in last_metrics.items():
+                        running[k] = float(v)
+            logs = dict(running)
+            if validation_data is not None:
+                val = self.evaluate(validation_data[0], validation_data[1],
+                                    batch_size=batch_size, verbose=0)
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            if verbose:
+                parts = ", ".join(f"{k}={v:.4f}" for k, v in logs.items())
+                print(f"Epoch {epoch + 1}/{epochs}: {parts}", flush=True)
+            for cb in callbacks:
+                cb.on_epoch_end(self, epoch, logs)
+        for cb in callbacks:
+            cb.on_train_end(self)
+        return history
+
+    def evaluate(self, x, y, batch_size: int = 32,
+                 verbose: int = 1) -> Dict[str, float]:
+        c = self._require_compiled()
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        dataset = Dataset([np.asarray(x), np.asarray(y)], batch_size,
+                          shuffle=False, drop_remainder=False)
+        sharding = None
+        if c["mesh"] is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sharding = NamedSharding(c["mesh"], PartitionSpec("data"))
+        totals: Dict[str, float] = {}
+        n = 0
+        for batch in iter(dataset):
+            bs = batch[0].shape[0]
+            if sharding is not None and bs % sharding.mesh.shape["data"] == 0:
+                batch = jax.device_put(batch, sharding)
+            metrics = c["eval_step"](self.state, batch)
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * bs
+            n += bs
+        out = {k: v / max(n, 1) for k, v in totals.items()}
+        if verbose:
+            parts = ", ".join(f"{k}={v:.4f}" for k, v in out.items())
+            print(f"evaluate: {parts}", flush=True)
+        return out
+
+    def predict(self, x, batch_size: int = 256) -> np.ndarray:
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        apply_fn = jax.jit(
+            lambda params, model_state, xb: self.stack.apply(
+                params, model_state, xb, train=False, rng=None)[0])
+        outs = []
+        x = np.asarray(x)
+        for lo in range(0, x.shape[0], batch_size):
+            outs.append(np.asarray(apply_fn(
+                self.state.params, self.state.model_state,
+                x[lo:lo + batch_size])))
+        return np.concatenate(outs, axis=0)
+
+    # -- introspection ---------------------------------------------------
+    def summary(self) -> str:
+        lines = [f"Model: {self.name}"]
+        total = 0
+        if self.state is not None:
+            for name, p in self.state.params.items():
+                n = sum(int(np.prod(leaf.shape))
+                        for leaf in jax.tree_util.tree_leaves(p))
+                total += n
+                lines.append(f"  {name}: {n:,} params")
+            lines.append(f"Total params: {total:,}")
+        else:
+            lines += [f"  {layer!r}" for layer in self._layers]
+        text = "\n".join(lines)
+        print(text, flush=True)
+        return text

@@ -1,0 +1,13 @@
+"""Functional op/layer library (compute tier: everything lowers to XLA HLO)."""
+
+from . import activations, initializers, losses, metrics
+from .layers import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Dropout,
+                     Embedding, Flatten, GlobalAvgPool, Layer, LayerNorm,
+                     MaxPool2D, Stack, serial)
+
+__all__ = [
+    "activations", "initializers", "losses", "metrics",
+    "Activation", "AvgPool2D", "BatchNorm", "Conv2D", "Dense", "Dropout",
+    "Embedding", "Flatten", "GlobalAvgPool", "Layer", "LayerNorm",
+    "MaxPool2D", "Stack", "serial",
+]

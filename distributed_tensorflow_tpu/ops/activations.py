@@ -1,0 +1,50 @@
+"""Activation functions (XLA fuses these into adjacent matmuls on TPU).
+
+The reference uses ``relu`` and ``sigmoid`` as Keras layer kwargs
+(reference example.py:149-155).  Registry lookup keeps that string-based
+API; everything is a plain jnp function so it traces into one fused HLO.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["relu", "sigmoid", "tanh", "gelu", "silu", "softmax",
+           "log_softmax", "identity", "get"]
+
+relu = jax.nn.relu
+sigmoid = jax.nn.sigmoid
+tanh = jnp.tanh
+gelu = jax.nn.gelu
+silu = jax.nn.silu
+softmax = jax.nn.softmax
+log_softmax = jax.nn.log_softmax
+
+
+def identity(x):
+    return x
+
+
+_REGISTRY = {
+    "relu": relu,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "gelu": gelu,
+    "silu": silu,
+    "swish": silu,
+    "softmax": softmax,
+    "log_softmax": log_softmax,
+    "linear": identity,
+    "identity": identity,
+    None: identity,
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return _REGISTRY[name_or_fn]
+    except KeyError:
+        raise ValueError(f"unknown activation {name_or_fn!r}; "
+                         f"known: {sorted(k for k in _REGISTRY if k)}") from None

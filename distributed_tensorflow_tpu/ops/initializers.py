@@ -1,0 +1,131 @@
+"""Parameter initializers.
+
+The reference gets initialization implicitly from Keras 2.0.8 layer defaults
+(glorot_uniform kernels, zero biases — invoked at reference example.py:149-155
+via ``Dense(...)``).  Here they are explicit, PRNG-keyed, and dtype-aware so
+params can be created directly in bfloat16 on TPU when requested.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["zeros", "ones", "constant", "normal", "truncated_normal",
+           "uniform", "glorot_uniform", "glorot_normal", "he_normal",
+           "he_uniform", "lecun_normal", "get"]
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.ones(shape, dtype)
+
+
+def constant(value):
+    def init(key, shape, dtype=jnp.float32):
+        del key
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+def normal(stddev=0.01):
+    def init(key, shape, dtype=jnp.float32):
+        return (stddev * jax.random.normal(key, shape)).astype(dtype)
+    return init
+
+
+def truncated_normal(stddev=0.02):
+    def init(key, shape, dtype=jnp.float32):
+        return (stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape)
+                ).astype(dtype)
+    return init
+
+
+def uniform(scale=0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, minval=-scale, maxval=scale
+                                  ).astype(dtype)
+    return init
+
+
+def _fans(shape: Sequence[int]):
+    """fan_in/fan_out for dense ([in, out]) and conv ([h, w, in, out])."""
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = math.prod(shape[:-2]) if len(shape) > 2 else 1
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def _variance_scaling(scale: float, mode: str, distribution: str):
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        if mode == "fan_in":
+            denom = max(1, fan_in)
+        elif mode == "fan_out":
+            denom = max(1, fan_out)
+        else:
+            denom = max(1.0, (fan_in + fan_out) / 2.0)
+        variance = scale / denom
+        if distribution == "uniform":
+            limit = math.sqrt(3.0 * variance)
+            out = jax.random.uniform(key, shape, minval=-limit, maxval=limit)
+        else:
+            stddev = math.sqrt(variance)
+            if distribution == "truncated_normal":
+                # correction so post-truncation stddev is as requested
+                stddev = stddev / 0.87962566103423978
+                out = stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape)
+            else:
+                out = stddev * jax.random.normal(key, shape)
+        return out.astype(dtype)
+    return init
+
+
+def glorot_uniform():
+    return _variance_scaling(1.0, "fan_avg", "uniform")
+
+
+def glorot_normal():
+    return _variance_scaling(1.0, "fan_avg", "truncated_normal")
+
+
+def he_normal():
+    return _variance_scaling(2.0, "fan_in", "truncated_normal")
+
+
+def he_uniform():
+    return _variance_scaling(2.0, "fan_in", "uniform")
+
+
+def lecun_normal():
+    return _variance_scaling(1.0, "fan_in", "truncated_normal")
+
+
+_REGISTRY = {
+    "zeros": zeros,
+    "ones": ones,
+    "glorot_uniform": glorot_uniform(),
+    "glorot_normal": glorot_normal(),
+    "he_normal": he_normal(),
+    "he_uniform": he_uniform(),
+    "lecun_normal": lecun_normal(),
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return _REGISTRY[name_or_fn]
+    except KeyError:
+        raise ValueError(f"unknown initializer {name_or_fn!r}; "
+                         f"known: {sorted(_REGISTRY)}") from None

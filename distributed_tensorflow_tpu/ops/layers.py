@@ -1,0 +1,436 @@
+"""Functional layer library (the L6 module tier).
+
+TPU-native re-design of the layer surface the reference pulls from Keras
+2.0.8: ``Dense(128, activation='relu')``, ``Dropout(0.3)`` applied to
+placeholders (reference example.py:149-155) and the same stack inside a
+``Sequential`` (reference example2.py:151-156).  Plus the conv/norm/embedding
+layers needed by the driver's CNN / ResNet-50 / BERT baseline configs.
+
+Conventions
+-----------
+* A ``Layer`` is a lightweight config object; all tensors live in explicit
+  pytrees.  ``init(key, in_shape) -> (params, state)`` where ``in_shape`` is
+  the per-example feature shape (no batch dim).  ``params`` is trainable;
+  ``state`` holds non-trainable stats (BatchNorm running moments) so
+  optimizers never have to mask anything.
+* ``apply(params, state, x, *, train=False, rng=None) -> (y, new_state)``.
+  ``train``/``rng`` replace the reference's global Keras learning-phase feed
+  (``K.learning_phase()`` at example.py:213,225) with explicit arguments —
+  a requirement for jit-traceability (two traces: train=True / train=False),
+  and Dropout randomness becomes explicit key-threading (SURVEY.md §7).
+* Mixed precision: params are stored in ``param_dtype`` (default f32) and
+  cast to the input's dtype at apply time, so feeding bf16 activations runs
+  the matmul on the MXU in bf16 with f32 master weights.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import activations as act_lib
+from . import initializers as init_lib
+
+__all__ = ["Layer", "Dense", "Dropout", "Flatten", "Activation", "Conv2D",
+           "MaxPool2D", "AvgPool2D", "GlobalAvgPool", "BatchNorm",
+           "LayerNorm", "Embedding", "serial", "Stack"]
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+Shape = Tuple[int, ...]
+
+
+def _pair(v: Union[int, Sequence[int]]) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    a, b = v
+    return (int(a), int(b))
+
+
+class Layer:
+    """Base layer: stateless identity."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or type(self).__name__.lower()
+
+    def init(self, key, in_shape: Shape) -> Tuple[Params, State]:
+        del key, in_shape
+        return {}, {}
+
+    def out_shape(self, in_shape: Shape) -> Shape:
+        return tuple(in_shape)
+
+    def apply(self, params: Params, state: State, x, *, train: bool = False,
+              rng=None):
+        del params, train, rng
+        return x, state
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class Dense(Layer):
+    """y = act(x @ W + b).  Keras-parity default init (glorot_uniform/zeros).
+
+    Replaces ``keras.layers.Dense`` as invoked at reference example.py:149-155.
+    The kernel is stored ``[in, out]`` so ``pjit`` tensor-parallel sharding
+    specs can target the output dim with ``P(None, 'tensor')``.
+    """
+
+    def __init__(self, units: int, activation=None, use_bias: bool = True,
+                 kernel_init="glorot_uniform", bias_init="zeros",
+                 param_dtype=jnp.float32, name: Optional[str] = None):
+        super().__init__(name)
+        self.units = int(units)
+        self.activation = act_lib.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = init_lib.get(kernel_init)
+        self.bias_init = init_lib.get(bias_init)
+        self.param_dtype = param_dtype
+
+    def init(self, key, in_shape):
+        in_dim = in_shape[-1]
+        k_kernel, k_bias = jax.random.split(key)
+        params = {"kernel": self.kernel_init(
+            k_kernel, (in_dim, self.units), self.param_dtype)}
+        if self.use_bias:
+            params["bias"] = self.bias_init(
+                k_bias, (self.units,), self.param_dtype)
+        return params, {}
+
+    def out_shape(self, in_shape):
+        return tuple(in_shape[:-1]) + (self.units,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        kernel = params["kernel"].astype(x.dtype)
+        y = x @ kernel
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self.activation(y), state
+
+    def __repr__(self):
+        return f"Dense({self.units})"
+
+
+class Dropout(Layer):
+    """Inverted dropout; active only when ``train`` and ``rng`` provided.
+
+    Replaces ``keras.layers.Dropout(0.3)`` + the learning-phase feed
+    (reference example.py:151,153,213,225): phase is the ``train`` kwarg and
+    randomness is an explicit PRNG key (split per step/layer by callers).
+    """
+
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name)
+        if not 0.0 <= rate < 1.0:
+            raise ValueError(f"dropout rate must be in [0, 1), got {rate}")
+        self.rate = float(rate)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout.apply(train=True) requires an rng key")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, jnp.zeros_like(x)), state
+
+    def __repr__(self):
+        return f"Dropout({self.rate})"
+
+
+class Flatten(Layer):
+    def out_shape(self, in_shape):
+        return (math.prod(in_shape),)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+class Activation(Layer):
+    def __init__(self, fn, name: Optional[str] = None):
+        super().__init__(name)
+        self.fn = act_lib.get(fn)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.fn(x), state
+
+
+class Conv2D(Layer):
+    """NHWC conv via ``lax.conv_general_dilated`` (lowers to the MXU).
+
+    Kernel layout HWIO so TP specs can shard the output-channel dim.
+    """
+
+    def __init__(self, filters: int, kernel_size, strides=1, padding="SAME",
+                 activation=None, use_bias: bool = True,
+                 kernel_init="he_normal", bias_init="zeros",
+                 param_dtype=jnp.float32, name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = int(filters)
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding
+        self.activation = act_lib.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = init_lib.get(kernel_init)
+        self.bias_init = init_lib.get(bias_init)
+        self.param_dtype = param_dtype
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        del h, w
+        k_kernel, k_bias = jax.random.split(key)
+        kh, kw = self.kernel_size
+        params = {"kernel": self.kernel_init(
+            k_kernel, (kh, kw, c, self.filters), self.param_dtype)}
+        if self.use_bias:
+            params["bias"] = self.bias_init(
+                k_bias, (self.filters,), self.param_dtype)
+        return params, {}
+
+    def _spatial_out(self, size: int, k: int, s: int) -> int:
+        if self.padding == "SAME":
+            return -(-size // s)
+        return -(-(size - k + 1) // s)
+
+    def out_shape(self, in_shape):
+        h, w, _ = in_shape
+        (kh, kw), (sh, sw) = self.kernel_size, self.strides
+        return (self._spatial_out(h, kh, sh), self._spatial_out(w, kw, sw),
+                self.filters)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        kernel = params["kernel"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self.activation(y), state
+
+    def __repr__(self):
+        return f"Conv2D({self.filters}, {self.kernel_size})"
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size=2, strides=None, padding="VALID",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = padding
+
+    def out_shape(self, in_shape):
+        h, w, c = in_shape
+        (kh, kw), (sh, sw) = self.pool_size, self.strides
+        if self.padding == "SAME":
+            return (-(-h // sh), -(-w // sw), c)
+        return (-(-(h - kh + 1) // sh), -(-(w - kw + 1) // sw), c)
+
+    def _reduce(self, x, init, op):
+        return lax.reduce_window(
+            x, init, op,
+            window_dimensions=(1,) + self.pool_size + (1,),
+            window_strides=(1,) + self.strides + (1,),
+            padding=self.padding)
+
+
+class MaxPool2D(_Pool2D):
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self._reduce(x, -jnp.inf, lax.max), state
+
+
+class AvgPool2D(_Pool2D):
+    def apply(self, params, state, x, *, train=False, rng=None):
+        total = self._reduce(x, 0.0, lax.add)
+        if self.padding == "SAME":
+            # Average over the *valid* elements per window (Keras/TF
+            # semantics): edge windows divide by their true coverage.
+            count = self._reduce(jnp.ones((1,) + x.shape[1:3] + (1,),
+                                          x.dtype), 0.0, lax.add)
+            return total / count, state
+        return total / math.prod(self.pool_size), state
+
+
+class GlobalAvgPool(Layer):
+    """NHWC -> NC mean over spatial dims."""
+
+    def out_shape(self, in_shape):
+        return (in_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+class BatchNorm(Layer):
+    """Batch normalization with running-moment state.
+
+    ``axis_name`` makes the batch statistics *cross-replica* when the layer
+    runs under ``shard_map``/``pmap`` with that mesh axis bound — the sync-DP
+    analogue of per-worker-local stats in the reference's PS world.  Under
+    plain ``jit`` over a sharded batch, XLA's global-mean semantics already
+    give cross-device stats, so leave it None there.
+    """
+
+    def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5,
+                 scale: bool = True, center: bool = True,
+                 axis_name: Optional[str] = None, name: Optional[str] = None):
+        super().__init__(name)
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+        self.scale = scale
+        self.center = center
+        self.axis_name = axis_name
+
+    def init(self, key, in_shape):
+        del key
+        dim = in_shape[-1]
+        params = {}
+        if self.scale:
+            params["gamma"] = jnp.ones((dim,), jnp.float32)
+        if self.center:
+            params["beta"] = jnp.zeros((dim,), jnp.float32)
+        state = {"mean": jnp.zeros((dim,), jnp.float32),
+                 "var": jnp.ones((dim,), jnp.float32)}
+        return params, state
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        reduce_axes = tuple(range(x.ndim - 1))
+        if train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                mean_sq = lax.pmean(mean_sq, self.axis_name)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.epsilon)
+        if self.scale:
+            inv = inv * params["gamma"]
+        y = (x.astype(jnp.float32) - mean) * inv
+        if self.center:
+            y = y + params["beta"]
+        return y.astype(x.dtype), new_state
+
+
+class LayerNorm(Layer):
+    """Layer normalization over the trailing dim (transformer workhorse)."""
+
+    def __init__(self, epsilon: float = 1e-6, scale: bool = True,
+                 center: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.epsilon = float(epsilon)
+        self.scale = scale
+        self.center = center
+
+    def init(self, key, in_shape):
+        del key
+        dim = in_shape[-1]
+        params = {}
+        if self.scale:
+            params["gamma"] = jnp.ones((dim,), jnp.float32)
+        if self.center:
+            params["beta"] = jnp.zeros((dim,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.epsilon)
+        if self.scale:
+            y = y * params["gamma"]
+        if self.center:
+            y = y + params["beta"]
+        return y.astype(x.dtype), state
+
+
+class Embedding(Layer):
+    """Token embedding table [vocab, dim]; shardable over 'tensor'."""
+
+    def __init__(self, vocab_size: int, dim: int,
+                 embedding_init=init_lib.normal(0.02),
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.embedding_init = init_lib.get(embedding_init)
+
+    def init(self, key, in_shape):
+        del in_shape
+        return {"embedding": self.embedding_init(
+            key, (self.vocab_size, self.dim), jnp.float32)}, {}
+
+    def out_shape(self, in_shape):
+        return tuple(in_shape) + (self.dim,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.take(params["embedding"], x, axis=0), state
+
+    def attend(self, params, x):
+        """Tied-softmax logits: x @ E^T (BERT MLM head)."""
+        return x @ params["embedding"].T.astype(x.dtype)
+
+
+class Stack(Layer):
+    """Serial composition of layers; params/state are name-keyed dicts."""
+
+    def __init__(self, layers: Sequence[Layer], name: Optional[str] = None):
+        super().__init__(name)
+        self.layers = list(layers)
+        # Unique name per layer: "dense", "dense_1", ...
+        counts: Dict[str, int] = {}
+        self.keys = []
+        for layer in self.layers:
+            base = layer.name
+            n = counts.get(base, 0)
+            counts[base] = n + 1
+            self.keys.append(base if n == 0 else f"{base}_{n}")
+
+    def init(self, key, in_shape):
+        params, state = {}, {}
+        shape = tuple(in_shape)
+        subkeys = jax.random.split(key, max(1, len(self.layers)))
+        for sub, name, layer in zip(subkeys, self.keys, self.layers):
+            p, s = layer.init(sub, shape)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+            shape = layer.out_shape(shape)
+        return params, state
+
+    def out_shape(self, in_shape):
+        shape = tuple(in_shape)
+        for layer in self.layers:
+            shape = layer.out_shape(shape)
+        return shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+        rngs = (jax.random.split(rng, max(1, len(self.layers)))
+                if rng is not None else [None] * len(self.layers))
+        for sub_rng, name, layer in zip(rngs, self.keys, self.layers):
+            x, s = layer.apply(params.get(name, {}), state.get(name, {}), x,
+                               train=train, rng=sub_rng)
+            if s:
+                new_state[name] = s
+        return x, new_state
+
+    def __repr__(self):
+        return "Stack(" + ", ".join(repr(l) for l in self.layers) + ")"
+
+
+def serial(*layers: Layer) -> Stack:
+    """stax-style combinator: ``serial(Dense(128, 'relu'), Dropout(0.3), ...)``."""
+    return Stack(layers)

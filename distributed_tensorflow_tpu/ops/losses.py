@@ -1,0 +1,66 @@
+"""Loss functions.
+
+The reference uses mean-squared error via ``keras.losses.mean_squared_error``
+wrapped in a ``reduce_mean`` (reference example.py:162-163) and the string
+``'mean_squared_error'`` in ``compile`` (reference example2.py:165).  The
+classification configs (MNIST/CIFAR/BERT in BASELINE.md) need cross-entropy.
+
+All losses reduce in float32 regardless of input dtype (bf16-safe) and return
+a scalar mean over all leading dims — under data-parallel sharding the global
+mean is exactly what makes XLA's gradient all-reduce a mean over replicas.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["mean_squared_error", "binary_cross_entropy",
+           "softmax_cross_entropy", "softmax_cross_entropy_with_integer_labels",
+           "get"]
+
+
+def mean_squared_error(preds, targets):
+    diff = preds.astype(jnp.float32) - targets.astype(jnp.float32)
+    return jnp.mean(jnp.square(diff))
+
+
+def binary_cross_entropy(preds, targets, epsilon: float = 1e-7):
+    """BCE over sigmoid outputs (probabilities), like Keras binary_crossentropy."""
+    p = jnp.clip(preds.astype(jnp.float32), epsilon, 1.0 - epsilon)
+    t = targets.astype(jnp.float32)
+    return -jnp.mean(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))
+
+
+def softmax_cross_entropy(logits, onehot_targets):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.sum(onehot_targets * logp, axis=-1))
+
+
+def softmax_cross_entropy_with_integer_labels(logits, labels, where=None):
+    """XE with int labels; optional ``where`` mask (BERT MLM masked positions)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    if where is None:
+        return jnp.mean(nll)
+    w = where.astype(jnp.float32)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+_REGISTRY = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "binary_crossentropy": binary_cross_entropy,
+    "categorical_crossentropy": softmax_cross_entropy,
+    "sparse_categorical_crossentropy":
+        softmax_cross_entropy_with_integer_labels,
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return _REGISTRY[name_or_fn]
+    except KeyError:
+        raise ValueError(f"unknown loss {name_or_fn!r}; "
+                         f"known: {sorted(_REGISTRY)}") from None

@@ -1,0 +1,55 @@
+"""Metrics.
+
+``bitwise_accuracy`` reproduces the reference's accuracy graph exactly:
+mean(round(preds) == round(labels)) element-wise over the 32 output bits
+(reference example.py:157-160).  ``accuracy`` is argmax accuracy for the
+classification baseline configs.  The reference's broken ``xor_metric``
+(example2.py:158-163 — no return statement, truthiness on arrays) is
+intentionally not reproduced (SURVEY.md §2a #15).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["bitwise_accuracy", "accuracy", "top_k_accuracy", "get"]
+
+
+def bitwise_accuracy(preds, targets):
+    match = jnp.round(preds.astype(jnp.float32)) == jnp.round(
+        targets.astype(jnp.float32))
+    return jnp.mean(match.astype(jnp.float32))
+
+
+def accuracy(logits, labels):
+    """Argmax accuracy; labels may be int class ids or one-hot."""
+    if labels.ndim == logits.ndim:
+        labels = jnp.argmax(labels, axis=-1)
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def top_k_accuracy(k: int):
+    def metric(logits, labels):
+        if labels.ndim == logits.ndim:
+            labels = jnp.argmax(labels, axis=-1)
+        top = jnp.argsort(logits, axis=-1)[..., -k:]
+        hit = jnp.any(top == labels[..., None], axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    metric.__name__ = f"top_{k}_accuracy"
+    return metric
+
+
+_REGISTRY = {
+    "accuracy": accuracy,
+    "bitwise_accuracy": bitwise_accuracy,
+    "top_5_accuracy": top_k_accuracy(5),
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return _REGISTRY[name_or_fn]
+    except KeyError:
+        raise ValueError(f"unknown metric {name_or_fn!r}; "
+                         f"known: {sorted(_REGISTRY)}") from None

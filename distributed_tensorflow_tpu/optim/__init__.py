@@ -1,0 +1,9 @@
+"""Optimizers and LR schedules (pure-functional, shardable opt_state)."""
+
+from . import schedules
+from .optimizers import (Optimizer, OptState, adam, adamw, apply_updates,
+                         clip_by_global_norm, get, global_norm, momentum, sgd)
+
+__all__ = ["schedules", "Optimizer", "OptState", "adam", "adamw",
+           "apply_updates", "clip_by_global_norm", "get", "global_norm",
+           "momentum", "sgd"]

@@ -1,0 +1,180 @@
+"""Optimizers (L4).
+
+The reference uses ``tf.train.AdamOptimizer().minimize(loss, global_step)``
+with TF 1.4 defaults (reference example.py:168-170).  Our ``Adam`` reproduces
+the *TF 1.4 update rule* exactly (bias-corrected LR folded in, epsilon added
+OUTSIDE the sqrt):
+
+    lr_t = lr * sqrt(1 - b2^t) / (1 - b1^t)
+    p   -= lr_t * m / (sqrt(v) + eps)
+
+(which differs from the common "epsilon-inside-bias-correction" variant) so
+single-device runs are numerically comparable to the reference's optimizer.
+
+Design: pure-functional GradientTransformation —
+``init(params) -> opt_state``, ``update(grads, opt_state, params) ->
+(updates, new_opt_state)`` — the pair jits cleanly and the opt_state pytree
+shards with the same PartitionSpecs as the params (fsdp-friendly).  The
+shared ``global_step`` variable of the PS design (example.py:169) becomes a
+scalar carried in ``opt_state.count`` / ``TrainState.step``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Optimizer", "OptState", "sgd", "momentum", "adam", "adamw",
+           "apply_updates", "clip_by_global_norm", "global_norm", "get"]
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+ScalarOrSchedule = Union[float, Schedule]
+
+
+class OptState(NamedTuple):
+    count: jnp.ndarray          # int32 step counter (the global_step cursor)
+    inner: Any                  # optimizer-specific pytree(s)
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Any], OptState]
+    update: Callable[..., Any]  # (grads, state, params) -> (updates, state)
+
+
+def _lr_at(lr: ScalarOrSchedule, count) -> jnp.ndarray:
+    if callable(lr):
+        return jnp.asarray(lr(count), jnp.float32)
+    return jnp.asarray(lr, jnp.float32)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def clip_by_global_norm(tree, max_norm: float):
+    norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                        tree), norm
+
+
+def apply_updates(params, updates):
+    """p + u, computed in f32 and cast back to each param's dtype."""
+    return jax.tree.map(
+        lambda p, u: (p.astype(jnp.float32) + u.astype(jnp.float32)
+                      ).astype(p.dtype),
+        params, updates)
+
+
+def sgd(learning_rate: ScalarOrSchedule = 0.01) -> Optimizer:
+    def init(params):
+        del params
+        return OptState(jnp.zeros((), jnp.int32), ())
+
+    def update(grads, state: OptState, params=None):
+        del params
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        updates = jax.tree.map(lambda g: -lr * g.astype(jnp.float32), grads)
+        return updates, OptState(count, ())
+
+    return Optimizer(init, update)
+
+
+def momentum(learning_rate: ScalarOrSchedule = 0.01, beta: float = 0.9,
+             nesterov: bool = False) -> Optimizer:
+    def init(params):
+        mu = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return OptState(jnp.zeros((), jnp.int32), mu)
+
+    def update(grads, state: OptState, params=None):
+        del params
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        mu = jax.tree.map(lambda m, g: beta * m + g.astype(jnp.float32),
+                          state.inner, grads)
+        if nesterov:
+            updates = jax.tree.map(
+                lambda m, g: -lr * (beta * m + g.astype(jnp.float32)),
+                mu, grads)
+        else:
+            updates = jax.tree.map(lambda m: -lr * m, mu)
+        return updates, OptState(count, mu)
+
+    return Optimizer(init, update)
+
+
+def adam(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
+         b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
+    """TF-1.4-parity Adam (defaults match reference example.py:168)."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+        return OptState(jnp.zeros((), jnp.int32),
+                        {"m": jax.tree.map(zeros, params),
+                         "v": jax.tree.map(zeros, params)})
+
+    def update(grads, state: OptState, params=None):
+        del params
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        lr_t = _lr_at(learning_rate, count) * jnp.sqrt(
+            1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g.astype(jnp.float32),
+                         state.inner["m"], grads)
+        v = jax.tree.map(
+            lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+            state.inner["v"], grads)
+        updates = jax.tree.map(lambda m_, v_: -lr_t * m_ / (jnp.sqrt(v_) + eps),
+                               m, v)
+        return updates, OptState(count, {"m": m, "v": v})
+
+    return Optimizer(init, update)
+
+
+def adamw(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
+          b2: float = 0.999, eps: float = 1e-8,
+          weight_decay: float = 0.01,
+          mask: Optional[Callable[[Any], Any]] = None) -> Optimizer:
+    """Adam with decoupled weight decay (BERT fine-tune config).
+
+    ``mask(params)`` returns a same-structure pytree of bools selecting which
+    leaves decay (convention: no decay on biases / norm scales).
+    """
+    base = adam(learning_rate, b1, b2, eps)
+
+    def update(grads, state: OptState, params):
+        updates, new_state = base.update(grads, state, params)
+        lr = _lr_at(learning_rate, new_state.count)
+        decay_mask = (mask(params) if mask is not None
+                      else jax.tree.map(lambda p: p.ndim > 1, params))
+        updates = jax.tree.map(
+            lambda u, p, m_: u - (lr * weight_decay * p.astype(jnp.float32)
+                                  if m_ else 0.0),
+            updates, params, decay_mask)
+        return updates, new_state
+
+    return Optimizer(base.init, update)
+
+
+_REGISTRY = {
+    "sgd": sgd,
+    "momentum": momentum,
+    "adam": adam,
+    "adamw": adamw,
+}
+
+
+def get(name_or_opt, **kwargs):
+    """'adam' -> TF-1.4-default Adam, matching ``compile(optimizer='adam')``
+    at reference example2.py:165."""
+    if isinstance(name_or_opt, Optimizer):
+        return name_or_opt
+    try:
+        return _REGISTRY[name_or_opt](**kwargs)
+    except KeyError:
+        raise ValueError(f"unknown optimizer {name_or_opt!r}; "
+                         f"known: {sorted(_REGISTRY)}") from None

@@ -1,0 +1,72 @@
+"""Learning-rate schedules (step -> lr), jit-traceable.
+
+The reference trains at a fixed default Adam LR (example.py:168); schedules
+are required by the larger baseline configs (ResNet-50 step decay, BERT
+linear warmup/decay).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["constant", "exponential_decay", "cosine_decay",
+           "warmup_cosine_decay", "warmup_linear_decay", "piecewise_constant"]
+
+
+def constant(value: float):
+    def schedule(count):
+        return jnp.full((), value, jnp.float32)
+    return schedule
+
+
+def exponential_decay(init_value: float, decay_steps: int, decay_rate: float,
+                      staircase: bool = False):
+    def schedule(count):
+        p = count.astype(jnp.float32) / decay_steps
+        if staircase:
+            p = jnp.floor(p)
+        return init_value * jnp.power(decay_rate, p)
+    return schedule
+
+
+def cosine_decay(init_value: float, decay_steps: int, alpha: float = 0.0):
+    def schedule(count):
+        frac = jnp.clip(count.astype(jnp.float32) / decay_steps, 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return init_value * ((1 - alpha) * cos + alpha)
+    return schedule
+
+
+def warmup_cosine_decay(peak_value: float, warmup_steps: int,
+                        decay_steps: int, end_value: float = 0.0):
+    def schedule(count):
+        t = count.astype(jnp.float32)
+        warm = peak_value * t / jnp.maximum(warmup_steps, 1)
+        frac = jnp.clip((t - warmup_steps) /
+                        jnp.maximum(decay_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = end_value + (peak_value - end_value) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(t < warmup_steps, warm, cos)
+    return schedule
+
+
+def warmup_linear_decay(peak_value: float, warmup_steps: int,
+                        total_steps: int):
+    """BERT-style: linear warmup then linear decay to zero."""
+    def schedule(count):
+        t = count.astype(jnp.float32)
+        warm = peak_value * t / jnp.maximum(warmup_steps, 1)
+        frac = jnp.clip((t - warmup_steps) /
+                        jnp.maximum(total_steps - warmup_steps, 1), 0.0, 1.0)
+        return jnp.where(t < warmup_steps, warm, peak_value * (1.0 - frac))
+    return schedule
+
+
+def piecewise_constant(boundaries, values):
+    """ResNet-style step schedule: values[i] for step < boundaries[i]."""
+    bounds = jnp.asarray(boundaries, jnp.float32)
+    vals = jnp.asarray(values, jnp.float32)
+
+    def schedule(count):
+        idx = jnp.sum(count.astype(jnp.float32) >= bounds)
+        return vals[idx]
+    return schedule

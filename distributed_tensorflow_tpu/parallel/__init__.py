@@ -1,0 +1,12 @@
+"""Parallelism layer: cluster bootstrap, meshes, shardings, collectives."""
+
+from . import cluster, mesh
+from .cluster import ClusterConfig, cluster_from_env, initialize, is_chief
+from .mesh import (AXIS_ORDER, data_parallel_mesh, data_shards,
+                   local_batch_size, make_mesh, named_sharding, replicated,
+                   round_batch_to_mesh)
+
+__all__ = ["cluster", "mesh", "ClusterConfig", "cluster_from_env",
+           "initialize", "is_chief", "AXIS_ORDER", "data_parallel_mesh",
+           "data_shards", "local_batch_size", "make_mesh", "named_sharding",
+           "replicated", "round_batch_to_mesh"]

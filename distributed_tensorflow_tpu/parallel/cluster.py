@@ -1,0 +1,154 @@
+"""Cluster bootstrap: env-var topology with a single-machine fallback.
+
+TPU-native replacement for the reference's L1/L2 stack
+(reference example.py:59-68 env bootstrap; example.py:108-143
+``device_and_target()`` building a ``ClusterSpec``, starting a gRPC
+``tf.train.Server`` and parking PS processes in ``server.join()``).
+
+Design (SURVEY.md §2d, §7):
+  * There is **no parameter server**.  Every process runs the same SPMD
+    program; parameters are replicated or sharded via ``jax.sharding`` and
+    gradient sync is an XLA collective over ICI — not a per-step gRPC
+    variable pull/push.
+  * Topology comes from the environment, exactly like the reference, and the
+    same script with no env vars set runs single-machine
+    (reference example.py:111-113).  New-style vars take priority;
+    the reference's legacy names are honoured for drop-in compatibility:
+
+      new                    legacy (reference example.py:59-68)
+      COORDINATOR_ADDRESS    first host in WORKER_HOSTS
+      NUM_PROCESSES          len(WORKER_HOSTS.split(','))
+      PROCESS_ID             TASK_INDEX
+      (no role)              JOB_NAME — "ps" processes exit with a warning;
+                             collectives have no passive role to park in
+                             ``server.join()``.
+  * Chief == ``jax.process_index() == 0`` (the reference's
+    ``is_chief=(task_index == 0)``, example.py:190 — minus its str/int
+    comparison bug, see SURVEY.md §7).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ClusterConfig", "cluster_from_env", "initialize", "is_chief",
+           "process_index", "process_count"]
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """Resolved multi-process topology. ``num_processes == 1`` => local."""
+    coordinator_address: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    job_name: Optional[str] = None          # legacy role, informational only
+    worker_hosts: Optional[List[str]] = None
+
+    @property
+    def distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def is_legacy_ps(self) -> bool:
+        return self.job_name == "ps"
+
+
+def _split_hosts(raw: Optional[str]) -> Optional[List[str]]:
+    if not raw:
+        return None
+    return [h.strip() for h in raw.split(",") if h.strip()]
+
+
+def cluster_from_env(environ=None) -> ClusterConfig:
+    """Resolve topology from env vars; absent vars => single-machine.
+
+    Mirrors the reference's try/except fallback (example.py:59-68) without
+    the bare ``except`` or the string-typed ``task_index``.
+    """
+    env = os.environ if environ is None else environ
+
+    coordinator = env.get("COORDINATOR_ADDRESS")
+    workers = _split_hosts(env.get("WORKER_HOSTS"))
+    job_name = env.get("JOB_NAME") or None
+
+    def _int(var: str, default: int) -> int:
+        raw = env.get(var)
+        if raw is None:
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            log.warning("env var %s=%r is not an int; using %d", var, raw, default)
+            return default
+
+    num_processes = _int("NUM_PROCESSES", len(workers) if workers else 1)
+    process_id = _int("PROCESS_ID", _int("TASK_INDEX", 0))
+
+    if coordinator is None and workers:
+        # Legacy convention: the first worker is the coordinator.  Chief
+        # (task 0) semantics then line up with the reference's
+        # ``is_chief=(task_index == 0)`` (example.py:190).
+        coordinator = workers[0]
+
+    if coordinator is None:
+        return ClusterConfig(job_name=job_name)
+
+    return ClusterConfig(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        job_name=job_name,
+        worker_hosts=workers,
+    )
+
+
+_initialized = False
+
+
+def initialize(config: Optional[ClusterConfig] = None) -> ClusterConfig:
+    """Bring up the multi-process JAX runtime (idempotent).
+
+    Single-machine (no topology in env) is a no-op, mirroring the
+    reference's local fallback path (example.py:111-113).  A legacy
+    ``JOB_NAME=ps`` process gets a warning and is treated as a normal
+    participant refusal: there is nothing for it to serve.
+    """
+    global _initialized
+    if config is None:
+        config = cluster_from_env()
+
+    if config.is_legacy_ps:
+        log.warning(
+            "JOB_NAME=ps ignored: the TPU runtime has no parameter-server "
+            "role (gradient sync is an ICI all-reduce, not a variable push; "
+            "see SURVEY.md §2d). This process will not start.")
+        return config
+
+    if config.distributed and not _initialized:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        _initialized = True
+    return config
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    """Chief does checkpointing and summary writes (reference example.py:74-76,190)."""
+    return process_index() == 0

@@ -1,0 +1,6 @@
+"""TB-compatible summaries (scalar events, CRC-framed, zero TF deps)."""
+
+from .crc32c import crc32c, masked_crc32c
+from .event_writer import EventFileWriter, SummaryWriter
+
+__all__ = ["crc32c", "masked_crc32c", "EventFileWriter", "SummaryWriter"]

@@ -1,0 +1,31 @@
+"""CRC32-C (Castagnoli) + TFRecord masking, dependency-free.
+
+Needed for the TensorBoard event-file record framing (each record's length
+and payload carry a masked crc32c).  Table-driven pure Python; fast enough
+for scalar summaries (a few hundred bytes per step).  A C implementation in
+``native/`` can be slotted in later for bulk record IO.
+"""
+from __future__ import annotations
+
+__all__ = ["crc32c", "masked_crc32c"]
+
+_POLY = 0x82F63B78
+_TABLE = []
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+    _TABLE.append(_c)
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data: bytes) -> int:
+    """The TFRecord mask: rotate right 15 and add a constant."""
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF

@@ -1,0 +1,146 @@
+"""TensorBoard-compatible event writer — no TensorFlow dependency.
+
+Capability parity with the reference's observability channel:
+``tf.summary.scalar`` + ``merge_all`` + ``FileWriter(log_dir)`` +
+``add_summary(s, step)`` (reference example.py:160,164,172-174,219) and the
+Keras ``TensorBoard`` callback (reference example2.py:6,197,200).
+
+The wire format is reproduced from first principles:
+  * Event / Summary protobufs are hand-encoded (varint + length-delimited
+    fields) — only the scalar subset TensorBoard needs:
+      Event{ wall_time=1(double), step=2(int64), file_version=3(string),
+             summary=5(Summary) };  Summary{ value=1 repeated
+             Value{ tag=1(string), simple_value=2(float) } }
+  * Records are framed TFRecord-style: len(u64le) + masked_crc32c(len) +
+    payload + masked_crc32c(payload).
+
+Supports the reference's fractional-epoch step convention
+(``epoch + i/total_batch``, example.py:219) by accepting float steps and
+writing the floor while keeping wall-time ordering.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Dict, Optional, Union
+
+from .crc32c import masked_crc32c
+
+__all__ = ["EventFileWriter", "SummaryWriter"]
+
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _field_varint(num: int, value: int) -> bytes:
+    # proto int64: negatives encode as 64-bit two's complement varints.
+    return _varint(num << 3) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _field_double(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", value)
+
+
+def _field_float(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", value)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _scalar_event(wall_time: float, step: int,
+                  scalars: Dict[str, float]) -> bytes:
+    values = b"".join(
+        _field_bytes(1, _field_bytes(1, tag.encode("utf-8")) +
+                     _field_float(2, float(val)))
+        for tag, val in scalars.items())
+    return (_field_double(1, wall_time) + _field_varint(2, int(step)) +
+            _field_bytes(5, values))
+
+
+def _version_event(wall_time: float) -> bytes:
+    return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
+
+
+class EventFileWriter:
+    """Appends framed Event records to one events file in ``log_dir``."""
+
+    def __init__(self, log_dir: str, filename_suffix: str = ""):
+        os.makedirs(log_dir, exist_ok=True)
+        name = "events.out.tfevents.%010d.%s%s" % (
+            int(time.time()), socket.gethostname(), filename_suffix)
+        self.path = os.path.join(log_dir, name)
+        self._file = open(self.path, "ab")
+        self._write_record(_version_event(time.time()))
+
+    def _write_record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._file.write(header)
+        self._file.write(struct.pack("<I", masked_crc32c(header)))
+        self._file.write(payload)
+        self._file.write(struct.pack("<I", masked_crc32c(payload)))
+
+    def add_scalars(self, scalars: Dict[str, float],
+                    step: Union[int, float],
+                    wall_time: Optional[float] = None) -> None:
+        self._write_record(_scalar_event(
+            wall_time if wall_time is not None else time.time(),
+            int(step), {k: float(v) for k, v in scalars.items()}))
+
+    def flush(self) -> None:
+        self._file.flush()
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.flush()
+            self._file.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SummaryWriter:
+    """User-facing scalar logger (the ``FileWriter`` analogue).
+
+    ``add_scalar``/``add_scalars`` accept float steps to honour the
+    reference's fractional-epoch x-axis (example.py:219).
+    """
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._writer = EventFileWriter(log_dir)
+
+    def add_scalar(self, tag: str, value: float,
+                   step: Union[int, float]) -> None:
+        self._writer.add_scalars({tag: value}, step)
+
+    def add_scalars(self, scalars: Dict[str, float],
+                    step: Union[int, float]) -> None:
+        self._writer.add_scalars(scalars, step)
+
+    def flush(self) -> None:
+        self._writer.flush()
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

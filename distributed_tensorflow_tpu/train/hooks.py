@@ -1,0 +1,222 @@
+"""Training hooks — the ``tf.train.SessionRunHook`` analogue.
+
+The reference uses exactly one hook, ``StopAtStepHook(last_step=...)``
+(reference example.py:187,192), and gets checkpointing + summaries as
+implicit MonitoredTrainingSession behaviors.  Here every such behavior is an
+explicit hook dispatched by ``TrainSession``:
+
+  begin(session)            once, after restore, before the first step
+  before_step(session)      each step, before the compiled step fn
+  after_step(session, metrics)   each step, with the step's metric dict
+  end(session)              once, at session exit
+
+Hooks must not force device->host syncs unless they fire: metric values
+arrive as (possibly still in-flight) jax arrays and are only pulled with
+``float()`` inside a firing hook, keeping the hot loop async-dispatch clean.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Hook", "StopAtStepHook", "CheckpointHook", "SummaryHook",
+           "LoggingHook", "NaNHook", "ProfilerHook"]
+
+
+class Hook:
+    def begin(self, session) -> None:
+        pass
+
+    def before_step(self, session) -> None:
+        pass
+
+    def after_step(self, session, metrics: Dict) -> None:
+        pass
+
+    def end(self, session) -> None:
+        pass
+
+
+class StopAtStepHook(Hook):
+    """Stop when the global step reaches ``last_step`` (or after
+    ``num_steps`` more steps from restore) — reference example.py:187.
+
+    In sync-DP one "step" is one globally synchronized update, not one
+    per-worker async push (SURVEY.md §7 `global_step` note).
+    """
+
+    def __init__(self, last_step: Optional[int] = None,
+                 num_steps: Optional[int] = None):
+        if (last_step is None) == (num_steps is None):
+            raise ValueError("exactly one of last_step/num_steps required")
+        self.last_step = last_step
+        self.num_steps = num_steps
+
+    def begin(self, session) -> None:
+        if self.num_steps is not None:
+            self.last_step = session.step + self.num_steps
+
+    def after_step(self, session, metrics) -> None:
+        if session.step >= self.last_step:
+            session.request_stop()
+
+
+class CheckpointHook(Hook):
+    """Periodic chief-only checkpoint save (+ final save at end)."""
+
+    def __init__(self, every_steps: Optional[int] = None,
+                 every_secs: Optional[float] = 600.0,
+                 save_at_end: bool = True):
+        self.every_steps = every_steps
+        self.every_secs = every_secs
+        self.save_at_end = save_at_end
+        self._last_time = time.time()
+        self._last_step = None
+
+    def begin(self, session) -> None:
+        self._last_time = time.time()
+        self._last_step = session.step
+
+    def _due(self, step: int) -> bool:
+        if self.every_steps and step - (self._last_step or 0) >= self.every_steps:
+            return True
+        if self.every_secs and time.time() - self._last_time >= self.every_secs:
+            return True
+        return False
+
+    def after_step(self, session, metrics) -> None:
+        if self._due(session.step):
+            session.save()
+            self._last_time = time.time()
+            self._last_step = session.step
+
+    def end(self, session) -> None:
+        if self.save_at_end and session.step != (self._last_step or -1):
+            session.save()
+
+
+class SummaryHook(Hook):
+    """Writes scalar metrics to TB events (reference example.py:172-174,219).
+
+    ``step_fn``: optional step->x-axis mapping, e.g. fractional epochs like
+    the reference's ``epoch + i/total_batch``.
+    """
+
+    def __init__(self, writer, every_steps: int = 1,
+                 step_fn: Optional[Callable[[int], float]] = None):
+        self.writer = writer
+        self.every_steps = max(1, every_steps)
+        self.step_fn = step_fn
+
+    def after_step(self, session, metrics) -> None:
+        if session.step % self.every_steps:
+            return
+        scalars = {k: float(v) for k, v in metrics.items()
+                   if _is_scalar(v)}
+        if scalars:
+            x = self.step_fn(session.step) if self.step_fn else session.step
+            self.writer.add_scalars(scalars, x)
+
+    def end(self, session) -> None:
+        self.writer.flush()
+
+
+class LoggingHook(Hook):
+    """Console progress lines (reference example.py:222-226 prints every
+    ``print_rate`` epochs); includes steps/sec like TF's LoggingTensorHook."""
+
+    def __init__(self, every_steps: int = 100,
+                 formatter: Optional[Callable[[int, Dict], str]] = None):
+        self.every_steps = max(1, every_steps)
+        self.formatter = formatter
+        self._t0 = time.time()
+        self._step0 = 0
+
+    def begin(self, session) -> None:
+        self._t0 = time.time()
+        self._step0 = session.step
+
+    def after_step(self, session, metrics) -> None:
+        if session.step % self.every_steps:
+            return
+        now = time.time()
+        rate = (session.step - self._step0) / max(now - self._t0, 1e-9)
+        self._t0, self._step0 = now, session.step
+        if self.formatter:
+            line = self.formatter(session.step, metrics)
+        else:
+            parts = [f"{k}={float(v):.4f}" for k, v in metrics.items()
+                     if _is_scalar(v)]
+            line = f"step {session.step}: " + ", ".join(parts)
+        log.info("%s (%.1f steps/s)", line, rate)
+        print(f"{line} ({rate:.1f} steps/s)", flush=True)
+
+
+class NaNHook(Hook):
+    """Stop (or raise) when the monitored metric goes non-finite.
+
+    The sync-DP replacement for the reference's silent tolerance of async
+    staleness (SURVEY.md §5 race-detection row): divergence is detected, not
+    raced through.
+    """
+
+    def __init__(self, metric: str = "loss", fail_fast: bool = True,
+                 every_steps: int = 25):
+        self.metric = metric
+        self.fail_fast = fail_fast
+        self.every_steps = max(1, every_steps)
+
+    def after_step(self, session, metrics) -> None:
+        if session.step % self.every_steps:
+            return
+        value = metrics.get(self.metric)
+        if value is None:
+            return
+        import math
+        v = float(value)
+        if math.isnan(v) or math.isinf(v):
+            msg = f"{self.metric} is non-finite ({v}) at step {session.step}"
+            if self.fail_fast:
+                raise FloatingPointError(msg)
+            log.error("%s — requesting stop", msg)
+            session.request_stop()
+
+
+class ProfilerHook(Hook):
+    """Captures a jax.profiler trace for steps [start, start+count)."""
+
+    def __init__(self, log_dir: str, start_step: int = 10,
+                 num_steps: int = 5):
+        self.log_dir = log_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def before_step(self, session) -> None:
+        import jax
+        if not self._active and session.step == self.start_step:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def after_step(self, session, metrics) -> None:
+        import jax
+        if self._active and session.step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def end(self, session) -> None:
+        import jax
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def _is_scalar(v) -> bool:
+    try:
+        return getattr(v, "ndim", 0) == 0 or (
+            hasattr(v, "shape") and v.shape == ())
+    except Exception:
+        return isinstance(v, (int, float))

@@ -1,0 +1,146 @@
+"""TrainSession — the TPU-native ``MonitoredTrainingSession``.
+
+Capability parity with reference example.py:187-228:
+  * chief semantics: only the chief writes checkpoints/summaries
+    (``is_chief=(task_index == 0)``, example.py:190 — here
+    ``jax.process_index() == 0`` without the str/int bug, SURVEY.md §7);
+  * auto-restore of the latest checkpoint in ``checkpoint_dir`` on entry and
+    periodic saves during training (MTS behavior at example.py:191);
+  * the ``while not sess.should_stop():`` loop protocol (example.py:198) with
+    a hook list (``StopAtStepHook`` etc., example.py:187,192).
+
+What changed for TPU: there is no session/master and no graph — the unit of
+execution is a *compiled step function* over an explicit ``TrainState``
+pytree.  ``session.run_step(batch)`` invokes it and advances the step
+cursor; dispatch is async (jax arrays returned un-pulled) so hooks that
+don't fire never force a device sync.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel import cluster
+from . import checkpoint as ckpt_lib
+from .hooks import Hook
+
+log = logging.getLogger(__name__)
+
+__all__ = ["TrainState", "TrainSession"]
+
+
+class TrainState(NamedTuple):
+    """The full training state pytree: the unit of checkpoint/restore.
+
+    ``step`` is the ``global_step`` analogue (reference example.py:169): in
+    sync-DP it counts globally synchronized updates.  ``model_state`` holds
+    non-trainable stats (BatchNorm moments); empty dict for pure models.
+    """
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    model_state: Any = ()
+
+    @classmethod
+    def create(cls, params, opt_state, model_state=()):
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=opt_state, model_state=model_state)
+
+
+StepFn = Callable[..., Tuple[TrainState, Dict[str, Any]]]
+
+
+class TrainSession:
+    """Monitored training loop driver.
+
+    Usage (the reference's loop shape, example.py:189-219)::
+
+        with TrainSession(state, step_fn, checkpoint_dir=logdir,
+                          hooks=[StopAtStepHook(30000)]) as sess:
+            for batch in data:
+                if sess.should_stop():
+                    break
+                metrics = sess.run_step(batch)
+
+    ``step_fn(state, batch) -> (new_state, metrics)`` is typically a jitted
+    (or pjit-sharded) function built by ``train.make_train_step``.
+    """
+
+    def __init__(self, state: TrainState, step_fn: StepFn,
+                 checkpoint_dir: Optional[str] = None,
+                 hooks: Sequence[Hook] = (),
+                 is_chief: Optional[bool] = None,
+                 max_to_keep: int = 5,
+                 restore: bool = True):
+        self.state = state
+        self.step_fn = step_fn
+        self.checkpoint_dir = checkpoint_dir
+        self.hooks = list(hooks)
+        self.is_chief = cluster.is_chief() if is_chief is None else is_chief
+        self.max_to_keep = max_to_keep
+        self._stop = False
+        self._entered = False
+
+        if restore and checkpoint_dir:
+            latest = ckpt_lib.latest_checkpoint(checkpoint_dir)
+            if latest is not None:
+                self.state = ckpt_lib.restore(self.state, latest)
+                log.info("restored checkpoint %s (step %d)", latest, self.step)
+                print(f"Restored checkpoint {os.path.basename(latest)} at "
+                      f"step {self.step}", flush=True)
+
+    # -- loop protocol ----------------------------------------------------
+    @property
+    def step(self) -> int:
+        return int(self.state.step)
+
+    def should_stop(self) -> bool:
+        return self._stop
+
+    def request_stop(self) -> None:
+        self._stop = True
+
+    def run_step(self, *args, **kwargs) -> Dict[str, Any]:
+        """One training step: hooks, compiled step fn, cursor advance."""
+        for hook in self.hooks:
+            hook.before_step(self)
+        new_state, metrics = self.step_fn(self.state, *args, **kwargs)
+        self.state = new_state
+        for hook in self.hooks:
+            hook.after_step(self, metrics)
+        return metrics
+
+    # -- checkpointing ----------------------------------------------------
+    def save(self) -> Optional[str]:
+        """Chief-only checkpoint write (reference chief role,
+        example.py:74-76); non-chief calls are no-ops."""
+        if not (self.is_chief and self.checkpoint_dir):
+            return None
+        path = ckpt_lib.save(self.checkpoint_dir, self.step, self.state,
+                             max_to_keep=self.max_to_keep)
+        log.info("saved checkpoint %s", path)
+        return path
+
+    # -- context manager --------------------------------------------------
+    def __enter__(self) -> "TrainSession":
+        self._entered = True
+        for hook in self.hooks:
+            hook.begin(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # On clean exit run end-hooks (summary flush etc.), then make sure a
+        # final checkpoint exists — MTS saves on close whenever a
+        # checkpoint_dir was given (reference example.py:191), with or
+        # without an explicit CheckpointHook.
+        if exc_type is None:
+            for hook in self.hooks:
+                hook.end(self)
+            if (self.checkpoint_dir and self.is_chief and
+                    ckpt_lib.latest_step(self.checkpoint_dir) != self.step):
+                self.save()
+        self._entered = False

@@ -1,0 +1,143 @@
+"""Compiled train/eval step builders.
+
+This is the TPU replacement for the reference's per-step
+``sess.run([accuracy, loss, summ, train_step], feed_dict=...)`` hot loop
+(reference example.py:207-213): the whole update — forward, backward, Adam
+apply, metric computation, and (when sharded over a mesh's data axis) the
+gradient all-reduce over ICI — is ONE jit-compiled XLA program.  There is no
+per-step variable pull/push (SURVEY.md §3.1): parameters live on device
+across steps and the state pytree is donated so updates happen in place.
+
+Sharding: pass a ``Mesh`` (and optionally a params PartitionSpec pytree) and
+the step is compiled with the batch sharded over the ``data`` axis.  Because
+the loss is a *global-batch mean*, the gradient XLA computes under that
+sharding already includes the cross-replica mean — the ``psum`` the north
+star asks for is inserted by the partitioner.  (The explicit
+``shard_map``+``psum`` spelling lives in ``parallel.data_parallel``.)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import losses as loss_lib
+from ..ops import metrics as metric_lib
+from ..optim import optimizers as opt_lib
+from .session import TrainState
+
+__all__ = ["make_train_step", "make_eval_step", "init_train_state"]
+
+
+def init_train_state(model, optimizer, key, in_shape) -> TrainState:
+    """Initialize params/state/opt_state for a layer Stack + Optimizer."""
+    params, model_state = model.init(key, in_shape)
+    opt_state = optimizer.init(params)
+    return TrainState.create(params, opt_state, model_state)
+
+
+def _metric_dict(metric_fns, preds, y) -> Dict[str, jnp.ndarray]:
+    out = {}
+    for name, fn in (metric_fns or {}).items():
+        out[name] = metric_lib.get(fn)(preds, y)
+    return out
+
+
+def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
+                    metric_fns: Optional[Dict[str, Any]] = None,
+                    seed: int = 0,
+                    mesh: Optional[Mesh] = None,
+                    params_spec: Any = None,
+                    batch_spec: P = P("data"),
+                    jit: bool = True,
+                    grad_clip_norm: Optional[float] = None) -> Callable:
+    """Build ``step(state, (x, y)) -> (new_state, metrics)``.
+
+    Dropout randomness: one base key from ``seed``, folded with the global
+    step inside the trace — deterministic, resume-stable, and unique per
+    step (the explicit-PRNG answer to the reference's learning-phase feed,
+    example.py:213; SURVEY.md §7 "Dropout determinism").
+    """
+    loss_fn = loss_lib.get(loss)
+    base_key = jax.random.PRNGKey(seed)
+
+    def step(state: TrainState, batch):
+        x, y = batch
+        rng = jax.random.fold_in(base_key, state.step)
+
+        def compute_loss(params):
+            preds, new_model_state = model.apply(
+                params, state.model_state, x, train=True, rng=rng)
+            return loss_fn(preds, y), (preds, new_model_state)
+
+        (loss_value, (preds, new_model_state)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(state.params)
+
+        metrics = {"loss": loss_value}
+        if grad_clip_norm is not None:
+            grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)
+            metrics["grad_norm"] = gnorm
+        updates, new_opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+        new_params = opt_lib.apply_updates(state.params, updates)
+        metrics.update(_metric_dict(metric_fns, preds, y))
+
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt_state,
+                               model_state=new_model_state)
+        return new_state, metrics
+
+    if not jit:
+        return step
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=0)
+
+    # Mesh path: replicate state (or shard params by params_spec), shard the
+    # batch over the data axis.  XLA partitions the whole step and inserts
+    # the gradient all-reduce implied by the global-mean loss.
+    replicated = NamedSharding(mesh, P())
+    if params_spec is None:
+        state_shardings = TrainState(step=replicated, params=replicated,
+                                     opt_state=replicated,
+                                     model_state=replicated)
+    else:
+        to_shard = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), params_spec,
+            is_leaf=lambda v: isinstance(v, P))
+        state_shardings = TrainState(step=replicated, params=to_shard,
+                                     opt_state=replicated,
+                                     model_state=replicated)
+    batch_sharding = NamedSharding(mesh, batch_spec)
+    return jax.jit(step, donate_argnums=0,
+                   in_shardings=(state_shardings,
+                                 (batch_sharding, batch_sharding)),
+                   )
+
+
+def make_eval_step(model, loss,
+                   metric_fns: Optional[Dict[str, Any]] = None,
+                   mesh: Optional[Mesh] = None,
+                   batch_spec: P = P("data"),
+                   jit: bool = True) -> Callable:
+    """Build ``eval_step(state, (x, y)) -> metrics`` (train=False phase,
+    the ``learning_phase: 0`` analogue of reference example.py:225)."""
+    loss_fn = loss_lib.get(loss)
+
+    def eval_step(state: TrainState, batch):
+        x, y = batch
+        preds, _ = model.apply(state.params, state.model_state, x,
+                               train=False, rng=None)
+        metrics = {"loss": loss_fn(preds, y)}
+        metrics.update(_metric_dict(metric_fns, preds, y))
+        return metrics
+
+    if not jit:
+        return eval_step
+    # No pinned in_shardings: input shardings propagate, so the same
+    # compiled fn serves mesh-sharded full batches and an unsharded
+    # remainder batch (each sharding combination caches its own executable).
+    del mesh, batch_spec
+    return jax.jit(eval_step)

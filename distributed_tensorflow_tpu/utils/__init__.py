@@ -1,0 +1,3 @@
+from . import flags
+
+__all__ = ["flags"]

@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference repo's de-facto smoke test was its single-machine fallback path
+(reference example.py:64-68,111-113): unset the cluster env vars and the same
+code runs locally.  The JAX-native analogue is a virtual multi-device CPU
+platform, so every multi-chip code path (shard_map, pjit on a Mesh, ring
+collectives) runs for real at world-size 8 inside plain pytest.
+
+This file must set the env vars BEFORE jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The axon sitecustomize force-selects the TPU platform via
+# jax.config.update("jax_platforms", ...), which overrides the env var —
+# override it back at the config level before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

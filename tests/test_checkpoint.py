@@ -1,0 +1,48 @@
+"""Checkpoint subsystem tests (reference MTS checkpoint_dir capability,
+example.py:189-192)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.train import checkpoint as ck
+
+
+def tree(value):
+    return {"params": {"dense": {"kernel": jnp.full((3, 2), value),
+                                 "bias": jnp.zeros((2,))}},
+            "step": jnp.asarray(int(value), jnp.int32)}
+
+
+def test_roundtrip(tmp_path):
+    d = str(tmp_path)
+    ck.save(d, 10, tree(1.5))
+    restored = ck.restore(tree(0.0), ck.latest_checkpoint(d))
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["dense"]["kernel"]),
+        np.full((3, 2), 1.5, np.float32))
+    assert int(restored["step"]) == 1
+
+
+def test_latest_and_max_to_keep(tmp_path):
+    d = str(tmp_path)
+    for step in [5, 10, 15, 20]:
+        ck.save(d, step, tree(step), max_to_keep=2)
+    assert ck.latest_step(d) == 20
+    assert len(ck.all_checkpoints(d)) == 2
+    with open(tmp_path / "checkpoint") as f:
+        assert f.read().strip() == "ckpt-0000000020"
+
+
+def test_restore_structure_mismatch(tmp_path):
+    d = str(tmp_path)
+    ck.save(d, 1, tree(1.0))
+    bad = {"params": {"dense": {"kernel": jnp.zeros((4, 2)),
+                                "bias": jnp.zeros((2,))}},
+           "step": jnp.asarray(0)}
+    with pytest.raises(ValueError, match="shape"):
+        ck.restore(bad, ck.latest_checkpoint(d))
+
+
+def test_empty_dir(tmp_path):
+    assert ck.latest_checkpoint(str(tmp_path)) is None
+    assert ck.latest_step(str(tmp_path)) is None

@@ -1,0 +1,59 @@
+"""Cluster bootstrap tests (reference example.py:59-68,108-143 capability)."""
+from distributed_tensorflow_tpu.parallel import cluster
+
+
+def test_single_machine_fallback():
+    """No env vars => local config (reference example.py:111-113)."""
+    cfg = cluster.cluster_from_env(environ={})
+    assert not cfg.distributed
+    assert cfg.process_id == 0
+    assert cfg.coordinator_address is None
+
+
+def test_new_style_env():
+    cfg = cluster.cluster_from_env(environ={
+        "COORDINATOR_ADDRESS": "host0:1234",
+        "NUM_PROCESSES": "4",
+        "PROCESS_ID": "2",
+    })
+    assert cfg.distributed
+    assert cfg.num_processes == 4
+    assert cfg.process_id == 2
+    assert cfg.coordinator_address == "host0:1234"
+
+
+def test_legacy_env_mapping():
+    """Reference-style WORKER_HOSTS/TASK_INDEX map onto the new runtime."""
+    cfg = cluster.cluster_from_env(environ={
+        "JOB_NAME": "worker",
+        "TASK_INDEX": "1",
+        "PS_HOSTS": "ps0:2222",
+        "WORKER_HOSTS": "w0:2222,w1:2222",
+    })
+    assert cfg.num_processes == 2
+    assert cfg.process_id == 1  # parsed as int, unlike the reference bug
+    assert cfg.coordinator_address == "w0:2222"
+    assert not cfg.is_legacy_ps
+
+
+def test_legacy_ps_refused():
+    cfg = cluster.cluster_from_env(environ={
+        "JOB_NAME": "ps",
+        "TASK_INDEX": "0",
+        "WORKER_HOSTS": "w0:2222",
+    })
+    assert cfg.is_legacy_ps
+    out = cluster.initialize(cfg)  # must not try to start anything
+    assert out is cfg
+
+
+def test_bad_int_env_falls_back():
+    cfg = cluster.cluster_from_env(environ={
+        "WORKER_HOSTS": "w0:2222,w1:2222",
+        "TASK_INDEX": "zero",
+    })
+    assert cfg.process_id == 0
+
+
+def test_is_chief_local():
+    assert cluster.is_chief()

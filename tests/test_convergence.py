@@ -1,0 +1,36 @@
+"""End-to-end convergence oracles (SURVEY.md §4: the XOR task as the
+integration-level correctness signal, reference example.py:222-226)."""
+import jax
+import numpy as np
+
+from distributed_tensorflow_tpu import data, models, ops, optim, parallel, train
+
+
+def test_xor_learns_low_level():
+    """Low-level tier (reference example.py shape): should reach >0.95 val
+    bitwise accuracy quickly on a reduced-size run."""
+    model = ops.serial(ops.Dense(128, "relu"), ops.Dropout(0.3),
+                       ops.Dense(128, "relu"), ops.Dropout(0.3),
+                       ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    (xt, yt), (xv, yv) = data.xor_data(8000, val_size=500, seed=0)
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    step = train.make_train_step(model, "mse", opt)
+    for batch in data.Dataset([xt, yt], 50, seed=0).epochs(60):
+        state, _ = step(state, batch)
+    evaluate = train.make_eval_step(model, "mse",
+                                    metric_fns={"acc": "bitwise_accuracy"})
+    acc = float(evaluate(state, (xv, yv))["acc"])
+    assert acc > 0.95, f"XOR val accuracy {acc} below threshold"
+
+
+def test_mnist_mlp_learns_data_parallel():
+    """Synthetic-MNIST MLP over the 8-device mesh (BASELINE config #1/#2)."""
+    (xt, yt), (xv, yv) = data.mnist(flatten=True)
+    xt, yt = xt[:8192], yt[:8192]
+    model = models.Sequential([ops.Dense(128, "relu"), ops.Dense(10)])
+    model.compile(loss="sparse_categorical_crossentropy", optimizer="adam",
+                  metrics=["accuracy"], mesh=parallel.data_parallel_mesh())
+    model.fit(xt, yt, epochs=2, batch_size=256, verbose=0)
+    out = model.evaluate(xv[:2048], yv[:2048], batch_size=256, verbose=0)
+    assert out["accuracy"] > 0.9, out

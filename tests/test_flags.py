@@ -1,0 +1,72 @@
+"""Flag system tests (reference example.py:56,71-105 capability)."""
+from distributed_tensorflow_tpu.utils import flags as flags_lib
+
+
+def make_flags():
+    fv = flags_lib.FlagValues()
+    fv.define("job_name", None, "", str)
+    fv.define("task_index", 0, "", int)
+    fv.define("lr", 0.001, "", float)
+    fv.define("use_tpu", False, "", flags_lib._parse_bool)
+    return fv
+
+
+def test_defaults():
+    fv = make_flags()
+    fv.parse([])
+    assert fv.job_name is None
+    assert fv.task_index == 0
+    assert fv.lr == 0.001
+    assert fv.use_tpu is False
+
+
+def test_parse_forms():
+    fv = make_flags()
+    rest = fv.parse(["--job_name=worker", "--task_index", "3", "--use_tpu",
+                     "positional", "--unknown=1"])
+    assert fv.job_name == "worker"
+    assert fv.task_index == 3 and isinstance(fv.task_index, int)
+    assert fv.use_tpu is True
+    assert rest == ["positional", "--unknown=1"]
+
+
+def test_no_bool_form():
+    fv = make_flags()
+    fv.parse(["--nouse_tpu"])
+    assert fv.use_tpu is False
+
+
+def test_task_index_is_int_not_str():
+    """The reference's chief-election bug: env string '0' vs int 0
+    (reference example.py:61,73,190). Our flags always cast."""
+    fv = make_flags()
+    fv.parse(["--task_index=0"])
+    assert fv.task_index == 0  # int comparison, not "0" == 0
+
+
+def test_env_default(monkeypatch):
+    monkeypatch.setenv("TASK_INDEX", "7")
+    assert flags_lib.env_default("TASK_INDEX", 0, int) == 7
+    monkeypatch.setenv("TASK_INDEX", "junk")
+    assert flags_lib.env_default("TASK_INDEX", 0, int) == 0
+    monkeypatch.delenv("TASK_INDEX")
+    assert flags_lib.env_default("TASK_INDEX", 5, int) == 5
+
+
+def test_reset():
+    fv = make_flags()
+    fv.parse(["--lr=0.1"])
+    assert fv.lr == 0.1
+    fv.reset()
+    fv.parse([])
+    assert fv.lr == 0.001
+
+
+def test_missing_value_is_loud():
+    import pytest
+    fv = make_flags()
+    with pytest.raises(ValueError, match="requires a value"):
+        fv.parse(["--task_index", "--job_name=w"])
+    fv2 = make_flags()
+    with pytest.raises(ValueError, match="requires a value"):
+        fv2.parse(["--task_index"])

@@ -1,0 +1,125 @@
+"""Layer library tests (reference example.py:149-155 capability + conv/norm)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import ops
+
+
+def test_dense_shapes_and_activation():
+    layer = ops.Dense(16, activation="relu")
+    params, state = layer.init(jax.random.PRNGKey(0), (8,))
+    assert params["kernel"].shape == (8, 16)
+    assert params["bias"].shape == (16,)
+    y, _ = layer.apply(params, state, jnp.ones((4, 8)))
+    assert y.shape == (4, 16)
+    assert (np.asarray(y) >= 0).all()
+
+
+def test_dense_mixed_precision():
+    layer = ops.Dense(16)
+    params, state = layer.init(jax.random.PRNGKey(0), (8,))
+    y, _ = layer.apply(params, state, jnp.ones((4, 8), jnp.bfloat16))
+    assert y.dtype == jnp.bfloat16
+    assert params["kernel"].dtype == jnp.float32  # master weights stay f32
+
+
+def test_dropout_phases():
+    layer = ops.Dropout(0.5)
+    x = jnp.ones((1000,))
+    y_eval, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = layer.apply({}, {}, x, train=True,
+                             rng=jax.random.PRNGKey(0))
+    kept = np.asarray(y_train) > 0
+    assert 0.3 < kept.mean() < 0.7
+    # inverted scaling preserves expectation
+    assert abs(np.asarray(y_train).mean() - 1.0) < 0.15
+
+
+def test_dropout_requires_rng_in_train():
+    with pytest.raises(ValueError):
+        ops.Dropout(0.5).apply({}, {}, jnp.ones((4,)), train=True)
+
+
+def test_conv2d_shapes():
+    layer = ops.Conv2D(8, 3, strides=2, padding="SAME")
+    params, state = layer.init(jax.random.PRNGKey(0), (32, 32, 3))
+    assert params["kernel"].shape == (3, 3, 3, 8)
+    assert layer.out_shape((32, 32, 3)) == (16, 16, 8)
+    y, _ = layer.apply(params, state, jnp.ones((2, 32, 32, 3)))
+    assert y.shape == (2, 16, 16, 8)
+
+
+def test_pooling():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = ops.MaxPool2D(2).apply({}, {}, x)
+    assert y.shape == (1, 2, 2, 1)
+    assert float(y[0, 0, 0, 0]) == 5.0
+    y, _ = ops.AvgPool2D(2).apply({}, {}, x)
+    assert float(y[0, 0, 0, 0]) == 2.5
+    y, _ = ops.GlobalAvgPool().apply({}, {}, x)
+    assert y.shape == (1, 1)
+    assert float(y[0, 0]) == 7.5
+
+
+def test_batchnorm_train_eval():
+    layer = ops.BatchNorm(momentum=0.5)
+    params, state = layer.init(jax.random.PRNGKey(0), (4,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (256, 4)) * 3.0 + 2.0
+    y, new_state = layer.apply(params, state, x, train=True)
+    # normalized output
+    assert abs(float(jnp.mean(y))) < 0.1
+    assert abs(float(jnp.std(y)) - 1.0) < 0.1
+    # running stats moved toward batch stats
+    assert float(jnp.max(new_state["mean"])) > 0.5
+    # eval path uses running stats, state unchanged
+    y2, state2 = layer.apply(params, new_state, x, train=False)
+    assert state2 is new_state
+
+
+def test_layernorm():
+    layer = ops.LayerNorm()
+    params, _ = layer.init(jax.random.PRNGKey(0), (8,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8)) * 5 + 3
+    y, _ = layer.apply(params, {}, x)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, -1)), 0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(jnp.std(y, -1)), 1, atol=1e-2)
+
+
+def test_embedding_and_attend():
+    layer = ops.Embedding(100, 16)
+    params, _ = layer.init(jax.random.PRNGKey(0), ())
+    ids = jnp.array([[1, 2], [3, 4]])
+    y, _ = layer.apply(params, {}, ids)
+    assert y.shape == (2, 2, 16)
+    logits = layer.attend(params, y)
+    assert logits.shape == (2, 2, 100)
+
+
+def test_stack_xor_model_shapes():
+    """The reference MLP: 64->128->drop->128->drop->32 (example.py:149-155),
+    28,960 params (SURVEY.md §6)."""
+    model = ops.serial(ops.Dense(128, "relu"), ops.Dropout(0.3),
+                       ops.Dense(128, "relu"), ops.Dropout(0.3),
+                       ops.Dense(32, "sigmoid"))
+    params, state = model.init(jax.random.PRNGKey(0), (64,))
+    n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
+    assert n == 28960
+    y, _ = model.apply(params, state, jnp.ones((5, 64)), train=True,
+                       rng=jax.random.PRNGKey(1))
+    assert y.shape == (5, 32)
+    assert model.out_shape((64,)) == (32,)
+
+
+def test_stack_unique_names():
+    model = ops.serial(ops.Dense(4), ops.Dense(4), ops.Dense(4))
+    assert model.keys == ["dense", "dense_1", "dense_2"]
+
+
+def test_avgpool_same_edge_windows():
+    """SAME avg-pool divides edge windows by valid coverage (Keras parity)."""
+    x = jnp.ones((1, 3, 3, 1))
+    y, _ = ops.AvgPool2D(2, strides=2, padding="SAME").apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y).ravel(), 1.0, rtol=1e-6)

@@ -1,0 +1,95 @@
+"""Optimizer tests, including TF-1.4 Adam parity (reference example.py:168)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_tpu import optim
+from distributed_tensorflow_tpu.optim import schedules
+
+
+def _run(opt, grads_seq, p0=1.0):
+    params = {"w": jnp.asarray(p0, jnp.float32)}
+    state = opt.init(params)
+    for g in grads_seq:
+        updates, state = opt.update({"w": jnp.asarray(g, jnp.float32)},
+                                    state, params)
+        params = optim.apply_updates(params, updates)
+    return float(params["w"]), state
+
+
+def test_adam_matches_tf14_formula():
+    """Replicate TF 1.4 AdamOptimizer by hand: lr_t = lr*sqrt(1-b2^t)/(1-b1^t);
+    p -= lr_t * m / (sqrt(v) + eps)."""
+    lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+    grads = [0.5, -0.3, 0.8, 0.1]
+    p, m, v = 1.0, 0.0, 0.0
+    for t, g in enumerate(grads, start=1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        p -= lr_t * m / (np.sqrt(v) + eps)
+    got, state = _run(optim.adam(lr), grads)
+    np.testing.assert_allclose(got, p, rtol=1e-6)
+    assert int(state.count) == 4
+
+
+def test_sgd_and_momentum():
+    got, _ = _run(optim.sgd(0.1), [1.0, 1.0])
+    np.testing.assert_allclose(got, 0.8, rtol=1e-6)
+    got, _ = _run(optim.momentum(0.1, beta=0.9), [1.0, 1.0])
+    # mu1=1, p=0.9; mu2=1.9, p=0.9-0.19=0.71
+    np.testing.assert_allclose(got, 0.71, rtol=1e-6)
+
+
+def test_adamw_decays_matrices_not_vectors():
+    opt = optim.adamw(1e-2, weight_decay=0.5)
+    params = {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))}
+    state = opt.init(params)
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    updates, state = opt.update(zero_grads, state, params)
+    assert float(jnp.max(jnp.abs(updates["bias"]))) == 0.0
+    assert float(jnp.max(jnp.abs(updates["kernel"]))) > 0.0
+
+
+def test_global_norm_clip():
+    tree = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+    clipped, norm = optim.clip_by_global_norm(tree, 1.0)
+    np.testing.assert_allclose(float(norm), 5.0, rtol=1e-6)
+    np.testing.assert_allclose(float(optim.global_norm(clipped)), 1.0,
+                               rtol=1e-5)
+
+
+def test_optimizer_state_jits():
+    opt = optim.adam()
+    params = {"w": jnp.ones((4, 4))}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        g = jax.tree.map(jnp.ones_like, params)
+        updates, state = opt.update(g, state, params)
+        return optim.apply_updates(params, updates), state
+
+    params, state = step(params, state)
+    assert int(state.count) == 1
+
+
+def test_schedules():
+    c = schedules.constant(0.1)(jnp.asarray(100))
+    np.testing.assert_allclose(float(c), 0.1, rtol=1e-6)
+    cos = schedules.cosine_decay(1.0, 100)
+    np.testing.assert_allclose(float(cos(jnp.asarray(0))), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(cos(jnp.asarray(100))), 0.0, atol=1e-6)
+    warm = schedules.warmup_linear_decay(1.0, 10, 110)
+    np.testing.assert_allclose(float(warm(jnp.asarray(5))), 0.5, atol=1e-6)
+    np.testing.assert_allclose(float(warm(jnp.asarray(110))), 0.0, atol=1e-6)
+    pw = schedules.piecewise_constant([10, 20], [1.0, 0.1, 0.01])
+    assert float(pw(jnp.asarray(5))) == 1.0
+    np.testing.assert_allclose(float(pw(jnp.asarray(15))), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(pw(jnp.asarray(25))), 0.01, rtol=1e-6)
+
+
+def test_schedule_in_adam():
+    sched = schedules.exponential_decay(1e-3, 10, 0.5)
+    got, _ = _run(optim.adam(sched), [0.5] * 3)
+    assert got < 1.0

@@ -1,0 +1,71 @@
+"""High-level Sequential API tests (reference example2.py:148-200 capability)."""
+import glob
+
+import numpy as np
+
+from distributed_tensorflow_tpu import data, models, ops
+
+
+def xor_model():
+    model = models.Sequential()
+    model.add(ops.Dense(64, "relu"))
+    model.add(ops.Dense(32, "sigmoid"))
+    model.compile(loss="mean_squared_error", optimizer="adam",
+                  metrics=["bitwise_accuracy"])
+    return model
+
+
+def test_fit_evaluate_predict():
+    (xt, yt), (xv, yv) = data.xor_data(600, val_size=64, seed=0)
+    model = xor_model()
+    hist = model.fit(xt, yt, epochs=2, batch_size=50,
+                     validation_data=(xv, yv), verbose=0)
+    assert set(hist.history) >= {"loss", "bitwise_accuracy", "val_loss",
+                                 "val_bitwise_accuracy"}
+    assert len(hist.history["loss"]) == 2
+    out = model.evaluate(xv, yv, verbose=0)
+    assert "loss" in out and "bitwise_accuracy" in out
+    preds = model.predict(xv)
+    assert preds.shape == (64, 32)
+    assert 0.0 <= preds.min() and preds.max() <= 1.0
+
+
+def test_tensorboard_callback(tmp_path):
+    (xt, yt), _ = data.xor_data(200, val_size=8, seed=0)
+    model = xor_model()
+    model.fit(xt, yt, epochs=2, batch_size=50, verbose=0,
+              callbacks=[models.TensorBoard(str(tmp_path))])
+    files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert len(files) == 1
+    from tests.test_summary import read_records
+    assert len(read_records(files[0])) == 3  # version + 2 epochs
+
+
+def test_early_stopping():
+    (xt, yt), _ = data.xor_data(200, val_size=8, seed=0)
+    model = xor_model()
+    stopper = models.EarlyStopping(monitor="loss", patience=1,
+                                   min_delta=10.0)  # impossible improvement
+    hist = model.fit(xt, yt, epochs=10, batch_size=50, verbose=0,
+                     callbacks=[stopper])
+    assert len(hist.history["loss"]) < 10
+
+
+def test_mesh_compile_fit():
+    """High-level API runs data-parallel over the 8-device mesh."""
+    from distributed_tensorflow_tpu import parallel
+    (xt, yt), (xv, yv) = data.xor_data(512, val_size=64, seed=0)
+    model = models.Sequential([ops.Dense(64, "relu"),
+                               ops.Dense(32, "sigmoid")])
+    model.compile(loss="mse", optimizer="adam", metrics=["bitwise_accuracy"],
+                  mesh=parallel.data_parallel_mesh())
+    hist = model.fit(xt, yt, epochs=2, batch_size=64,
+                     validation_data=(xv, yv), verbose=0)
+    assert len(hist.history["loss"]) == 2
+
+
+def test_summary(capsys):
+    model = xor_model()
+    model.build((64,))
+    text = model.summary()
+    assert "Total params" in text

@@ -1,0 +1,120 @@
+"""TrainSession + hooks tests (reference MTS loop, example.py:187-228)."""
+import jax
+import pytest
+
+from distributed_tensorflow_tpu import data, ops, optim, train
+
+
+def make_bits():
+    model = ops.serial(ops.Dense(16, "relu"), ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    step = train.make_train_step(model, "mse", opt,
+                                 metric_fns={"acc": "bitwise_accuracy"})
+    (xt, yt), _ = data.xor_data(500, val_size=10, seed=0)
+    ds = data.Dataset([xt, yt], 50, seed=0)
+    return model, opt, state, step, ds
+
+
+def run_session(sess, ds, max_batches=10_000):
+    it = iter(ds.epochs(1000))
+    n = 0
+    while not sess.should_stop() and n < max_batches:
+        sess.run_step(next(it))
+        n += 1
+
+
+def test_stop_at_step():
+    _, _, state, step, ds = make_bits()
+    with train.TrainSession(state, step,
+                            hooks=[train.StopAtStepHook(last_step=7)]) as sess:
+        run_session(sess, ds)
+    assert sess.step == 7
+
+
+def test_checkpoint_and_resume(tmp_path):
+    """MTS semantics: periodic save + auto-restore-latest on a fresh session
+    (reference example.py:189-192)."""
+    model, opt, state, step, ds = make_bits()
+    d = str(tmp_path)
+    with train.TrainSession(state, step, checkpoint_dir=d,
+                            hooks=[train.StopAtStepHook(last_step=12),
+                                   train.CheckpointHook(every_steps=5)]) as s1:
+        run_session(s1, ds)
+    assert train.checkpoint.latest_step(d) == 12  # final save at end
+
+    fresh = train.init_train_state(model, opt, jax.random.PRNGKey(9), (64,))
+    with train.TrainSession(fresh, step, checkpoint_dir=d,
+                            hooks=[train.StopAtStepHook(last_step=15)]) as s2:
+        assert s2.step == 12  # restored, the global_step resume cursor
+        run_session(s2, ds)
+    assert s2.step == 15
+
+
+def test_num_steps_counts_from_restore(tmp_path):
+    model, opt, state, step, ds = make_bits()
+    d = str(tmp_path)
+    with train.TrainSession(state, step, checkpoint_dir=d,
+                            hooks=[train.StopAtStepHook(last_step=4)]) as s1:
+        run_session(s1, ds)
+    fresh = train.init_train_state(model, opt, jax.random.PRNGKey(9), (64,))
+    with train.TrainSession(fresh, step, checkpoint_dir=d,
+                            hooks=[train.StopAtStepHook(num_steps=3)]) as s2:
+        run_session(s2, ds)
+    assert s2.step == 7
+
+
+def test_non_chief_never_writes(tmp_path):
+    _, _, state, step, ds = make_bits()
+    d = str(tmp_path)
+    with train.TrainSession(state, step, checkpoint_dir=d, is_chief=False,
+                            hooks=[train.StopAtStepHook(last_step=3),
+                                   train.CheckpointHook(every_steps=1)]) as s:
+        run_session(s, ds)
+    assert train.checkpoint.latest_checkpoint(d) is None
+
+
+def test_nan_hook():
+    _, _, state, _, ds = make_bits()
+
+    def bad_step(state, batch):
+        return state._replace(step=state.step + 1), {
+            "loss": jax.numpy.asarray(float("nan"))}
+
+    with pytest.raises(FloatingPointError):
+        with train.TrainSession(state, bad_step,
+                                hooks=[train.NaNHook(every_steps=1)]) as s:
+            run_session(s, ds)
+
+
+def test_summary_hook(tmp_path):
+    import glob
+    from distributed_tensorflow_tpu.summary import SummaryWriter
+    from tests.test_summary import parse_event, read_records
+
+    _, _, state, step, ds = make_bits()
+    writer = SummaryWriter(str(tmp_path))
+    with train.TrainSession(state, step,
+                            hooks=[train.StopAtStepHook(last_step=4),
+                                   train.SummaryHook(writer, every_steps=2)]) as s:
+        run_session(s, ds)
+    writer.close()
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)[1:]  # drop version record
+    assert len(records) == 2  # steps 2 and 4
+    tags = set()
+    for rec in records:
+        summary = parse_event(parse_event(rec)[5][0])
+        for v in summary[1]:
+            tags.add(parse_event(v)[1][0])
+    assert tags == {b"loss", b"acc"}
+
+
+def test_logging_hook(capsys):
+    _, _, state, step, ds = make_bits()
+    with train.TrainSession(state, step,
+                            hooks=[train.StopAtStepHook(last_step=4),
+                                   train.LoggingHook(every_steps=2)]) as s:
+        run_session(s, ds)
+    out = capsys.readouterr().out
+    assert "step 2:" in out and "step 4:" in out and "loss=" in out
