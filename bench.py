@@ -1,0 +1,128 @@
+"""Benchmark harness — prints ONE JSON line to stdout.
+
+Metric (per BASELINE.md): MNIST-MLP training examples/sec/chip, measured on
+the framework's compiled data-parallel train step on whatever devices are
+available (the real TPU chip under the driver; the virtual CPU mesh in
+tests), plus a convergence gate (final eval accuracy must clear 0.9 on the
+synthetic set or the result is reported as failed).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md:
+"published: {}"), so the baseline is a measured stand-in for its
+CPU/GPU-era stack: the SAME model/batch/optimizer stepped with torch on CPU
+(the reference's TF-1.4 path is unrunnable here).  When torch is
+unavailable the documented fallback constant is used.  Everything except
+the JSON line goes to stderr.
+"""
+import json
+import sys
+import time
+
+# Estimated examples/sec for the reference-era stack on a single CPU host —
+# used only if the live torch baseline cannot run.
+FALLBACK_BASELINE = 1.0e5
+
+BATCH = 8192
+WARMUP = 5
+STEPS = 60
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_framework():
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu import data, models, optim, parallel, train
+
+    n_chips = len(jax.devices())
+    mesh = parallel.data_parallel_mesh()
+    log(f"framework: {n_chips} x {jax.devices()[0].platform}, "
+        f"mesh={dict(mesh.shape)}")
+
+    (xt, yt), (xv, yv) = data.mnist(flatten=True)
+    model = models.mnist_mlp()
+    optimizer = optim.adam()
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 optimizer, mesh=mesh)
+    eval_step = train.make_eval_step(model, "sparse_categorical_crossentropy",
+                                     metric_fns={"accuracy": "accuracy"})
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    bsh = NamedSharding(mesh, P("data"))
+
+    batch = parallel.round_batch_to_mesh(BATCH, mesh)
+    ds = data.Dataset([xt, yt], batch, seed=0)
+
+    # Convergence gate: a couple of epochs must clear 0.9 eval accuracy.
+    for b in ds.epochs(2):
+        state, _ = step(state, jax.device_put(b, bsh))
+    acc = float(eval_step(state, (xv[:8192], yv[:8192]))["accuracy"])
+    log(f"eval accuracy after 2 epochs: {acc:.4f}")
+
+    # Throughput: fixed resident batch, async dispatch, block at the end.
+    bench_batch = jax.device_put(next(iter(ds)), bsh)
+    for _ in range(WARMUP):
+        state, m = step(state, bench_batch)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, m = step(state, bench_batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    eps = STEPS * batch / dt
+    log(f"framework: {eps:,.0f} examples/s total, "
+        f"{eps / n_chips:,.0f} /chip ({dt / STEPS * 1e3:.2f} ms/step)")
+    return eps / n_chips, acc
+
+
+def bench_torch_baseline():
+    """Same MLP/batch/optimizer stepped with torch on CPU (reference-era
+    proxy: host-resident training, no XLA)."""
+    try:
+        import torch
+        import torch.nn as nn
+    except Exception as e:  # pragma: no cover
+        log(f"torch baseline unavailable ({e}); using fallback constant")
+        return None
+    torch.manual_seed(0)
+    torch.set_num_threads(max(1, (torch.get_num_threads())))
+    model = nn.Sequential(nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
+                          nn.Linear(128, 10))
+    opt = torch.optim.Adam(model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    x = torch.rand(BATCH, 784)
+    y = torch.randint(0, 10, (BATCH,))
+    for _ in range(3):  # warmup
+        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+    steps = 15
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+    dt = time.perf_counter() - t0
+    eps = steps * BATCH / dt
+    log(f"torch CPU baseline: {eps:,.0f} examples/s")
+    return eps
+
+
+def main():
+    value, acc = bench_framework()
+    baseline = bench_torch_baseline()
+    if baseline is None:
+        baseline = FALLBACK_BASELINE
+    converged = acc > 0.9
+    result = {
+        "metric": "mnist_mlp_train_examples_per_sec_per_chip"
+                  + ("" if converged else "_NOT_CONVERGED"),
+        "value": round(value, 1),
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(value / baseline, 3),
+    }
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,8 +1,13 @@
 """Model zoo + high-level Sequential/compile/fit API."""
 
-from . import callbacks
+from . import bert, callbacks, resnet, zoo
+from .bert import Bert, BertConfig, bert_base, bert_tiny
 from .callbacks import Callback, EarlyStopping, History, TensorBoard
+from .resnet import ResNet, resnet18, resnet50, resnet_cifar
 from .sequential import Sequential
+from .zoo import cifar_cnn, mnist_mlp, xor_mlp
 
-__all__ = ["callbacks", "Callback", "EarlyStopping", "History",
-           "TensorBoard", "Sequential"]
+__all__ = ["bert", "callbacks", "resnet", "zoo", "Bert", "BertConfig",
+           "bert_base", "bert_tiny", "Callback", "EarlyStopping", "History",
+           "TensorBoard", "ResNet", "resnet18", "resnet50", "resnet_cifar",
+           "Sequential", "cifar_cnn", "mnist_mlp", "xor_mlp"]

@@ -1,0 +1,296 @@
+"""BERT encoder family (MLM pre-train / fine-tune) — the flagship model.
+
+The reference has no transformer (its model is a 3-layer MLP, reference
+example.py:149-155); BERT-base MLM is the driver's largest baseline config
+(BASELINE.md #5: pjit data+model parallel on v5p-128).  TPU-first design:
+
+  * **Scanned layer stack**: the L encoder layers are ONE set of parameter
+    arrays with a leading ``[L, ...]`` stacking dim, applied with
+    ``lax.scan`` — compile time is O(1) in depth and XLA pipelines the
+    layers.  Optional ``remat`` wraps the scan body in ``jax.checkpoint``
+    to trade recompute for HBM (long-context requirement).
+  * **4D mesh-ready sharding**: ``partition_rules()`` ships megatron-style
+    specs — attention heads and FFN hidden on ``tensor`` (column-parallel
+    in, row-parallel out), optional ``fsdp`` on the complementary dim,
+    embeddings sharded on vocab — one rule table from 1 chip to a pod.
+  * **Sequence parallelism**: ``apply`` takes the activations in
+    ``[batch, seq, hidden]``; with ``seq_axis`` set, attention runs as ring
+    attention over the ``seq`` mesh axis (parallel.ring) so sequences can
+    exceed one chip's HBM.
+  * bf16 activations / f32 master params via the shared layer conventions.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import attention as attn_lib
+from ..ops import initializers as init_lib
+from ..ops import losses as loss_lib
+from ..parallel.sharding import PartitionRules
+
+__all__ = ["BertConfig", "Bert", "bert_base", "bert_tiny"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32          # activation/compute dtype
+    remat: bool = False               # checkpoint each encoder layer
+    seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def bert_base(**kw) -> "Bert":
+    return Bert(BertConfig(**kw))
+
+
+def bert_tiny(**kw) -> "Bert":
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("intermediate_size", 512)
+    kw.setdefault("vocab_size", 1000)
+    kw.setdefault("max_position", 128)
+    return Bert(BertConfig(**kw))
+
+
+def _layer_norm(params, x, eps):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * params["gamma"] + params["beta"]).astype(x.dtype)
+
+
+def _dropout(x, rate, rng, train):
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+class Bert:
+    """Functional BERT: ``init(key) -> params``, ``apply(params, batch, ...)``."""
+
+    def __init__(self, config: BertConfig, mesh=None):
+        self.config = config
+        # Mesh is only needed for sequence parallelism: with ``seq_axis``
+        # set and a mesh attached, attention runs as a partial-manual ring
+        # over that axis inside the otherwise-auto pjit program.
+        self.mesh = mesh
+
+    # -- init -------------------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        c = self.config
+        trunc = init_lib.truncated_normal(0.02)
+        k_emb, k_layers, k_head = jax.random.split(key, 3)
+        ke = jax.random.split(k_emb, 3)
+
+        def ln():
+            return {"gamma": jnp.ones((c.hidden_size,), jnp.float32),
+                    "beta": jnp.zeros((c.hidden_size,), jnp.float32)}
+
+        params: Dict[str, Any] = {
+            "embeddings": {
+                "word": trunc(ke[0], (c.vocab_size, c.hidden_size)),
+                "position": trunc(ke[1], (c.max_position, c.hidden_size)),
+                "type": trunc(ke[2], (c.type_vocab_size, c.hidden_size)),
+                "ln": ln(),
+            },
+        }
+
+        h, hd, d, i = c.num_heads, c.head_dim, c.hidden_size, c.intermediate_size
+
+        def one_layer(k):
+            ks = jax.random.split(k, 6)
+            return {
+                "attention": {
+                    "query": {"kernel": trunc(ks[0], (d, h, hd)),
+                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "key": {"kernel": trunc(ks[1], (d, h, hd)),
+                            "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "value": {"kernel": trunc(ks[2], (d, h, hd)),
+                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "out": {"kernel": trunc(ks[3], (h, hd, d)),
+                            "bias": jnp.zeros((d,), jnp.float32)},
+                    "ln": ln(),
+                },
+                "ffn": {
+                    "w_in": {"kernel": trunc(ks[4], (d, i)),
+                             "bias": jnp.zeros((i,), jnp.float32)},
+                    "w_out": {"kernel": trunc(ks[5], (i, d)),
+                              "bias": jnp.zeros((d,), jnp.float32)},
+                    "ln": ln(),
+                },
+            }
+
+        # Stacked layers: vmap init over per-layer keys -> leading [L, ...].
+        params["encoder"] = jax.vmap(one_layer)(
+            jax.random.split(k_layers, c.num_layers))
+
+        kh = jax.random.split(k_head, 2)
+        params["mlm"] = {
+            "transform": {"kernel": trunc(kh[0], (d, d)),
+                          "bias": jnp.zeros((d,), jnp.float32)},
+            "ln": ln(),
+            "output_bias": jnp.zeros((c.vocab_size,), jnp.float32),
+        }
+        params["pooler"] = {"kernel": trunc(kh[1], (d, d)),
+                            "bias": jnp.zeros((d,), jnp.float32)}
+        return params
+
+    # -- encoder ----------------------------------------------------------
+    def _attention(self, p, x, mask, valid, rng, train):
+        c = self.config
+
+        if c.seq_axis is not None and self.mesh is not None:
+            from ..parallel.ring import ring_attention_sharded
+            attention_fn = lambda q, k, v, mask=None: ring_attention_sharded(
+                q, k, v, self.mesh, seq_axis=c.seq_axis, kv_valid=valid)
+        elif c.seq_axis is not None:
+            from ..parallel.ring import ring_attention
+            attention_fn = lambda q, k, v, mask=None: ring_attention(
+                q, k, v, axis_name=c.seq_axis, kv_valid=valid)
+        else:
+            attention_fn = attn_lib.dot_product_attention
+        return attn_lib.attention_core(
+            p, x, mask=mask, dropout_rate=c.dropout_rate, rng=rng,
+            train=train, attention_fn=attention_fn)
+
+    def _encoder_layer(self, p, x, mask, valid, rng, train):
+        c = self.config
+        r1, r2, r3 = jax.random.split(rng, 3)
+        attn_out = self._attention(p["attention"], x, mask, valid, r1, train)
+        x = _layer_norm(p["attention"]["ln"],
+                        x + _dropout(attn_out, c.dropout_rate, r2, train),
+                        c.layer_norm_eps)
+        dtype = x.dtype
+        hmid = jax.nn.gelu(
+            jnp.einsum("bsd,di->bsi", x, p["ffn"]["w_in"]["kernel"].astype(dtype))
+            + p["ffn"]["w_in"]["bias"].astype(dtype))
+        ffn_out = (jnp.einsum("bsi,id->bsd", hmid,
+                              p["ffn"]["w_out"]["kernel"].astype(dtype))
+                   + p["ffn"]["w_out"]["bias"].astype(dtype))
+        return _layer_norm(p["ffn"]["ln"],
+                           x + _dropout(ffn_out, c.dropout_rate, r3, train),
+                           c.layer_norm_eps)
+
+    def apply(self, params, input_ids, *, token_type_ids=None,
+              attention_mask=None, train: bool = False, rng=None):
+        """-> sequence output [batch, seq, hidden] in config.dtype."""
+        c = self.config
+        if rng is None:
+            if train:
+                raise ValueError(
+                    "Bert.apply(train=True) requires an rng key (dropout); "
+                    "use make_custom_train_step or pass rng explicitly")
+            rng = jax.random.PRNGKey(0)   # eval: dropout is a no-op
+        b, s = input_ids.shape
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], input_ids, axis=0)
+        x = x + emb["position"][None, :s, :]
+        if token_type_ids is not None:
+            x = x + jnp.take(emb["type"], token_type_ids, axis=0)
+        else:
+            x = x + emb["type"][0][None, None, :]
+        x = _layer_norm(emb["ln"], x, c.layer_norm_eps)
+        r_emb, r_layers = jax.random.split(rng)
+        x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
+
+        mask = (attn_lib.padding_mask(attention_mask)
+                if attention_mask is not None else None)
+        valid = attention_mask  # raw [b, s] form for the ring path
+
+        layer_fn = self._encoder_layer
+        if c.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(5,))
+
+        def body(carry, inputs):
+            layer_params, layer_key = inputs
+            return layer_fn(layer_params, carry, mask, valid, layer_key,
+                            train), None
+
+        layer_keys = jax.random.split(r_layers, c.num_layers)
+        x, _ = jax.lax.scan(body, x, (params["encoder"], layer_keys))
+        return x
+
+    # -- heads ------------------------------------------------------------
+    def mlm_logits(self, params, sequence_output):
+        """Tied-embedding MLM head -> [batch, seq, vocab] (f32 logits)."""
+        c = self.config
+        p = params["mlm"]
+        dtype = sequence_output.dtype
+        h = jax.nn.gelu(sequence_output @ p["transform"]["kernel"].astype(dtype)
+                        + p["transform"]["bias"].astype(dtype))
+        h = _layer_norm(p["ln"], h, c.layer_norm_eps)
+        logits = h @ params["embeddings"]["word"].T.astype(dtype)
+        return logits.astype(jnp.float32) + p["output_bias"]
+
+    def pooled(self, params, sequence_output):
+        """[CLS] pooler -> [batch, hidden] (classification fine-tune)."""
+        p = params["pooler"]
+        first = sequence_output[:, 0, :]
+        return jnp.tanh(first @ p["kernel"].astype(first.dtype)
+                        + p["bias"].astype(first.dtype))
+
+    # -- losses -----------------------------------------------------------
+    def mlm_loss_fn(self):
+        """Contract for ``train.make_custom_train_step``: batch dict with
+        input_ids / labels / mlm mask (-100 or mask array) / attention_mask."""
+
+        def loss_fn(params, model_state, batch, rng, train):
+            seq = self.apply(params, batch["input_ids"],
+                             token_type_ids=batch.get("token_type_ids"),
+                             attention_mask=batch.get("attention_mask"),
+                             train=train, rng=rng)
+            logits = self.mlm_logits(params, seq)
+            mask = batch["mlm_mask"]
+            loss = loss_lib.softmax_cross_entropy_with_integer_labels(
+                logits, batch["labels"], where=mask)
+            acc_hits = (jnp.argmax(logits, -1) == batch["labels"]).astype(
+                jnp.float32) * mask
+            accuracy = jnp.sum(acc_hits) / jnp.maximum(jnp.sum(mask), 1.0)
+            return loss, ({"mlm_accuracy": accuracy}, model_state)
+
+        return loss_fn
+
+    # -- sharding ---------------------------------------------------------
+    def partition_rules(self, fsdp: bool = False) -> PartitionRules:
+        """Megatron-style TP specs (+ optional fsdp on the complementary
+        dim).  Paths include the scanned leading layer dim, which is never
+        sharded (each chip holds all L slices of its shard)."""
+        f = "fsdp" if fsdp else None
+        return PartitionRules([
+            # embeddings: vocab on tensor (row-parallel gather + tied head)
+            (r"embeddings/word$", P("tensor", f)),
+            (r"embeddings/(position|type)$", P(None, None)),
+            # attention projections [L, d, h, hd]: heads on tensor
+            (r"encoder/attention/(query|key|value)/kernel", P(None, f, "tensor", None)),
+            (r"encoder/attention/(query|key|value)/bias", P(None, "tensor", None)),
+            # out projection [L, h, hd, d]: heads on tensor (row-parallel)
+            (r"encoder/attention/out/kernel", P(None, "tensor", None, f)),
+            # FFN [L, d, i] / [L, i, d]: hidden i on tensor
+            (r"encoder/ffn/w_in/kernel", P(None, f, "tensor")),
+            (r"encoder/ffn/w_in/bias", P(None, "tensor")),
+            (r"encoder/ffn/w_out/kernel", P(None, "tensor", f)),
+            (r"mlm/transform/kernel", P(f, "tensor")),
+            (r"pooler/kernel", P(f, "tensor")),
+            (r"mlm/output_bias", P("tensor")),
+        ])

@@ -1,0 +1,194 @@
+"""ResNet family (v1.5 bottleneck) — BASELINE config #4 (pjit on a 2D mesh).
+
+Implemented against the framework's own Layer protocol (params + BatchNorm
+running-stat state), NHWC throughout so convs tile onto the MXU.  The
+``partition_rules`` shard conv output channels over ``tensor`` and
+optionally fsdp the input-channel dim; BatchNorm can be made cross-replica
+by passing ``axis_name`` when training under shard_map (under plain pjit the
+global-batch stats come out of the partitioner automatically).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.layers import BatchNorm, Conv2D, Dense, GlobalAvgPool, Layer
+from ..parallel.sharding import PartitionRules
+
+__all__ = ["ResNet", "resnet18", "resnet50", "resnet_cifar"]
+
+
+class _Bottleneck(Layer):
+    """1x1 -> 3x3 -> 1x1 (x4) with projection shortcut when shapes change."""
+    expansion = 4
+
+    def __init__(self, filters: int, in_channels: int, stride: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name or "bottleneck")
+        self.filters = filters
+        self.stride = stride
+        self.conv1 = Conv2D(filters, 1, use_bias=False)
+        self.bn1 = BatchNorm()
+        self.conv2 = Conv2D(filters, 3, strides=stride, use_bias=False)
+        self.bn2 = BatchNorm()
+        self.conv3 = Conv2D(filters * self.expansion, 1, use_bias=False)
+        self.bn3 = BatchNorm()
+        # Shortcut structure is fixed at construction (not in init()), so a
+        # fresh model instance can apply() restored params directly.
+        out_ch = filters * self.expansion
+        if stride != 1 or in_channels != out_ch:
+            self.proj: Optional[Conv2D] = Conv2D(out_ch, 1, strides=stride,
+                                                 use_bias=False)
+            self.bn_proj: Optional[BatchNorm] = BatchNorm()
+        else:
+            self.proj = None
+            self.bn_proj = None
+
+    def _parts(self):
+        parts = [("conv1", self.conv1), ("bn1", self.bn1),
+                 ("conv2", self.conv2), ("bn2", self.bn2),
+                 ("conv3", self.conv3), ("bn3", self.bn3)]
+        if self.proj is not None:
+            parts += [("proj", self.proj), ("bn_proj", self.bn_proj)]
+        return parts
+
+    def init(self, key, in_shape):
+        params, state = {}, {}
+        shape = tuple(in_shape)
+        keys = jax.random.split(key, 8)
+        shapes = {"conv1": shape}
+        shapes["bn1"] = self.conv1.out_shape(shape)
+        shapes["conv2"] = shapes["bn1"]
+        shapes["bn2"] = self.conv2.out_shape(shapes["conv2"])
+        shapes["conv3"] = shapes["bn2"]
+        shapes["bn3"] = self.conv3.out_shape(shapes["conv3"])
+        shapes["proj"] = shape
+        shapes["bn_proj"] = shapes["bn3"]
+        for k_, (name, layer) in zip(keys, self._parts()):
+            p, s = layer.init(k_, shapes[name])
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        return params, state
+
+    def out_shape(self, in_shape):
+        return self.conv3.out_shape(
+            self.conv2.out_shape(self.conv1.out_shape(in_shape)))
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+
+        def run(name, layer, h):
+            out, s = layer.apply(params.get(name, {}), state.get(name, {}), h,
+                                 train=train, rng=None)
+            if s:
+                new_state[name] = s
+            return out
+
+        h = jax.nn.relu(run("bn1", self.bn1, run("conv1", self.conv1, x)))
+        h = jax.nn.relu(run("bn2", self.bn2, run("conv2", self.conv2, h)))
+        h = run("bn3", self.bn3, run("conv3", self.conv3, h))
+        shortcut = x
+        if self.proj is not None:
+            shortcut = run("bn_proj", self.bn_proj,
+                           run("proj", self.proj, x))
+        return jax.nn.relu(h + shortcut), new_state
+
+
+class ResNet(Layer):
+    """Stage-structured ResNet; ``stages`` = blocks per stage."""
+
+    def __init__(self, stages: Sequence[int], num_classes: int = 1000,
+                 stem_stride: int = 2, stem_pool: bool = True,
+                 width: int = 64, name: Optional[str] = None):
+        super().__init__(name or "resnet")
+        self.stem = Conv2D(width, 7 if stem_pool else 3,
+                           strides=stem_stride, use_bias=False)
+        self.stem_bn = BatchNorm()
+        self.stem_pool = stem_pool
+        self.blocks = []
+        filters = width
+        in_channels = width   # channels coming out of the stem
+        for stage_idx, num_blocks in enumerate(stages):
+            for block_idx in range(num_blocks):
+                stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
+                block = _Bottleneck(filters, in_channels, stride)
+                self.blocks.append(
+                    (f"stage{stage_idx}_block{block_idx}", block))
+                in_channels = filters * _Bottleneck.expansion
+            filters *= 2
+        self.head = Dense(num_classes)
+        self.pool = GlobalAvgPool()
+
+    def init(self, key, in_shape):
+        params, state = {}, {}
+        keys = jax.random.split(key, len(self.blocks) + 3)
+        shape = tuple(in_shape)
+        p, s = self.stem.init(keys[0], shape)
+        params["stem"] = p
+        shape = self.stem.out_shape(shape)
+        p, s = self.stem_bn.init(keys[1], shape)
+        if p:
+            params["stem_bn"] = p
+        state["stem_bn"] = s
+        if self.stem_pool:
+            shape = (-(-shape[0] // 2), -(-shape[1] // 2), shape[2])
+        for k_, (name, block) in zip(keys[2:-1], self.blocks):
+            p, s = block.init(k_, shape)
+            params[name] = p
+            if s:
+                state[name] = s
+            shape = block.out_shape(shape)
+        p, _ = self.head.init(keys[-1], (shape[-1],))
+        params["head"] = p
+        return params, state
+
+    def out_shape(self, in_shape):
+        return (self.head.units,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state = dict(state)
+        h, _ = self.stem.apply(params["stem"], {}, x)
+        h, s = self.stem_bn.apply(params.get("stem_bn", {}),
+                                  state["stem_bn"], h, train=train)
+        new_state["stem_bn"] = s
+        h = jax.nn.relu(h)
+        if self.stem_pool:
+            h = jax.lax.reduce_window(
+                h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+        for name, block in self.blocks:
+            h, s = block.apply(params[name], state.get(name, {}), h,
+                               train=train, rng=None)
+            if s:
+                new_state[name] = s
+        h, _ = self.pool.apply({}, {}, h)
+        logits, _ = self.head.apply(params["head"], {}, h)
+        return logits, new_state
+
+    @staticmethod
+    def partition_rules(fsdp: bool = False) -> PartitionRules:
+        f = "fsdp" if fsdp else None
+        return PartitionRules([
+            # conv kernels [kh, kw, cin, cout]: output channels on tensor
+            (r"(conv|proj|stem).*kernel", P(None, None, f, "tensor")),
+            (r"head/kernel", P(f, "tensor")),
+        ])
+
+
+def resnet50(num_classes: int = 1000) -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes)
+
+
+def resnet18(num_classes: int = 1000) -> ResNet:
+    # (kept bottleneck-based for uniformity; depth-equivalent small net)
+    return ResNet([2, 2, 2, 2], num_classes)
+
+
+def resnet_cifar(num_classes: int = 10) -> ResNet:
+    """3x3 stem, no maxpool — the standard CIFAR variant."""
+    return ResNet([2, 2, 2], num_classes, stem_stride=1, stem_pool=False,
+                  width=32)

@@ -1,12 +1,14 @@
 """Functional op/layer library (compute tier: everything lowers to XLA HLO)."""
 
-from . import activations, initializers, losses, metrics
+from . import activations, attention, initializers, losses, metrics
+from .attention import MultiHeadAttention, causal_mask, dot_product_attention
 from .layers import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Dropout,
                      Embedding, Flatten, GlobalAvgPool, Layer, LayerNorm,
                      MaxPool2D, Stack, serial)
 
 __all__ = [
-    "activations", "initializers", "losses", "metrics",
+    "activations", "attention", "initializers", "losses", "metrics",
+    "MultiHeadAttention", "causal_mask", "dot_product_attention",
     "Activation", "AvgPool2D", "BatchNorm", "Conv2D", "Dense", "Dropout",
     "Embedding", "Flatten", "GlobalAvgPool", "Layer", "LayerNorm",
     "MaxPool2D", "Stack", "serial",

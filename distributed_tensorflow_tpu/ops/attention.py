@@ -1,0 +1,144 @@
+"""Attention ops: scaled-dot-product and multi-head attention.
+
+The reference has no attention at all (its model is an MLP, reference
+example.py:149-155); this module exists for the driver's BERT-base baseline
+config and the long-context design requirement (SURVEY.md §5 long-context
+row).  TPU-first choices:
+
+  * head layout ``[batch, seq, heads, head_dim]`` with projections stored
+    ``[d_model, heads, head_dim]`` — the heads axis is the natural tensor-
+    parallel shard (``P(None, 'tensor', None)``), so TP needs no reshapes;
+  * logits/softmax computed in float32 regardless of activation dtype
+    (bf16-safe), matmuls in the input dtype so they hit the MXU in bf16;
+  * additive masks (0 / -inf convention) so causal+padding masks compose by
+    addition and fuse into one XLA op.
+
+``ring_attention`` (sequence parallelism over the ``seq`` mesh axis) builds
+on this module from ``parallel.ring``; a fused Pallas flash-attention kernel
+slots in behind the same ``dot_product_attention`` signature.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import initializers as init_lib
+from .layers import Layer
+
+__all__ = ["dot_product_attention", "causal_mask", "padding_mask",
+           "attention_core", "MultiHeadAttention"]
+
+NEG_INF = -1e9  # finite -inf stand-in: keeps softmax well-defined in f32
+
+
+def causal_mask(seq_len: int) -> jnp.ndarray:
+    """[1, 1, seq, seq] additive mask; position i attends to j<=i."""
+    mask = jnp.tril(jnp.ones((seq_len, seq_len), jnp.bool_))
+    return jnp.where(mask, 0.0, NEG_INF)[None, None, :, :]
+
+
+def padding_mask(valid: jnp.ndarray) -> jnp.ndarray:
+    """valid: [batch, seq] bool/int (1 = real token) -> [b, 1, 1, seq]."""
+    return jnp.where(valid.astype(jnp.bool_), 0.0, NEG_INF)[:, None, None, :]
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mask: Optional[jnp.ndarray] = None,
+                          scale: Optional[float] = None) -> jnp.ndarray:
+    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
+
+    Logit/softmax math in f32; matmuls stay in the input dtype for the MXU.
+    """
+    head_dim = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(head_dim)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = logits + mask
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
+                   rng=None, train: bool = False,
+                   attention_fn=dot_product_attention) -> jnp.ndarray:
+    """The shared multi-head attention body.
+
+    ``params``: {query,key,value: {kernel [d,h,hd], bias [h,hd]},
+    out: {kernel [h,hd,d], bias [d]}} — used by both the
+    ``MultiHeadAttention`` layer and the scanned BERT stack, so projection/
+    dtype/dropout fixes land in exactly one place.  ``attention_fn``
+    swaps the inner kernel (full softmax, ring attention, a Pallas flash
+    kernel) behind the same signature.
+    """
+    dtype = x.dtype
+
+    def project(p):
+        return (jnp.einsum("bsd,dhk->bshk", x, p["kernel"].astype(dtype))
+                + p["bias"].astype(dtype))
+
+    q = project(params["query"])
+    k = project(params["key"])
+    v = project(params["value"])
+    ctx = attention_fn(q, k, v, mask=mask)
+    if train and dropout_rate > 0.0:
+        if rng is None:
+            raise ValueError("attention dropout requires rng in train mode")
+        keep = 1.0 - dropout_rate
+        drop = jax.random.bernoulli(rng, keep, ctx.shape)
+        ctx = jnp.where(drop, ctx / keep, jnp.zeros_like(ctx))
+    out = jnp.einsum("bshk,hkd->bsd", ctx,
+                     params["out"]["kernel"].astype(dtype))
+    return out + params["out"]["bias"].astype(dtype)
+
+
+class MultiHeadAttention(Layer):
+    """Self-attention with TP-ready [d, heads, head_dim] projections."""
+
+    def __init__(self, num_heads: int, d_model: int,
+                 head_dim: Optional[int] = None,
+                 dropout_rate: float = 0.0,
+                 kernel_init="glorot_uniform",
+                 name: Optional[str] = None):
+        super().__init__(name or "attention")
+        self.num_heads = num_heads
+        self.d_model = d_model
+        self.head_dim = head_dim or d_model // num_heads
+        self.dropout_rate = dropout_rate
+        self.kernel_init = init_lib.get(kernel_init)
+
+    def init(self, key, in_shape):
+        d = in_shape[-1]
+        keys = jax.random.split(key, 4)
+        h, hd = self.num_heads, self.head_dim
+        shape_in = (d, h, hd)
+
+        def proj(k, shape):
+            # variance-scaled on the flattened fan
+            flat = self.kernel_init(k, (shape[0],
+                                        int(jnp.prod(jnp.asarray(shape[1:])))))
+            return flat.reshape(shape)
+
+        params = {
+            "query": {"kernel": proj(keys[0], shape_in),
+                      "bias": jnp.zeros((h, hd), jnp.float32)},
+            "key": {"kernel": proj(keys[1], shape_in),
+                    "bias": jnp.zeros((h, hd), jnp.float32)},
+            "value": {"kernel": proj(keys[2], shape_in),
+                      "bias": jnp.zeros((h, hd), jnp.float32)},
+            "out": {"kernel": proj(keys[3], (h * hd, self.d_model)
+                                   ).reshape(h, hd, self.d_model),
+                    "bias": jnp.zeros((self.d_model,), jnp.float32)},
+        }
+        return params, {}
+
+    def out_shape(self, in_shape):
+        return tuple(in_shape[:-1]) + (self.d_model,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return attention_core(params, x, mask=mask,
+                              dropout_rate=self.dropout_rate, rng=rng,
+                              train=train), state

@@ -1,12 +1,16 @@
 """Parallelism layer: cluster bootstrap, meshes, shardings, collectives."""
 
-from . import cluster, mesh
+from . import cluster, mesh, ring, sharding
 from .cluster import ClusterConfig, cluster_from_env, initialize, is_chief
+from .ring import ring_attention, ring_attention_sharded
+from .sharding import PartitionRules, shard_pytree
 from .mesh import (AXIS_ORDER, data_parallel_mesh, data_shards,
                    local_batch_size, make_mesh, named_sharding, replicated,
                    round_batch_to_mesh)
 
-__all__ = ["cluster", "mesh", "ClusterConfig", "cluster_from_env",
-           "initialize", "is_chief", "AXIS_ORDER", "data_parallel_mesh",
-           "data_shards", "local_batch_size", "make_mesh", "named_sharding",
-           "replicated", "round_batch_to_mesh"]
+__all__ = ["cluster", "mesh", "ring", "sharding", "ClusterConfig",
+           "cluster_from_env", "initialize", "is_chief", "ring_attention",
+           "ring_attention_sharded", "PartitionRules", "shard_pytree",
+           "AXIS_ORDER", "data_parallel_mesh", "data_shards",
+           "local_batch_size", "make_mesh", "named_sharding", "replicated",
+           "round_batch_to_mesh"]

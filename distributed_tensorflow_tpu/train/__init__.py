@@ -4,9 +4,10 @@ from . import checkpoint, hooks
 from .hooks import (CheckpointHook, Hook, LoggingHook, NaNHook, ProfilerHook,
                     StopAtStepHook, SummaryHook)
 from .session import TrainSession, TrainState
-from .step import init_train_state, make_eval_step, make_train_step
+from .step import (init_train_state, make_custom_train_step, make_eval_step,
+                   make_train_step)
 
 __all__ = ["checkpoint", "hooks", "CheckpointHook", "Hook", "LoggingHook",
            "NaNHook", "ProfilerHook", "StopAtStepHook", "SummaryHook",
-           "TrainSession", "TrainState", "init_train_state", "make_eval_step",
-           "make_train_step"]
+           "TrainSession", "TrainState", "init_train_state",
+           "make_custom_train_step", "make_eval_step", "make_train_step"]

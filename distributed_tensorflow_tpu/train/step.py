@@ -55,66 +55,89 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                     grad_clip_norm: Optional[float] = None) -> Callable:
     """Build ``step(state, (x, y)) -> (new_state, metrics)``.
 
+    Thin adapter over ``make_custom_train_step``: wraps the (model, loss,
+    metrics) trio into the generic loss-fn contract, and translates the
+    (mesh, params_spec, batch_spec) convenience arguments into state/batch
+    sharding pytrees.  XLA partitions the whole step and inserts the
+    gradient all-reduce implied by the global-mean loss.
+
     Dropout randomness: one base key from ``seed``, folded with the global
     step inside the trace — deterministic, resume-stable, and unique per
     step (the explicit-PRNG answer to the reference's learning-phase feed,
     example.py:213; SURVEY.md §7 "Dropout determinism").
     """
-    loss_fn = loss_lib.get(loss)
+    loss_value_fn = loss_lib.get(loss)
+
+    def loss_fn(params, model_state, batch, rng, train):
+        x, y = batch
+        preds, new_model_state = model.apply(params, model_state, x,
+                                             train=train, rng=rng)
+        metrics = _metric_dict(metric_fns, preds, y)
+        return loss_value_fn(preds, y), (metrics, new_model_state)
+
+    state_shardings = batch_shardings = None
+    if mesh is not None:
+        replicated = NamedSharding(mesh, P())
+        params_shardings = replicated
+        if params_spec is not None:
+            params_shardings = jax.tree.map(
+                lambda spec: NamedSharding(mesh, spec), params_spec,
+                is_leaf=lambda v: isinstance(v, P))
+        state_shardings = TrainState(step=replicated,
+                                     params=params_shardings,
+                                     opt_state=replicated,
+                                     model_state=replicated)
+        batch_sharding = NamedSharding(mesh, batch_spec)
+        batch_shardings = (batch_sharding, batch_sharding)
+
+    return make_custom_train_step(loss_fn, optimizer, seed=seed, mesh=mesh,
+                                  state_shardings=state_shardings,
+                                  batch_shardings=batch_shardings, jit=jit,
+                                  grad_clip_norm=grad_clip_norm)
+
+
+def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
+                           seed: int = 0,
+                           mesh: Optional[Mesh] = None,
+                           state_shardings: Any = None,
+                           batch_shardings: Any = None,
+                           jit: bool = True,
+                           grad_clip_norm: Optional[float] = None) -> Callable:
+    """Generalized step builder for model families with structured batches.
+
+    ``loss_fn(params, model_state, batch, rng, train) ->
+    (loss, (metrics_dict, new_model_state))`` — the contract used by the
+    model zoo (BERT MLM, ResNet, ...).  Sharding: pass a TrainState-shaped
+    ``state_shardings`` and a batch-shaped ``batch_shardings`` (NamedSharding
+    pytrees) for the pjit path.
+    """
     base_key = jax.random.PRNGKey(seed)
 
     def step(state: TrainState, batch):
-        x, y = batch
         rng = jax.random.fold_in(base_key, state.step)
 
-        def compute_loss(params):
-            preds, new_model_state = model.apply(
-                params, state.model_state, x, train=True, rng=rng)
-            return loss_fn(preds, y), (preds, new_model_state)
+        def compute(params):
+            return loss_fn(params, state.model_state, batch, rng, True)
 
-        (loss_value, (preds, new_model_state)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(state.params)
-
-        metrics = {"loss": loss_value}
+        (loss_value, (metrics, new_model_state)), grads = jax.value_and_grad(
+            compute, has_aux=True)(state.params)
+        metrics = {"loss": loss_value, **metrics}
         if grad_clip_norm is not None:
             grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)
             metrics["grad_norm"] = gnorm
         updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                                   state.params)
         new_params = opt_lib.apply_updates(state.params, updates)
-        metrics.update(_metric_dict(metric_fns, preds, y))
-
-        new_state = TrainState(step=state.step + 1, params=new_params,
-                               opt_state=new_opt_state,
-                               model_state=new_model_state)
-        return new_state, metrics
+        return TrainState(step=state.step + 1, params=new_params,
+                          opt_state=new_opt_state,
+                          model_state=new_model_state), metrics
 
     if not jit:
         return step
-
-    if mesh is None:
+    if mesh is None or state_shardings is None:
         return jax.jit(step, donate_argnums=0)
-
-    # Mesh path: replicate state (or shard params by params_spec), shard the
-    # batch over the data axis.  XLA partitions the whole step and inserts
-    # the gradient all-reduce implied by the global-mean loss.
-    replicated = NamedSharding(mesh, P())
-    if params_spec is None:
-        state_shardings = TrainState(step=replicated, params=replicated,
-                                     opt_state=replicated,
-                                     model_state=replicated)
-    else:
-        to_shard = jax.tree.map(
-            lambda spec: NamedSharding(mesh, spec), params_spec,
-            is_leaf=lambda v: isinstance(v, P))
-        state_shardings = TrainState(step=replicated, params=to_shard,
-                                     opt_state=replicated,
-                                     model_state=replicated)
-    batch_sharding = NamedSharding(mesh, batch_spec)
     return jax.jit(step, donate_argnums=0,
-                   in_shardings=(state_shardings,
-                                 (batch_sharding, batch_sharding)),
-                   )
+                   in_shardings=(state_shardings, batch_shardings))
 
 
 def make_eval_step(model, loss,

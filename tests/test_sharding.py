@@ -1,0 +1,45 @@
+"""Partition-rule machinery tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_tensorflow_tpu.parallel import make_mesh
+from distributed_tensorflow_tpu.parallel.sharding import (PartitionRules,
+                                                          prune_spec,
+                                                          shard_pytree,
+                                                          tree_paths)
+
+
+def test_tree_paths():
+    tree = {"a": {"b": jnp.zeros(2), "c": jnp.zeros(3)}, "d": jnp.zeros(4)}
+    assert tree_paths(tree) == ["a/b", "a/c", "d"]
+
+
+def test_first_match_wins():
+    rules = PartitionRules([
+        (r"special/kernel", P("tensor")),
+        (r"kernel", P("data")),
+    ])
+    assert rules.spec_for("layer/special/kernel") == P("tensor")
+    assert rules.spec_for("layer/other/kernel") == P("data")
+    assert rules.spec_for("layer/bias") == P()
+
+
+def test_prune_spec_degrades_gracefully():
+    mesh = make_mesh({"data": 8})
+    assert prune_spec(P("tensor", None), mesh) == P(None, None)
+    assert prune_spec(P("data", "tensor"), mesh) == P("data", None)
+    assert prune_spec(P(("data", "fsdp"), None), mesh) == P(("data",), None)
+
+
+def test_shard_pytree_places_leaves():
+    mesh = make_mesh({"data": 4, "tensor": 2})
+    params = {"dense": {"kernel": jnp.ones((8, 16)), "bias": jnp.ones((16,))}}
+    rules = PartitionRules([(r"kernel", P(None, "tensor"))])
+    out = shard_pytree(params, mesh, rules)
+    assert "tensor" in str(out["dense"]["kernel"].sharding.spec)
+    # bias replicated across all 8 devices
+    assert len(out["dense"]["bias"].sharding.device_set) == 8
+    shapes = {s.data.shape for s in out["dense"]["kernel"].addressable_shards}
+    assert shapes == {(8, 8)}
