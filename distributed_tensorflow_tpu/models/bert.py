@@ -50,6 +50,7 @@ class BertConfig:
     dtype: Any = jnp.float32          # activation/compute dtype
     remat: bool = False               # checkpoint each encoder layer
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
+    use_flash: bool = False           # fused Pallas flash-attention kernel
 
     @property
     def head_dim(self) -> int:
@@ -168,6 +169,10 @@ class Bert:
             from ..parallel.ring import ring_attention
             attention_fn = lambda q, k, v, mask=None: ring_attention(
                 q, k, v, axis_name=c.seq_axis, kv_valid=valid)
+        elif c.use_flash:
+            from ..ops.pallas import flash_attention
+            attention_fn = lambda q, k, v, mask=None: flash_attention(
+                q, k, v, kv_valid=valid)
         else:
             attention_fn = attn_lib.dot_product_attention
         return attn_lib.attention_core(

@@ -1,0 +1,10 @@
+"""Shared helpers for the Pallas kernel package."""
+from __future__ import annotations
+
+import jax
+
+
+def use_interpret() -> bool:
+    """Off-TPU (CPU test mesh, debugging) kernels run in interpret mode so
+    the same kernel code executes everywhere."""
+    return jax.default_backend() != "tpu"

@@ -1,0 +1,252 @@
+"""Fused flash-attention forward kernel (Pallas / Mosaic-TPU).
+
+Replaces the O(seq²)-memory ``ops.attention.dot_product_attention`` hot path
+with a blockwise online-softmax kernel: Q stays resident in VMEM per block
+row while K/V blocks stream through, so the full logits matrix never
+materialises in HBM.  The MXU sees [block_q, head_dim] x [head_dim, block_k]
+matmuls with float32 accumulation; inputs may be bfloat16.
+
+Grid layout: ``(batch, heads, q_blocks, k_blocks)`` with the K dimension
+minormost — Pallas executes the grid sequentially on a TPU core, so the
+float32 accumulator / running-max / running-sum scratch carried across the
+k iterations implements the streaming softmax without HBM round-trips.
+
+The backward pass recomputes attention with the pure-XLA reference
+implementation under ``jax.vjp`` (flash forward + rematerialised backward);
+a fused Pallas backward is a later optimisation — the forward is where the
+memory ceiling was.
+
+Reference parity note: the reference repo has no attention at all (its model
+is an MLP, reference example.py:149-155); this kernel serves the BERT/GPT
+model families the driver's baseline configs require.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .common import use_interpret as _use_interpret
+
+__all__ = ["flash_attention", "make_flash_attention_fn"]
+
+NEG_INF = float("-inf")
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *,
+                  scale: float, causal: bool,
+                  block_q: int, block_k: int):
+    """One (batch, head, q_block, k_block) grid step.
+
+    Refs: q [1,1,bq,d], k/v [1,1,bk,d], valid [1,bk] float (1=real key),
+    o [1,1,bq,d]; scratch acc [bq,d] f32, m/l [bq,1] f32.
+    """
+    # program_id must be read at kernel top level: the HLO interpreter used
+    # off-TPU cannot lower it from inside a pl.when body.
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)            # [bk, d]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        valid = valid_ref[0, :] > 0.5                   # [bk]
+        logits = jnp.where(valid[None, :], logits, NEG_INF)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+
+        m_prev = m_ref[:, 0]                            # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1))
+        # Rows with every key masked so far keep m == -inf; shift by 0 there
+        # so exp() stays finite and contributes nothing.
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        probs = jnp.exp(logits - shift[:, None])        # masked -> exp(-inf)=0
+        correction = jnp.where(jnp.isfinite(m_prev),
+                               jnp.exp(m_prev - shift), 0.0)
+
+        l_ref[:, 0] = l_ref[:, 0] * correction + jnp.sum(probs, axis=-1)
+        acc_ref[:] = (acc_ref[:] * correction[:, None] +
+                      jax.lax.dot_general(
+                          probs, v_ref[0, 0].astype(jnp.float32),
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32))
+        m_ref[:, 0] = m_new
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing: no query
+        # row in this block can attend to any key column in it.
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        out = acc_ref[:] / jnp.where(l > 0.0, l, 1.0)[:, None]
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
+                   interpret):
+    """q,k,v: [b, h, s, d]; valid: [b, s_k] float32.  Returns [b, h, s, d]."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+
+    q = _pad_to(q, 2, bq)
+    k = _pad_to(k, 2, bk)
+    v = _pad_to(v, 2, bk)
+    valid = _pad_to(valid, 1, bk)          # padded keys arrive masked
+    sq_p, sk_p = q.shape[2], k.shape[2]
+    grid = (b, h, sq_p // bq, sk_p // bk)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, bk), lambda ib, ih, iq, ik: (ib, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, valid)
+    return out[:, :, :sq, :]
+
+
+def _reference(q, k, v, valid, scale, causal):
+    """Pure-XLA parity implementation; also the rematerialised backward."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    logits = jnp.where(valid[:, None, None, :] > 0.5, logits, NEG_INF)
+    if causal:
+        sq, sk = logits.shape[-2:]
+        mask = (jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :])
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    # Fully-masked rows: softmax of all -inf — zero the output instead.
+    row_any = jnp.any(logits > NEG_INF, axis=-1, keepdims=True)
+    weights = jax.nn.softmax(jnp.where(row_any, logits, 0.0), axis=-1)
+    weights = jnp.where(row_any, weights, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, valid, scale, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
+                          interpret)
+
+
+def _flash_fwd(q, k, v, valid, scale, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
+                         interpret)
+    return out, (q, k, v, valid)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, valid = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference(q_, k_, v_, valid, scale, causal),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(valid)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    kv_valid: Optional[jnp.ndarray] = None,
+                    causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused attention.  q,k,v: [batch, seq, heads, head_dim] (the
+    framework-wide head layout, see ops.attention); kv_valid: optional
+    [batch, seq_k] mask, 1 = real key.  Returns [batch, seq, heads, head_dim].
+
+    Off-TPU the kernel runs in Pallas interpret mode, so CPU tests cover the
+    identical kernel code.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _use_interpret()
+    if kv_valid is None:
+        valid = jnp.ones((k.shape[0], k.shape[1]), jnp.float32)
+    else:
+        valid = kv_valid.astype(jnp.float32)
+
+    # [b, s, h, d] -> [b, h, s, d] for per-(batch, head) grid blocking.
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash(qt, kt, vt, valid, float(scale), bool(causal),
+                 int(block_q), int(block_k), bool(interpret))
+    return jnp.swapaxes(out, 1, 2)
+
+
+def make_flash_attention_fn(causal: bool = False, block_q: int = 128,
+                            block_k: int = 128):
+    """Adapter matching the ``attention_fn(q, k, v, mask=...)`` slot of
+    ``ops.attention.attention_core``.
+
+    Accepts ``mask=None`` or a *padding* mask shaped [b, 1, 1, s_k] (the
+    output of ``ops.attention.padding_mask``); arbitrary additive masks
+    don't map onto the fused kernel and raise.
+    """
+    def fn(q, k, v, mask=None, scale=None):
+        kv_valid = None
+        if mask is not None:
+            if mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1:
+                raise ValueError(
+                    "flash attention accepts only padding masks "
+                    f"[b,1,1,s]; got {mask.shape}")
+            kv_valid = (mask[:, 0, 0, :] >= 0.0)
+        return flash_attention(q, k, v, kv_valid=kv_valid, causal=causal,
+                               scale=scale, block_q=block_q, block_k=block_k)
+    return fn

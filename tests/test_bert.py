@@ -156,3 +156,15 @@ def test_sp_respects_padding_mask():
     out_sp = sp.apply(params, ids, attention_mask=mask)
     np.testing.assert_allclose(np.asarray(out_dense[:, :40]),
                                np.asarray(out_sp[:, :40]), atol=2e-4)
+
+
+def test_flash_attention_matches_dense():
+    dense = bert_tiny(dropout_rate=0.0)
+    flash = bert_tiny(dropout_rate=0.0, use_flash=True)
+    params = dense.init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 1000)
+    mask = jnp.ones((2, 32), jnp.int32).at[1, 20:].set(0)
+    out_dense = dense.apply(params, ids, attention_mask=mask)
+    out_flash = flash.apply(params, ids, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(out_dense), np.asarray(out_flash),
+                               atol=2e-4, rtol=2e-4)

@@ -1,0 +1,189 @@
+"""Pallas kernel parity tests (interpret mode on the CPU test mesh)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.ops.attention import (
+    dot_product_attention, padding_mask, causal_mask)
+from distributed_tensorflow_tpu.ops.pallas import (
+    flash_attention, make_flash_attention_fn, fused_adam_update,
+    fused_layernorm)
+
+
+def _qkv(key, b=2, s=64, h=4, d=16, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, h, d), dtype)
+    v = jax.random.normal(kv, (b, s, h, d), dtype)
+    return q, k, v
+
+
+class TestFlashAttention:
+    def test_matches_reference_no_mask(self):
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        got = flash_attention(q, k, v, block_q=32, block_k=32)
+        want = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_causal(self):
+        q, k, v = _qkv(jax.random.PRNGKey(1))
+        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        want = dot_product_attention(q, k, v, mask=causal_mask(q.shape[1]))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_padding_mask(self):
+        q, k, v = _qkv(jax.random.PRNGKey(2))
+        valid = jnp.asarray(
+            np.random.default_rng(0).random((2, 64)) < 0.7, jnp.int32)
+        valid = valid.at[:, 0].set(1)      # no fully-masked rows
+        got = flash_attention(q, k, v, kv_valid=valid, block_q=32, block_k=32)
+        want = dot_product_attention(q, k, v, mask=padding_mask(valid))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_ragged_seq_not_multiple_of_block(self):
+        q, k, v = _qkv(jax.random.PRNGKey(3), s=50)
+        got = flash_attention(q, k, v, block_q=16, block_k=16)
+        want = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_causal_ragged(self):
+        q, k, v = _qkv(jax.random.PRNGKey(4), s=40)
+        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        want = dot_product_attention(q, k, v, mask=causal_mask(40))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_bfloat16(self):
+        q, k, v = _qkv(jax.random.PRNGKey(5), dtype=jnp.bfloat16)
+        got = flash_attention(q, k, v, block_q=32, block_k=32)
+        want = dot_product_attention(q, k, v)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(got.astype(np.float32),
+                                   want.astype(np.float32),
+                                   atol=3e-2, rtol=3e-2)
+
+    def test_gradients_match_reference(self):
+        q, k, v = _qkv(jax.random.PRNGKey(6), b=1, s=32, h=2, d=8)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=16, block_k=16) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(
+                q, k, v, mask=causal_mask(q.shape[1])) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_jit_compiles(self):
+        q, k, v = _qkv(jax.random.PRNGKey(7), s=32)
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v,
+                                                    block_q=16, block_k=16))
+        np.testing.assert_allclose(f(q, k, v),
+                                   dot_product_attention(q, k, v),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_attention_fn_adapter(self):
+        q, k, v = _qkv(jax.random.PRNGKey(8), s=32)
+        valid = jnp.ones((2, 32), jnp.int32).at[:, 20:].set(0)
+        fn = make_flash_attention_fn(block_q=16, block_k=16)
+        got = fn(q, k, v, mask=padding_mask(valid))
+        want = dot_product_attention(q, k, v, mask=padding_mask(valid))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_attention_fn_rejects_full_mask(self):
+        q, k, v = _qkv(jax.random.PRNGKey(9), s=16)
+        fn = make_flash_attention_fn()
+        with pytest.raises(ValueError):
+            fn(q, k, v, mask=causal_mask(16))
+
+
+class TestFusedAdam:
+    def _naive(self, p, g, m, v, t, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+               wd=0.0):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        p = p - lr * (mh / (jnp.sqrt(vh) + eps) + wd * p)
+        return p, m, v
+
+    @pytest.mark.parametrize("shape", [(37,), (128, 130), (3, 5, 7)])
+    def test_matches_naive(self, shape):
+        key = jax.random.PRNGKey(0)
+        kp, kg, km, kv = jax.random.split(key, 4)
+        p = jax.random.normal(kp, shape)
+        g = jax.random.normal(kg, shape)
+        m = jax.random.normal(km, shape) * 0.1
+        v = jax.random.uniform(kv, shape) * 0.01
+        for t in (1, 10):
+            got = fused_adam_update(p, g, m, v, jnp.asarray(t))
+            want = self._naive(p, g, m, v, t)
+            for a, b in zip(got, want):
+                np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5)
+
+    def test_weight_decay(self):
+        # Large wd + early steps: catches decay scaled by the bias-corrected
+        # lr_t instead of plain lr (decoupled-AdamW semantics).
+        p = jnp.ones((64,)) * 0.5
+        g = jnp.ones((64,)) * 0.1
+        m = jnp.zeros((64,))
+        v = jnp.zeros((64,))
+        for t in (1, 5):
+            got = fused_adam_update(p, g, m, v, jnp.asarray(t),
+                                    weight_decay=0.1)
+            want = self._naive(p, g, m, v, t, wd=0.1)
+            for a, b in zip(got, want):
+                np.testing.assert_allclose(a, b, atol=1e-7, rtol=1e-6)
+
+    def test_under_jit_with_traced_step(self):
+        p = jnp.ones((100,))
+        g = jnp.full((100,), 0.3)
+        m = jnp.zeros((100,))
+        v = jnp.zeros((100,))
+        f = jax.jit(lambda p, g, m, v, t: fused_adam_update(p, g, m, v, t))
+        got = f(p, g, m, v, jnp.asarray(3))
+        want = self._naive(p, g, m, v, 3)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5)
+
+
+class TestFusedLayerNorm:
+    def _ref(self, x, gamma, beta, eps=1e-6):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+
+    def test_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 7, 96))
+        gamma = jax.random.normal(jax.random.PRNGKey(1), (96,)) + 1.0
+        beta = jax.random.normal(jax.random.PRNGKey(2), (96,))
+        got = fused_layernorm(x, gamma, beta)
+        np.testing.assert_allclose(got, self._ref(x, gamma, beta),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_bfloat16(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (8, 64), jnp.bfloat16)
+        gamma = jnp.ones((64,))
+        beta = jnp.zeros((64,))
+        got = fused_layernorm(x, gamma, beta)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            got.astype(np.float32),
+            self._ref(x.astype(jnp.float32), gamma, beta),
+            atol=3e-2, rtol=3e-2)
+
+    def test_gradients(self):
+        x = jax.random.normal(jax.random.PRNGKey(4), (6, 32))
+        gamma = jnp.ones((32,)) * 1.5
+        beta = jnp.zeros((32,))
+
+        g1 = jax.grad(lambda x, g, b: jnp.sum(fused_layernorm(x, g, b) ** 2),
+                      argnums=(0, 1, 2))(x, gamma, beta)
+        g2 = jax.grad(lambda x, g, b: jnp.sum(self._ref(x, g, b) ** 2),
+                      argnums=(0, 1, 2))(x, gamma, beta)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
