@@ -1,14 +1,18 @@
 """Parallelism layer: cluster bootstrap, meshes, shardings, collectives."""
 
-from . import cluster, mesh, ring, sharding
+from . import cluster, mesh, pipeline, ring, sharding
 from .cluster import ClusterConfig, cluster_from_env, initialize, is_chief
+from .pipeline import (pipeline_apply, pipeline_rules_spec,
+                       stack_pipeline_params)
 from .ring import ring_attention, ring_attention_sharded
 from .sharding import PartitionRules, shard_pytree
 from .mesh import (AXIS_ORDER, data_parallel_mesh, data_shards,
                    local_batch_size, make_mesh, named_sharding, replicated,
                    round_batch_to_mesh)
 
-__all__ = ["cluster", "mesh", "ring", "sharding", "ClusterConfig",
+__all__ = ["cluster", "mesh", "pipeline", "ring", "sharding",
+           "pipeline_apply", "pipeline_rules_spec", "stack_pipeline_params",
+           "ClusterConfig",
            "cluster_from_env", "initialize", "is_chief", "ring_attention",
            "ring_attention_sharded", "PartitionRules", "shard_pytree",
            "AXIS_ORDER", "data_parallel_mesh", "data_shards",

@@ -1,0 +1,124 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a ``pipe`` axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2c: "no stage
+partitioning anywhere"); this supplies the strategy TPU-natively so the one
+framework covers dp/fsdp/tp/sp/pp/ep on a single named Mesh.
+
+Design (TPU-first, not a port of any PS/NCCL scheme):
+  * every stage runs the SAME compiled program under ``shard_map`` manual
+    over the ``pipe`` axis — SPMD, no per-stage executables, no host-side
+    scheduler process;
+  * stage parameters are stacked on a leading axis and sharded
+    ``P('pipe')``, so each device holds exactly its stage's weights;
+  * activations move stage-to-stage with ``lax.ppermute`` — a neighbor
+    exchange that rides ICI, never the host;
+  * the schedule is a ``lax.scan`` over ``num_microbatches + num_stages - 1``
+    ticks (the classic GPipe fill/steady/drain trapezoid).  Backward is not
+    hand-scheduled: JAX autodiff transposes the scan+ppermute program into
+    the reverse pipeline automatically, which XLA overlaps the same way.
+
+Constraint of this formulation: every stage maps activations of one shape to
+activations of the SAME shape (transformer-block style).  Embed before the
+pipeline, project after — see tests/test_pipeline.py for the usage pattern.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_pipeline_params", "pipeline_rules_spec"]
+
+
+def stack_pipeline_params(stage_params: Sequence[Any]):
+    """Stack per-stage param pytrees on a new leading ``pipe`` axis.
+
+    All stages must share one tree structure/shapes (same-shape stages are
+    already required by the schedule).  Shard the result with ``P('pipe')``
+    on every leaf (``pipeline_rules_spec``).
+    """
+    return jax.tree.map(lambda *ps: jnp.stack(ps), *stage_params)
+
+
+def pipeline_rules_spec(stacked_params, axis: str = "pipe"):
+    """Same-structure pytree of ``P(axis)`` specs for the stacked params."""
+    return jax.tree.map(lambda _: P(axis), stacked_params)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stacked_params, x: jnp.ndarray, mesh: Mesh,
+                   num_microbatches: int, axis: str = "pipe") -> jnp.ndarray:
+    """Run ``x`` through ``num_stages`` copies of ``stage_fn`` as a pipeline.
+
+    ``stage_fn(params_for_one_stage, acts) -> acts`` (same shape in/out).
+    ``stacked_params``: leaves with leading dim == mesh.shape[axis]
+    (see ``stack_pipeline_params``); pass them in already sharded
+    ``P('pipe')`` or let shard_map slice them.
+    ``x``: [global_batch, ...] — must divide by ``num_microbatches``.
+
+    Returns [global_batch, ...] outputs, replicated over the pipe axis
+    (a masked ``psum`` broadcast from the last stage).  Differentiable:
+    ``jax.grad`` through this IS the backward pipeline.
+    """
+    n_stages = mesh.shape[axis]
+    if x.shape[0] % num_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {num_microbatches} "
+            "microbatches")
+    mb = x.shape[0] // num_microbatches
+    n_ticks = num_microbatches + n_stages - 1
+
+    # Activation dtype for the scan carry: a stage may promote (bf16 batch
+    # through f32 params -> f32 activations), and lax.scan requires a fixed
+    # carry dtype — resolve the promotion once, outside the trace.
+    one_stage = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(p.shape[1:], p.dtype), stacked_params)
+    mb_in = jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype)
+    act_dtype = jnp.result_type(
+        x.dtype, jax.eval_shape(stage_fn, one_stage, mb_in).dtype)
+
+    def inner(params, x):
+        # shard_map hands each device a leading pipe-dim of 1 — drop it.
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = lax.axis_index(axis)
+        is_first = idx == 0
+        is_last = idx == n_stages - 1
+        mbs = x.reshape(num_microbatches, mb, *x.shape[1:])
+
+        shift_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        def tick(carry, t):
+            state, buf = carry
+            # Stage 0 injects microbatch t (clamped repeat once drained —
+            # its outputs past t==M-1 never land in ``buf``); later stages
+            # consume what arrived over the ring last tick.
+            feed = mbs[jnp.clip(t, 0, num_microbatches - 1)]
+            inp = jnp.where(is_first, feed.astype(act_dtype), state)
+            out = stage_fn(params, inp).astype(act_dtype)
+            # The last stage banks microbatch ``t - (n_stages-1)`` once the
+            # pipeline has filled; O(1) slot-sized select, not a full-buffer
+            # copy.
+            slot = t - (n_stages - 1)
+            write = is_last & (slot >= 0)
+            slot_c = jnp.clip(slot, 0, num_microbatches - 1)
+            buf = buf.at[slot_c].set(jnp.where(write, out, buf[slot_c]))
+            state = lax.ppermute(out, axis, shift_perm)
+            return (state, buf), None
+
+        state0 = jnp.zeros((mb, *x.shape[1:]), act_dtype)
+        buf0 = jnp.zeros((num_microbatches, mb, *x.shape[1:]), act_dtype)
+        (_, buf), _ = lax.scan(tick, (state0, buf0), jnp.arange(n_ticks))
+        # Broadcast the last stage's result to every stage (masked psum) so
+        # the caller sees a pipe-replicated output.
+        out = lax.psum(jnp.where(is_last, buf, 0.0), axis)
+        return out.reshape(x.shape[0], *x.shape[1:])
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis), stacked_params), P()),
+        out_specs=P(),
+        axis_names=frozenset({axis}),
+        check_vma=False)(stacked_params, x)
