@@ -1,0 +1,164 @@
+"""Mixture-of-Experts FFN with expert parallelism over an ``expert`` axis.
+
+The reference has no routing/expert code (SURVEY.md §2c EP row: NO); this
+supplies expert parallelism TPU-natively so the full dp/fsdp/tp/sp/pp/ep
+axis set of ``parallel.mesh.AXIS_ORDER`` is covered.
+
+TPU-first design (GShard/Switch style, not a port):
+  * routing, dispatch and combine are dense einsums over one-hot
+    capacity-slot masks — static shapes, MXU-friendly, no gather/scatter or
+    data-dependent control flow, so the whole layer jits into one XLA
+    program;
+  * expert weights carry a leading ``num_experts`` dim sharded
+    ``P('expert')``; with tokens sharded over ``data``, XLA lowers the
+    dispatch/combine einsums to ``all_to_all`` over ICI automatically — the
+    collective is implied by shardings, never hand-written;
+  * over-capacity tokens are dropped (output zeros) — callers add the
+    residual connection so dropped tokens degrade to identity, the standard
+    MoE-transformer contract.
+
+``aux_loss`` (Switch load-balancing: E * Σ_e f_e·P_e, =1.0 at perfect
+balance) and ``router_z_loss`` must be added to the training loss by the
+caller to keep routing healthy.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import activations as act_lib
+from . import initializers as init_lib
+
+__all__ = ["init_moe", "apply_moe", "moe_partition_rules"]
+
+
+def init_moe(key, d_model: int, d_ff: int, num_experts: int,
+             param_dtype=jnp.float32) -> Dict[str, Any]:
+    """Router + a bank of ``num_experts`` two-matmul FFNs (leading E dim)."""
+    k_r, k_in, k_out = jax.random.split(key, 3)
+    glorot = init_lib.get("glorot_uniform")
+    w_in = jnp.stack([
+        glorot(k, (d_model, d_ff), param_dtype)
+        for k in jax.random.split(k_in, num_experts)])
+    w_out = jnp.stack([
+        glorot(k, (d_ff, d_model), param_dtype)
+        for k in jax.random.split(k_out, num_experts)])
+    return {
+        "router": {"kernel": glorot(k_r, (d_model, num_experts), param_dtype)},
+        "experts": {
+            "w_in": w_in,                                   # [E, D, F]
+            "b_in": jnp.zeros((num_experts, d_ff), param_dtype),
+            "w_out": w_out,                                 # [E, F, D]
+            "b_out": jnp.zeros((num_experts, d_model), param_dtype),
+        },
+    }
+
+
+def moe_partition_rules():
+    """(regex, PartitionSpec) rows for ``parallel.PartitionRules``: experts
+    sharded over ``expert``, the FFN hidden dim optionally over ``tensor``,
+    router replicated."""
+    return [
+        (r"experts/w_in$", P("expert", None, "tensor")),
+        (r"experts/b_in$", P("expert", "tensor")),
+        (r"experts/w_out$", P("expert", "tensor", None)),
+        (r"experts/b_out$", P("expert", None)),
+        (r"router/", P()),
+    ]
+
+
+def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
+    """One-hot capacity-slot dispatch/combine tensors from router probs.
+
+    probs: [T, E].  Returns (dispatch [T, E, C] bool-ish float,
+    combine [T, E, C] float, top1_mask [T, E]).
+    Iterative arg-max (k is 1 or 2 in practice): choice i masks out the
+    experts already taken, then tokens claim capacity slots in token order
+    via a cumsum — all static-shape, no sort network needed.
+    """
+    t, e = probs.shape
+    remaining = probs
+    fill = jnp.zeros((e,), jnp.int32)          # slots already used per expert
+    dispatch = jnp.zeros((t, e, capacity), probs.dtype)
+    combine = jnp.zeros((t, e, capacity), probs.dtype)
+    top1_mask = None
+    gate_sum = jnp.zeros((t,), probs.dtype)
+
+    for i in range(k):
+        idx = jnp.argmax(remaining, axis=-1)               # [T]
+        mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)   # [T, E]
+        if i == 0:
+            top1_mask = mask
+        gate = jnp.sum(probs * mask, axis=-1)              # [T]
+        # Position of each token within its chosen expert's capacity.
+        pos = (jnp.cumsum(mask, axis=0) - 1) * mask + fill[None, :] * mask
+        pos_tok = jnp.sum(pos, axis=-1).astype(jnp.int32)  # [T]
+        keep = (pos_tok < capacity) & (jnp.max(mask, axis=-1) > 0)
+        slot = jax.nn.one_hot(pos_tok, capacity,
+                              dtype=probs.dtype)           # [T, C]
+        assign = (mask[:, :, None] * slot[:, None, :]
+                  * keep[:, None, None].astype(probs.dtype))
+        dispatch = dispatch + assign
+        combine = combine + assign * gate[:, None, None]
+        gate_sum = gate_sum + gate * keep.astype(probs.dtype)
+        fill = fill + jnp.sum(assign, axis=(0, 2)).astype(jnp.int32)
+        remaining = remaining * (1.0 - mask)
+
+    # Normalize combine weights over the (kept) top-k gates per token.
+    combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+    return dispatch, combine, top1_mask
+
+
+def apply_moe(params: Dict[str, Any], x: jnp.ndarray, *, k: int = 2,
+              capacity_factor: float = 1.25,
+              capacity: Optional[int] = None,
+              activation="gelu", train: bool = False, rng=None,
+              jitter: float = 1e-2) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """x: [..., d_model] -> (y [..., d_model], metrics).
+
+    ``metrics['aux_loss']`` / ``metrics['router_z_loss']`` are scalars the
+    caller adds to the loss (weighted ~1e-2 / ~1e-3).  Dropped (over-
+    capacity) tokens return zeros — add the residual outside.
+    ``jitter``: multiplicative router-input noise when ``train`` and ``rng``.
+    """
+    act = act_lib.get(activation)
+    *lead, d = x.shape
+    tokens = x.reshape(-1, d)
+    t = tokens.shape[0]
+    e = params["experts"]["w_in"].shape[0]
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * k * t / e))
+
+    router_in = tokens
+    if train and rng is not None and jitter > 0:
+        router_in = tokens * jax.random.uniform(
+            rng, tokens.shape, tokens.dtype, 1.0 - jitter, 1.0 + jitter)
+    logits = router_in @ params["router"]["kernel"].astype(x.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    dispatch, combine, top1 = _top_k_dispatch(probs, k, capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+
+    ex = params["experts"]
+    # [T,E,C] x [T,D] -> [E,C,D]: the all_to_all boundary under sharding.
+    staged = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    h = act(jnp.einsum("ecd,edf->ecf", staged, ex["w_in"].astype(x.dtype))
+            + ex["b_in"].astype(x.dtype)[:, None, :])
+    out_e = (jnp.einsum("ecf,efd->ecd", h, ex["w_out"].astype(x.dtype))
+             + ex["b_out"].astype(x.dtype)[:, None, :])
+    y = jnp.einsum("tec,ecd->td", combine, out_e)
+
+    frac_tokens = jnp.mean(top1, axis=0)                   # f_e
+    mean_probs = jnp.mean(probs, axis=0)                   # P_e
+    aux_loss = e * jnp.sum(frac_tokens * mean_probs)
+    z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    metrics = {
+        "aux_loss": aux_loss.astype(jnp.float32),
+        "router_z_loss": jnp.mean(z ** 2),
+        "dropped_fraction": 1.0 - jnp.sum(dispatch) / (k * t),
+    }
+    return y.reshape(*lead, d), metrics

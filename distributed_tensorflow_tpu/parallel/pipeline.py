@@ -64,6 +64,12 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     ``jax.grad`` through this IS the backward pipeline.
     """
     n_stages = mesh.shape[axis]
+    leading = {p.shape[0] for p in jax.tree.leaves(stacked_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stacked params have leading dim(s) {sorted(leading)} but the "
+            f"'{axis}' mesh axis has {n_stages} stages — shard_map would "
+            "silently drop stages")
     if x.shape[0] % num_microbatches:
         raise ValueError(
             f"batch {x.shape[0]} not divisible by {num_microbatches} "

@@ -110,3 +110,12 @@ def test_pipeline_mixed_precision_carry():
     ref = _sequential(stages, x.astype(jnp.float32))
     assert out.dtype == jnp.float32
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-2)
+
+
+def test_pipeline_stage_count_mismatch_raises():
+    import pytest
+    mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+    stacked = stack_pipeline_params(_stages(8))
+    x = jnp.zeros((8, HID))
+    with pytest.raises(ValueError, match="drop stages"):
+        pipeline_apply(_stage_fn, stacked, x, mesh, num_microbatches=2)
