@@ -55,7 +55,8 @@ def bench_framework():
     bsh = NamedSharding(mesh, P("data"))
 
     batch = parallel.round_batch_to_mesh(BATCH, mesh)
-    ds = data.Dataset([xt, yt], batch, seed=0)
+    # backend="auto": the native C++ threaded gather loader when built.
+    ds = data.Dataset([xt, yt], batch, seed=0, backend="auto")
 
     # Convergence gate: a couple of epochs must clear 0.9 eval accuracy.
     for b in ds.epochs(2):

@@ -25,12 +25,22 @@ __all__ = ["Dataset", "prefetch_to_device"]
 
 
 class Dataset:
-    """In-memory (x, y) dataset with shuffled minibatch iteration."""
+    """In-memory (x, y) dataset with shuffled minibatch iteration.
+
+    ``backend``: ``"numpy"`` (default) is the portable pure-Python path with
+    the documented (seed, epoch) numpy shuffle stream — same batches on every
+    machine.  ``"auto"`` opts into the native C++ threaded gather loader
+    (``utils.native.NativeLoader``) when the library is available and the
+    dataset shape fits it (1–2 arrays, full batches), falling back to numpy
+    otherwise — NOTE its shuffle stream differs from numpy's, so same-seed
+    runs are only reproducible within one backend.  ``"native"`` requires
+    the native path (raises if unavailable).
+    """
 
     def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
                  shuffle: bool = True, drop_remainder: bool = True,
                  seed: int = 0, process_index: int = 0,
-                 process_count: int = 1):
+                 process_count: int = 1, backend: str = "numpy"):
         n = arrays[0].shape[0]
         for a in arrays:
             if a.shape[0] != n:
@@ -49,6 +59,18 @@ class Dataset:
         self.drop_remainder = drop_remainder
         self.seed = seed
         self.epoch = 0
+        if backend not in ("auto", "native", "numpy"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        if backend == "native" and not self._native_usable():
+            raise RuntimeError(
+                "backend='native' but the native loader is unavailable or "
+                "the dataset shape does not fit it")
+
+    def _native_usable(self) -> bool:
+        from ..utils import native
+        return (len(self.arrays) in (1, 2) and self.drop_remainder
+                and self.n >= self.batch_size and native.native_available())
 
     @property
     def batches_per_epoch(self) -> int:
@@ -60,6 +82,9 @@ class Dataset:
         return self.batches_per_epoch
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        if self.backend != "numpy" and self._native_usable():
+            yield from self._iter_native()
+            return
         if self.shuffle:
             rng = np.random.default_rng((self.seed, self.epoch))
             order = rng.permutation(self.n)
@@ -71,6 +96,23 @@ class Dataset:
         for lo in range(0, stop, self.batch_size):
             idx = order[lo:lo + self.batch_size]
             yield tuple(a[idx] for a in self.arrays)
+
+    def _iter_native(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        """One epoch through the C++ threaded gather loader; a fresh loader
+        per epoch with a seed fold-in keeps the per-epoch reshuffle contract
+        of the numpy path (and makes partial epoch consumption safe)."""
+        from ..utils import native
+        x = self.arrays[0]
+        y = self.arrays[1] if len(self.arrays) == 2 else None
+        seed = (self.seed * 1_000_003 + self.epoch) & 0xFFFFFFFFFFFFFFFF
+        self.epoch += 1
+        loader = native.NativeLoader(x, y, self.batch_size, seed=seed,
+                                     shuffle=self.shuffle)
+        try:
+            for _ in range(loader.batches_per_epoch):
+                yield loader.next()
+        finally:
+            loader.close()
 
     def epochs(self, num_epochs: int) -> Iterator[Tuple[np.ndarray, ...]]:
         for _ in range(num_epochs):

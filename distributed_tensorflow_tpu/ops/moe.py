@@ -115,9 +115,17 @@ def _top_k_dispatch(probs: jnp.ndarray, k: int, capacity: int):
 def apply_moe(params: Dict[str, Any], x: jnp.ndarray, *, k: int = 2,
               capacity_factor: float = 1.25,
               capacity: Optional[int] = None,
+              group_size: Optional[int] = None,
               activation="gelu", train: bool = False, rng=None,
               jitter: float = 1e-2) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """x: [..., d_model] -> (y [..., d_model], metrics).
+
+    Routing is GROUPED (GShard style): tokens are split into fixed-size
+    groups and each group routes into its own per-expert capacity slots, so
+    the dispatch/combine tensors are [G, S, E, C] with C ∝ S — linear in
+    total tokens, never O(T²).  Default grouping: the leading (batch) dim
+    when ``x`` has ≥3 dims, one group otherwise; ``group_size`` overrides
+    (must divide the token count).  ``capacity`` is per group per expert.
 
     ``metrics['aux_loss']`` / ``metrics['router_z_loss']`` are scalars the
     caller adds to the loss (weighted ~1e-2 / ~1e-3).  Dropped (over-
@@ -129,31 +137,41 @@ def apply_moe(params: Dict[str, Any], x: jnp.ndarray, *, k: int = 2,
     tokens = x.reshape(-1, d)
     t = tokens.shape[0]
     e = params["experts"]["w_in"].shape[0]
-    if capacity is None:
-        capacity = max(1, int(capacity_factor * k * t / e))
 
-    router_in = tokens
+    if group_size is None:
+        group_size = t // x.shape[0] if x.ndim >= 3 else t
+    if t % group_size:
+        raise ValueError(f"group_size {group_size} does not divide token "
+                         f"count {t}")
+    tok = tokens.reshape(-1, group_size, d)                # [G, S, D]
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * k * group_size / e))
+
+    router_in = tok
     if train and rng is not None and jitter > 0:
-        router_in = tokens * jax.random.uniform(
-            rng, tokens.shape, tokens.dtype, 1.0 - jitter, 1.0 + jitter)
-    logits = router_in @ params["router"]["kernel"].astype(x.dtype)
+        router_in = tok * jax.random.uniform(
+            rng, tok.shape, tok.dtype, 1.0 - jitter, 1.0 + jitter)
+    logits = jnp.einsum("gsd,de->gse", router_in,
+                        params["router"]["kernel"].astype(x.dtype))
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
-    dispatch, combine, top1 = _top_k_dispatch(probs, k, capacity)
+    dispatch, combine, top1 = jax.vmap(
+        lambda p: _top_k_dispatch(p, k, capacity))(probs)  # [G,S,E,C] x2
     dispatch = dispatch.astype(x.dtype)
     combine = combine.astype(x.dtype)
 
     ex = params["experts"]
-    # [T,E,C] x [T,D] -> [E,C,D]: the all_to_all boundary under sharding.
-    staged = jnp.einsum("tec,td->ecd", dispatch, tokens)
-    h = act(jnp.einsum("ecd,edf->ecf", staged, ex["w_in"].astype(x.dtype))
-            + ex["b_in"].astype(x.dtype)[:, None, :])
-    out_e = (jnp.einsum("ecf,efd->ecd", h, ex["w_out"].astype(x.dtype))
-             + ex["b_out"].astype(x.dtype)[:, None, :])
-    y = jnp.einsum("tec,ecd->td", combine, out_e)
+    # [G,S,E,C] x [G,S,D] -> [G,E,C,D]: the all_to_all boundary under
+    # sharding (groups ride ``data``, experts ride ``expert``).
+    staged = jnp.einsum("gsec,gsd->gecd", dispatch, tok)
+    h = act(jnp.einsum("gecd,edf->gecf", staged, ex["w_in"].astype(x.dtype))
+            + ex["b_in"].astype(x.dtype)[None, :, None, :])
+    out_e = (jnp.einsum("gecf,efd->gecd", h, ex["w_out"].astype(x.dtype))
+             + ex["b_out"].astype(x.dtype)[None, :, None, :])
+    y = jnp.einsum("gsec,gecd->gsd", combine, out_e)
 
-    frac_tokens = jnp.mean(top1, axis=0)                   # f_e
-    mean_probs = jnp.mean(probs, axis=0)                   # P_e
+    frac_tokens = jnp.mean(top1, axis=(0, 1))              # f_e
+    mean_probs = jnp.mean(probs, axis=(0, 1))              # P_e
     aux_loss = e * jnp.sum(frac_tokens * mean_probs)
     z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
     metrics = {

@@ -1,13 +1,14 @@
-"""CRC32-C (Castagnoli) + TFRecord masking, dependency-free.
+"""CRC32-C (Castagnoli) + TFRecord masking.
 
 Needed for the TensorBoard event-file record framing (each record's length
-and payload carry a masked crc32c).  Table-driven pure Python; fast enough
-for scalar summaries (a few hundred bytes per step).  A C implementation in
-``native/`` can be slotted in later for bulk record IO.
+and payload carry a masked crc32c).  The native slice-by-8 implementation
+(``native/dttpu_native.cpp``, byte-identical output) is preferred for bulk
+record IO; the table-driven pure-Python version below is the always-available
+fallback and the cross-check oracle in tests.
 """
 from __future__ import annotations
 
-__all__ = ["crc32c", "masked_crc32c"]
+__all__ = ["crc32c", "masked_crc32c", "py_crc32c", "py_masked_crc32c"]
 
 _POLY = 0x82F63B78
 _TABLE = []
@@ -29,3 +30,17 @@ def masked_crc32c(data: bytes) -> int:
     """The TFRecord mask: rotate right 15 and add a constant."""
     crc = crc32c(data)
     return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+py_crc32c = crc32c
+py_masked_crc32c = masked_crc32c
+
+try:  # prefer the native implementation when it is ALREADY built — never
+    # run a compiler from an import path (build=False).
+    from ..utils import native as _native
+
+    if _native.native_available(build=False):
+        crc32c = _native.crc32c
+        masked_crc32c = _native.masked_crc32c
+except Exception:  # pragma: no cover — fallback stays bound
+    pass

@@ -1,3 +1,4 @@
-from . import flags
+from . import flags, native
+from .native import NativeLoader, native_available
 
-__all__ = ["flags"]
+__all__ = ["flags", "native", "NativeLoader", "native_available"]

@@ -1,0 +1,331 @@
+// Native runtime components for distributed_tensorflow_tpu.
+//
+// The reference's native layer lives inside the tensorflow==1.4.0 wheel
+// (C++ graph executor, gRPC runtime, Saver/record IO — SURVEY.md §2b); the
+// TPU compute path here is XLA, but the host-side runtime around it is
+// native C++ as well:
+//
+//   * crc32c (Castagnoli, slice-by-8) + the TFRecord mask — the framing
+//     checksum for TensorBoard event files / TFRecord IO, byte-identical to
+//     the pure-Python summary/crc32c.py implementation;
+//   * a vectorized XOR-task sample generator (the reference's get_data,
+//     example.py:24-48, built sample-by-sample in Python lists);
+//   * a threaded, double-buffered batch loader: per-epoch Fisher–Yates
+//     shuffle + row gather executed by worker threads into a bounded ring of
+//     pre-allocated pinned-ish buffers, so the Python training loop's
+//     next() is a memcpy away from an already-gathered batch (the
+//     feed_dict-era host stall moves off the hot path entirely).
+//
+// C ABI only — consumed from Python via ctypes (no pybind11 in the image).
+// Build: `make -C native` -> libdttpu.so.
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// crc32c (Castagnoli), slice-by-8.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint32_t g_tables[8][256];
+std::atomic<bool> g_tables_ready{false};
+std::mutex g_tables_mu;
+
+void init_tables() {
+  if (g_tables_ready.load(std::memory_order_acquire)) return;
+  std::lock_guard<std::mutex> lock(g_tables_mu);
+  if (g_tables_ready.load(std::memory_order_relaxed)) return;
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+    g_tables[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = g_tables[0][i];
+    for (int t = 1; t < 8; ++t) {
+      c = g_tables[0][c & 0xFF] ^ (c >> 8);
+      g_tables[t][i] = c;
+    }
+  }
+  g_tables_ready.store(true, std::memory_order_release);
+}
+
+}  // namespace
+
+extern "C" uint32_t dt_crc32c(const uint8_t* data, uint64_t len,
+                              uint32_t crc) {
+  init_tables();
+  crc ^= 0xFFFFFFFFu;
+  while (len >= 8) {
+    uint64_t word;
+    std::memcpy(&word, data, 8);
+    crc ^= static_cast<uint32_t>(word);
+    uint32_t hi = static_cast<uint32_t>(word >> 32);
+    crc = g_tables[7][crc & 0xFF] ^ g_tables[6][(crc >> 8) & 0xFF] ^
+          g_tables[5][(crc >> 16) & 0xFF] ^ g_tables[4][crc >> 24] ^
+          g_tables[3][hi & 0xFF] ^ g_tables[2][(hi >> 8) & 0xFF] ^
+          g_tables[1][(hi >> 16) & 0xFF] ^ g_tables[0][hi >> 24];
+    data += 8;
+    len -= 8;
+  }
+  while (len--) crc = g_tables[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+extern "C" uint32_t dt_masked_crc32c(const uint8_t* data, uint64_t len) {
+  uint32_t crc = dt_crc32c(data, len, 0);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+// ---------------------------------------------------------------------------
+// PRNG: splitmix64 (seeding) + xoshiro256** (stream).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+struct Xoshiro {
+  uint64_t s[4];
+  explicit Xoshiro(uint64_t seed) {
+    for (auto& w : s) w = splitmix64(seed);
+  }
+  static uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+  uint64_t next() {
+    uint64_t result = rotl(s[1] * 5, 7) * 9;
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0]; s[3] ^= s[1]; s[1] ^= s[2]; s[0] ^= s[3]; s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+  // Unbiased bounded draw (Lemire).
+  uint64_t bounded(uint64_t n) {
+    unsigned __int128 m = static_cast<unsigned __int128>(next()) * n;
+    uint64_t lo = static_cast<uint64_t>(m);
+    if (lo < n) {
+      uint64_t thresh = (0 - n) % n;
+      while (lo < thresh) {
+        m = static_cast<unsigned __int128>(next()) * n;
+        lo = static_cast<uint64_t>(m);
+      }
+    }
+    return static_cast<uint64_t>(m >> 64);
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// XOR-task generator: x [n, 2*bits] in {0,1}, y = x_lo ^ x_hi [n, bits].
+// ---------------------------------------------------------------------------
+
+// The RNG stream is derived from the fixed-size block index, NOT the thread
+// id, so the output is identical regardless of the machine's core count —
+// multi-host jobs that generate "the same" dataset per process and slice it
+// by process_index must see byte-identical rows everywhere.
+static const int64_t kXorBlock = 4096;
+
+extern "C" void dt_xor_generate(uint64_t seed, int64_t n, int32_t bits,
+                                float* x, float* y) {
+  int64_t nblocks = (n + kXorBlock - 1) / kXorBlock;
+  int64_t nthreads = std::max<int64_t>(
+      1, std::min<int64_t>(std::thread::hardware_concurrency(), nblocks));
+  std::vector<std::thread> pool;
+  std::atomic<int64_t> next_block{0};
+  for (int64_t t = 0; t < nthreads; ++t) {
+    pool.emplace_back([&, n, bits, seed]() {
+      for (;;) {
+        int64_t blk = next_block.fetch_add(1);
+        if (blk >= nblocks) return;
+        int64_t lo = blk * kXorBlock, hi = std::min(n, lo + kXorBlock);
+        uint64_t s = seed ^ (0x9E3779B97F4A7C15ull *
+                             static_cast<uint64_t>(blk + 1));
+        Xoshiro rng(s);
+        for (int64_t i = lo; i < hi; ++i) {
+          float* xr = x + i * 2 * bits;
+          float* yr = y + i * bits;
+          for (int32_t b = 0; b < 2 * bits; b += 64) {
+            uint64_t w = rng.next();
+            int32_t take = std::min(64, 2 * bits - b);
+            for (int32_t j = 0; j < take; ++j)
+              xr[b + j] = static_cast<float>((w >> j) & 1);
+          }
+          for (int32_t j = 0; j < bits; ++j)
+            yr[j] = static_cast<float>(
+                (static_cast<int>(xr[j]) ^ static_cast<int>(xr[bits + j])));
+        }
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// Threaded batch loader.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Slot {
+  std::vector<uint8_t> x, y;
+  int64_t batch_id = -1;   // which global batch occupies this slot
+  bool ready = false;
+};
+
+struct Loader {
+  const uint8_t* x;
+  const uint8_t* y;
+  int64_t xrow, yrow, n, batch, per_epoch;
+  uint64_t seed;
+  bool shuffle;
+
+  std::vector<Slot> slots;
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::atomic<int64_t> next_job{0};
+  int64_t next_consume = 0;
+  bool stop = false;
+
+  // Epoch permutations are built lazily, guarded by mu.
+  int64_t perm_epoch = -1;
+  std::vector<int64_t> perm;
+
+  void build_perm(int64_t epoch) {
+    perm.resize(n);
+    for (int64_t i = 0; i < n; ++i) perm[i] = i;
+    if (!shuffle) return;
+    uint64_t s = seed * 0x9E3779B97F4A7C15ull + static_cast<uint64_t>(epoch);
+    Xoshiro rng(s);
+    for (int64_t i = n - 1; i > 0; --i) {
+      int64_t j = static_cast<int64_t>(rng.bounded(i + 1));
+      std::swap(perm[i], perm[j]);
+    }
+  }
+
+  // Copy the permutation rows for global batch `job` while holding mu (the
+  // perm vector mutates across epochs), then gather outside the lock.
+  void run_worker() {
+    for (;;) {
+      int64_t job = next_job.fetch_add(1);
+      std::vector<int64_t> idx(batch);
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        if (stop) return;
+        int64_t epoch = job / per_epoch;
+        int64_t off = (job % per_epoch) * batch;
+        // Serialize epoch transitions: a worker may only build/read perm for
+        // `epoch` once all earlier batches have been *assigned* (they have —
+        // job ids are monotonic) and the perm is current.
+        while (!stop && perm_epoch != epoch) {
+          if (perm_epoch < epoch &&
+              next_consume >= std::min(job, epoch * per_epoch)) {
+            build_perm(epoch);
+            perm_epoch = epoch;
+            break;
+          }
+          cv_free.wait_for(lock, std::chrono::milliseconds(1));
+        }
+        if (stop) return;
+        for (int64_t i = 0; i < batch; ++i) idx[i] = perm[off + i];
+        // Wait for this job's ring slot to be free AND for the job to fit
+        // the in-flight window.  The window check prevents claim-jumping:
+        // without it a fast worker could claim slot (j % depth) for job
+        // j+depth while the slower worker holding job j is still at the
+        // epoch barrier — the consumer needs j on that slot first, and all
+        // three would wait on each other forever.
+        Slot& s = slots[job % slots.size()];
+        while (!stop &&
+               (s.batch_id >= 0 ||
+                job - next_consume >= static_cast<int64_t>(slots.size())))
+          cv_free.wait(lock);
+        if (stop) return;
+        s.batch_id = job;  // claim
+      }
+      Slot& s = slots[job % slots.size()];
+      for (int64_t i = 0; i < batch; ++i) {
+        std::memcpy(s.x.data() + i * xrow, x + idx[i] * xrow, xrow);
+        if (y != nullptr)
+          std::memcpy(s.y.data() + i * yrow, y + idx[i] * yrow, yrow);
+      }
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        s.ready = true;
+      }
+      cv_ready.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+// Row sizes are in BYTES — the gather is dtype-agnostic memcpy, so any
+// fixed-width row layout (f32 features, i32 labels, ...) loads natively.
+extern "C" void* dt_loader_create(const uint8_t* x, int64_t xrow,
+                                  const uint8_t* y, int64_t yrow, int64_t n,
+                                  int64_t batch, uint64_t seed,
+                                  int32_t shuffle, int32_t num_threads,
+                                  int32_t queue_depth) {
+  if (batch <= 0 || n < batch) return nullptr;
+  auto* L = new Loader();
+  L->x = x; L->y = y; L->xrow = xrow; L->yrow = yrow;
+  L->n = n; L->batch = batch; L->per_epoch = n / batch;
+  L->seed = seed; L->shuffle = shuffle != 0;
+  if (num_threads <= 0) num_threads = 2;
+  if (queue_depth < num_threads + 1) queue_depth = num_threads + 1;
+  L->slots.resize(queue_depth);
+  for (auto& s : L->slots) {
+    s.x.resize(batch * xrow);
+    if (y != nullptr) s.y.resize(batch * yrow);
+  }
+  for (int32_t i = 0; i < num_threads; ++i)
+    L->workers.emplace_back([L] { L->run_worker(); });
+  return L;
+}
+
+extern "C" int64_t dt_loader_batches_per_epoch(void* h) {
+  return static_cast<Loader*>(h)->per_epoch;
+}
+
+// Blocks until the next in-order batch is gathered; copies it out.
+extern "C" void dt_loader_next(void* h, uint8_t* xout, uint8_t* yout) {
+  auto* L = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lock(L->mu);
+  int64_t want = L->next_consume;
+  Slot& s = L->slots[want % L->slots.size()];
+  L->cv_ready.wait(lock, [&] { return s.batch_id == want && s.ready; });
+  std::memcpy(xout, s.x.data(), L->batch * L->xrow);
+  if (yout != nullptr && L->y != nullptr)
+    std::memcpy(yout, s.y.data(), L->batch * L->yrow);
+  s.batch_id = -1;
+  s.ready = false;
+  L->next_consume = want + 1;
+  lock.unlock();
+  L->cv_free.notify_all();
+}
+
+extern "C" void dt_loader_destroy(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  {
+    std::lock_guard<std::mutex> lock(L->mu);
+    L->stop = true;
+  }
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}

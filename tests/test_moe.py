@@ -42,10 +42,25 @@ def test_tiny_capacity_drops_tokens_to_zero():
     params = init_moe(jax.random.PRNGKey(0), D, F, num_experts=4)
     x = _x(b=2, s=16)
     y, metrics = apply_moe(params, x, k=1, capacity=1)
-    # 32 tokens, 4 experts x 1 slot -> at most 4 kept.
-    assert float(metrics["dropped_fraction"]) >= 1.0 - 4.0 / 32.0 - 1e-6
+    # 2 groups x 16 tokens, 4 experts x 1 slot per group -> at most 8 kept.
+    assert float(metrics["dropped_fraction"]) >= 1.0 - 8.0 / 32.0 - 1e-6
     tok_norms = np.linalg.norm(np.asarray(y).reshape(-1, D), axis=-1)
-    assert (tok_norms == 0).sum() >= 28
+    assert (tok_norms == 0).sum() >= 24
+
+
+def test_group_size_linear_capacity():
+    """Dispatch stays [G,S,E,C] with C ∝ group size, not total tokens, and
+    explicit group_size matches default-grouped routing."""
+    params = init_moe(jax.random.PRNGKey(0), D, F, num_experts=4)
+    x = _x(b=4, s=8)
+    y_default, _ = apply_moe(params, x, k=2, capacity_factor=2.0)
+    y_explicit, _ = apply_moe(params, x, k=2, capacity_factor=2.0,
+                              group_size=8)
+    np.testing.assert_allclose(np.asarray(y_default),
+                               np.asarray(y_explicit), atol=1e-6)
+    import pytest
+    with pytest.raises(ValueError, match="does not divide"):
+        apply_moe(params, x, group_size=7)
 
 
 def test_aux_loss_uniform_routing_is_one():
