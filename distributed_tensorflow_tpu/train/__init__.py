@@ -1,13 +1,15 @@
 """Training-session layer: state, compiled steps, hooks, checkpointing."""
 
 from . import checkpoint, hooks
-from .hooks import (CheckpointHook, Hook, LoggingHook, NaNHook, ProfilerHook,
-                    StopAtStepHook, SummaryHook)
+from .hooks import (CheckpointHook, Hook, LoggingHook, NaNHook,
+                    PreemptionHook, ProfilerHook, StopAtStepHook,
+                    SummaryHook, WatchdogHook)
 from .session import TrainSession, TrainState
 from .step import (init_train_state, make_custom_train_step, make_eval_step,
                    make_train_step)
 
 __all__ = ["checkpoint", "hooks", "CheckpointHook", "Hook", "LoggingHook",
-           "NaNHook", "ProfilerHook", "StopAtStepHook", "SummaryHook",
+           "NaNHook", "PreemptionHook", "ProfilerHook", "StopAtStepHook",
+           "SummaryHook", "WatchdogHook",
            "TrainSession", "TrainState", "init_train_state",
            "make_custom_train_step", "make_eval_step", "make_train_step"]

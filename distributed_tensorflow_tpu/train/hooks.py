@@ -23,7 +23,8 @@ from typing import Callable, Dict, Optional
 log = logging.getLogger(__name__)
 
 __all__ = ["Hook", "StopAtStepHook", "CheckpointHook", "SummaryHook",
-           "LoggingHook", "NaNHook", "ProfilerHook"]
+           "LoggingHook", "NaNHook", "ProfilerHook", "PreemptionHook",
+           "WatchdogHook"]
 
 
 class Hook:
@@ -37,7 +38,12 @@ class Hook:
         pass
 
     def end(self, session) -> None:
-        pass
+        """Clean-exit work (flushes, final saves) — NOT run if an exception
+        escapes the session; put unconditional cleanup in ``close``."""
+
+    def close(self, session) -> None:
+        """Unconditional cleanup (restore signal handlers, stop threads) —
+        runs in a ``finally`` on every session exit, clean or not."""
 
 
 class StopAtStepHook(Hook):
@@ -94,7 +100,11 @@ class CheckpointHook(Hook):
             self._last_step = session.step
 
     def end(self, session) -> None:
-        if self.save_at_end and session.step != (self._last_step or -1):
+        # Skip if the session already holds a save at this exact step (e.g.
+        # PreemptionHook saved inside the grace window — don't double the
+        # checkpoint I/O right when time is shortest).
+        if (self.save_at_end and session.step != (self._last_step or -1)
+                and getattr(session, "last_saved_step", None) != session.step):
             session.save()
 
 
@@ -207,11 +217,135 @@ class ProfilerHook(Hook):
             jax.profiler.stop_trace()
             self._active = False
 
-    def end(self, session) -> None:
+    def close(self, session) -> None:
+        # close, not end: a trace left running after an exception would leak.
         import jax
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+
+
+class PreemptionHook(Hook):
+    """Preemption-aware save+stop (SURVEY.md §5 failure-detection row).
+
+    The reference's only recovery story is MTS restore-on-restart
+    (reference example.py:189-192); Cloud TPU preemptions additionally give
+    a SIGTERM grace window.  This hook catches the signal, lets the
+    in-flight step finish, writes a final checkpoint (chief-only via
+    ``session.save``), and requests a clean stop so the next run
+    auto-restores from the exact preemption step instead of the last
+    periodic save.
+
+    Multi-host: assumes WHOLE-SLICE preemption (every process receives
+    SIGTERM, the Cloud TPU maintenance/preemption default), so all
+    processes stop at the same step.  If only a subset of hosts can be
+    signalled, pass ``sync_fn`` — e.g. a psum of the flag — so the stop
+    decision is agreed cross-host; otherwise the surviving hosts would
+    block in the next step's collective.
+    """
+
+    def __init__(self, signals=None, save: bool = True,
+                 sync_fn: Optional[Callable[[bool], bool]] = None):
+        import signal as signal_mod
+        self.signals = (tuple(signals) if signals is not None
+                        else (signal_mod.SIGTERM,))
+        self.save = save
+        self.sync_fn = sync_fn
+        self.triggered = False
+        self._prev = {}
+
+    def _on_signal(self, signum, frame):
+        del frame
+        log.warning("received signal %s — will checkpoint and stop after "
+                    "the current step", signum)
+        self.triggered = True
+
+    def begin(self, session) -> None:
+        import signal as signal_mod
+        self.triggered = False
+        for sig in self.signals:
+            self._prev[sig] = signal_mod.signal(sig, self._on_signal)
+
+    def after_step(self, session, metrics) -> None:
+        triggered = (self.sync_fn(self.triggered) if self.sync_fn
+                     else self.triggered)
+        if triggered and not session.should_stop():
+            if self.save:
+                session.save()
+            session.request_stop()
+
+    def close(self, session) -> None:
+        import signal as signal_mod
+        for sig, prev in self._prev.items():
+            try:
+                signal_mod.signal(sig, prev)
+            except Exception:  # pragma: no cover
+                pass
+        self._prev.clear()
+
+
+class WatchdogHook(Hook):
+    """Failure detection for hung steps (stuck collectives, host stalls).
+
+    A multi-host collective waits forever if one participant dies; nothing
+    in-band ever returns.  A daemon thread watches the time since the last
+    completed step and fires ``on_stall(session, elapsed)`` once the
+    ``timeout_secs`` budget is exceeded — default action logs an error and
+    dumps all thread stacks (faulthandler) so the operator sees WHERE the
+    program is wedged.  Detection only; recovery is restart-from-checkpoint
+    (SURVEY.md §5: collectives are all-or-nothing).
+    """
+
+    def __init__(self, timeout_secs: float = 600.0,
+                 on_stall: Optional[Callable] = None,
+                 poll_secs: Optional[float] = None):
+        self.timeout_secs = timeout_secs
+        self.on_stall = on_stall or self._default_on_stall
+        self.poll_secs = poll_secs or min(10.0, timeout_secs / 4)
+        self._last = None
+        self._thread = None
+        self._stop_evt = None
+        self.stall_count = 0
+
+    @staticmethod
+    def _default_on_stall(session, elapsed):
+        # Dump stacks FIRST and never touch session.step here: reading it
+        # pulls a (possibly in-flight) device array, and on a genuinely hung
+        # collective that read would wedge the watchdog thread too.
+        import faulthandler
+        import sys
+        faulthandler.dump_traceback(file=sys.stderr)
+        log.error("no step completed in %.1fs — possible hung collective; "
+                  "stacks dumped above", elapsed)
+
+    def begin(self, session) -> None:
+        import threading
+        self._last = time.time()
+        self._stop_evt = threading.Event()
+
+        def watch():
+            fired_at = None
+            while not self._stop_evt.wait(self.poll_secs):
+                elapsed = time.time() - self._last
+                if elapsed > self.timeout_secs and fired_at != self._last:
+                    fired_at = self._last  # once per stall
+                    self.stall_count += 1
+                    try:
+                        self.on_stall(session, elapsed)
+                    except Exception:  # pragma: no cover
+                        log.exception("watchdog on_stall raised")
+
+        self._thread = threading.Thread(target=watch, daemon=True,
+                                        name="train-watchdog")
+        self._thread.start()
+
+    def after_step(self, session, metrics) -> None:
+        self._last = time.time()
+
+    def close(self, session) -> None:
+        if self._stop_evt is not None:
+            self._stop_evt.set()
+            self._thread.join(timeout=5)
 
 
 def _is_scalar(v) -> bool:

@@ -82,6 +82,7 @@ class TrainSession:
         self.hooks = list(hooks)
         self.is_chief = cluster.is_chief() if is_chief is None else is_chief
         self.max_to_keep = max_to_keep
+        self.last_saved_step = None
         self._stop = False
         self._entered = False
 
@@ -122,6 +123,7 @@ class TrainSession:
             return None
         path = ckpt_lib.save(self.checkpoint_dir, self.step, self.state,
                              max_to_keep=self.max_to_keep)
+        self.last_saved_step = self.step
         log.info("saved checkpoint %s", path)
         return path
 
@@ -136,11 +138,21 @@ class TrainSession:
         # On clean exit run end-hooks (summary flush etc.), then make sure a
         # final checkpoint exists — MTS saves on close whenever a
         # checkpoint_dir was given (reference example.py:191), with or
-        # without an explicit CheckpointHook.
-        if exc_type is None:
+        # without an explicit CheckpointHook.  Cleanup hooks (``close``:
+        # signal handlers, watchdog threads, profiler traces) run
+        # UNCONDITIONALLY — an exception must not leave a dead session's
+        # SIGTERM handler installed or a watchdog thread polling.
+        try:
+            if exc_type is None:
+                for hook in self.hooks:
+                    hook.end(self)
+                if (self.checkpoint_dir and self.is_chief and
+                        ckpt_lib.latest_step(self.checkpoint_dir) != self.step):
+                    self.save()
+        finally:
             for hook in self.hooks:
-                hook.end(self)
-            if (self.checkpoint_dir and self.is_chief and
-                    ckpt_lib.latest_step(self.checkpoint_dir) != self.step):
-                self.save()
-        self._entered = False
+                try:
+                    hook.close(self)
+                except Exception:  # pragma: no cover
+                    log.exception("hook %r close() raised", hook)
+            self._entered = False

@@ -169,7 +169,8 @@ def main() -> int:
     last_step = FLAGS.epochs * total_batch
     writer = SummaryWriter(FLAGS.log_dir) if is_chief else None
     hooks = [train.StopAtStepHook(last_step=last_step),
-             train.CheckpointHook(every_secs=60.0)]
+             train.CheckpointHook(every_secs=60.0),
+             train.PreemptionHook()]
     if writer is not None:
         hooks.append(train.SummaryHook(
             writer, every_steps=max(1, total_batch // 60),

@@ -73,7 +73,8 @@ def main() -> int:
     writer = SummaryWriter(FLAGS.log_dir) if is_chief else None
     hooks = [train.StopAtStepHook(last_step=FLAGS.epochs * len(dataset)),
              train.CheckpointHook(every_secs=120.0),
-             train.LoggingHook(every_steps=max(10, len(dataset) // 2))]
+             train.LoggingHook(every_steps=max(10, len(dataset) // 2)),
+             train.PreemptionHook()]
     if writer is not None:
         hooks.append(train.SummaryHook(writer, every_steps=10))
 

@@ -1,0 +1,140 @@
+"""Failure-detection / preemption tests (SURVEY.md §5 failure row)."""
+import os
+import signal
+import time
+
+import jax
+
+from distributed_tensorflow_tpu import data, ops, optim, train
+
+
+def make_bits():
+    model = ops.serial(ops.Dense(16, "relu"), ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    step = train.make_train_step(model, "mse", opt)
+    (xt, yt), _ = data.xor_data(500, val_size=10, seed=0)
+    ds = data.Dataset([xt, yt], 50, seed=0)
+    return state, step, ds
+
+
+def test_preemption_saves_and_stops(tmp_path):
+    """SIGTERM mid-loop: finish the step, write a checkpoint at the exact
+    preemption step, stop cleanly, auto-restore on the next session."""
+    state, step, ds = make_bits()
+    d = str(tmp_path)
+
+    class KillAtStep(train.Hook):
+        def after_step(self, session, metrics):
+            if session.step == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    hooks = [KillAtStep(), train.PreemptionHook(),
+             train.StopAtStepHook(last_step=1000)]
+    with train.TrainSession(state, step, checkpoint_dir=d,
+                            hooks=hooks) as sess:
+        it = iter(ds.epochs(100))
+        n = 0
+        while not sess.should_stop() and n < 100:
+            sess.run_step(next(it))
+            n += 1
+    # KillAtStep fires inside step 3's hook phase; PreemptionHook (later in
+    # the list) sees the flag in the same step's after_step.
+    assert sess.step == 3
+    assert train.checkpoint.latest_step(d) == 3
+    # the exact pre-session handler was restored on exit
+    assert signal.getsignal(signal.SIGTERM) == prev_handler
+
+    state2, step2, _ = make_bits()
+    with train.TrainSession(state2, step2, checkpoint_dir=d,
+                            hooks=[train.StopAtStepHook(last_step=5)]) as s2:
+        assert s2.step == 3  # auto-restored from the preemption save
+
+
+def test_preemption_without_save(tmp_path):
+    state, step, ds = make_bits()
+
+    class KillNow(train.Hook):
+        def after_step(self, session, metrics):
+            if session.step == 1:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    hooks = [KillNow(), train.PreemptionHook(save=False)]
+    with train.TrainSession(state, step, hooks=hooks) as sess:
+        it = iter(ds.epochs(100))
+        while not sess.should_stop():
+            sess.run_step(next(it))
+    assert sess.step == 1
+    assert train.checkpoint.latest_checkpoint(str(tmp_path)) is None
+
+
+def test_watchdog_fires_on_stall_and_not_on_progress():
+    state, step, ds = make_bits()
+    # Warm the jit cache so in-session steps are fast relative to the
+    # watchdog budget (first-compile would legitimately count as a stall).
+    state, _ = step(state, next(iter(ds)))
+    fired = []
+
+    wd = train.WatchdogHook(timeout_secs=0.3, poll_secs=0.05,
+                            on_stall=lambda s, e: fired.append(e))
+    hooks = [wd, train.StopAtStepHook(last_step=4)]
+    with train.TrainSession(state, step, hooks=hooks) as sess:
+        it = iter(ds.epochs(100))
+        while not sess.should_stop():
+            sess.run_step(next(it))
+        assert fired == []          # steady progress: no stall
+        time.sleep(0.6)             # simulated hang (no steps completing)
+        assert wd.stall_count == 1 and len(fired) == 1
+        time.sleep(0.4)             # same stall: fires only once
+        assert wd.stall_count == 1
+    # watchdog thread stopped at session exit
+    assert not wd._thread.is_alive()
+
+
+def test_cleanup_hooks_run_on_exception():
+    """close() must run even when the loop raises: the SIGTERM handler is
+    restored and the watchdog thread stops (regression: end() was skipped on
+    exception, leaving a dead session's handler installed forever)."""
+    import pytest
+    state, step, ds = make_bits()
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    pre = train.PreemptionHook()
+    wd = train.WatchdogHook(timeout_secs=60.0)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        with train.TrainSession(state, step, hooks=[pre, wd]) as sess:
+            sess.run_step(next(iter(ds)))
+            raise RuntimeError("boom")
+    assert signal.getsignal(signal.SIGTERM) == prev_handler
+    wd._thread.join(timeout=5)
+    assert not wd._thread.is_alive()
+
+
+def test_preemption_save_not_duplicated_by_checkpoint_hook(tmp_path, monkeypatch):
+    """SIGTERM at step N with a CheckpointHook installed: exactly one save
+    at N (PreemptionHook's), not a second identical one at exit."""
+    state, step, ds = make_bits()
+    d = str(tmp_path)
+    saves = []
+    orig = train.checkpoint.save
+
+    def counting_save(directory, step_, state_, **kw):
+        saves.append(step_)
+        return orig(directory, step_, state_, **kw)
+
+    monkeypatch.setattr(train.checkpoint, "save", counting_save)
+
+    class KillNow(train.Hook):
+        def after_step(self, session, metrics):
+            if session.step == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    hooks = [KillNow(), train.PreemptionHook(),
+             train.CheckpointHook(every_secs=9999.0)]
+    with train.TrainSession(state, step, checkpoint_dir=d,
+                            hooks=hooks) as sess:
+        it = iter(ds.epochs(100))
+        while not sess.should_stop():
+            sess.run_step(next(it))
+    assert saves == [2]
