@@ -6,10 +6,10 @@ from .hooks import (CheckpointHook, Hook, LoggingHook, NaNHook,
                     SummaryHook, WatchdogHook)
 from .session import TrainSession, TrainState
 from .step import (init_train_state, make_custom_train_step, make_eval_step,
-                   make_train_step)
+                   make_multi_train_step, make_train_step)
 
 __all__ = ["checkpoint", "hooks", "CheckpointHook", "Hook", "LoggingHook",
            "NaNHook", "PreemptionHook", "ProfilerHook", "StopAtStepHook",
            "SummaryHook", "WatchdogHook",
-           "TrainSession", "TrainState", "init_train_state",
+           "TrainSession", "TrainState", "init_train_state", "make_multi_train_step",
            "make_custom_train_step", "make_eval_step", "make_train_step"]

@@ -1,4 +1,6 @@
-from . import flags, native
+from . import flags, native, paths
 from .native import NativeLoader, native_available
+from .paths import get_data_path, get_logs_path
 
-__all__ = ["flags", "native", "NativeLoader", "native_available"]
+__all__ = ["flags", "native", "paths", "NativeLoader", "native_available",
+           "get_data_path", "get_logs_path"]

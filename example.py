@@ -27,6 +27,7 @@ set this runs single-machine, exactly like the reference.
 import os
 import sys
 
+from distributed_tensorflow_tpu import utils
 from distributed_tensorflow_tpu.utils import flags as flags_lib
 from distributed_tensorflow_tpu.utils.flags import FLAGS
 
@@ -63,11 +64,16 @@ flags_lib.DEFINE_integer(
 flags_lib.DEFINE_string(
     "worker_hosts", flags_lib.env_default("WORKER_HOSTS", None),
     "Legacy comma-separated worker list; first host becomes coordinator")
+# Local-vs-cloud defaults via the clusterone-helper analogue (reference
+# example.py:83-102): DTTPU_DATA_ROOT / DTTPU_LOGS_ROOT switch to managed
+# roots, else the local fallback.
 flags_lib.DEFINE_string(
-    "data_dir", os.environ.get("DATA_DIR", os.path.join("logs", "data")),
+    "data_dir", os.environ.get("DATA_DIR") or utils.get_data_path(
+        "xor", local_root=os.path.join("logs", "data"), local_repo="xor"),
     "Directory containing/receiving training data")
 flags_lib.DEFINE_string(
-    "log_dir", os.environ.get("LOG_DIR", os.path.join("logs", "xor")),
+    "log_dir", os.environ.get("LOG_DIR") or utils.get_logs_path(
+        os.path.join("logs", "xor")),
     "Directory for checkpoints and TensorBoard event files")
 flags_lib.DEFINE_string(
     "device", "", "Force a JAX platform ('tpu', 'cpu'); empty = default")

@@ -70,3 +70,24 @@ def test_missing_value_is_loud():
     fv2 = make_flags()
     with pytest.raises(ValueError, match="requires a value"):
         fv2.parse(["--task_index"])
+
+
+def test_paths_local_fallback(monkeypatch):
+    from distributed_tensorflow_tpu.utils import paths
+    monkeypatch.delenv("DTTPU_DATA_ROOT", raising=False)
+    monkeypatch.delenv("DTTPU_LOGS_ROOT", raising=False)
+    p = paths.get_data_path("u/mnist", local_root="/tmp/data",
+                            local_repo="mnist")
+    assert p == "/tmp/data/mnist"
+    assert paths.get_logs_path("/tmp/logs") == "/tmp/logs"
+
+
+def test_paths_cloud_mode(monkeypatch):
+    from distributed_tensorflow_tpu.utils import paths
+    monkeypatch.setenv("DTTPU_DATA_ROOT", "gs://bucket/data")
+    monkeypatch.setenv("DTTPU_LOGS_ROOT", "gs://bucket/logs")
+    monkeypatch.setenv("USER", "alice")
+    monkeypatch.setenv("DTTPU_JOB_NAME", "xor1")
+    assert paths.get_data_path("u/mnist", path="train") == \
+        "gs://bucket/data/u/mnist/train"
+    assert paths.get_logs_path("/ignored") == "gs://bucket/logs/alice/xor1"

@@ -118,3 +118,31 @@ def test_logging_hook(capsys):
         run_session(s, ds)
     out = capsys.readouterr().out
     assert "step 2:" in out and "step 4:" in out and "loss=" in out
+
+
+def test_multi_train_step_matches_sequential_single_steps():
+    """K scanned updates in one dispatch == K single-step dispatches."""
+    import numpy as np
+    from distributed_tensorflow_tpu import parallel
+    model = ops.serial(ops.Dense(16, "relu"), ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    mesh = parallel.data_parallel_mesh()
+    single = train.make_train_step(model, "mse", opt, mesh=mesh)
+    multi = train.make_multi_train_step(model, "mse", opt, steps_per_call=4,
+                                        mesh=mesh)
+    (xt, yt), _ = data.xor_data(400, val_size=10, seed=0)
+    xs = xt[:320].reshape(4, 80, 64)
+    ys = yt[:320].reshape(4, 80, 32)
+
+    s1 = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    for i in range(4):
+        s1, m1 = single(s1, (xs[i], ys[i]))
+
+    s2 = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    s2, metrics = multi(s2, (xs, ys))
+    assert metrics["loss"].shape == (4,)
+    assert int(s2.step) == int(s1.step) == 4
+    np.testing.assert_allclose(float(metrics["loss"][-1]), float(m1["loss"]),
+                               rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-5), s1.params, s2.params)
