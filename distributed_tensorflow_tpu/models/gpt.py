@@ -1,0 +1,360 @@
+"""GPT decoder family (causal LM + KV-cache generation).
+
+The reference has no transformer at all (3-layer MLP, reference
+example.py:149-155); the decoder family completes the model zoo beside the
+BERT encoder (models/bert.py) with the same TPU-first machinery:
+
+  * **Scanned layer stack**: L pre-LN decoder blocks as ONE stacked
+    parameter set applied with ``lax.scan`` — O(1) compile time in depth;
+    optional ``remat`` for long-context HBM headroom.
+  * **Causal attention** through the shared kernel swap: full softmax by
+    default, Pallas flash attention (``use_flash``) on TPU, ring attention
+    over a ``seq`` mesh axis (``seq_axis``) for context parallelism.
+  * **KV-cache decode**: ``init_cache`` + ``decode_step`` run one token
+    through the stack against a static-shape cache (``dynamic_update_slice``
+    writes, position-masked reads) so ``generate`` is a ``lax.scan`` with no
+    recompilation per token.
+  * **Tied embeddings**: the LM head is the word-embedding transpose —
+    megatron-style ``tensor`` sharding applies to both at once
+    (``partition_rules``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops import attention as attn_lib
+from ..ops import initializers as init_lib
+from ..ops import losses as loss_lib
+from ..parallel.sharding import PartitionRules
+from .bert import _dropout, _layer_norm
+
+__all__ = ["GPTConfig", "GPT", "gpt_small", "gpt_tiny"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 1024
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    remat: bool = False
+    seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
+    use_flash: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def gpt_small(**kw) -> "GPT":
+    return GPT(GPTConfig(**kw))
+
+
+def gpt_tiny(**kw) -> "GPT":
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("intermediate_size", 512)
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("max_position", 128)
+    return GPT(GPTConfig(**kw))
+
+
+class GPT:
+    """Functional decoder: ``init(key) -> params``,
+    ``apply(params, input_ids, ...) -> [b, s, hidden]``."""
+
+    def __init__(self, config: GPTConfig, mesh=None):
+        self.config = config
+        self.mesh = mesh  # only needed for the ring-attention (SP) path
+
+    # -- init -------------------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        c = self.config
+        trunc = init_lib.truncated_normal(0.02)
+        k_emb, k_layers = jax.random.split(key)
+        ke = jax.random.split(k_emb, 2)
+
+        def ln():
+            return {"gamma": jnp.ones((c.hidden_size,), jnp.float32),
+                    "beta": jnp.zeros((c.hidden_size,), jnp.float32)}
+
+        h, hd, d, i = c.num_heads, c.head_dim, c.hidden_size, \
+            c.intermediate_size
+
+        def one_layer(k):
+            ks = jax.random.split(k, 6)
+            return {
+                "ln_1": ln(),
+                "attention": {
+                    "query": {"kernel": trunc(ks[0], (d, h, hd)),
+                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "key": {"kernel": trunc(ks[1], (d, h, hd)),
+                            "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "value": {"kernel": trunc(ks[2], (d, h, hd)),
+                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "out": {"kernel": trunc(ks[3], (h, hd, d)),
+                            "bias": jnp.zeros((d,), jnp.float32)},
+                },
+                "ln_2": ln(),
+                "ffn": {
+                    "w_in": {"kernel": trunc(ks[4], (d, i)),
+                             "bias": jnp.zeros((i,), jnp.float32)},
+                    "w_out": {"kernel": trunc(ks[5], (i, d)),
+                              "bias": jnp.zeros((d,), jnp.float32)},
+                },
+            }
+
+        return {
+            "embeddings": {
+                "word": trunc(ke[0], (c.vocab_size, c.hidden_size)),
+                "position": trunc(ke[1], (c.max_position, c.hidden_size)),
+            },
+            "decoder": jax.vmap(one_layer)(
+                jax.random.split(k_layers, c.num_layers)),
+            "ln_f": ln(),
+        }
+
+    # -- blocks -----------------------------------------------------------
+    def _attention(self, p, x, mask, rng, train):
+        c = self.config
+        if c.seq_axis is not None and self.mesh is not None:
+            from ..parallel.ring import ring_attention_sharded
+            attention_fn = lambda q, k, v, mask=None: ring_attention_sharded(
+                q, k, v, self.mesh, seq_axis=c.seq_axis, causal=True)
+        elif c.seq_axis is not None:
+            from ..parallel.ring import ring_attention
+            attention_fn = lambda q, k, v, mask=None: ring_attention(
+                q, k, v, axis_name=c.seq_axis, causal=True)
+        elif c.use_flash:
+            from ..ops.pallas import flash_attention
+            attention_fn = lambda q, k, v, mask=None: flash_attention(
+                q, k, v, causal=True)
+        else:
+            attention_fn = attn_lib.dot_product_attention
+        return attn_lib.attention_core(
+            p, x, mask=mask, dropout_rate=c.dropout_rate, rng=rng,
+            train=train, attention_fn=attention_fn)
+
+    def _ffn(self, p, x):
+        """Pre-LN FFN: shared by the full-sequence and KV-cache paths so the
+        math can never diverge between them."""
+        c = self.config
+        dtype = x.dtype
+        h = _layer_norm(p["ln_2"], x, c.layer_norm_eps)
+        h = jax.nn.gelu(
+            jnp.einsum("bsd,di->bsi", h,
+                       p["ffn"]["w_in"]["kernel"].astype(dtype))
+            + p["ffn"]["w_in"]["bias"].astype(dtype))
+        return (jnp.einsum("bsi,id->bsd", h,
+                           p["ffn"]["w_out"]["kernel"].astype(dtype))
+                + p["ffn"]["w_out"]["bias"].astype(dtype))
+
+    def _block(self, p, x, mask, rng, train):
+        c = self.config
+        r_attn, r_res, r_ffn = jax.random.split(rng, 3)
+        attn_out = self._attention(
+            p["attention"], _layer_norm(p["ln_1"], x, c.layer_norm_eps),
+            mask, r_attn, train)
+        x = x + _dropout(attn_out, c.dropout_rate, r_res, train)
+        return x + _dropout(self._ffn(p, x), c.dropout_rate, r_ffn, train)
+
+    # -- full-sequence forward -------------------------------------------
+    def apply(self, params, input_ids, *, train: bool = False, rng=None):
+        c = self.config
+        if rng is None:
+            if train:
+                raise ValueError("GPT.apply(train=True) requires rng")
+            rng = jax.random.PRNGKey(0)
+        b, s = input_ids.shape
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], input_ids, axis=0)
+        x = x + emb["position"][None, :s, :]
+        r_emb, r_layers = jax.random.split(rng)
+        x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
+
+        # Ring / flash paths mask internally (causal=True); the dense path
+        # gets an explicit causal mask.
+        mask = (None if (c.seq_axis is not None or c.use_flash)
+                else attn_lib.causal_mask(s))
+
+        layer_fn = self._block
+        if c.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
+
+        def body(carry, inputs):
+            layer_params, layer_key = inputs
+            return layer_fn(layer_params, carry, mask, layer_key, train), None
+
+        layer_keys = jax.random.split(r_layers, c.num_layers)
+        x, _ = lax.scan(body, x, (params["decoder"], layer_keys))
+        return _layer_norm(params["ln_f"], x, c.layer_norm_eps)
+
+    def logits(self, params, hidden):
+        """Tied LM head -> [b, s, vocab] f32 logits."""
+        w = params["embeddings"]["word"].T.astype(hidden.dtype)
+        return (hidden @ w).astype(jnp.float32)
+
+    # -- training ---------------------------------------------------------
+    def lm_loss_fn(self):
+        """Contract for ``train.make_custom_train_step``: batch dict with
+        ``input_ids`` [b, s] and optional ``loss_mask`` [b, s-1]; next-token
+        targets are the shifted inputs."""
+
+        def loss_fn(params, model_state, batch, rng, train):
+            ids = batch["input_ids"]
+            hidden = self.apply(params, ids[:, :-1], train=train, rng=rng)
+            logits = self.logits(params, hidden)
+            targets = ids[:, 1:]
+            mask = batch.get("loss_mask")
+            loss = loss_lib.softmax_cross_entropy_with_integer_labels(
+                logits, targets, where=mask)
+            hits = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+            if mask is not None:
+                acc = jnp.sum(hits * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            else:
+                acc = jnp.mean(hits)
+            return loss, ({"token_accuracy": acc}, model_state)
+
+        return loss_fn
+
+    # -- KV-cache decode --------------------------------------------------
+    def init_cache(self, batch_size: int, max_len: Optional[int] = None):
+        c = self.config
+        max_len = max_len or c.max_position
+        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
+        return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def decode_step(self, params, cache, token_ids):
+        """One token through the stack against the cache.
+
+        token_ids: [b] int32 — the token at position ``cache['pos']``.
+        Returns (logits [b, vocab] f32, new cache).  Static shapes: cache
+        reads are masked by position, writes are ``dynamic_update_slice``.
+        """
+        c = self.config
+        b = token_ids.shape[0]
+        pos = cache["pos"]
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], token_ids, axis=0)[:, None, :]   # [b,1,d]
+        x = x + lax.dynamic_slice_in_dim(emb["position"], pos, 1)[None]
+        x = x.astype(c.dtype)
+
+        max_len = cache["k"].shape[2]
+        # keys at positions > pos are zeros/garbage — mask them out
+        # (additive 0/-inf convention of ops.attention)
+        kv_mask = jnp.where(jnp.arange(max_len) <= pos, 0.0,
+                            attn_lib.NEG_INF)[None, None, None, :]
+
+        def body(carry, inputs):
+            x = carry
+            p, k_cache, v_cache = inputs
+
+            h = _layer_norm(p["ln_1"], x, c.layer_norm_eps)
+            a = p["attention"]
+            dtype = h.dtype
+            q = (jnp.einsum("bsd,dhk->bshk", h,
+                            a["query"]["kernel"].astype(dtype))
+                 + a["query"]["bias"].astype(dtype))
+            k = (jnp.einsum("bsd,dhk->bshk", h,
+                            a["key"]["kernel"].astype(dtype))
+                 + a["key"]["bias"].astype(dtype))
+            v = (jnp.einsum("bsd,dhk->bshk", h,
+                            a["value"]["kernel"].astype(dtype))
+                 + a["value"]["bias"].astype(dtype))
+            k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
+            v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+            attn = attn_lib.dot_product_attention(q, k_cache, v_cache,
+                                                  mask=kv_mask)
+            attn_out = (jnp.einsum("bshk,hkd->bsd", attn,
+                                   a["out"]["kernel"].astype(dtype))
+                        + a["out"]["bias"].astype(dtype))
+            x = x + attn_out
+            return x + self._ffn(p, x), (k_cache, v_cache)
+
+        x, (new_k, new_v) = lax.scan(
+            body, x, (params["decoder"], cache["k"], cache["v"]))
+        x = _layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        logits = self.logits(params, x)[:, 0, :]
+        return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+
+    def generate(self, params, prompt_ids, max_new_tokens: int,
+                 temperature: float = 0.0, rng=None,
+                 max_len: Optional[int] = None) -> jnp.ndarray:
+        """Autoregressive sampling with the KV cache.
+
+        prompt_ids: [b, p] int32.  temperature 0 = greedy.  Returns
+        [b, p + max_new_tokens].  The whole loop is one ``lax.scan`` (prompt
+        positions are teacher-forced), so generation jits with no per-token
+        recompilation.
+        """
+        c = self.config
+        b, plen = prompt_ids.shape
+        total = plen + max_new_tokens
+        max_len = max_len or max(total, 1)
+        if max_len > c.max_position:
+            raise ValueError(f"generation length {max_len} exceeds "
+                             f"max_position {c.max_position}")
+        if total > max_len:
+            # dynamic_update_slice would silently clamp cache writes at
+            # max_len and corrupt every later token — refuse instead.
+            raise ValueError(f"prompt ({plen}) + max_new_tokens "
+                             f"({max_new_tokens}) = {total} exceeds "
+                             f"max_len {max_len}")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        cache = self.init_cache(b, max_len)
+        tokens = jnp.zeros((b, total), jnp.int32)
+        tokens = tokens.at[:, :plen].set(prompt_ids)
+
+        def step(carry, i):
+            tokens, cache, rng = carry
+            tok = lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
+            logits, cache = self.decode_step(params, cache, tok)
+            rng, sub = jax.random.split(rng)
+            if temperature > 0:
+                nxt = jax.random.categorical(sub, logits / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # Teacher-force while still inside the prompt.
+            inside = i + 1 < plen
+            target = lax.dynamic_slice_in_dim(
+                tokens, jnp.minimum(i + 1, total - 1), 1, axis=1)[:, 0]
+            nxt = jnp.where(inside, target, nxt.astype(jnp.int32))
+            tokens = lax.dynamic_update_slice_in_dim(
+                tokens, nxt[:, None], i + 1, axis=1)
+            return (tokens, cache, rng), None
+
+        (tokens, _, _), _ = lax.scan(step, (tokens, cache, rng),
+                                     jnp.arange(total - 1))
+        return tokens
+
+    # -- sharding ---------------------------------------------------------
+    def partition_rules(self, fsdp: bool = False) -> PartitionRules:
+        """Megatron-style TP specs; tied head sharding comes free with the
+        word embedding (vocab on ``tensor``)."""
+        f = "fsdp" if fsdp else None
+        return PartitionRules([
+            (r"embeddings/word$", P("tensor", f)),
+            (r"embeddings/position$", P(None, None)),
+            (r"decoder/attention/(query|key|value)/kernel",
+             P(None, f, "tensor", None)),
+            (r"decoder/attention/(query|key|value)/bias",
+             P(None, "tensor", None)),
+            (r"decoder/attention/out/kernel", P(None, "tensor", None, f)),
+            (r"decoder/ffn/w_in/kernel", P(None, f, "tensor")),
+            (r"decoder/ffn/w_in/bias", P(None, "tensor")),
+            (r"decoder/ffn/w_out/kernel", P(None, "tensor", f)),
+        ])

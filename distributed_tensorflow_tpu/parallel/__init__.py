@@ -1,6 +1,7 @@
 """Parallelism layer: cluster bootstrap, meshes, shardings, collectives."""
 
-from . import cluster, mesh, pipeline, ring, sharding
+from . import cluster, data_parallel, mesh, pipeline, ring, sharding
+from .data_parallel import make_psum_train_step
 from .cluster import ClusterConfig, cluster_from_env, initialize, is_chief
 from .pipeline import (pipeline_apply, pipeline_rules_spec,
                        stack_pipeline_params)
@@ -10,7 +11,8 @@ from .mesh import (AXIS_ORDER, data_parallel_mesh, data_shards,
                    local_batch_size, make_mesh, named_sharding, replicated,
                    round_batch_to_mesh)
 
-__all__ = ["cluster", "mesh", "pipeline", "ring", "sharding",
+__all__ = ["cluster", "data_parallel", "make_psum_train_step",
+           "mesh", "pipeline", "ring", "sharding",
            "pipeline_apply", "pipeline_rules_spec", "stack_pipeline_params",
            "ClusterConfig",
            "cluster_from_env", "initialize", "is_chief", "ring_attention",
