@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops import attention as attn_lib
 from ..ops import initializers as init_lib
 from ..ops import losses as loss_lib
+from ..ops.moe import moe_partition_rules
 from ..parallel.sharding import PartitionRules
 from .bert import _dropout, _layer_norm
 
@@ -51,6 +52,14 @@ class GPTConfig:
     remat: bool = False
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
     use_flash: bool = False
+    # Sparse (MoE) FFN: 0 = dense.  With experts > 0 every block's FFN is a
+    # grouped top-k MoE bank (ops.moe) shardable over the ``expert`` axis;
+    # the router aux losses are folded into lm_loss_fn automatically.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
+    moe_z_weight: float = 1e-3
 
     @property
     def head_dim(self) -> int:
@@ -95,7 +104,7 @@ class GPT:
 
         def one_layer(k):
             ks = jax.random.split(k, 6)
-            return {
+            layer = {
                 "ln_1": ln(),
                 "attention": {
                     "query": {"kernel": trunc(ks[0], (d, h, hd)),
@@ -108,13 +117,18 @@ class GPT:
                             "bias": jnp.zeros((d,), jnp.float32)},
                 },
                 "ln_2": ln(),
-                "ffn": {
+            }
+            if c.moe_experts > 0:
+                from ..ops.moe import init_moe
+                layer["moe"] = init_moe(ks[4], d, i, c.moe_experts)
+            else:
+                layer["ffn"] = {
                     "w_in": {"kernel": trunc(ks[4], (d, i)),
                              "bias": jnp.zeros((i,), jnp.float32)},
                     "w_out": {"kernel": trunc(ks[5], (i, d)),
                               "bias": jnp.zeros((d,), jnp.float32)},
-                },
-            }
+                }
+            return layer
 
         return {
             "embeddings": {
@@ -147,31 +161,51 @@ class GPT:
             p, x, mask=mask, dropout_rate=c.dropout_rate, rng=rng,
             train=train, attention_fn=attention_fn)
 
-    def _ffn(self, p, x):
-        """Pre-LN FFN: shared by the full-sequence and KV-cache paths so the
-        math can never diverge between them."""
+    def _ffn(self, p, x, rng=None, train=False):
+        """Pre-LN FFN (dense or MoE): shared by the full-sequence and
+        KV-cache paths so the math can never diverge between them.
+
+        Returns ``(out, aux)`` — ``aux`` is the weighted router loss scalar
+        (0 for the dense path).  Note: at KV-cache decode the MoE routes one
+        token per group, so capacity never drops; full-sequence outputs
+        match decode exactly only when the configured capacity drops no
+        tokens (use a generous ``moe_capacity_factor`` at eval).
+        """
         c = self.config
-        dtype = x.dtype
         h = _layer_norm(p["ln_2"], x, c.layer_norm_eps)
+        if "moe" in p:
+            from ..ops.moe import apply_moe
+            y, m = apply_moe(p["moe"], h, k=c.moe_top_k,
+                             capacity_factor=c.moe_capacity_factor,
+                             train=train, rng=rng)
+            aux = (c.moe_aux_weight * m["aux_loss"]
+                   + c.moe_z_weight * m["router_z_loss"])
+            return y, aux
+        dtype = x.dtype
         h = jax.nn.gelu(
             jnp.einsum("bsd,di->bsi", h,
                        p["ffn"]["w_in"]["kernel"].astype(dtype))
             + p["ffn"]["w_in"]["bias"].astype(dtype))
-        return (jnp.einsum("bsi,id->bsd", h,
-                           p["ffn"]["w_out"]["kernel"].astype(dtype))
-                + p["ffn"]["w_out"]["bias"].astype(dtype))
+        out = (jnp.einsum("bsi,id->bsd", h,
+                          p["ffn"]["w_out"]["kernel"].astype(dtype))
+               + p["ffn"]["w_out"]["bias"].astype(dtype))
+        return out, jnp.zeros((), jnp.float32)
 
     def _block(self, p, x, mask, rng, train):
         c = self.config
-        r_attn, r_res, r_ffn = jax.random.split(rng, 3)
+        r_attn, r_res, r_moe, r_drop = jax.random.split(rng, 4)
         attn_out = self._attention(
             p["attention"], _layer_norm(p["ln_1"], x, c.layer_norm_eps),
             mask, r_attn, train)
         x = x + _dropout(attn_out, c.dropout_rate, r_res, train)
-        return x + _dropout(self._ffn(p, x), c.dropout_rate, r_ffn, train)
+        ffn_out, aux = self._ffn(p, x, rng=r_moe, train=train)
+        return x + _dropout(ffn_out, c.dropout_rate, r_drop, train), aux
 
     # -- full-sequence forward -------------------------------------------
-    def apply(self, params, input_ids, *, train: bool = False, rng=None):
+    def apply(self, params, input_ids, *, train: bool = False, rng=None,
+              return_aux: bool = False):
+        """-> hidden [b, s, d]; with ``return_aux`` also the summed router
+        aux-loss scalar (nonzero only for MoE configs)."""
         c = self.config
         if rng is None:
             if train:
@@ -195,11 +229,16 @@ class GPT:
 
         def body(carry, inputs):
             layer_params, layer_key = inputs
-            return layer_fn(layer_params, carry, mask, layer_key, train), None
+            new_x, aux = layer_fn(layer_params, carry, mask, layer_key,
+                                  train)
+            return new_x, aux
 
         layer_keys = jax.random.split(r_layers, c.num_layers)
-        x, _ = lax.scan(body, x, (params["decoder"], layer_keys))
-        return _layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        x, aux_per_layer = lax.scan(body, x, (params["decoder"], layer_keys))
+        hidden = _layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        if return_aux:
+            return hidden, jnp.sum(aux_per_layer)
+        return hidden
 
     def logits(self, params, hidden):
         """Tied LM head -> [b, s, vocab] f32 logits."""
@@ -214,7 +253,8 @@ class GPT:
 
         def loss_fn(params, model_state, batch, rng, train):
             ids = batch["input_ids"]
-            hidden = self.apply(params, ids[:, :-1], train=train, rng=rng)
+            hidden, aux = self.apply(params, ids[:, :-1], train=train,
+                                     rng=rng, return_aux=True)
             logits = self.logits(params, hidden)
             targets = ids[:, 1:]
             mask = batch.get("loss_mask")
@@ -225,7 +265,10 @@ class GPT:
                 acc = jnp.sum(hits * mask) / jnp.maximum(jnp.sum(mask), 1.0)
             else:
                 acc = jnp.mean(hits)
-            return loss, ({"token_accuracy": acc}, model_state)
+            metrics = {"token_accuracy": acc}
+            if self.config.moe_experts > 0:
+                metrics["moe_aux"] = aux
+            return loss + aux, (metrics, model_state)
 
         return loss_fn
 
@@ -282,7 +325,8 @@ class GPT:
                                    a["out"]["kernel"].astype(dtype))
                         + a["out"]["bias"].astype(dtype))
             x = x + attn_out
-            return x + self._ffn(p, x), (k_cache, v_cache)
+            ffn_out, _ = self._ffn(p, x)   # aux unused at decode
+            return x + ffn_out, (k_cache, v_cache)
 
         x, (new_k, new_v) = lax.scan(
             body, x, (params["decoder"], cache["k"], cache["v"]))
@@ -357,4 +401,7 @@ class GPT:
             (r"decoder/ffn/w_in/kernel", P(None, f, "tensor")),
             (r"decoder/ffn/w_in/bias", P(None, "tensor")),
             (r"decoder/ffn/w_out/kernel", P(None, "tensor", f)),
-        ])
+            # MoE rows derive from the canonical ops.moe table (its patterns
+            # are suffix-matching), with the scanned leading layer dim
+            # prepended to each spec — one source of truth.
+        ] + [(pat, P(None, *spec)) for pat, spec in moe_partition_rules()])
