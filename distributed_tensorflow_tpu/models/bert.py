@@ -272,7 +272,11 @@ class Bert:
             acc_hits = (jnp.argmax(logits, -1) == batch["labels"]).astype(
                 jnp.float32) * mask
             accuracy = jnp.sum(acc_hits) / jnp.maximum(jnp.sum(mask), 1.0)
-            return loss, ({"mlm_accuracy": accuracy}, model_state)
+            # loss_weight: the masked-mean normalizer, consumed by
+            # train.step gradient accumulation for exact full-batch grads.
+            return loss, ({"mlm_accuracy": accuracy,
+                           "loss_weight": jnp.sum(mask).astype(jnp.float32)},
+                          model_state)
 
         return loss_fn
 

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops import attention as attn_lib
 from ..ops import initializers as init_lib
 from ..ops import losses as loss_lib
-from ..ops.moe import moe_partition_rules
+from ..ops.moe import apply_moe, init_moe, moe_partition_rules
 from ..parallel.sharding import PartitionRules
 from .bert import _dropout, _layer_norm
 
@@ -119,7 +119,6 @@ class GPT:
                 "ln_2": ln(),
             }
             if c.moe_experts > 0:
-                from ..ops.moe import init_moe
                 layer["moe"] = init_moe(ks[4], d, i, c.moe_experts)
             else:
                 layer["ffn"] = {
@@ -174,7 +173,6 @@ class GPT:
         c = self.config
         h = _layer_norm(p["ln_2"], x, c.layer_norm_eps)
         if "moe" in p:
-            from ..ops.moe import apply_moe
             y, m = apply_moe(p["moe"], h, k=c.moe_top_k,
                              capacity_factor=c.moe_capacity_factor,
                              train=train, rng=rng)
@@ -266,6 +264,9 @@ class GPT:
             else:
                 acc = jnp.mean(hits)
             metrics = {"token_accuracy": acc}
+            if mask is not None:
+                # normalizer for exact gradient accumulation (train.step)
+                metrics["loss_weight"] = jnp.sum(mask).astype(jnp.float32)
             if self.config.moe_experts > 0:
                 metrics["moe_aux"] = aux
             return loss + aux, (metrics, model_state)

@@ -33,9 +33,15 @@ import jax
 import numpy as np
 
 __all__ = ["save", "restore", "latest_checkpoint", "latest_step",
-           "all_checkpoints"]
+           "all_checkpoints", "AsyncCheckpointer", "ckpt_path"]
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+def ckpt_path(ckpt_dir: str, step: int) -> str:
+    """The canonical checkpoint directory name for a step — single source
+    of truth for the ``ckpt-{step}`` convention."""
+    return os.path.join(ckpt_dir, f"ckpt-{int(step):010d}")
 
 
 def _leaf_paths(tree) -> Tuple[List[str], Any]:
@@ -61,7 +67,7 @@ def save(ckpt_dir: str, step: int, tree: Any, max_to_keep: int = 5) -> str:
         }
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
-        final = os.path.join(ckpt_dir, f"ckpt-{int(step):010d}")
+        final = ckpt_path(ckpt_dir, step)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.replace(tmp, final)
@@ -100,6 +106,68 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     if path is None:
         return None
     return int(_CKPT_RE.match(os.path.basename(path)).group(1))
+
+
+class AsyncCheckpointer:
+    """Background checkpoint writes so the train loop never stalls on disk.
+
+    The device→host copy happens on the CALLER's thread (it must complete
+    before donated buffers are reused by the next step; jax arrays are
+    immutable so the snapshot is consistent), then the npz serialization,
+    atomic rename, and pruning run on one worker thread.  Writes land in
+    submission order.  ``wait()`` blocks until everything pending is on
+    disk and re-raises the first failure; call it before reading the
+    checkpoint back or exiting the process.
+    """
+
+    def __init__(self):
+        import concurrent.futures
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer")
+        self._pending: List[Any] = []
+
+    def save(self, ckpt_dir: str, step: int, tree: Any,
+             max_to_keep: int = 5):
+        """Snapshot to host now, write in the background; returns a future
+        resolving to the checkpoint path."""
+        self._raise_failed()
+        host_tree = jax.tree.map(
+            lambda leaf: np.asarray(jax.device_get(leaf)), tree)
+        fut = self._executor.submit(save, ckpt_dir, step, host_tree,
+                                    max_to_keep)
+        self._pending.append(fut)
+        return fut
+
+    def _raise_failed(self) -> None:
+        still = []
+        for f in self._pending:
+            if f.done():
+                f.result()  # re-raise a background failure loudly
+            else:
+                still.append(f)
+        self._pending = still
+
+    def wait(self) -> None:
+        # Drain everything, log any additional failures, raise the first —
+        # no failure is silently lost and none is reported twice.
+        pending, self._pending = self._pending, []
+        first_error = None
+        for f in pending:
+            try:
+                f.result()
+            except Exception as e:
+                if first_error is None:
+                    first_error = e
+                else:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "additional async checkpoint write failed")
+        if first_error is not None:
+            raise first_error
+
+    def close(self) -> None:
+        self.wait()
+        self._executor.shutdown(wait=True)
 
 
 def restore(target: Any, ckpt_path: str) -> Any:

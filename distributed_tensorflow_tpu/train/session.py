@@ -75,7 +75,8 @@ class TrainSession:
                  hooks: Sequence[Hook] = (),
                  is_chief: Optional[bool] = None,
                  max_to_keep: int = 5,
-                 restore: bool = True):
+                 restore: bool = True,
+                 async_checkpoint: bool = False):
         self.state = state
         self.step_fn = step_fn
         self.checkpoint_dir = checkpoint_dir
@@ -85,11 +86,16 @@ class TrainSession:
         self.last_saved_step = None
         self._stop = False
         self._entered = False
+        # Async: disk writes happen on a background thread (the device->host
+        # snapshot still happens inline); drained on session exit.
+        self._async_ckpt = (ckpt_lib.AsyncCheckpointer()
+                            if async_checkpoint else None)
 
         if restore and checkpoint_dir:
             latest = ckpt_lib.latest_checkpoint(checkpoint_dir)
             if latest is not None:
                 self.state = ckpt_lib.restore(self.state, latest)
+                self.last_saved_step = self.step  # disk already has this step
                 log.info("restored checkpoint %s (step %d)", latest, self.step)
                 print(f"Restored checkpoint {os.path.basename(latest)} at "
                       f"step {self.step}", flush=True)
@@ -121,8 +127,13 @@ class TrainSession:
         example.py:74-76); non-chief calls are no-ops."""
         if not (self.is_chief and self.checkpoint_dir):
             return None
-        path = ckpt_lib.save(self.checkpoint_dir, self.step, self.state,
-                             max_to_keep=self.max_to_keep)
+        if self._async_ckpt is not None:
+            self._async_ckpt.save(self.checkpoint_dir, self.step, self.state,
+                                  max_to_keep=self.max_to_keep)
+            path = ckpt_lib.ckpt_path(self.checkpoint_dir, self.step)
+        else:
+            path = ckpt_lib.save(self.checkpoint_dir, self.step, self.state,
+                                 max_to_keep=self.max_to_keep)
         self.last_saved_step = self.step
         log.info("saved checkpoint %s", path)
         return path
@@ -146,8 +157,10 @@ class TrainSession:
             if exc_type is None:
                 for hook in self.hooks:
                     hook.end(self)
+                # last_saved_step (not disk state) is the dedup cursor: an
+                # async write for this step may not have landed yet.
                 if (self.checkpoint_dir and self.is_chief and
-                        ckpt_lib.latest_step(self.checkpoint_dir) != self.step):
+                        self.last_saved_step != self.step):
                     self.save()
         finally:
             for hook in self.hooks:
@@ -155,4 +168,13 @@ class TrainSession:
                     hook.close(self)
                 except Exception:  # pragma: no cover
                     log.exception("hook %r close() raised", hook)
+            if self._async_ckpt is not None:
+                try:
+                    self._async_ckpt.close()  # drain pending writes
+                except Exception:
+                    if exc_type is None:
+                        raise  # clean exit: a lost checkpoint must be loud
+                    # don't mask the original in-flight exception
+                    log.exception("async checkpoint write failed during "
+                                  "exception unwind")
             self._entered = False

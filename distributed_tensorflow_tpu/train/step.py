@@ -69,7 +69,8 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                     params_spec: Any = None,
                     batch_spec: P = P("data"),
                     jit: bool = True,
-                    grad_clip_norm: Optional[float] = None) -> Callable:
+                    grad_clip_norm: Optional[float] = None,
+                    accum_steps: int = 1) -> Callable:
     """Build ``step(state, (x, y)) -> (new_state, metrics)``.
 
     Thin adapter over ``make_custom_train_step``: wraps the (model, loss,
@@ -100,7 +101,8 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
     return make_custom_train_step(loss_fn, optimizer, seed=seed, mesh=mesh,
                                   state_shardings=state_shardings,
                                   batch_shardings=batch_shardings, jit=jit,
-                                  grad_clip_norm=grad_clip_norm)
+                                  grad_clip_norm=grad_clip_norm,
+                                  accum_steps=accum_steps)
 
 
 def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
@@ -109,7 +111,8 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
                            state_shardings: Any = None,
                            batch_shardings: Any = None,
                            jit: bool = True,
-                           grad_clip_norm: Optional[float] = None) -> Callable:
+                           grad_clip_norm: Optional[float] = None,
+                           accum_steps: int = 1) -> Callable:
     """Generalized step builder for model families with structured batches.
 
     ``loss_fn(params, model_state, batch, rng, train) ->
@@ -117,17 +120,81 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
     model zoo (BERT MLM, ResNet, ...).  Sharding: pass a TrainState-shaped
     ``state_shardings`` and a batch-shaped ``batch_shardings`` (NamedSharding
     pytrees) for the pjit path.
+
+    ``accum_steps > 1``: gradient accumulation — the batch's leading dim is
+    split into that many microbatches, gradients/metrics are averaged over a
+    ``lax.scan`` (peak activation memory drops ~accum_steps-fold) and ONE
+    optimizer update is applied.  Each microbatch gets its own dropout key
+    and model_state (BatchNorm stats) threads through sequentially.
+
+    Masked-mean losses: a per-microbatch masked mean averaged with equal
+    weights is NOT the full-batch masked mean when mask counts differ per
+    microbatch.  A ``loss_fn`` whose loss normalizes by a mask (GPT/BERT
+    LM heads) should report ``metrics['loss_weight']`` = its normalizer
+    (e.g. the mask sum); accumulation then weights every microbatch's
+    gradients/loss/metrics by it, recovering the exact full-batch gradient.
+    Without that key all microbatches weigh 1 (exact for plain-mean losses).
     """
     base_key = jax.random.PRNGKey(seed)
+
+    def grad_of(params, model_state, mb, rng):
+        def compute(p):
+            return loss_fn(p, model_state, mb, rng, True)
+        return jax.value_and_grad(compute, has_aux=True)(params)
 
     def step(state: TrainState, batch):
         rng = jax.random.fold_in(base_key, state.step)
 
-        def compute(params):
-            return loss_fn(params, state.model_state, batch, rng, True)
+        if accum_steps == 1:
+            (loss_value, (metrics, new_model_state)), grads = grad_of(
+                state.params, state.model_state, batch, rng)
+        else:
+            lead = {a.shape[0] for a in jax.tree.leaves(batch)}
+            bad = [n for n in lead if n % accum_steps]
+            if bad:
+                raise ValueError(
+                    f"batch leading dim(s) {sorted(bad)} not divisible by "
+                    f"accum_steps={accum_steps}")
+            mbs = jax.tree.map(
+                lambda a: a.reshape(accum_steps, a.shape[0] // accum_steps,
+                                    *a.shape[1:]), batch)
+            mb_shapes = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), mbs)
+            (loss_s, (metrics_s, _)), grads_s = jax.eval_shape(
+                grad_of, state.params, state.model_state, mb_shapes, rng)
+            has_weight = "loss_weight" in metrics_s
+            metrics_s = dict(metrics_s)
+            metrics_s.pop("loss_weight", None)
 
-        (loss_value, (metrics, new_model_state)), grads = jax.value_and_grad(
-            compute, has_aux=True)(state.params)
+            def zeros(tree):
+                return jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), tree)
+
+            def body(carry, inp):
+                grads, loss_sum, metrics_sum, model_state, w_sum = carry
+                mb, i = inp
+                (l, (m, model_state)), g = grad_of(
+                    state.params, model_state, mb, jax.random.fold_in(rng, i))
+                m = dict(m)
+                w = m.pop("loss_weight", jnp.ones((), jnp.float32))
+                w = w.astype(jnp.float32)
+                grads = jax.tree.map(lambda a, b: a + b * w, grads, g)
+                metrics_sum = jax.tree.map(lambda a, b: a + b * w,
+                                           metrics_sum, m)
+                return (grads, loss_sum + l * w, metrics_sum, model_state,
+                        w_sum + w), None
+
+            carry0 = (zeros(grads_s), jnp.zeros(loss_s.shape, loss_s.dtype),
+                      zeros(metrics_s), state.model_state,
+                      jnp.zeros((), jnp.float32))
+            (grads, loss_value, metrics, new_model_state, w_sum), _ = \
+                jax.lax.scan(body, carry0, (mbs, jnp.arange(accum_steps)))
+            inv = 1.0 / jnp.maximum(w_sum, 1e-9)
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            loss_value = loss_value * inv
+            metrics = jax.tree.map(lambda m: m * inv, metrics)
+            if has_weight:
+                metrics["loss_weight"] = w_sum
         metrics = {"loss": loss_value, **metrics}
         if grad_clip_norm is not None:
             grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)

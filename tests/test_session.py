@@ -146,3 +146,105 @@ def test_multi_train_step_matches_sequential_single_steps():
                                rtol=1e-5)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=1e-5), s1.params, s2.params)
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=4 on one batch == the full-batch gradient step (mean
+    loss, no dropout)."""
+    import numpy as np
+    model = ops.serial(ops.Dense(16, "relu"), ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    (xt, yt), _ = data.xor_data(80, val_size=10, seed=0)
+    batch = (xt[:80], yt[:80])
+
+    s1 = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    full = train.make_train_step(model, "mse", opt)
+    s1, m1 = full(s1, batch)
+
+    s2 = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    accum = train.make_train_step(model, "mse", opt, accum_steps=4)
+    s2, m2 = accum(s2, batch)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-5), s1.params, s2.params)
+
+
+def test_async_checkpointer_roundtrip_and_errors(tmp_path):
+    import numpy as np
+    import pytest
+    tree = {"a": jax.numpy.arange(6.0).reshape(2, 3), "b": {"c": jax.numpy.ones(4)}}
+    ck = train.checkpoint.AsyncCheckpointer()
+    ck.save(str(tmp_path), 7, tree)
+    ck.wait()
+    assert train.checkpoint.latest_step(str(tmp_path)) == 7
+    target = jax.tree.map(lambda a: jax.numpy.zeros_like(a), tree)
+    out = train.checkpoint.restore(
+        target, train.checkpoint.latest_checkpoint(str(tmp_path)))
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.asarray(tree["a"]))
+    # background failure surfaces on wait()
+    ck.save("/proc/definitely/not/writable", 8, tree)
+    with pytest.raises(Exception):
+        ck.wait()
+    ck.close()
+
+
+def test_session_async_checkpoint_and_resume(tmp_path):
+    _, _, state, step, ds = make_bits()
+    d = str(tmp_path)
+    with train.TrainSession(state, step, checkpoint_dir=d,
+                            hooks=[train.StopAtStepHook(last_step=5)],
+                            async_checkpoint=True) as sess:
+        run_session(sess, ds)
+    # exit drained the writer: the final save is durable
+    assert train.checkpoint.latest_step(d) == 5
+    _, _, state2, step2, _ = make_bits()
+    with train.TrainSession(state2, step2, checkpoint_dir=d,
+                            hooks=[train.StopAtStepHook(last_step=6)]) as s2:
+        assert s2.step == 5
+
+
+def test_masked_loss_accumulation_exact():
+    """Unequal mask counts per microbatch: loss_weight-weighted accumulation
+    reproduces the full-batch masked-mean gradient exactly."""
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    model = gpt_tiny(dropout_rate=0.0)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optim.adam(1e-3)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 512)
+    # heavily skewed mask: microbatch 0 has 2 tokens, microbatch 3 has 40
+    mask = np.zeros((8, 15), np.float32)
+    mask[0, :1] = 1; mask[1, :1] = 1
+    mask[2, :4] = 1; mask[3, :4] = 1
+    mask[4, :9] = 1; mask[5, :9] = 1
+    mask[6:, :] = 1
+    batch = {"input_ids": ids, "loss_mask": jnp.asarray(mask)}
+
+    # copy params per state: the jitted steps donate their inputs
+    s1 = train.TrainState.create(jax.tree.map(jnp.copy, params),
+                                 opt.init(params))
+    s2 = train.TrainState.create(jax.tree.map(jnp.copy, params),
+                                 opt.init(params))
+    full = train.make_custom_train_step(model.lm_loss_fn(), opt)
+    s1, m1 = full(s1, batch)
+    accum = train.make_custom_train_step(model.lm_loss_fn(), opt,
+                                         accum_steps=4)
+    s2, m2 = accum(s2, batch)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=2e-5), s1.params, s2.params)
+
+
+def test_accum_steps_divisibility_error():
+    import pytest
+    model = ops.serial(ops.Dense(16, "relu"), ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    step = train.make_train_step(model, "mse", opt, accum_steps=4)
+    (xt, yt), _ = data.xor_data(30, val_size=10, seed=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        step(state, (xt[:30], yt[:30]))
