@@ -1,10 +1,15 @@
 """Benchmark harness — prints ONE JSON line to stdout.
 
-Metric (per BASELINE.md): MNIST-MLP training examples/sec/chip, measured on
-the framework's compiled data-parallel train step on whatever devices are
-available (the real TPU chip under the driver; the virtual CPU mesh in
-tests), plus a convergence gate (final eval accuracy must clear 0.9 on the
-synthetic set or the result is reported as failed).
+Default metric (per BASELINE.md): MNIST-MLP training examples/sec/chip,
+measured on the framework's compiled data-parallel train step on whatever
+devices are available (the real TPU chip under the driver; the virtual CPU
+mesh in tests), plus a convergence gate (final eval accuracy must clear 0.9
+on the synthetic set or the result is reported as failed).
+
+Other BASELINE configs: ``python bench.py --config=cifar_cnn|resnet50|bert``
+measure those rows (same JSON shape; resnet50/bert are throughput+finite-loss
+benches, no convergence gate).  ``DTTPU_BENCH_SMOKE=1`` shrinks model/batch
+sizes so every config path smoke-runs on the CPU mesh.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md:
 "published: {}"), so the baseline is a measured stand-in for its
@@ -14,17 +19,20 @@ unavailable the documented fallback constant is used.  Everything except
 the JSON line goes to stderr.
 """
 import json
+import os
 import sys
 import time
+
+SMOKE = bool(os.environ.get("DTTPU_BENCH_SMOKE"))
 
 # Estimated examples/sec for the reference-era stack on a single CPU host —
 # used only if the live torch baseline cannot run.
 FALLBACK_BASELINE = 1.0e5
 
-BATCH = 8192
-STEPS_PER_CALL = 32   # lax.scan'd updates per dispatch (train.make_multi_train_step)
-WARMUP_CALLS = 2
-CALLS = 8
+BATCH = 512 if SMOKE else 8192
+STEPS_PER_CALL = 4 if SMOKE else 32   # scanned updates per dispatch
+WARMUP_CALLS = 1 if SMOKE else 2
+CALLS = 2 if SMOKE else 8
 
 
 def log(msg):
@@ -60,8 +68,12 @@ def bench_framework():
     ds = data.Dataset([xt, yt], batch, seed=0, backend="auto")
 
     # Convergence gate: a couple of epochs must clear 0.9 eval accuracy.
-    for b in ds.epochs(2):
-        state, _ = step(state, jax.device_put(b, bsh))
+    # (XLA:CPU collective rendezvous can't take deep async queues — sync
+    # each step in smoke mode; on TPU the queue stays async.)
+    for b in ds.epochs(1 if SMOKE else 2):
+        state, m_ = step(state, jax.device_put(b, bsh))
+        if SMOKE:
+            jax.block_until_ready(m_["loss"])
     acc = float(eval_step(state, (xv[:8192], yv[:8192]))["accuracy"])
     log(f"eval accuracy after 2 epochs: {acc:.4f}")
 
@@ -82,6 +94,8 @@ def bench_framework():
     t0 = time.perf_counter()
     for _ in range(CALLS):
         state, m = multi(state, bench_batch)
+        if SMOKE:
+            jax.block_until_ready(m["loss"])
     jax.block_until_ready(m["loss"])
     dt = time.perf_counter() - t0
     steps = CALLS * k
@@ -93,56 +107,251 @@ def bench_framework():
     # Single-step dispatch path (what TrainSession drives per batch) — kept
     # visible so a regression there can't hide behind the scanned number.
     single_batch = (bench_batch[0][0], bench_batch[1][0])
-    for _ in range(5):
+    n_single = 8 if SMOKE else 40
+    for _ in range(2 if SMOKE else 5):
         state, m = step(state, single_batch)
     jax.block_until_ready(m["loss"])
     t0 = time.perf_counter()
-    for _ in range(40):
+    for _ in range(n_single):
         state, m = step(state, single_batch)
+        if SMOKE:
+            jax.block_until_ready(m["loss"])
     jax.block_until_ready(m["loss"])
     dts = time.perf_counter() - t0
-    eps_single = 40 * batch / dts
+    eps_single = n_single * batch / dts
     log(f"framework (single-step): {eps_single:,.0f} examples/s total "
-        f"({dts / 40 * 1e3:.2f} ms/step)")
+        f"({dts / n_single * 1e3:.2f} ms/step)")
     return eps / n_chips, acc, eps_single / n_chips
 
 
 def bench_torch_baseline():
     """Same MLP/batch/optimizer stepped with torch on CPU (reference-era
     proxy: host-resident training, no XLA)."""
-    try:
+
+    def build():
         import torch
         import torch.nn as nn
-    except Exception as e:  # pragma: no cover
-        log(f"torch baseline unavailable ({e}); using fallback constant")
-        return None
-    torch.manual_seed(0)
-    torch.set_num_threads(max(1, (torch.get_num_threads())))
-    model = nn.Sequential(nn.Linear(784, 128), nn.ReLU(), nn.Dropout(0.2),
-                          nn.Linear(128, 10))
-    opt = torch.optim.Adam(model.parameters())
-    loss_fn = nn.CrossEntropyLoss()
-    x = torch.rand(BATCH, 784)
-    y = torch.randint(0, 10, (BATCH,))
-    for _ in range(3):  # warmup
-        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
-    steps = 15
+        model = nn.Sequential(nn.Linear(784, 128), nn.ReLU(),
+                              nn.Dropout(0.2), nn.Linear(128, 10))
+        x = torch.rand(BATCH, 784)
+        y = torch.randint(0, 10, (BATCH,))
+        ce = nn.CrossEntropyLoss()
+        return model, lambda out: ce(out, y), \
+            torch.optim.Adam(model.parameters()), (x,), BATCH
+
+    return _torch_step_rate(build, warmup=3, steps=15)
+
+
+def _time_steps(step, state, batch, warmup=3, steps=12):
+    """Generic throughput timing for a compiled train step.  Returns
+    (steps/sec, last loss, sec/step); per-chip normalization is the
+    caller's job.  SMOKE syncs every step (XLA:CPU collective rendezvous
+    can't take deep async queues)."""
+    import jax
+    if SMOKE:
+        warmup, steps = min(warmup, 2), min(steps, 4)
+    for _ in range(warmup):
+        state, m = step(state, batch)
+        if SMOKE:
+            jax.block_until_ready(m["loss"])
+    jax.block_until_ready(m["loss"])
     t0 = time.perf_counter()
     for _ in range(steps):
-        opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+        state, m = step(state, batch)
+        if SMOKE:
+            jax.block_until_ready(m["loss"])
+    jax.block_until_ready(m["loss"])
     dt = time.perf_counter() - t0
-    eps = steps * BATCH / dt
-    log(f"torch CPU baseline: {eps:,.0f} examples/s")
+    loss = float(m["loss"])
+    return steps / dt, loss, dt / steps
+
+
+def _torch_step_rate(build, warmup=2, steps=3):
+    """examples/sec for the same workload stepped with torch on CPU;
+    ``build() -> (module, loss_fn, optimizer, example_inputs, batch)``.
+    Returns None (logged) on ANY failure — a missing torch/torchvision
+    feature must not lose the framework measurement."""
+    try:
+        import torch
+        torch.manual_seed(0)
+        model, loss_fn, opt, inputs, batch = build()
+        for _ in range(warmup):
+            opt.zero_grad(); loss_fn(model(*inputs)).backward(); opt.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            opt.zero_grad(); loss_fn(model(*inputs)).backward(); opt.step()
+        eps = steps * batch / (time.perf_counter() - t0)
+    except Exception as e:  # pragma: no cover
+        log(f"torch baseline unavailable ({e})")
+        return None
+    log(f"torch CPU baseline: {eps:,.1f} examples/s")
     return eps
 
 
-def main():
+def bench_cifar_cnn():
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import data, models, optim, parallel, train
+
+    n_chips = len(jax.devices())
+    mesh = parallel.data_parallel_mesh()
+    batch = parallel.round_batch_to_mesh(64 if SMOKE else 1024, mesh)
+    (xt, yt), (xv, yv) = data.cifar10()
+    model = models.cifar_cnn()
+    optimizer = optim.adam()
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 optimizer, mesh=mesh)
+    eval_step = train.make_eval_step(model, "sparse_categorical_crossentropy",
+                                     metric_fns={"accuracy": "accuracy"})
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (32, 32, 3))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    bsh = NamedSharding(mesh, P("data"))
+    ds = data.Dataset([xt, yt], batch, seed=0, backend="auto")
+    epochs = 1 if SMOKE else 2
+    for b in ds.epochs(epochs):
+        state, m = step(state, jax.device_put(b, bsh))
+        if SMOKE:
+            break
+    acc = float(eval_step(state, (xv[:2048], yv[:2048]))["accuracy"])
+    log(f"cifar_cnn eval accuracy: {acc:.4f}")
+    bench_batch = jax.device_put(next(iter(ds)), bsh)
+    rate, loss, ms = _time_steps(step, state, bench_batch)
+    eps = rate * batch / n_chips
+    log(f"cifar_cnn: {eps:,.0f} examples/s/chip ({ms*1e3:.2f} ms/step)")
+
+    def torch_build():
+        import torch
+        import torch.nn as nn
+        m = nn.Sequential(
+            nn.Conv2d(3, 32, 3), nn.ReLU(), nn.Conv2d(32, 32, 3), nn.ReLU(),
+            nn.MaxPool2d(2), nn.Conv2d(32, 64, 3), nn.ReLU(),
+            nn.Conv2d(64, 64, 3), nn.ReLU(), nn.MaxPool2d(2), nn.Flatten(),
+            nn.LazyLinear(256), nn.ReLU(), nn.Dropout(0.5), nn.Linear(256, 10))
+        tb = 64
+        x = torch.rand(tb, 3, 32, 32)
+        y = torch.randint(0, 10, (tb,))
+        ce = nn.CrossEntropyLoss()
+        m(x)  # materialize lazy
+        return m, lambda out: ce(out, y), torch.optim.Adam(m.parameters()), (x,), tb
+
+    baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE
+    gate = 0.15 if SMOKE else 0.35
+    return dict(metric="cifar_cnn_train_examples_per_sec_per_chip"
+                       + ("" if acc > gate else "_NOT_CONVERGED"),
+                value=round(eps, 1), unit="examples/sec/chip",
+                vs_baseline=round(eps / baseline, 3),
+                eval_accuracy=round(acc, 4))
+
+
+def bench_resnet50():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import models, optim, parallel, train
+
+    n_chips = len(jax.devices())
+    mesh = parallel.data_parallel_mesh()
+    batch = parallel.round_batch_to_mesh(8 if SMOKE else 64, mesh)
+    size = 64 if SMOKE else 224
+    model = models.resnet50(num_classes=1000)
+    optimizer = optim.momentum(0.1, beta=0.9)
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 optimizer, mesh=mesh)
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (size, size, 3))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    rng = np.random.default_rng(0)
+    x = rng.random((batch, size, size, 3), np.float32)
+    y = rng.integers(0, 1000, batch).astype(np.int32)
+    bsh = NamedSharding(mesh, P("data"))
+    bench_batch = (jax.device_put(jnp.asarray(x, jnp.bfloat16), bsh),
+                   jax.device_put(y, bsh))
+    rate, loss, ms = _time_steps(step, state, bench_batch,
+                                 warmup=2, steps=4 if SMOKE else 10)
+    eps = rate * batch / n_chips
+    log(f"resnet50: {eps:,.1f} examples/s/chip ({ms*1e3:.1f} ms/step, "
+        f"loss={loss:.3f})")
+
+    def torch_build():
+        import torch
+        import torch.nn as nn
+        try:
+            from torchvision.models import resnet50 as tv_resnet50
+            m = tv_resnet50()
+        except Exception:
+            raise RuntimeError("torchvision unavailable")
+        tb = 4
+        x = torch.rand(tb, 3, size, size)
+        y = torch.randint(0, 1000, (tb,))
+        ce = nn.CrossEntropyLoss()
+        return m, lambda out: ce(out, y), \
+            torch.optim.SGD(m.parameters(), 0.1, momentum=0.9), (x,), tb
+
+    baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE
+    finite = np.isfinite(loss)
+    return dict(metric="resnet50_train_examples_per_sec_per_chip"
+                       + ("" if finite else "_NONFINITE_LOSS"),
+                value=round(eps, 2), unit="examples/sec/chip",
+                vs_baseline=round(eps / baseline, 3),
+                image_size=size)
+
+
+def bench_bert():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import optim, train, parallel
+    from distributed_tensorflow_tpu.models.bert import Bert, BertConfig
+
+    n_chips = len(jax.devices())
+    mesh = parallel.data_parallel_mesh()
+    seq = 128
+    batch = parallel.round_batch_to_mesh(4 if SMOKE else 32, mesh)
+    config = (BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                         num_heads=2, intermediate_size=512,
+                         max_position=seq, dtype=jnp.bfloat16) if SMOKE
+              else BertConfig(max_position=seq, dtype=jnp.bfloat16))
+    model = Bert(config)
+    params = model.init(jax.random.PRNGKey(0))
+    optimizer = optim.adamw(1e-4)
+    state = train.TrainState.create(params, optimizer.init(params))
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    step = train.make_custom_train_step(model.mlm_loss_fn(), optimizer,
+                                        grad_clip_norm=1.0)
+    rng = np.random.default_rng(0)
+    bsh = NamedSharding(mesh, P("data"))
+    bench_batch = jax.device_put({
+        "input_ids": rng.integers(0, config.vocab_size,
+                                  (batch, seq)).astype(np.int32),
+        "labels": rng.integers(0, config.vocab_size,
+                               (batch, seq)).astype(np.int32),
+        "mlm_mask": (rng.random((batch, seq)) < 0.15).astype(np.float32),
+        "attention_mask": np.ones((batch, seq), np.int32),
+    }, bsh)
+    rate, loss, ms = _time_steps(step, state, bench_batch,
+                                 warmup=2, steps=4 if SMOKE else 10)
+    tokens = rate * batch * seq / n_chips
+    log(f"bert: {tokens:,.0f} tokens/s/chip ({ms*1e3:.1f} ms/step, "
+        f"loss={loss:.3f})")
+    finite = np.isfinite(loss)
+    return dict(metric="bert_mlm_train_tokens_per_sec_per_chip"
+                       + ("" if finite else "_NONFINITE_LOSS"),
+                value=round(tokens, 1), unit="tokens/sec/chip",
+                vs_baseline=1.0,  # no runnable reference-era BERT baseline
+                seq_len=seq, batch=batch)
+
+
+def bench_mnist_mlp():
     value, acc, value_single = bench_framework()
     baseline = bench_torch_baseline()
     if baseline is None:
         baseline = FALLBACK_BASELINE
     converged = acc > 0.9
-    result = {
+    return {
         "metric": "mnist_mlp_train_examples_per_sec_per_chip"
                   + ("" if converged else "_NOT_CONVERGED"),
         "value": round(value, 1),
@@ -152,6 +361,24 @@ def main():
         "single_step_value": round(value_single, 1),
         "eval_accuracy": round(acc, 4),
     }
+
+
+CONFIGS = {
+    "mnist_mlp": bench_mnist_mlp,
+    "cifar_cnn": bench_cifar_cnn,
+    "resnet50": bench_resnet50,
+    "bert": bench_bert,
+}
+
+
+def main():
+    config = "mnist_mlp"
+    for arg in sys.argv[1:]:
+        config = arg.split("=", 1)[1] if arg.startswith("--config=") else arg
+    if config not in CONFIGS:
+        log(f"unknown config {config!r}; choices: {sorted(CONFIGS)}")
+        sys.exit(2)
+    result = CONFIGS[config]()
     print(json.dumps(result), flush=True)
 
 
