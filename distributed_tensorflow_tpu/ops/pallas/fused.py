@@ -37,9 +37,11 @@ from .common import use_interpret as _use_interpret
 # ---------------------------------------------------------------------------
 
 def _adam_kernel(scalars_ref, p_ref, g_ref, m_ref, v_ref,
-                 po_ref, mo_ref, vo_ref, *, b1, b2, wd):
+                 po_ref, mo_ref, vo_ref, *, b1, b2, wd, delta):
     """scalars: [1, 3] SMEM = (lr_t, eps_t, lr) with bias correction folded
-    into lr_t/eps_t; plain lr drives the decoupled weight-decay term."""
+    into lr_t/eps_t; plain lr drives the decoupled weight-decay term.
+    ``delta``: emit (new_p - p) instead of new_p — free in-kernel (p is
+    already in VMEM) and lets optimizer wrappers report exact updates."""
     lr_t = scalars_ref[0, 0]
     eps_t = scalars_ref[0, 1]
     lr = scalars_ref[0, 2]
@@ -47,10 +49,10 @@ def _adam_kernel(scalars_ref, p_ref, g_ref, m_ref, v_ref,
     p = p_ref[:]
     m = b1 * m_ref[:] + (1.0 - b1) * g
     v = b2 * v_ref[:] + (1.0 - b2) * g * g
-    new_p = p - lr_t * (m / (jnp.sqrt(v) + eps_t))
+    step_term = -lr_t * (m / (jnp.sqrt(v) + eps_t))
     if wd:
-        new_p = new_p - lr * wd * p
-    po_ref[:] = new_p
+        step_term = step_term - lr * wd * p
+    po_ref[:] = step_term if delta else p + step_term
     mo_ref[:] = m
     vo_ref[:] = v
 
@@ -59,6 +61,8 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
                       m: jnp.ndarray, v: jnp.ndarray, step: jnp.ndarray,
                       lr: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
                       eps: float = 1e-8, weight_decay: float = 0.0,
+                      tf14_eps: bool = False,
+                      return_delta: bool = False,
                       interpret: Optional[bool] = None
                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One exact Adam(W) step for a single tensor, fused into one kernel.
@@ -66,8 +70,12 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
     ``step`` is the 1-based step count (traced scalar is fine).  Bias
     correction is folded into scalar prefactors outside the kernel:
     ``p -= lr*sqrt(1-b2^t)/(1-b1^t) * m / (sqrt(v) + eps*sqrt(1-b2^t))``,
-    algebraically identical to the m_hat/v_hat form.  Returns
-    ``(new_params, new_m, new_v)`` with the original shape/dtype.
+    algebraically identical to the m_hat/v_hat form.  ``tf14_eps=True``
+    instead applies eps UN-scaled (``sqrt(v) + eps`` on raw v) — the TF-1.4
+    rule ``optim.adam`` documents; the two differ when eps matters.
+    ``return_delta=True`` returns ``new_p - p`` (f32) in slot 0 instead of
+    new params, for optimizer wrappers that report updates.  Returns
+    ``(new_params_or_delta, new_m, new_v)``.
     """
     if interpret is None:
         interpret = _use_interpret()
@@ -76,7 +84,7 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
     bc1 = 1.0 - jnp.power(jnp.float32(b1), t)
     bc2 = 1.0 - jnp.power(jnp.float32(b2), t)
     lr_t = lr * jnp.sqrt(bc2) / bc1
-    eps_t = eps * jnp.sqrt(bc2)
+    eps_t = jnp.float32(eps) if tf14_eps else eps * jnp.sqrt(bc2)
     scalars = jnp.stack([lr_t, eps_t, jnp.float32(lr)]
                         ).reshape(1, 3).astype(jnp.float32)
 
@@ -98,7 +106,8 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
     tensor_spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
     shape = jax.ShapeDtypeStruct(p2.shape, jnp.float32)
     new_p, new_m, new_v = pl.pallas_call(
-        functools.partial(_adam_kernel, b1=b1, b2=b2, wd=weight_decay),
+        functools.partial(_adam_kernel, b1=b1, b2=b2, wd=weight_decay,
+                          delta=return_delta),
         out_shape=(shape, shape, shape),
         grid=grid,
         in_specs=[
@@ -113,7 +122,8 @@ def fused_adam_update(params: jnp.ndarray, grads: jnp.ndarray,
     n = math.prod(orig_shape) if orig_shape else 1
     def unflat(x, dtype):
         return x.reshape(-1)[:n].reshape(orig_shape).astype(dtype)
-    return (unflat(new_p, orig_dtype), unflat(new_m, jnp.float32),
+    out_dtype = jnp.float32 if return_delta else orig_dtype
+    return (unflat(new_p, out_dtype), unflat(new_m, jnp.float32),
             unflat(new_v, jnp.float32))
 
 
