@@ -86,11 +86,10 @@ class EventFileWriter:
         self._write_record(_version_event(time.time()))
 
     def _write_record(self, payload: bytes) -> None:
-        header = struct.pack("<Q", len(payload))
-        self._file.write(header)
-        self._file.write(struct.pack("<I", masked_crc32c(header)))
-        self._file.write(payload)
-        self._file.write(struct.pack("<I", masked_crc32c(payload)))
+        # One framing implementation repo-wide (lazy import: the data
+        # package initializes after summary on the package import path).
+        from ..data.tfrecord import write_framed
+        write_framed(self._file, payload)
 
     def add_scalars(self, scalars: Dict[str, float],
                     step: Union[int, float],

@@ -1,14 +1,15 @@
 """Training-session layer: state, compiled steps, hooks, checkpointing."""
 
 from . import checkpoint, hooks
-from .hooks import (CheckpointHook, Hook, LoggingHook, NaNHook,
+from .hooks import (CheckpointHook, EvalHook, Hook, LoggingHook, NaNHook,
                     PreemptionHook, ProfilerHook, StopAtStepHook,
                     SummaryHook, WatchdogHook)
 from .session import TrainSession, TrainState
 from .step import (init_train_state, make_custom_train_step, make_eval_step,
                    make_multi_train_step, make_train_step)
 
-__all__ = ["checkpoint", "hooks", "CheckpointHook", "Hook", "LoggingHook",
+__all__ = ["checkpoint", "hooks", "CheckpointHook", "EvalHook", "Hook",
+           "LoggingHook",
            "NaNHook", "PreemptionHook", "ProfilerHook", "StopAtStepHook",
            "SummaryHook", "WatchdogHook",
            "TrainSession", "TrainState", "init_train_state", "make_multi_train_step",

@@ -24,7 +24,7 @@ log = logging.getLogger(__name__)
 
 __all__ = ["Hook", "StopAtStepHook", "CheckpointHook", "SummaryHook",
            "LoggingHook", "NaNHook", "ProfilerHook", "PreemptionHook",
-           "WatchdogHook"]
+           "WatchdogHook", "EvalHook"]
 
 
 class Hook:
@@ -223,6 +223,46 @@ class ProfilerHook(Hook):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+
+
+class EvalHook(Hook):
+    """Periodic validation — the reference's every-5-epochs val accuracy
+    print (example.py:222-226) as a composable hook.
+
+    ``eval_fn(state) -> {name: scalar}`` (typically a closure over
+    ``train.make_eval_step`` and the val set).  Results are logged with a
+    ``val_`` prefix, optionally written to a summary writer, and stored on
+    ``self.last_metrics`` for callers (e.g. early stopping on top).
+    """
+
+    def __init__(self, eval_fn: Callable, every_steps: int,
+                 writer=None, prefix: str = "val_", also_at_end: bool = True):
+        self.eval_fn = eval_fn
+        self.every_steps = max(1, every_steps)
+        self.writer = writer
+        self.prefix = prefix
+        self.also_at_end = also_at_end
+        self.last_metrics: Optional[Dict] = None
+        self._last_eval_step = -1
+
+    def _run(self, session) -> None:
+        metrics = {f"{self.prefix}{k}": float(v)
+                   for k, v in self.eval_fn(session.state).items()}
+        self.last_metrics = metrics
+        self._last_eval_step = session.step
+        line = ", ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+        log.info("step %d: %s", session.step, line)
+        print(f"step {session.step}: {line}", flush=True)
+        if self.writer is not None:
+            self.writer.add_scalars(metrics, session.step)
+
+    def after_step(self, session, metrics) -> None:
+        if session.step % self.every_steps == 0:
+            self._run(session)
+
+    def end(self, session) -> None:
+        if self.also_at_end and session.step != self._last_eval_step:
+            self._run(session)
 
 
 class PreemptionHook(Hook):

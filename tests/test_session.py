@@ -248,3 +248,26 @@ def test_accum_steps_divisibility_error():
     (xt, yt), _ = data.xor_data(30, val_size=10, seed=0)
     with pytest.raises(ValueError, match="not divisible"):
         step(state, (xt[:30], yt[:30]))
+
+
+def test_eval_hook_runs_periodically_and_at_end():
+    model, opt, state, step, ds = make_bits()
+    eval_step = train.make_eval_step(model, "mse",
+                                     metric_fns={"acc": "bitwise_accuracy"})
+    (xv, yv) = data.xor_data(100, val_size=40, seed=1)[1]
+    calls = []
+
+    def eval_fn(s):
+        m = eval_step(s, (xv, yv))
+        calls.append(True)
+        return m
+
+    hook = train.EvalHook(eval_fn, every_steps=3)
+    with train.TrainSession(state, step,
+                            hooks=[hook,
+                                   train.StopAtStepHook(last_step=7)]) as sess:
+        run_session(sess, ds)
+    # fired at steps 3, 6 and once more at end (step 7)
+    assert len(calls) == 3
+    assert hook.last_metrics is not None
+    assert set(hook.last_metrics) == {"val_loss", "val_acc"}
