@@ -74,6 +74,39 @@ def _version_event(wall_time: float) -> bytes:
     return _field_double(1, wall_time) + _field_bytes(3, b"brain.Event:2")
 
 
+def _packed_doubles(num: int, values) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _histogram_proto(values) -> bytes:
+    """HistogramProto{min=1,max=2,num=3,sum=4,sum_squares=5,
+    bucket_limit=6(packed),bucket=7(packed)} over a flat array."""
+    import numpy as np
+    v = np.asarray(values, np.float64).ravel()
+    if v.size == 0:
+        v = np.zeros(1)
+    lo, hi = float(v.min()), float(v.max())
+    if lo == hi:           # degenerate: one bucket holding everything
+        limits = [hi, hi + 1e-12]
+        counts = [float(v.size), 0.0]
+    else:
+        counts_np, edges = np.histogram(v, bins=min(30, max(1, v.size)))
+        limits = list(edges[1:])
+        counts = [float(c) for c in counts_np]
+    return (_field_double(1, lo) + _field_double(2, hi) +
+            _field_double(3, float(v.size)) + _field_double(4, float(v.sum()))
+            + _field_double(5, float(np.square(v).sum()))
+            + _packed_doubles(6, limits) + _packed_doubles(7, counts))
+
+
+def _histogram_event(wall_time: float, step: int, tag: str, values) -> bytes:
+    value = (_field_bytes(1, tag.encode("utf-8"))
+             + _field_bytes(4, _histogram_proto(values)))
+    return (_field_double(1, wall_time) + _field_varint(2, int(step)) +
+            _field_bytes(5, _field_bytes(1, value)))
+
+
 class EventFileWriter:
     """Appends framed Event records to one events file in ``log_dir``."""
 
@@ -97,6 +130,13 @@ class EventFileWriter:
         self._write_record(_scalar_event(
             wall_time if wall_time is not None else time.time(),
             int(step), {k: float(v) for k, v in scalars.items()}))
+
+    def add_histogram(self, tag: str, values, step: Union[int, float],
+                      wall_time: Optional[float] = None) -> None:
+        """Histogram summary (e.g. a weight/gradient tensor per N steps)."""
+        self._write_record(_histogram_event(
+            wall_time if wall_time is not None else time.time(),
+            int(step), tag, values))
 
     def flush(self) -> None:
         self._file.flush()
@@ -131,6 +171,10 @@ class SummaryWriter:
     def add_scalars(self, scalars: Dict[str, float],
                     step: Union[int, float]) -> None:
         self._writer.add_scalars(scalars, step)
+
+    def add_histogram(self, tag: str, values,
+                      step: Union[int, float]) -> None:
+        self._writer.add_histogram(tag, values, step)
 
     def flush(self) -> None:
         self._writer.flush()

@@ -57,8 +57,15 @@ def parse_event(payload):
                     break
             fields.setdefault(num, []).append(val)
         elif wire == 2:
-            ln = payload[off]
-            off += 1
+            ln = 0
+            shift = 0
+            while True:  # varint length (can exceed one byte)
+                b = payload[off]
+                off += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
             fields.setdefault(num, []).append(payload[off:off + ln])
             off += ln
     return fields
@@ -99,3 +106,35 @@ def test_negative_step_does_not_hang(tmp_path):
     w.close()
     (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
     assert len(read_records(path)) == 2
+
+
+def test_histograms_parse(tmp_path):
+    import numpy as np
+    w = SummaryWriter(str(tmp_path))
+    vals = np.concatenate([np.zeros(10), np.ones(30), np.full(60, 2.0)])
+    w.add_histogram("weights/w1", vals, step=3)
+    w.add_histogram("constant", np.full(7, 5.0), step=3)  # degenerate range
+    w.close()
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)[1:]
+    assert len(records) == 2
+    ev = parse_event(records[0])
+    value = parse_event(parse_event(ev[5][0])[1][0])
+    assert value[1][0] == b"weights/w1"
+    histo = parse_event(value[4][0])
+    (mn,) = struct.unpack("<d", histo[1][0])
+    (mx,) = struct.unpack("<d", histo[2][0])
+    (num,) = struct.unpack("<d", histo[3][0])
+    (total,) = struct.unpack("<d", histo[4][0])
+    assert (mn, mx, num) == (0.0, 2.0, 100.0)
+    assert total == vals.sum()
+    # packed bucket arrays decode to matching lengths and full coverage
+    limits = struct.unpack(f"<{len(histo[6][0])//8}d", histo[6][0])
+    counts = struct.unpack(f"<{len(histo[7][0])//8}d", histo[7][0])
+    assert len(limits) == len(counts) and sum(counts) == 100.0
+    # degenerate histogram also parses
+    ev2 = parse_event(records[1])
+    v2 = parse_event(parse_event(ev2[5][0])[1][0])
+    h2 = parse_event(v2[4][0])
+    (n2,) = struct.unpack("<d", h2[3][0])
+    assert n2 == 7.0
