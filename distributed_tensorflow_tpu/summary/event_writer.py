@@ -75,20 +75,26 @@ def _version_event(wall_time: float) -> bytes:
 
 
 def _packed_doubles(num: int, values) -> bytes:
-    payload = b"".join(struct.pack("<d", float(v)) for v in values)
-    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+    return _field_bytes(
+        num, b"".join(struct.pack("<d", float(v)) for v in values))
 
 
 def _histogram_proto(values) -> bytes:
     """HistogramProto{min=1,max=2,num=3,sum=4,sum_squares=5,
-    bucket_limit=6(packed),bucket=7(packed)} over a flat array."""
+    bucket_limit=6(packed),bucket=7(packed)} over a flat array.
+
+    Non-finite entries are dropped before bucketing (the moment a tensor
+    goes NaN is exactly when you want the histogram logged, not a crash);
+    min/max/sum still reflect only the finite values.
+    """
     import numpy as np
     v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
     if v.size == 0:
         v = np.zeros(1)
     lo, hi = float(v.min()), float(v.max())
     if lo == hi:           # degenerate: one bucket holding everything
-        limits = [hi, hi + 1e-12]
+        limits = [hi, float(np.nextafter(hi, np.inf))]
         counts = [float(v.size), 0.0]
     else:
         counts_np, edges = np.histogram(v, bins=min(30, max(1, v.size)))
@@ -101,8 +107,10 @@ def _histogram_proto(values) -> bytes:
 
 
 def _histogram_event(wall_time: float, step: int, tag: str, values) -> bytes:
+    # Summary.Value: tag=1, simple_value=2, image=4, histo=5 (TF
+    # summary.proto oneof) — histograms MUST land in field 5.
     value = (_field_bytes(1, tag.encode("utf-8"))
-             + _field_bytes(4, _histogram_proto(values)))
+             + _field_bytes(5, _histogram_proto(values)))
     return (_field_double(1, wall_time) + _field_varint(2, int(step)) +
             _field_bytes(5, _field_bytes(1, value)))
 

@@ -121,7 +121,8 @@ def test_histograms_parse(tmp_path):
     ev = parse_event(records[0])
     value = parse_event(parse_event(ev[5][0])[1][0])
     assert value[1][0] == b"weights/w1"
-    histo = parse_event(value[4][0])
+    assert 4 not in value  # image slot must stay empty
+    histo = parse_event(value[5][0])  # Summary.Value.histo = field 5
     (mn,) = struct.unpack("<d", histo[1][0])
     (mx,) = struct.unpack("<d", histo[2][0])
     (num,) = struct.unpack("<d", histo[3][0])
@@ -135,6 +136,26 @@ def test_histograms_parse(tmp_path):
     # degenerate histogram also parses
     ev2 = parse_event(records[1])
     v2 = parse_event(parse_event(ev2[5][0])[1][0])
-    h2 = parse_event(v2[4][0])
+    h2 = parse_event(v2[5][0])
     (n2,) = struct.unpack("<d", h2[3][0])
     assert n2 == 7.0
+    lim2 = struct.unpack("<2d", h2[6][0])
+    assert lim2[1] > lim2[0]  # strictly increasing even at huge magnitudes
+
+
+def test_histogram_nonfinite_and_large_constant(tmp_path):
+    import numpy as np
+    w = SummaryWriter(str(tmp_path))
+    w.add_histogram("has_nan", np.array([1.0, np.nan, 2.0, np.inf]), 1)
+    w.add_histogram("big_const", np.full(7, 1e5), 1)
+    w.close()
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)[1:]
+    h = parse_event(parse_event(parse_event(records[0])[5][0])[1][0])
+    histo = parse_event(h[5][0])
+    (num,) = struct.unpack("<d", histo[3][0])
+    assert num == 2.0  # only the finite values counted
+    h2 = parse_event(parse_event(parse_event(records[1])[5][0])[1][0])
+    histo2 = parse_event(h2[5][0])
+    lims = struct.unpack("<2d", histo2[6][0])
+    assert lims[1] > lims[0]
