@@ -6,11 +6,12 @@ from .hooks import (CheckpointHook, EvalHook, Hook, LoggingHook, NaNHook,
                     SummaryHook, WatchdogHook)
 from .session import TrainSession, TrainState
 from .step import (init_train_state, make_custom_train_step, make_eval_step,
-                   make_multi_train_step, make_train_step)
+                   make_multi_train_step, make_train_step,
+                   shard_train_state)
 
 __all__ = ["checkpoint", "hooks", "CheckpointHook", "EvalHook", "Hook",
            "LoggingHook",
            "NaNHook", "PreemptionHook", "ProfilerHook", "StopAtStepHook",
            "SummaryHook", "WatchdogHook",
-           "TrainSession", "TrainState", "init_train_state", "make_multi_train_step",
+           "TrainSession", "TrainState", "init_train_state", "make_multi_train_step", "shard_train_state",
            "make_custom_train_step", "make_eval_step", "make_train_step"]

@@ -29,7 +29,50 @@ from ..optim import optimizers as opt_lib
 from .session import TrainState
 
 __all__ = ["make_train_step", "make_multi_train_step", "make_eval_step",
-           "init_train_state"]
+           "init_train_state", "shard_train_state"]
+
+
+def shard_train_state(state: "TrainState", mesh: Mesh, rules) -> "TrainState":
+    """Place a TrainState for fsdp/tensor-parallel training.
+
+    Params get their rule-table shardings; every opt_state subtree with the
+    SAME tree structure as params (Adam's m/v, momentum's mu) gets the SAME
+    shardings — the ZeRO requirement that optimizer moments shard with
+    their parameters, not replicate.  Everything else (step counters,
+    model_state) replicates.  Use with a plain-jit step (no pinned
+    in_shardings): XLA propagates these placements through the program.
+    """
+    params_sh = rules.tree_shardings(mesh, state.params)
+    params_def = jax.tree_util.tree_structure(state.params)
+    replicated = NamedSharding(mesh, P())
+
+    def place(subtree):
+        if jax.tree_util.tree_structure(subtree) == params_def:
+            return jax.device_put(subtree, params_sh)
+        return jax.device_put(subtree, replicated)
+
+    opt_state = state.opt_state
+    inner = opt_state.inner
+    # Params-shaped FIRST: momentum's mu IS a params-shaped pytree (dict or
+    # bare array) and must shard with the params, not fall into the
+    # per-key dict branch (where no subtree matches) and replicate.
+    if jax.tree_util.tree_structure(inner) == params_def:
+        new_inner = place(inner)
+    elif isinstance(inner, dict):
+        new_inner = {k: place(v) for k, v in inner.items()}
+    elif not jax.tree_util.tree_leaves(inner):
+        new_inner = inner          # stateless (sgd)
+    else:
+        new_inner = place(inner)
+    new_opt = type(opt_state)(jax.device_put(opt_state.count, replicated),
+                              new_inner)
+    return state._replace(
+        step=jax.device_put(state.step, replicated),
+        params=jax.device_put(state.params, params_sh),
+        opt_state=new_opt,
+        model_state=jax.device_put(state.model_state, replicated)
+        if jax.tree_util.tree_leaves(state.model_state)
+        else state.model_state)
 
 
 def init_train_state(model, optimizer, key, in_shape) -> TrainState:

@@ -43,3 +43,76 @@ def test_shard_pytree_places_leaves():
     assert len(out["dense"]["bias"].sharding.device_set) == 8
     shapes = {s.data.shape for s in out["dense"]["kernel"].addressable_shards}
     assert shapes == {(8, 8)}
+
+
+
+def test_fsdp_shards_params_and_optimizer_moments():
+    """ZeRO requirement: Adam m/v shard WITH their params over fsdp; the
+    sharded run matches the replicated run numerically."""
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import optim, train
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"fsdp": 8})
+    model = gpt_tiny(dropout_rate=0.0)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = optim.adam(1e-3)
+    rules = model.partition_rules(fsdp=True)
+
+    state = train.TrainState.create(
+        jax.tree.map(jnp.copy, params), opt.init(params))
+    state = train.shard_train_state(state, mesh, rules)
+    w_in = state.params["decoder"]["ffn"]["w_in"]["kernel"]
+    assert "fsdp" in str(w_in.sharding.spec)
+    m_in = state.opt_state.inner["m"]["decoder"]["ffn"]["w_in"]["kernel"]
+    assert m_in.sharding == w_in.sharding  # moments shard with params
+
+    step = train.make_custom_train_step(model.lm_loss_fn(), opt)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 512)
+    state, m = step(state, {"input_ids": ids})
+    assert np.isfinite(float(m["loss"]))
+    # placements survive the step
+    assert "fsdp" in str(
+        state.params["decoder"]["ffn"]["w_in"]["kernel"].sharding.spec)
+    assert state.opt_state.inner["m"]["decoder"]["ffn"]["w_in"][
+        "kernel"].sharding == state.params["decoder"]["ffn"]["w_in"][
+        "kernel"].sharding
+
+    ref_state = train.TrainState.create(
+        jax.tree.map(jnp.copy, params), opt.init(params))
+    ref_state, ref_m = step(ref_state, {"input_ids": ids})
+    np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-5)
+    # atol 5e-5: sharded reductions reorder float sums vs the replicated run
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=5e-5),
+        jax.device_get(state.params), jax.device_get(ref_state.params))
+
+
+def test_shard_train_state_momentum_and_sgd():
+    """momentum's mu (params-shaped inner) shards WITH params; sgd's empty
+    inner passes through; bare-array params don't crash."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from distributed_tensorflow_tpu import optim, train
+    from distributed_tensorflow_tpu.parallel import (PartitionRules,
+                                                     make_mesh)
+
+    mesh = make_mesh({"fsdp": 8})
+    params = {"dense": {"kernel": jnp.ones((16, 8))}}
+    rules = PartitionRules([(r"kernel", P("fsdp", None))])
+
+    opt = optim.momentum(0.1)
+    state = train.shard_train_state(
+        train.TrainState.create(params, opt.init(params)), mesh, rules)
+    k_sh = state.params["dense"]["kernel"].sharding
+    assert "fsdp" in str(k_sh.spec)
+    assert state.opt_state.inner["dense"]["kernel"].sharding == k_sh
+
+    opt2 = optim.sgd(0.1)
+    s2 = train.shard_train_state(
+        train.TrainState.create(params, opt2.init(params)), mesh, rules)
+    assert s2.opt_state.inner == ()
