@@ -324,14 +324,23 @@ class BatchNorm(Layer):
 
 
 class LayerNorm(Layer):
-    """Layer normalization over the trailing dim (transformer workhorse)."""
+    """Layer normalization over the trailing dim (transformer workhorse).
+
+    ``fused=True`` runs the Pallas TPU kernel (``ops.pallas.fused_layernorm``,
+    one HBM pass; interpret mode off-TPU) — requires both scale and center.
+    """
 
     def __init__(self, epsilon: float = 1e-6, scale: bool = True,
-                 center: bool = True, name: Optional[str] = None):
+                 center: bool = True, fused: bool = False,
+                 name: Optional[str] = None):
         super().__init__(name)
         self.epsilon = float(epsilon)
         self.scale = scale
         self.center = center
+        if fused and not (scale and center):
+            raise ValueError("LayerNorm(fused=True) requires scale and "
+                             "center (the kernel applies gamma and beta)")
+        self.fused = fused
 
     def init(self, key, in_shape):
         del key
@@ -344,6 +353,10 @@ class LayerNorm(Layer):
         return params, {}
 
     def apply(self, params, state, x, *, train=False, rng=None):
+        if self.fused:
+            from .pallas import fused_layernorm
+            return fused_layernorm(x, params["gamma"], params["beta"],
+                                   eps=self.epsilon), state
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)

@@ -78,6 +78,12 @@ flags_lib.DEFINE_string(
 flags_lib.DEFINE_string(
     "device", "", "Force a JAX platform ('tpu', 'cpu'); empty = default")
 flags_lib.DEFINE_integer("epochs", epochs, "Training epochs")
+flags_lib.DEFINE_integer(
+    "accum_steps", 1,
+    "Gradient-accumulation microbatches per update (1 = off)")
+flags_lib.DEFINE_bool(
+    "async_checkpoint", False,
+    "Write checkpoints on a background thread (never stalls the step)")
 flags_lib.DEFINE_integer("batch_size", train_batch_size, "Global batch size")
 flags_lib.DEFINE_integer("seed", 0, "PRNG seed")
 
@@ -160,7 +166,8 @@ def main() -> int:
     metric_fns = {"accuracy": "bitwise_accuracy"}
     train_step = train.make_train_step(model, "mse", optimizer,
                                        metric_fns=metric_fns, mesh=mesh,
-                                       seed=FLAGS.seed)
+                                       seed=FLAGS.seed,
+                                       accum_steps=FLAGS.accum_steps)
     eval_step = train.make_eval_step(model, "mse", metric_fns=metric_fns,
                                      mesh=mesh)
 
@@ -185,7 +192,8 @@ def main() -> int:
     val_batch = jax.device_put((x_val, y_val), batch_sharding)
 
     with train.TrainSession(state, train_step, checkpoint_dir=FLAGS.log_dir,
-                            hooks=hooks, is_chief=is_chief) as sess:
+                            hooks=hooks, is_chief=is_chief,
+                            async_checkpoint=FLAGS.async_checkpoint) as sess:
         start_epoch = sess.step // total_batch
         for epoch in range(start_epoch, FLAGS.epochs):
             if sess.should_stop():

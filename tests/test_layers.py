@@ -123,3 +123,20 @@ def test_avgpool_same_edge_windows():
     x = jnp.ones((1, 3, 3, 1))
     y, _ = ops.AvgPool2D(2, strides=2, padding="SAME").apply({}, {}, x)
     np.testing.assert_allclose(np.asarray(y).ravel(), 1.0, rtol=1e-6)
+
+
+def test_layernorm_fused_matches_reference():
+    import numpy as np
+    import pytest
+    from distributed_tensorflow_tpu import ops
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 9, 32)) * 3 + 1
+    ref_ln = ops.LayerNorm()
+    fus_ln = ops.LayerNorm(fused=True)
+    params, _ = ref_ln.init(jax.random.PRNGKey(1), (32,))
+    params["gamma"] = params["gamma"] * 1.7
+    params["beta"] = params["beta"] + 0.3
+    ref, _ = ref_ln.apply(params, {}, x)
+    got, _ = fus_ln.apply(params, {}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+    with pytest.raises(ValueError, match="fused=True"):
+        ops.LayerNorm(scale=False, fused=True)
