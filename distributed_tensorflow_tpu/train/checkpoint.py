@@ -17,8 +17,8 @@ Design:
     matching the reference's chief semantics (example.py:74-76,190).
   * Restore is *into* a target pytree (same treedef), so restored leaves come
     back with the target's structure; callers re-apply shardings by donating
-    the result to their jitted step (single-controller scale; a
-    multi-host-sharded array writer is layered above this in parallel/).
+    the result to their jitted step (single-controller scale; the multi-host
+    per-shard writer is ``train/sharded_checkpoint.py``).
 """
 from __future__ import annotations
 
@@ -36,6 +36,34 @@ __all__ = ["save", "restore", "latest_checkpoint", "latest_step",
            "all_checkpoints", "AsyncCheckpointer", "ckpt_path"]
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+# npy cannot faithfully serialize extension dtypes (bfloat16, float8_*):
+# their descr degrades to raw void bytes that cannot be cast on load.  Store
+# them viewed as same-width unsigned ints and view back on read.
+_UINT_OF_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _storage_view(a: np.ndarray) -> np.ndarray:
+    """An equal-bytes array whose dtype survives the npy format."""
+    descr = np.lib.format.dtype_to_descr(a.dtype)
+    try:
+        faithful = np.lib.format.descr_to_dtype(descr) == a.dtype
+    except Exception:
+        faithful = False
+    if faithful:
+        return a
+    return a.view(_UINT_OF_WIDTH[a.dtype.itemsize])
+
+
+def _logical_view(a: np.ndarray, dtype) -> np.ndarray:
+    """Undo ``_storage_view``: reinterpret a loaded array as its logical
+    dtype (no-op when it was stored faithfully)."""
+    dtype = np.dtype(dtype)
+    if a.dtype == dtype:
+        return a
+    if a.dtype.itemsize == dtype.itemsize and a.dtype.kind == "u":
+        return a.view(dtype)
+    return a  # dtype changed legitimately (caller casts)
 
 
 def ckpt_path(ckpt_dir: str, step: int) -> str:
@@ -59,7 +87,8 @@ def save(ckpt_dir: str, step: int, tree: Any, max_to_keep: int = 5) -> str:
     tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=ckpt_dir)
     try:
         np.savez(os.path.join(tmp, "arrays.npz"),
-                 **{f"leaf_{i}": leaf for i, leaf in enumerate(leaves)})
+                 **{f"leaf_{i}": _storage_view(leaf)
+                    for i, leaf in enumerate(leaves)})
         manifest = {
             "step": int(step),
             "leaves": [{"path": p, "shape": list(l.shape), "dtype": str(l.dtype)}
@@ -183,7 +212,7 @@ def restore(target: Any, ckpt_path: str) -> Any:
         leaves = []
         for i, ((path, leaf), meta) in enumerate(
                 zip(flat, manifest["leaves"])):
-            stored = z[f"leaf_{i}"]
+            stored = _logical_view(z[f"leaf_{i}"], meta["dtype"])
             want = jax.tree_util.keystr(path)
             if meta["path"] != want:
                 raise ValueError(

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..parallel import cluster
 from . import checkpoint as ckpt_lib
+from . import sharded_checkpoint as sharded_lib
 from .hooks import Hook
 
 log = logging.getLogger(__name__)
@@ -76,7 +77,8 @@ class TrainSession:
                  is_chief: Optional[bool] = None,
                  max_to_keep: int = 5,
                  restore: bool = True,
-                 async_checkpoint: bool = False):
+                 async_checkpoint: bool = False,
+                 sharded_checkpoint: bool = False):
         self.state = state
         self.step_fn = step_fn
         self.checkpoint_dir = checkpoint_dir
@@ -86,12 +88,31 @@ class TrainSession:
         self.last_saved_step = None
         self._stop = False
         self._entered = False
+        # Sharded: every process writes its own chunks (scale path for
+        # pjit-sharded states, train/sharded_checkpoint.py); restore
+        # reassembles only locally-addressable slices.
+        self.sharded = sharded_checkpoint
+        if sharded_checkpoint and async_checkpoint:
+            raise ValueError("sharded_checkpoint does not compose with "
+                             "async_checkpoint yet; pick one")
         # Async: disk writes happen on a background thread (the device->host
         # snapshot still happens inline); drained on session exit.
         self._async_ckpt = (ckpt_lib.AsyncCheckpointer()
                             if async_checkpoint else None)
 
         if restore and checkpoint_dir:
+            if sharded_checkpoint:
+                ckpts = sharded_lib.all_sharded_checkpoints(checkpoint_dir)
+                latest = ckpts[-1] if ckpts else None
+                if latest is not None:
+                    self.state = sharded_lib.restore_sharded(self.state,
+                                                             latest)
+                    self.last_saved_step = self.step
+                    log.info("restored sharded checkpoint %s (step %d)",
+                             latest, self.step)
+                    print(f"Restored checkpoint {os.path.basename(latest)} "
+                          f"at step {self.step}", flush=True)
+                return
             latest = ckpt_lib.latest_checkpoint(checkpoint_dir)
             if latest is not None:
                 self.state = ckpt_lib.restore(self.state, latest)
@@ -124,8 +145,19 @@ class TrainSession:
     # -- checkpointing ----------------------------------------------------
     def save(self) -> Optional[str]:
         """Chief-only checkpoint write (reference chief role,
-        example.py:74-76); non-chief calls are no-ops."""
-        if not (self.is_chief and self.checkpoint_dir):
+        example.py:74-76); non-chief calls are no-ops — except in sharded
+        mode, where EVERY process writes the chunks it owns and only the
+        manifest is chief-only (inside save_sharded)."""
+        if not self.checkpoint_dir:
+            return None
+        if self.sharded:
+            path = sharded_lib.save_sharded(self.checkpoint_dir, self.step,
+                                            self.state,
+                                            max_to_keep=self.max_to_keep)
+            self.last_saved_step = self.step
+            log.info("saved sharded checkpoint %s", path)
+            return path
+        if not self.is_chief:
             return None
         if self._async_ckpt is not None:
             self._async_ckpt.save(self.checkpoint_dir, self.step, self.state,
@@ -159,7 +191,8 @@ class TrainSession:
                     hook.end(self)
                 # last_saved_step (not disk state) is the dedup cursor: an
                 # async write for this step may not have landed yet.
-                if (self.checkpoint_dir and self.is_chief and
+                if (self.checkpoint_dir and
+                        (self.is_chief or self.sharded) and
                         self.last_saved_step != self.step):
                     self.save()
         finally:

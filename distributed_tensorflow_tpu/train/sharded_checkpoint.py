@@ -1,0 +1,292 @@
+"""Multi-host sharded checkpointing: each process writes only its shards.
+
+The plain subsystem (``train/checkpoint.py``) gathers every leaf to one host
+— the right call at the reference's scale (a 29k-param MLP,
+reference example.py:149-155,191) but wrong for pjit-sharded states whose
+global arrays exceed one host's memory (the ResNet/BERT rows of
+BASELINE.md).  This module is the scale path, the analogue of the sharded
+``Saver`` machinery TF's C++ runtime provided under
+``MonitoredTrainingSession(checkpoint_dir=...)``:
+
+  * **Save** — every process writes ONE ``shards-{pid:05d}.npz`` holding the
+    chunks of each leaf that are addressable locally and for which it is the
+    first replica (``replica_id == 0``), so replicated leaves are written
+    once globally, not once per device.  The chief additionally writes
+    ``manifest.json`` (leaf paths, global shapes, dtypes, chunk index) last
+    — its presence marks the checkpoint complete, preserving the atomicity
+    contract of the plain writer.
+  * **Restore** — ``jax.make_array_from_callback`` asks only for the slices
+    each local device needs; the callback assembles them from whatever saved
+    chunks overlap.  The global array is never materialized, and the target
+    sharding may differ from the saved one (different mesh shape, axis
+    order, or axis names) — resharding happens chunk-wise on the host.
+
+On a real pod the checkpoint directory must be shared (or gathered) storage;
+single-host multi-device meshes (the test fixture, SURVEY.md §4) exercise
+the same chunk-indexed format with one process.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from . import checkpoint as _plain
+
+__all__ = ["save_sharded", "restore_sharded", "is_sharded_checkpoint"]
+
+_SHARD_FILE = "shards-{pid:05d}.npz"
+
+
+def _chunk_key(leaf_i: int, start: Sequence[int]) -> str:
+    return f"leaf_{leaf_i}@" + ",".join(str(int(s)) for s in start)
+
+
+def _parse_chunk_key(key: str) -> Tuple[int, Tuple[int, ...]]:
+    head, _, tail = key.partition("@")
+    leaf_i = int(head[len("leaf_"):])
+    start = tuple(int(s) for s in tail.split(",")) if tail else ()
+    return leaf_i, start
+
+
+def _index_starts(index: Tuple[slice, ...], shape: Sequence[int]) -> Tuple[int, ...]:
+    return tuple(0 if s.start is None else int(s.start)
+                 for s in index) or tuple([0] * len(shape))
+
+
+def save_sharded(ckpt_dir: str, step: int, tree: Any,
+                 max_to_keep: int = 5,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 sync_fn=None) -> str:
+    """Write this process's shards of ``tree``; chief finalizes the manifest.
+
+    Every process (not just the chief) must call this — each owns distinct
+    chunks.  ``sync_fn``, when given, is called as a barrier between the
+    shard writes and the chief's manifest write (on a pod, pass e.g. a
+    ``jax.experimental.multihost_utils.sync_global_devices`` wrapper); with
+    one process the default no-op is exact.  Returns the checkpoint dir.
+    """
+    pid = jax.process_index() if process_index is None else process_index
+    nproc = jax.process_count() if process_count is None else process_count
+    chief = pid == 0
+    final = _plain.ckpt_path(ckpt_dir, step)
+    os.makedirs(final, exist_ok=True)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [jax.tree_util.keystr(p) for p, _ in flat]
+
+    chunks: Dict[str, np.ndarray] = {}
+    # manifest rows: one per leaf; chunk list only filled by the owner rows
+    leaves_meta: List[Dict[str, Any]] = []
+    my_chunks: List[Dict[str, Any]] = []
+    for i, (_, leaf) in enumerate(flat):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            gshape = tuple(leaf.shape)
+            dtype = str(leaf.dtype)
+            seen = set()
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue  # identical copy owned by another device
+                start = _index_starts(shard.index, gshape)
+                if start in seen:
+                    continue
+                seen.add(start)
+                data = np.asarray(jax.device_get(shard.data))
+                chunks[_chunk_key(i, start)] = _plain._storage_view(data)
+                my_chunks.append({"leaf": i, "start": list(start),
+                                  "shape": list(data.shape), "pid": pid})
+            leaves_meta.append({"path": paths[i], "shape": list(gshape),
+                                "dtype": dtype, "kind": "sharded"})
+        else:
+            # host scalars / numpy leaves: chief owns them whole
+            data = np.asarray(leaf)
+            if chief:
+                start = tuple([0] * data.ndim)
+                chunks[_chunk_key(i, start)] = _plain._storage_view(data)
+                my_chunks.append({"leaf": i, "start": list(start),
+                                  "shape": list(data.shape), "pid": pid})
+            leaves_meta.append({"path": paths[i], "shape": list(data.shape),
+                                "dtype": str(data.dtype), "kind": "host"})
+
+    shard_name = _SHARD_FILE.format(pid=pid)
+    fd, tmp = tempfile.mkstemp(prefix=".shard-tmp-", dir=final)
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **chunks)
+        os.replace(tmp, os.path.join(final, shard_name))
+        with open(os.path.join(final, f"chunks-{pid:05d}.json"), "w") as f:
+            json.dump(my_chunks, f)
+    except Exception:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+    if sync_fn is not None:
+        sync_fn()
+
+    if chief:
+        # Collect every process's chunk index into the manifest.  On shared
+        # storage all chunks-*.json files are visible after the barrier.
+        all_chunks: List[Dict[str, Any]] = []
+        for p in range(nproc):
+            cpath = os.path.join(final, f"chunks-{p:05d}.json")
+            if os.path.exists(cpath):
+                with open(cpath) as f:
+                    all_chunks.extend(json.load(f))
+        manifest = {"step": int(step), "format": "sharded-v1",
+                    "process_count": nproc, "leaves": leaves_meta,
+                    "chunks": all_chunks}
+        mtmp = os.path.join(final, ".manifest-tmp")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f, indent=1)
+        os.replace(mtmp, os.path.join(final, "manifest.json"))
+        with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
+            f.write(os.path.basename(final) + "\n")
+        if max_to_keep and max_to_keep > 0:
+            for old in all_sharded_checkpoints(ckpt_dir)[:-max_to_keep]:
+                shutil.rmtree(old, ignore_errors=True)
+    return final
+
+
+def is_sharded_checkpoint(ckpt_path: str) -> bool:
+    mpath = os.path.join(ckpt_path, "manifest.json")
+    if not os.path.exists(mpath):
+        return False
+    with open(mpath) as f:
+        return json.load(f).get("format") == "sharded-v1"
+
+
+def all_sharded_checkpoints(ckpt_dir: str) -> List[str]:
+    """Complete (manifest-finalized) sharded checkpoints, oldest → newest."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    found = []
+    for name in os.listdir(ckpt_dir):
+        m = _plain._CKPT_RE.match(name)
+        path = os.path.join(ckpt_dir, name)
+        if m and is_sharded_checkpoint(path):
+            found.append((int(m.group(1)), path))
+    return [p for _, p in sorted(found)]
+
+
+class _ChunkReader:
+    """Lazy reader over every process's shard file for one checkpoint."""
+
+    def __init__(self, ckpt_path: str, manifest: Dict[str, Any]):
+        self._path = ckpt_path
+        self._files: Dict[int, Any] = {}
+        # leaf index -> the dtype it was SAVED with (extension dtypes are
+        # stored uint-encoded; see checkpoint._storage_view)
+        self._saved_dtypes = {i: m["dtype"]
+                              for i, m in enumerate(manifest["leaves"])}
+        # leaf index -> [(start, shape, pid)]
+        self._by_leaf: Dict[int, List[Tuple[Tuple[int, ...], Tuple[int, ...], int]]] = {}
+        for c in manifest["chunks"]:
+            self._by_leaf.setdefault(int(c["leaf"]), []).append(
+                (tuple(c["start"]), tuple(c["shape"]), int(c["pid"])))
+
+    def _file(self, pid: int):
+        if pid not in self._files:
+            self._files[pid] = np.load(
+                os.path.join(self._path, _SHARD_FILE.format(pid=pid)))
+        return self._files[pid]
+
+    def read(self, leaf_i: int, index: Tuple[slice, ...],
+             shape: Sequence[int], dtype) -> np.ndarray:
+        """Assemble the slice ``index`` of leaf ``leaf_i`` from saved chunks."""
+        want_start = [0 if s.start is None else int(s.start) for s in index]
+        want_stop = [shape[d] if s.stop is None else int(s.stop)
+                     for d, s in enumerate(index)]
+        out = np.empty([b - a for a, b in zip(want_start, want_stop)],
+                       dtype=dtype)
+        filled = np.zeros(out.shape, dtype=bool) if out.size else None
+        for start, cshape, pid in self._by_leaf.get(leaf_i, []):
+            lo = [max(a, s) for a, s in zip(want_start, start)]
+            hi = [min(b, s + c) for b, s, c in zip(want_stop, start, cshape)]
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue  # no overlap
+            chunk = _plain._logical_view(
+                self._file(pid)[_chunk_key(leaf_i, start)],
+                self._saved_dtypes[leaf_i])
+            src = tuple(slice(l - s, h - s) for l, s, h in zip(lo, start, hi))
+            dst = tuple(slice(l - a, h - a)
+                        for l, a, h in zip(lo, want_start, hi))
+            out[dst] = chunk[src]
+            if filled is not None:
+                filled[dst] = True
+        if filled is not None and not filled.all():
+            raise ValueError(
+                f"checkpoint chunks do not cover leaf {leaf_i} slice "
+                f"{index} — missing shard files?")
+        return out
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+
+
+def restore_sharded(target: Any, ckpt_path: str,
+                    shardings: Any = None) -> Any:
+    """Load a sharded checkpoint into the structure (and placement) of
+    ``target``.
+
+    ``shardings``: optional pytree of ``jax.sharding.Sharding`` matching
+    ``target``'s structure.  When omitted, each jax.Array leaf of ``target``
+    keeps its own sharding.  Only the slices addressable on this process are
+    read from disk.
+    """
+    with open(os.path.join(ckpt_path, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != "sharded-v1":
+        raise ValueError(f"{ckpt_path} is not a sharded-v1 checkpoint")
+    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+    metas = manifest["leaves"]
+    if len(flat) != len(metas):
+        raise ValueError(
+            f"checkpoint has {len(metas)} leaves but target has {len(flat)}")
+    # keep None entries (= "use the target leaf's own placement / host")
+    sh_flat = (None if shardings is None
+               else jax.tree_util.tree_flatten(
+                   shardings, is_leaf=lambda x: x is None)[0])
+    if sh_flat is not None and len(sh_flat) != len(flat):
+        raise ValueError("shardings tree does not match target structure")
+
+    reader = _ChunkReader(ckpt_path, manifest)
+    try:
+        leaves = []
+        for i, ((path, leaf), meta) in enumerate(zip(flat, metas)):
+            want = jax.tree_util.keystr(path)
+            if meta["path"] != want:
+                raise ValueError(
+                    f"leaf {i} path mismatch: checkpoint {meta['path']!r} "
+                    f"vs target {want!r}")
+            gshape = tuple(meta["shape"])
+            if tuple(np.shape(leaf)) != gshape:
+                raise ValueError(
+                    f"leaf {want}: checkpoint shape {gshape} vs target "
+                    f"{np.shape(leaf)}")
+            sharding = (sh_flat[i] if sh_flat is not None else
+                        leaf.sharding if isinstance(leaf, jax.Array) else None)
+            if sharding is not None:
+                dtype = (leaf.dtype if isinstance(leaf, jax.Array)
+                         else np.dtype(meta["dtype"]))
+                arr = jax.make_array_from_callback(
+                    gshape, sharding,
+                    lambda idx, i=i, d=dtype: reader.read(i, idx, gshape, d))
+                leaves.append(arr)
+            else:
+                dtype = np.asarray(leaf).dtype
+                full = reader.read(
+                    i, tuple(slice(0, s) for s in gshape) or (),
+                    gshape, dtype)
+                leaves.append(full if gshape else full[()])
+    finally:
+        reader.close()
+    return jax.tree_util.tree_unflatten(treedef, leaves)

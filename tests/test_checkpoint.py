@@ -46,3 +46,15 @@ def test_restore_structure_mismatch(tmp_path):
 def test_empty_dir(tmp_path):
     assert ck.latest_checkpoint(str(tmp_path)) is None
     assert ck.latest_step(str(tmp_path)) is None
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    """bf16 leaves survive npz save/restore (stored uint16-encoded)."""
+    d = str(tmp_path)
+    t = {"w": jnp.arange(8, dtype=jnp.bfloat16)}
+    ck.save(d, 1, t)
+    out = ck.restore({"w": jnp.zeros(8, jnp.bfloat16)},
+                     ck.latest_checkpoint(d))
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out["w"], np.float32),
+                                  np.arange(8, dtype=np.float32))
