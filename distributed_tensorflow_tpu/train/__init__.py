@@ -1,6 +1,8 @@
 """Training-session layer: state, compiled steps, hooks, checkpointing."""
 
-from . import checkpoint, hooks, sharded_checkpoint
+from . import checkpoint, hooks, precision, sharded_checkpoint
+from .precision import (DynamicLossScale, Policy, StaticLossScale,
+                        attach_loss_scale)
 from .sharded_checkpoint import restore_sharded, save_sharded
 from .hooks import (CheckpointHook, EvalHook, Hook, LoggingHook, NaNHook,
                     PreemptionHook, ProfilerHook, StopAtStepHook,
@@ -10,8 +12,10 @@ from .step import (init_train_state, make_custom_train_step, make_eval_step,
                    make_multi_train_step, make_train_step,
                    shard_train_state)
 
-__all__ = ["checkpoint", "hooks", "sharded_checkpoint", "save_sharded",
-           "restore_sharded", "CheckpointHook", "EvalHook", "Hook",
+__all__ = ["checkpoint", "hooks", "precision", "sharded_checkpoint",
+           "save_sharded", "restore_sharded", "Policy", "StaticLossScale",
+           "DynamicLossScale", "attach_loss_scale",
+           "CheckpointHook", "EvalHook", "Hook",
            "LoggingHook",
            "NaNHook", "PreemptionHook", "ProfilerHook", "StopAtStepHook",
            "SummaryHook", "WatchdogHook",

@@ -1,0 +1,208 @@
+"""Mixed-precision policies and loss scaling.
+
+The reference trains in float32 end-to-end (TF 1.4 defaults; nothing in
+reference example.py selects a dtype).  On TPU the MXU's native input format
+is bfloat16 — matmuls run at full rate with bf16 inputs and f32
+accumulation — so the idiomatic setup is **params in float32, compute in
+bfloat16**, which needs no loss scaling (bf16 keeps float32's exponent
+range).  Loss scaling is still provided for float16-style narrow-range
+formats and as the standard guard-rail subsystem a framework owes its
+users: scale the loss up before backward so small gradients stay
+representable, unscale before the update, skip the update and shrink the
+scale when non-finite gradients appear, and grow it back after a streak of
+finite steps.
+
+Pieces:
+  * ``Policy(param_dtype, compute_dtype, output_dtype)`` + ``policy(str)``
+    parser: ``policy("mixed_bfloat16")``, ``policy("float32")``, or an
+    explicit ``"params=float32,compute=bfloat16,output=float32"``.
+  * ``StaticLossScale`` / ``DynamicLossScale`` / ``NoLossScale`` — pytree
+    values (they checkpoint and cross jit boundaries with the TrainState).
+  * ``attach_loss_scale(state, ls)`` wraps a TrainState's ``model_state``
+    in a ``LossScaled`` record; the step builders
+    (``make_custom_train_step(loss_scale=True)``) unwrap it, scale the
+    loss, unscale gradients, and thread the adjusted scale forward.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Policy", "policy", "all_finite", "NoLossScale",
+           "StaticLossScale", "DynamicLossScale", "LossScaled",
+           "attach_loss_scale"]
+
+_ABBREV = {
+    "f32": "float32", "f16": "float16", "bf16": "bfloat16",
+    "float32": "float32", "float16": "float16", "bfloat16": "bfloat16",
+    "float64": "float64", "f64": "float64",
+}
+
+
+class Policy(NamedTuple):
+    """Which dtype each tensor class lives in (jmp-style three-way split)."""
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    def _cast(self, tree, dtype):
+        def leaf(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dtype)
+            return x
+        return jax.tree.map(leaf, tree)
+
+    def cast_to_compute(self, tree):
+        """Floating leaves -> compute dtype (ints/bools untouched)."""
+        return self._cast(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree):
+        return self._cast(tree, self.param_dtype)
+
+    def cast_to_output(self, tree):
+        return self._cast(tree, self.output_dtype)
+
+
+def policy(spec: Union[str, Policy, None]) -> Policy:
+    """Parse a policy string.
+
+    ``"mixed_bfloat16"`` / ``"mixed_float16"``: f32 params, narrow compute,
+    f32 output — the standard mixed recipes.  ``"bfloat16"``/``"float32"``:
+    one dtype everywhere.  Or explicit comma form
+    ``"params=float32,compute=bfloat16,output=float32"`` (keys may be
+    abbreviated ``p=/c=/o=``, dtypes ``f32/bf16/f16``).
+    """
+    if spec is None:
+        return Policy()
+    if isinstance(spec, Policy):
+        return spec
+    s = spec.strip().lower()
+    if s in ("mixed_bfloat16", "mixed_bf16"):
+        return Policy(jnp.float32, jnp.bfloat16, jnp.float32)
+    if s in ("mixed_float16", "mixed_f16"):
+        return Policy(jnp.float32, jnp.float16, jnp.float32)
+    if s in _ABBREV:
+        d = jnp.dtype(_ABBREV[s])
+        return Policy(d, d, d)
+    out = {}
+    for part in s.split(","):
+        k, _, v = part.partition("=")
+        k = {"p": "param", "params": "param", "param": "param",
+             "c": "compute", "compute": "compute",
+             "o": "output", "output": "output"}.get(k.strip())
+        if k is None or v.strip() not in _ABBREV:
+            raise ValueError(f"unparseable policy fragment {part!r} in "
+                             f"{spec!r}")
+        out[k + "_dtype"] = jnp.dtype(_ABBREV[v.strip()])
+    return Policy(**out)
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """Scalar bool: every element of every floating leaf is finite."""
+    leaves = [x for x in jax.tree.leaves(tree)
+              if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]).all()
+
+
+class NoLossScale(NamedTuple):
+    """Identity scale — lets one code path serve scaled and unscaled runs."""
+
+    def scale(self, x):
+        return x
+
+    def unscale(self, tree):
+        return tree
+
+    def adjust(self, grads_finite):
+        del grads_finite
+        return self
+
+    @property
+    def scale_value(self):
+        return jnp.asarray(1.0, jnp.float32)
+
+
+class StaticLossScale(NamedTuple):
+    """Fixed multiplier (still skips non-finite updates downstream)."""
+    value: jnp.ndarray
+
+    @classmethod
+    def create(cls, value: float):
+        return cls(jnp.asarray(value, jnp.float32))
+
+    def scale(self, x):
+        return x * self.value.astype(x.dtype)
+
+    def unscale(self, tree):
+        inv = (1.0 / self.value)
+        return jax.tree.map(lambda g: g * inv.astype(g.dtype), tree)
+
+    def adjust(self, grads_finite):
+        del grads_finite
+        return self
+
+    @property
+    def scale_value(self):
+        return self.value
+
+
+class DynamicLossScale(NamedTuple):
+    """TF/jmp-style dynamic scale: halve on overflow, double after
+    ``growth_interval`` consecutive finite steps."""
+    value: jnp.ndarray            # f32 scalar, current scale
+    streak: jnp.ndarray           # int32, consecutive finite steps
+    growth_interval: int = 2000
+    factor: float = 2.0
+    min_value: float = 1.0
+
+    @classmethod
+    def create(cls, initial: float = 2.0 ** 15, growth_interval: int = 2000,
+               factor: float = 2.0, min_value: float = 1.0):
+        return cls(jnp.asarray(initial, jnp.float32),
+                   jnp.zeros((), jnp.int32),
+                   growth_interval=growth_interval, factor=factor,
+                   min_value=min_value)
+
+    def scale(self, x):
+        return x * self.value.astype(x.dtype)
+
+    def unscale(self, tree):
+        inv = 1.0 / self.value
+        return jax.tree.map(lambda g: g * inv.astype(g.dtype), tree)
+
+    def adjust(self, grads_finite) -> "DynamicLossScale":
+        grow = self.streak + 1 >= self.growth_interval
+        new_value = jnp.where(
+            grads_finite,
+            jnp.where(grow, self.value * self.factor, self.value),
+            jnp.maximum(self.value / self.factor, self.min_value))
+        new_streak = jnp.where(grads_finite & ~grow, self.streak + 1, 0)
+        return self._replace(value=new_value,
+                             streak=new_streak.astype(jnp.int32))
+
+    @property
+    def scale_value(self):
+        return self.value
+
+
+LossScale = Union[NoLossScale, StaticLossScale, DynamicLossScale]
+
+
+class LossScaled(NamedTuple):
+    """``model_state`` wrapper carrying the loss-scale state through the
+    TrainState (so it checkpoints and resumes with everything else)."""
+    model_state: Any
+    loss_scale: Any
+
+
+def attach_loss_scale(state, loss_scale: LossScale):
+    """Wrap ``state.model_state`` so a ``loss_scale=True`` train step can
+    thread the scale.  Use before the first step (and after restore-less
+    init); checkpoints taken afterwards round-trip the wrapper."""
+    return state._replace(
+        model_state=LossScaled(state.model_state, loss_scale))

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import losses as loss_lib
 from ..ops import metrics as metric_lib
 from ..optim import optimizers as opt_lib
+from . import precision as prec_lib
 from .session import TrainState
 
 __all__ = ["make_train_step", "make_multi_train_step", "make_eval_step",
@@ -113,7 +114,9 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                     batch_spec: P = P("data"),
                     jit: bool = True,
                     grad_clip_norm: Optional[float] = None,
-                    accum_steps: int = 1) -> Callable:
+                    accum_steps: int = 1,
+                    policy: Any = None,
+                    loss_scale: bool = False) -> Callable:
     """Build ``step(state, (x, y)) -> (new_state, metrics)``.
 
     Thin adapter over ``make_custom_train_step``: wraps the (model, loss,
@@ -145,7 +148,8 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                                   state_shardings=state_shardings,
                                   batch_shardings=batch_shardings, jit=jit,
                                   grad_clip_norm=grad_clip_norm,
-                                  accum_steps=accum_steps)
+                                  accum_steps=accum_steps, policy=policy,
+                                  loss_scale=loss_scale)
 
 
 def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
@@ -155,7 +159,9 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
                            batch_shardings: Any = None,
                            jit: bool = True,
                            grad_clip_norm: Optional[float] = None,
-                           accum_steps: int = 1) -> Callable:
+                           accum_steps: int = 1,
+                           policy: Any = None,
+                           loss_scale: bool = False) -> Callable:
     """Generalized step builder for model families with structured batches.
 
     ``loss_fn(params, model_state, batch, rng, train) ->
@@ -177,20 +183,46 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
     (e.g. the mask sum); accumulation then weights every microbatch's
     gradients/loss/metrics by it, recovering the exact full-batch gradient.
     Without that key all microbatches weigh 1 (exact for plain-mean losses).
+
+    ``policy``: a precision.Policy (or its string spec, e.g.
+    ``"mixed_bfloat16"``) — params are cast to the compute dtype inside the
+    differentiated function, so gradients come back in the param dtype and
+    the master copy stays full-precision.  ``loss_scale=True``: the state's
+    ``model_state`` must be wrapped via ``precision.attach_loss_scale``;
+    the step scales the loss, unscales the gradients, SKIPS the update on
+    non-finite gradients, and threads the adjusted scale forward (reported
+    as ``metrics['loss_scale']`` / ``metrics['grads_finite']``).
     """
     base_key = jax.random.PRNGKey(seed)
+    pol = prec_lib.policy(policy) if policy is not None else None
 
-    def grad_of(params, model_state, mb, rng):
+    def grad_of(params, model_state, mb, rng, ls=None):
         def compute(p):
-            return loss_fn(p, model_state, mb, rng, True)
+            mb_ = mb
+            if pol is not None:
+                p = pol.cast_to_compute(p)
+                mb_ = pol.cast_to_compute(mb)
+            value, aux = loss_fn(p, model_state, mb_, rng, True)
+            if ls is not None:
+                value = ls.scale(value)
+            return value, aux
         return jax.value_and_grad(compute, has_aux=True)(params)
 
     def step(state: TrainState, batch):
         rng = jax.random.fold_in(base_key, state.step)
+        if loss_scale:
+            if not isinstance(state.model_state, prec_lib.LossScaled):
+                raise TypeError(
+                    "loss_scale=True needs state.model_state wrapped by "
+                    "precision.attach_loss_scale(state, loss_scale)")
+            model_state_in = state.model_state.model_state
+            ls = state.model_state.loss_scale
+        else:
+            model_state_in, ls = state.model_state, None
 
         if accum_steps == 1:
             (loss_value, (metrics, new_model_state)), grads = grad_of(
-                state.params, state.model_state, batch, rng)
+                state.params, model_state_in, batch, rng, ls)
         else:
             lead = {a.shape[0] for a in jax.tree.leaves(batch)}
             bad = [n for n in lead if n % accum_steps]
@@ -204,7 +236,7 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
             mb_shapes = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), mbs)
             (loss_s, (metrics_s, _)), grads_s = jax.eval_shape(
-                grad_of, state.params, state.model_state, mb_shapes, rng)
+                grad_of, state.params, model_state_in, mb_shapes, rng)
             has_weight = "loss_weight" in metrics_s
             metrics_s = dict(metrics_s)
             metrics_s.pop("loss_weight", None)
@@ -217,7 +249,8 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
                 grads, loss_sum, metrics_sum, model_state, w_sum = carry
                 mb, i = inp
                 (l, (m, model_state)), g = grad_of(
-                    state.params, model_state, mb, jax.random.fold_in(rng, i))
+                    state.params, model_state, mb, jax.random.fold_in(rng, i),
+                    ls)
                 m = dict(m)
                 w = m.pop("loss_weight", jnp.ones((), jnp.float32))
                 w = w.astype(jnp.float32)
@@ -228,7 +261,7 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
                         w_sum + w), None
 
             carry0 = (zeros(grads_s), jnp.zeros(loss_s.shape, loss_s.dtype),
-                      zeros(metrics_s), state.model_state,
+                      zeros(metrics_s), model_state_in,
                       jnp.zeros((), jnp.float32))
             (grads, loss_value, metrics, new_model_state, w_sum), _ = \
                 jax.lax.scan(body, carry0, (mbs, jnp.arange(accum_steps)))
@@ -238,6 +271,16 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
             metrics = jax.tree.map(lambda m: m * inv, metrics)
             if has_weight:
                 metrics["loss_weight"] = w_sum
+        if ls is not None:
+            grads = ls.unscale(grads)
+            loss_value = ls.unscale(loss_value)
+            finite = prec_lib.all_finite(grads)
+            new_ls = ls.adjust(finite)
+            # Zero the grads on overflow: the update is dropped below, and
+            # this keeps inf/nan out of everything derived from them
+            # (grad_norm metric, optimizer moment math).
+            grads = jax.tree.map(
+                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
         metrics = {"loss": loss_value, **metrics}
         if grad_clip_norm is not None:
             grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)
@@ -245,6 +288,17 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
         updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                                   state.params)
         new_params = opt_lib.apply_updates(state.params, updates)
+        if ls is not None:
+            # Non-finite grads: drop the whole update (params AND optimizer
+            # state, including its step count — bias correction must not see
+            # skipped steps), shrink the scale, advance only the cursor.
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new, old)
+            new_params = keep(new_params, state.params)
+            new_opt_state = keep(new_opt_state, state.opt_state)
+            metrics["grads_finite"] = finite
+            metrics["loss_scale"] = new_ls.scale_value
+            new_model_state = prec_lib.LossScaled(new_model_state, new_ls)
         return TrainState(step=state.step + 1, params=new_params,
                           opt_state=new_opt_state,
                           model_state=new_model_state), metrics
@@ -264,7 +318,9 @@ def make_multi_train_step(model, loss, optimizer: opt_lib.Optimizer,
                           mesh: Optional[Mesh] = None,
                           params_spec: Any = None,
                           batch_spec: P = P("data"),
-                          grad_clip_norm: Optional[float] = None) -> Callable:
+                          grad_clip_norm: Optional[float] = None,
+                          policy: Any = None,
+                          loss_scale: bool = False) -> Callable:
     """``step(state, (xs, ys)) -> (state, metrics)`` running
     ``steps_per_call`` updates in ONE dispatch via ``lax.scan``.
 
@@ -279,7 +335,8 @@ def make_multi_train_step(model, loss, optimizer: opt_lib.Optimizer,
     """
     inner = make_train_step(model, loss, optimizer, metric_fns=metric_fns,
                             seed=seed, jit=False,
-                            grad_clip_norm=grad_clip_norm)
+                            grad_clip_norm=grad_clip_norm, policy=policy,
+                            loss_scale=loss_scale)
 
     def multi(state: TrainState, batch):
         return jax.lax.scan(inner, state, batch, length=steps_per_call)
@@ -303,7 +360,11 @@ def make_eval_step(model, loss,
 
     def eval_step(state: TrainState, batch):
         x, y = batch
-        preds, _ = model.apply(state.params, state.model_state, x,
+        # A loss-scaled TrainState wraps model_state; models see through it.
+        model_state = state.model_state
+        if isinstance(model_state, prec_lib.LossScaled):
+            model_state = model_state.model_state
+        preds, _ = model.apply(state.params, model_state, x,
                                train=False, rng=None)
         metrics = {"loss": loss_fn(preds, y)}
         metrics.update(_metric_dict(metric_fns, preds, y))

@@ -1,0 +1,191 @@
+"""Mixed-precision policy and loss-scaling tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import models, optim, train
+from distributed_tensorflow_tpu.train import precision as prec
+
+
+def test_policy_parsing():
+    p = prec.policy("mixed_bfloat16")
+    assert p.param_dtype == jnp.float32
+    assert p.compute_dtype == jnp.bfloat16
+    assert p.output_dtype == jnp.float32
+    p = prec.policy("bf16")
+    assert p.param_dtype == p.compute_dtype == jnp.bfloat16
+    p = prec.policy("params=f32,compute=bf16,output=f32")
+    assert p.compute_dtype == jnp.bfloat16
+    p = prec.policy("p=f16,c=f16,o=f32")
+    assert p.param_dtype == jnp.float16 and p.output_dtype == jnp.float32
+    assert prec.policy(None) == prec.Policy()
+    with pytest.raises(ValueError, match="unparseable"):
+        prec.policy("compute=int8")
+
+
+def test_policy_casts_only_floats():
+    p = prec.policy("mixed_bfloat16")
+    tree = {"w": jnp.ones(3, jnp.float32), "ids": jnp.ones(3, jnp.int32)}
+    out = p.cast_to_compute(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["ids"].dtype == jnp.int32
+
+
+def test_all_finite():
+    assert bool(prec.all_finite({"a": jnp.ones(3)}))
+    assert not bool(prec.all_finite({"a": jnp.array([1.0, jnp.inf])}))
+    assert bool(prec.all_finite({"ids": jnp.ones(3, jnp.int32)}))  # no floats
+
+
+def test_dynamic_loss_scale_adjust():
+    ls = prec.DynamicLossScale.create(1024.0, growth_interval=2)
+    ls = ls.adjust(jnp.asarray(False))           # overflow: halve
+    assert float(ls.value) == 512.0 and int(ls.streak) == 0
+    ls = ls.adjust(jnp.asarray(True))            # finite 1/2
+    assert float(ls.value) == 512.0 and int(ls.streak) == 1
+    ls = ls.adjust(jnp.asarray(True))            # finite 2/2: double
+    assert float(ls.value) == 1024.0 and int(ls.streak) == 0
+    tiny = prec.DynamicLossScale.create(1.0)
+    assert float(tiny.adjust(jnp.asarray(False)).value) == 1.0  # min clamp
+
+
+def test_mixed_bf16_step_keeps_f32_master_params():
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.adam()
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 optimizer, policy="mixed_bfloat16")
+    x = jnp.ones((8, 784))
+    y = jnp.zeros((8,), jnp.int32)
+    state2, metrics = step(state, (x, y))
+    assert np.isfinite(float(metrics["loss"]))
+    for leaf in jax.tree.leaves(state2.params):
+        assert leaf.dtype == jnp.float32  # master copy untouched by casts
+
+
+def test_loss_scale_skips_nonfinite_update():
+    """A poisoned batch (inf input) must leave params/opt state untouched
+    and halve the scale; a clean batch then updates normally."""
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.adam()
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    ls = prec.DynamicLossScale.create(1024.0, growth_interval=1000)
+    state = train.attach_loss_scale(state, ls)
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 optimizer, loss_scale=True)
+    y = jnp.zeros((8,), jnp.int32)
+    bad_x = jnp.full((8, 784), jnp.inf)
+    before = [np.asarray(l) for l in jax.tree.leaves(state.params)]
+    state2, m = step(state, (bad_x, y))   # donates state
+    assert not bool(m["grads_finite"])
+    assert float(m["loss_scale"]) == 512.0
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(state2.params)[0]), before[0])
+    assert int(state2.opt_state.count) == 0   # optimizer saw no step
+    assert int(state2.step) == 1              # cursor still advances
+
+    good_x = jnp.ones((8, 784))
+    p2 = [np.asarray(l) for l in jax.tree.leaves(state2.params)]
+    state3, m = step(state2, (good_x, y))  # donates state2
+    assert bool(m["grads_finite"])
+    assert int(state3.opt_state.count) == 1
+    changed = any(
+        not np.array_equal(np.asarray(a), b)
+        for a, b in zip(jax.tree.leaves(state3.params), p2))
+    assert changed
+
+
+def test_loss_scale_gradients_match_unscaled():
+    """Static scale: the applied update equals the unscaled update."""
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.sgd(0.1)
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (8, 784))
+    y = jnp.zeros((8,), jnp.int32)
+
+    s_plain = train.init_train_state(model, optimizer, key, (784,))
+    plain = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                  optimizer)
+    out_plain, _ = plain(s_plain, (x, y))
+
+    s_scaled = train.init_train_state(model, optimizer, key, (784,))
+    s_scaled = train.attach_loss_scale(s_scaled,
+                                       prec.StaticLossScale.create(4096.0))
+    scaled = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                   optimizer, loss_scale=True)
+    out_scaled, _ = scaled(s_scaled, (x, y))
+    for a, b in zip(jax.tree.leaves(out_plain.params),
+                    jax.tree.leaves(out_scaled.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_loss_scale_state_checkpoints(tmp_path):
+    """The LossScaled wrapper (incl. scale value) round-trips checkpoints."""
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.adam()
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    state = train.attach_loss_scale(
+        state, prec.DynamicLossScale.create(2048.0))
+    from distributed_tensorflow_tpu.train import checkpoint as ck
+    d = str(tmp_path)
+    ck.save(d, 0, state)
+    target = train.init_train_state(model, optimizer, jax.random.PRNGKey(1),
+                                    (784,))
+    target = train.attach_loss_scale(
+        target, prec.DynamicLossScale.create(1.0))
+    out = ck.restore(target, ck.latest_checkpoint(d))
+    assert float(out.model_state.loss_scale.value) == 2048.0
+
+
+def test_accum_with_loss_scale_and_policy():
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.adam()
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    state = train.attach_loss_scale(state,
+                                    prec.StaticLossScale.create(256.0))
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 optimizer, accum_steps=4,
+                                 policy="mixed_bfloat16", loss_scale=True)
+    x = jnp.ones((16, 784))
+    y = jnp.zeros((16,), jnp.int32)
+    state2, m = step(state, (x, y))
+    assert np.isfinite(float(m["loss"]))
+    assert bool(m["grads_finite"])
+
+
+def test_eval_step_sees_through_loss_scaled_state():
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.adam()
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    state = train.attach_loss_scale(state,
+                                    prec.DynamicLossScale.create(1024.0))
+    eval_step = train.make_eval_step(
+        model, "sparse_categorical_crossentropy",
+        metric_fns={"accuracy": "accuracy"})
+    m = eval_step(state, (jnp.ones((8, 784)), jnp.zeros((8,), jnp.int32)))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_skipped_step_grad_norm_is_finite():
+    """Overflow steps must not leak inf into the grad_norm metric (a
+    NaNHook watching it would kill the very run loss scaling protects)."""
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.adam()
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    state = train.attach_loss_scale(state,
+                                    prec.DynamicLossScale.create(1024.0))
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 optimizer, loss_scale=True,
+                                 grad_clip_norm=1.0)
+    bad_x = jnp.full((8, 784), jnp.inf)
+    _, m = step(state, (bad_x, jnp.zeros((8,), jnp.int32)))
+    assert not bool(m["grads_finite"])
+    assert np.isfinite(float(m["grad_norm"]))
