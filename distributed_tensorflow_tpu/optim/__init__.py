@@ -1,9 +1,10 @@
 """Optimizers and LR schedules (pure-functional, shardable opt_state)."""
 
 from . import schedules
+from .ema import EMAState, ema, ema_params, with_ema
 from .optimizers import (Optimizer, OptState, adam, adamw, apply_updates,
                          clip_by_global_norm, get, global_norm, momentum, sgd)
 
 __all__ = ["schedules", "Optimizer", "OptState", "adam", "adamw",
            "apply_updates", "clip_by_global_norm", "get", "global_norm",
-           "momentum", "sgd"]
+           "momentum", "sgd", "EMAState", "ema", "ema_params", "with_ema"]

@@ -1,0 +1,112 @@
+"""Exponential moving average of parameters.
+
+The evaluation-time trick the reference era shipped as
+``tf.train.ExponentialMovingAverage``: keep a shadow copy
+``s ← d·s + (1−d)·p`` of every parameter and evaluate/serve from the shadow.
+Two forms:
+
+  * ``ema(decay)`` — a standalone functional tracker (init/update/value)
+    for custom loops.
+  * ``with_ema(optimizer, decay)`` — an Optimizer wrapper: the shadow rides
+    inside ``opt_state`` so every existing step builder, checkpoint, and
+    session works unchanged; pull the averaged params out with
+    ``ema_params(state.opt_state)``.
+
+Both debias by default (divide by ``1 − d^t``), so early-step averages are
+unbiased instead of pulled toward the zero initialization.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .optimizers import Optimizer, OptState, apply_updates
+
+__all__ = ["EMAState", "ema", "with_ema", "ema_params"]
+
+
+class EMAState(NamedTuple):
+    count: jnp.ndarray     # int32 number of updates folded in
+    decay: jnp.ndarray     # f32 scalar (carried so readers need no config)
+    debias: jnp.ndarray    # bool scalar (ditto — readers honor it)
+    shadow: Any            # params-shaped pytree
+
+
+def _update_shadow(state: EMAState, params) -> EMAState:
+    d = state.decay
+    shadow = jax.tree.map(lambda s, p: d * s + (1.0 - d) * p.astype(s.dtype),
+                          state.shadow, params)
+    return state._replace(count=state.count + 1, shadow=shadow)
+
+
+def _value(state: EMAState):
+    # After t updates from a zero init the shadow carries total weight
+    # 1 - d^t; dividing restores an unbiased average (Adam-style).  The
+    # debias choice was made at construction and travels in the state.
+    scale = jnp.where(
+        state.debias,
+        1.0 / (1.0 - state.decay
+               ** jnp.maximum(state.count, 1).astype(jnp.float32)),
+        1.0)
+    return jax.tree.map(lambda s: s * scale.astype(s.dtype), state.shadow)
+
+
+class _EMA(NamedTuple):
+    init: Any
+    update: Any
+    value: Any
+
+
+def ema(decay: float = 0.999, debias: bool = True) -> _EMA:
+    """Standalone tracker: ``state = e.init(params)``,
+    ``state = e.update(state, params)`` each step,
+    ``e.value(state)`` -> averaged params."""
+
+    def init(params) -> EMAState:
+        return EMAState(jnp.zeros((), jnp.int32),
+                        jnp.asarray(decay, jnp.float32),
+                        jnp.asarray(debias),
+                        jax.tree.map(jnp.zeros_like, params))
+
+    def update(state: EMAState, params) -> EMAState:
+        return _update_shadow(state, params)
+
+    return _EMA(init, update, _value)
+
+
+def with_ema(optimizer: Optimizer, decay: float = 0.999,
+             debias: bool = True) -> Optimizer:
+    """Wrap an Optimizer so the post-update params feed a shadow average
+    carried in ``opt_state.inner['ema']``.  Requires the step to pass
+    ``params`` to ``update`` (every builder in train/step.py does)."""
+    tracker = ema(decay, debias)
+
+    def init(params) -> OptState:
+        inner = optimizer.init(params)
+        return OptState(inner.count,
+                        {"opt": inner, "ema": tracker.init(params)})
+
+    def update(grads, state: OptState, params=None):
+        if params is None:
+            raise ValueError("with_ema needs params passed to update()")
+        updates, new_inner = optimizer.update(grads, state.inner["opt"],
+                                              params)
+        new_params = apply_updates(params, updates)
+        new_ema = tracker.update(state.inner["ema"], new_params)
+        return updates, OptState(new_inner.count,
+                                 {"opt": new_inner, "ema": new_ema})
+
+    return Optimizer(init, update)
+
+
+def ema_params(opt_state: OptState):
+    """The averaged params from a ``with_ema`` optimizer's state (debias
+    honored as configured at construction)."""
+    try:
+        state = opt_state.inner["ema"]
+    except (TypeError, KeyError):
+        raise ValueError("opt_state does not carry an EMA (build the "
+                         "optimizer with optim.with_ema)") from None
+    return _value(state)

@@ -48,25 +48,34 @@ def shard_train_state(state: "TrainState", mesh: Mesh, rules) -> "TrainState":
     replicated = NamedSharding(mesh, P())
 
     def place(subtree):
+        """Recursive ZeRO placement: any params-shaped subtree (Adam m/v,
+        momentum mu, EMA shadow) shards like the params; containers and
+        wrapper states (with_ema's {'opt': OptState, 'ema': EMAState})
+        recurse; scalars/leftovers replicate."""
+        # Params-shaped FIRST: momentum's mu IS a params-shaped pytree
+        # (dict or bare array) and must shard with the params, not fall
+        # into the container branches and replicate.
         if jax.tree_util.tree_structure(subtree) == params_def:
             return jax.device_put(subtree, params_sh)
+        if isinstance(subtree, dict):
+            return {k: place(v) for k, v in subtree.items()}
+        if isinstance(subtree, opt_lib.OptState):
+            return opt_lib.OptState(
+                jax.device_put(subtree.count, replicated),
+                place(subtree.inner))
+        if (hasattr(subtree, "_fields") and hasattr(subtree, "_replace")
+                and "shadow" in getattr(subtree, "_fields", ())):
+            # EMAState-shaped: shard the shadow, replicate the scalars.
+            rest = {f: jax.device_put(getattr(subtree, f), replicated)
+                    for f in subtree._fields if f != "shadow"}
+            return subtree._replace(shadow=place(subtree.shadow), **rest)
+        if not jax.tree_util.tree_leaves(subtree):
+            return subtree         # stateless (sgd)
         return jax.device_put(subtree, replicated)
 
     opt_state = state.opt_state
-    inner = opt_state.inner
-    # Params-shaped FIRST: momentum's mu IS a params-shaped pytree (dict or
-    # bare array) and must shard with the params, not fall into the
-    # per-key dict branch (where no subtree matches) and replicate.
-    if jax.tree_util.tree_structure(inner) == params_def:
-        new_inner = place(inner)
-    elif isinstance(inner, dict):
-        new_inner = {k: place(v) for k, v in inner.items()}
-    elif not jax.tree_util.tree_leaves(inner):
-        new_inner = inner          # stateless (sgd)
-    else:
-        new_inner = place(inner)
     new_opt = type(opt_state)(jax.device_put(opt_state.count, replicated),
-                              new_inner)
+                              place(opt_state.inner))
     return state._replace(
         step=jax.device_put(state.step, replicated),
         params=jax.device_put(state.params, params_sh),
@@ -281,6 +290,11 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
             # (grad_norm metric, optimizer moment math).
             grads = jax.tree.map(
                 lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+        if pol is not None:
+            # output_dtype governs what leaves the step: reported loss and
+            # metrics come back widened (bf16 compute, f32 logs).
+            loss_value = pol.cast_to_output(loss_value)
+            metrics = pol.cast_to_output(metrics)
         metrics = {"loss": loss_value, **metrics}
         if grad_clip_norm is not None:
             grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)
@@ -289,13 +303,19 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
                                                   state.params)
         new_params = opt_lib.apply_updates(state.params, updates)
         if ls is not None:
-            # Non-finite grads: drop the whole update (params AND optimizer
-            # state, including its step count — bias correction must not see
-            # skipped steps), shrink the scale, advance only the cursor.
+            # Non-finite grads: drop the whole update (params, optimizer
+            # state including its step count — bias correction must not see
+            # skipped steps — and model_state: overflow activations must not
+            # contaminate running stats), shrink the scale, advance only the
+            # cursor.  The reported loss is sanitized on skipped steps so a
+            # NaNHook doesn't abort the run this machinery just rescued.
             keep = lambda new, old: jax.tree.map(
                 lambda n, o: jnp.where(finite, n, o), new, old)
             new_params = keep(new_params, state.params)
             new_opt_state = keep(new_opt_state, state.opt_state)
+            new_model_state = keep(new_model_state, model_state_in)
+            metrics["loss"] = jnp.where(finite, metrics["loss"],
+                                        jnp.zeros_like(metrics["loss"]))
             metrics["grads_finite"] = finite
             metrics["loss_scale"] = new_ls.scale_value
             new_model_state = prec_lib.LossScaled(new_model_state, new_ls)
@@ -353,10 +373,17 @@ def make_eval_step(model, loss,
                    metric_fns: Optional[Dict[str, Any]] = None,
                    mesh: Optional[Mesh] = None,
                    batch_spec: P = P("data"),
-                   jit: bool = True) -> Callable:
+                   jit: bool = True,
+                   policy: Any = None) -> Callable:
     """Build ``eval_step(state, (x, y)) -> metrics`` (train=False phase,
-    the ``learning_phase: 0`` analogue of reference example.py:225)."""
+    the ``learning_phase: 0`` analogue of reference example.py:225).
+
+    ``policy``: same spec as the train builders — params/inputs are cast to
+    the compute dtype for the forward pass, predictions to the output dtype
+    before loss/metrics.
+    """
     loss_fn = loss_lib.get(loss)
+    pol = prec_lib.policy(policy) if policy is not None else None
 
     def eval_step(state: TrainState, batch):
         x, y = batch
@@ -364,8 +391,14 @@ def make_eval_step(model, loss,
         model_state = state.model_state
         if isinstance(model_state, prec_lib.LossScaled):
             model_state = model_state.model_state
-        preds, _ = model.apply(state.params, model_state, x,
+        params = state.params
+        if pol is not None:
+            params = pol.cast_to_compute(params)
+            x = pol.cast_to_compute(x)
+        preds, _ = model.apply(params, model_state, x,
                                train=False, rng=None)
+        if pol is not None:
+            preds = pol.cast_to_output(preds)
         metrics = {"loss": loss_fn(preds, y)}
         metrics.update(_metric_dict(metric_fns, preds, y))
         return metrics

@@ -189,3 +189,33 @@ def test_skipped_step_grad_norm_is_finite():
     _, m = step(state, (bad_x, jnp.zeros((8,), jnp.int32)))
     assert not bool(m["grads_finite"])
     assert np.isfinite(float(m["grad_norm"]))
+
+
+def test_skipped_step_sanitizes_loss_and_keeps_model_state():
+    """Overflow steps: metrics['loss'] must be finite (NaNHook safety) and
+    model_state (running stats) must keep its pre-step values."""
+    from distributed_tensorflow_tpu import ops
+
+    model = ops.Stack([ops.Dense(8), ops.BatchNorm(), ops.Dense(4)]) \
+        if hasattr(ops, "BatchNorm") else None
+    if model is None:
+        pytest.skip("no BatchNorm layer")
+    optimizer = optim.adam()
+    params, mstate = model.init(jax.random.PRNGKey(0), (16,))
+    state = train.TrainState.create(params, optimizer.init(params), mstate)
+    state = train.attach_loss_scale(state,
+                                    prec.DynamicLossScale.create(1024.0))
+    step = train.make_custom_train_step(
+        lambda p, ms, b, rng, t: (
+            lambda preds_ms: (jnp.mean((preds_ms[0] - b[1]) ** 2),
+                              ({}, preds_ms[1]))
+        )(model.apply(p, ms, b[0], train=t, rng=rng)),
+        optimizer, loss_scale=True)
+    ms_before = jax.tree.map(np.asarray, state.model_state.model_state)
+    bad = (jnp.full((8, 16), jnp.inf), jnp.zeros((8, 4)))
+    state2, m = step(state, bad)
+    assert not bool(m["grads_finite"])
+    assert np.isfinite(float(m["loss"]))
+    for a, b in zip(jax.tree.leaves(state2.model_state.model_state),
+                    jax.tree.leaves(ms_before)):
+        np.testing.assert_array_equal(np.asarray(a), b)
