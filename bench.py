@@ -39,6 +39,17 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def _fetch(metrics) -> float:
+    """Device->host fetch of the loss — the only reliable completion
+    barrier.  Over the axon TPU tunnel ``jax.block_until_ready`` returns
+    before the remote execution finishes, so any window "closed" with it
+    times dispatch, not compute; a value fetch cannot lie.  The steps in a
+    window form a donated-state chain, so fetching the last loss proves
+    every step ran."""
+    import numpy as np
+    return float(np.asarray(metrics["loss"]).ravel()[-1])
+
+
 def bench_framework():
     import jax
     import numpy as np
@@ -90,13 +101,13 @@ def bench_framework():
     bench_batch = (jax.device_put(xs, msh), jax.device_put(ys, msh))
     for _ in range(WARMUP_CALLS):
         state, m = multi(state, bench_batch)
-    jax.block_until_ready(m["loss"])
+    _fetch(m)
     t0 = time.perf_counter()
     for _ in range(CALLS):
         state, m = multi(state, bench_batch)
         if SMOKE:
             jax.block_until_ready(m["loss"])
-    jax.block_until_ready(m["loss"])
+    _fetch(m)
     dt = time.perf_counter() - t0
     steps = CALLS * k
     eps = steps * batch / dt
@@ -110,13 +121,13 @@ def bench_framework():
     n_single = 8 if SMOKE else 40
     for _ in range(2 if SMOKE else 5):
         state, m = step(state, single_batch)
-    jax.block_until_ready(m["loss"])
+    _fetch(m)
     t0 = time.perf_counter()
     for _ in range(n_single):
         state, m = step(state, single_batch)
         if SMOKE:
             jax.block_until_ready(m["loss"])
-    jax.block_until_ready(m["loss"])
+    _fetch(m)
     dts = time.perf_counter() - t0
     eps_single = n_single * batch / dts
     log(f"framework (single-step): {eps_single:,.0f} examples/s total "
@@ -154,15 +165,14 @@ def _time_steps(step, state, batch, warmup=3, steps=12):
         state, m = step(state, batch)
         if SMOKE:
             jax.block_until_ready(m["loss"])
-    jax.block_until_ready(m["loss"])
+    _fetch(m)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = step(state, batch)
         if SMOKE:
             jax.block_until_ready(m["loss"])
-    jax.block_until_ready(m["loss"])
+    loss = _fetch(m)
     dt = time.perf_counter() - t0
-    loss = float(m["loss"])
     return steps / dt, loss, dt / steps
 
 
@@ -373,8 +383,17 @@ CONFIGS = {
 
 def main():
     config = "mnist_mlp"
+    device = os.environ.get("DTTPU_BENCH_DEVICE")
     for arg in sys.argv[1:]:
+        if arg.startswith("--device="):
+            device = arg.split("=", 1)[1]
+            continue
         config = arg.split("=", 1)[1] if arg.startswith("--config=") else arg
+    if device:
+        # The axon sitecustomize force-selects the TPU platform at the
+        # config level, so an env var alone cannot redirect to CPU.
+        import jax
+        jax.config.update("jax_platforms", device)
     if config not in CONFIGS:
         log(f"unknown config {config!r}; choices: {sorted(CONFIGS)}")
         sys.exit(2)

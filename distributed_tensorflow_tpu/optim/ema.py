@@ -36,9 +36,15 @@ class EMAState(NamedTuple):
 
 def _update_shadow(state: EMAState, params) -> EMAState:
     d = state.decay
-    shadow = jax.tree.map(lambda s, p: d * s + (1.0 - d) * p.astype(s.dtype),
-                          state.shadow, params)
-    return state._replace(count=state.count + 1, shadow=shadow)
+
+    def one(s, p):
+        # Accumulate in f32, store back in the shadow's own dtype — the
+        # carry type must be step-invariant (lax.scan, buffer donation).
+        new = d * s.astype(jnp.float32) + (1.0 - d) * p.astype(jnp.float32)
+        return new.astype(s.dtype)
+
+    return state._replace(count=state.count + 1,
+                          shadow=jax.tree.map(one, state.shadow, params))
 
 
 def _value(state: EMAState):
@@ -85,7 +91,10 @@ def with_ema(optimizer: Optimizer, decay: float = 0.999,
 
     def init(params) -> OptState:
         inner = optimizer.init(params)
-        return OptState(inner.count,
+        # The wrapper's count is its own buffer, NOT a reference to
+        # inner.count — aliased leaves in one state break buffer donation
+        # ("donate the same buffer twice").
+        return OptState(jnp.zeros((), jnp.int32),
                         {"opt": inner, "ema": tracker.init(params)})
 
     def update(grads, state: OptState, params=None):
@@ -95,7 +104,7 @@ def with_ema(optimizer: Optimizer, decay: float = 0.999,
                                               params)
         new_params = apply_updates(params, updates)
         new_ema = tracker.update(state.inner["ema"], new_params)
-        return updates, OptState(new_inner.count,
+        return updates, OptState(state.count + 1,
                                  {"opt": new_inner, "ema": new_ema})
 
     return Optimizer(init, update)

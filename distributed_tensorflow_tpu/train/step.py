@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import losses as loss_lib
 from ..ops import metrics as metric_lib
 from ..optim import optimizers as opt_lib
+from ..optim.ema import EMAState
 from . import precision as prec_lib
 from .session import TrainState
 
@@ -63,12 +64,13 @@ def shard_train_state(state: "TrainState", mesh: Mesh, rules) -> "TrainState":
             return opt_lib.OptState(
                 jax.device_put(subtree.count, replicated),
                 place(subtree.inner))
-        if (hasattr(subtree, "_fields") and hasattr(subtree, "_replace")
-                and "shadow" in getattr(subtree, "_fields", ())):
-            # EMAState-shaped: shard the shadow, replicate the scalars.
-            rest = {f: jax.device_put(getattr(subtree, f), replicated)
-                    for f in subtree._fields if f != "shadow"}
-            return subtree._replace(shadow=place(subtree.shadow), **rest)
+        if isinstance(subtree, EMAState):
+            # shard the shadow like the params, replicate the scalars
+            return EMAState(
+                jax.device_put(subtree.count, replicated),
+                jax.device_put(subtree.decay, replicated),
+                jax.device_put(subtree.debias, replicated),
+                place(subtree.shadow))
         if not jax.tree_util.tree_leaves(subtree):
             return subtree         # stateless (sgd)
         return jax.device_put(subtree, replicated)

@@ -112,3 +112,25 @@ def test_shard_train_state_shards_ema_shadow_and_moments():
                  state.opt_state.inner["ema"].shadow):
         specs = kernel_specs(tree)
         assert specs and all(s == P("fsdp", None) for s in specs), tree
+
+
+def test_ema_shadow_dtype_stable_under_scan():
+    """bf16 shadow must keep its dtype across updates (lax.scan carry and
+    buffer donation demand a step-invariant state type)."""
+    model = models.mnist_mlp(num_classes=4)
+    optimizer = optim.with_ema(optim.adam(), decay=0.9)
+    state = train.init_train_state(model, optimizer, jax.random.PRNGKey(0),
+                                   (784,))
+    # force a bf16 shadow (as a bf16-params run would produce)
+    ema0 = state.opt_state.inner["ema"]
+    state = state._replace(opt_state=state.opt_state._replace(inner={
+        "opt": state.opt_state.inner["opt"],
+        "ema": ema0._replace(shadow=jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), ema0.shadow))}))
+    multi = train.make_multi_train_step(
+        model, "sparse_categorical_crossentropy", optimizer, steps_per_call=3)
+    xs = jnp.ones((3, 8, 784))
+    ys = jnp.zeros((3, 8), jnp.int32)
+    state2, m = multi(state, (xs, ys))  # traces: carry types must match
+    for leaf in jax.tree.leaves(state2.opt_state.inner["ema"].shadow):
+        assert leaf.dtype == jnp.bfloat16
