@@ -61,8 +61,13 @@ class Sequential:
     def compile(self, loss, optimizer="adam",
                 metrics: Sequence = (),
                 mesh=None, params_spec=None, seed: int = 0,
-                grad_clip_norm: Optional[float] = None) -> None:
-        """reference example2.py:165 parity: strings or callables/objects."""
+                grad_clip_norm: Optional[float] = None,
+                policy=None) -> None:
+        """reference example2.py:165 parity: strings or callables/objects.
+
+        ``policy``: mixed-precision spec (e.g. ``"mixed_bfloat16"``) applied
+        to both the train and eval steps — see train/precision.py.
+        """
         loss_fn = loss_lib.get(loss)
         opt = opt_lib.get(optimizer)
         metric_fns = {}
@@ -74,9 +79,10 @@ class Sequential:
             train_step=step_lib.make_train_step(
                 self.stack, loss_fn, opt, metric_fns=metric_fns, seed=seed,
                 mesh=mesh, params_spec=params_spec,
-                grad_clip_norm=grad_clip_norm),
+                grad_clip_norm=grad_clip_norm, policy=policy),
             eval_step=step_lib.make_eval_step(
-                self.stack, loss_fn, metric_fns=metric_fns, mesh=mesh),
+                self.stack, loss_fn, metric_fns=metric_fns, mesh=mesh,
+                policy=policy),
         )
 
     def _require_compiled(self) -> dict:

@@ -219,3 +219,18 @@ def test_skipped_step_sanitizes_loss_and_keeps_model_state():
     for a, b in zip(jax.tree.leaves(state2.model_state.model_state),
                     jax.tree.leaves(ms_before)):
         np.testing.assert_array_equal(np.asarray(a), b)
+
+
+def test_sequential_compile_with_policy():
+    from distributed_tensorflow_tpu.models import Sequential
+    from distributed_tensorflow_tpu import ops
+
+    m = Sequential([ops.Dense(16, activation="relu"), ops.Dense(4)])
+    m.compile("sparse_categorical_crossentropy", metrics=["accuracy"],
+              policy="mixed_bfloat16")
+    x = np.random.default_rng(0).random((64, 8), np.float32)
+    y = np.zeros((64,), np.int32)
+    h = m.fit(x, y, epochs=1, batch_size=16, verbose=0)
+    assert np.isfinite(h.history["loss"][-1])
+    out = m.evaluate(x, y, batch_size=32, verbose=0)
+    assert np.isfinite(out["loss"])
