@@ -26,8 +26,11 @@ import time
 SMOKE = bool(os.environ.get("DTTPU_BENCH_SMOKE"))
 
 # Estimated examples/sec for the reference-era stack on a single CPU host —
-# used only if the live torch baseline cannot run.
-FALLBACK_BASELINE = 1.0e5
+# used only if the live torch baseline cannot run.  Per config: these are
+# measured torch-CPU rates from this machine (mnist/cifar) or the
+# torchvision-resnet50-on-CPU ballpark (no torchvision in this image).
+FALLBACK_BASELINE = {"mnist_mlp": 1.9e5, "cifar_cnn": 9.0e2,
+                     "resnet50": 3.0, "bert": 1.0}
 
 BATCH = 512 if SMOKE else 8192
 STEPS_PER_CALL = 4 if SMOKE else 32   # scanned updates per dispatch
@@ -246,7 +249,7 @@ def bench_cifar_cnn():
         m(x)  # materialize lazy
         return m, lambda out: ce(out, y), torch.optim.Adam(m.parameters()), (x,), tb
 
-    baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE
+    baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE["cifar_cnn"]
     gate = 0.15 if SMOKE else 0.35
     return dict(metric="cifar_cnn_train_examples_per_sec_per_chip"
                        + ("" if acc > gate else "_NOT_CONVERGED"),
@@ -300,7 +303,7 @@ def bench_resnet50():
         return m, lambda out: ce(out, y), \
             torch.optim.SGD(m.parameters(), 0.1, momentum=0.9), (x,), tb
 
-    baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE
+    baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE["resnet50"]
     finite = np.isfinite(loss)
     return dict(metric="resnet50_train_examples_per_sec_per_chip"
                        + ("" if finite else "_NONFINITE_LOSS"),
@@ -351,7 +354,8 @@ def bench_bert():
     return dict(metric="bert_mlm_train_tokens_per_sec_per_chip"
                        + ("" if finite else "_NONFINITE_LOSS"),
                 value=round(tokens, 1), unit="tokens/sec/chip",
-                vs_baseline=1.0,  # no runnable reference-era BERT baseline
+                vs_baseline=FALLBACK_BASELINE["bert"],  # no runnable
+                # reference-era BERT baseline exists; documented constant
                 seq_len=seq, batch=batch)
 
 
@@ -359,7 +363,7 @@ def bench_mnist_mlp():
     value, acc, value_single = bench_framework()
     baseline = bench_torch_baseline()
     if baseline is None:
-        baseline = FALLBACK_BASELINE
+        baseline = FALLBACK_BASELINE["mnist_mlp"]
     converged = acc > 0.9
     return {
         "metric": "mnist_mlp_train_examples_per_sec_per_chip"

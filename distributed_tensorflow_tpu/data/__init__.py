@@ -1,12 +1,12 @@
 """Data subsystem: synthetic tasks, dataset loaders, device-prefetch pipeline."""
 
-from . import datasets, pipeline, tfrecord, xor
+from . import augment, datasets, pipeline, tfrecord, xor
 from .datasets import cifar10, mnist, synthetic_image_classes
 from .pipeline import Dataset, prefetch_to_device
 from .tfrecord import RecordWriter, read_tfrecord, write_tfrecord
 from .xor import get_data as xor_data
 
-__all__ = ["datasets", "pipeline", "tfrecord", "xor",
+__all__ = ["augment", "datasets", "pipeline", "tfrecord", "xor",
            "RecordWriter", "read_tfrecord", "write_tfrecord", "cifar10", "mnist",
            "synthetic_image_classes", "Dataset", "prefetch_to_device",
            "xor_data"]

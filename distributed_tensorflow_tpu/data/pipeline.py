@@ -40,7 +40,8 @@ class Dataset:
     def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
                  shuffle: bool = True, drop_remainder: bool = True,
                  seed: int = 0, process_index: int = 0,
-                 process_count: int = 1, backend: str = "numpy"):
+                 process_count: int = 1, backend: str = "numpy",
+                 transform=None):
         n = arrays[0].shape[0]
         for a in arrays:
             if a.shape[0] != n:
@@ -66,6 +67,9 @@ class Dataset:
             raise RuntimeError(
                 "backend='native' but the native loader is unavailable or "
                 "the dataset shape does not fit it")
+        # Per-batch augmentation (data.augment.compose(...)); runs on the
+        # host after gather, on BOTH the numpy and native paths.
+        self.transform = transform
 
     def _native_usable(self) -> bool:
         from ..utils import native
@@ -90,12 +94,15 @@ class Dataset:
             order = rng.permutation(self.n)
         else:
             order = np.arange(self.n)
+        t_rng = np.random.default_rng((self.seed, self.epoch, 1))
         self.epoch += 1
         stop = (self.n - self.batch_size + 1 if self.drop_remainder
                 else self.n)
         for lo in range(0, stop, self.batch_size):
             idx = order[lo:lo + self.batch_size]
-            yield tuple(a[idx] for a in self.arrays)
+            batch = tuple(a[idx] for a in self.arrays)
+            yield batch if self.transform is None \
+                else self.transform(t_rng, batch)
 
     def _iter_native(self) -> Iterator[Tuple[np.ndarray, ...]]:
         """One epoch through the C++ threaded gather loader; a fresh loader
@@ -105,12 +112,15 @@ class Dataset:
         x = self.arrays[0]
         y = self.arrays[1] if len(self.arrays) == 2 else None
         seed = (self.seed * 1_000_003 + self.epoch) & 0xFFFFFFFFFFFFFFFF
+        t_rng = np.random.default_rng((self.seed, self.epoch, 1))
         self.epoch += 1
         loader = native.NativeLoader(x, y, self.batch_size, seed=seed,
                                      shuffle=self.shuffle)
         try:
             for _ in range(loader.batches_per_epoch):
-                yield loader.next()
+                batch = loader.next()
+                yield batch if self.transform is None \
+                    else self.transform(t_rng, batch)
         finally:
             loader.close()
 

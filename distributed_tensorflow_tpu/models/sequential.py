@@ -107,8 +107,13 @@ class Sequential:
             validation_data: Optional[Tuple] = None,
             callbacks: Sequence[Callback] = (),
             shuffle: bool = True, seed: int = 0,
-            verbose: int = 1) -> History:
-        """reference example2.py:197-200 parity (sync-DP underneath)."""
+            verbose: int = 1, augment=None) -> History:
+        """reference example2.py:197-200 parity (sync-DP underneath).
+
+        ``augment``: per-batch transform from ``data.augment`` (host-side,
+        overlapped with device compute via the prefetch queue); applied to
+        training batches only, never to validation.
+        """
         c = self._require_compiled()
         if self.state is None:
             self.build(tuple(np.shape(x)[1:]), seed=seed)
@@ -125,7 +130,7 @@ class Sequential:
                          batch_size, rounded)
                 batch_size = rounded
         dataset = Dataset([np.asarray(x), np.asarray(y)], batch_size,
-                          shuffle=shuffle, seed=seed)
+                          shuffle=shuffle, seed=seed, transform=augment)
         sharding = None
         if c["mesh"] is not None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -138,14 +143,20 @@ class Sequential:
                 break
             for cb in callbacks:
                 cb.on_epoch_begin(self, epoch)
-            # Keep the last batch's metrics device-side; pull once per epoch.
+            # Keep metrics device-side between pulls.  XLA:CPU's collective
+            # rendezvous dies under a deep async queue of collective
+            # programs (threads from queued executions miss its 40s
+            # window), so the CPU mesh syncs every step; TPU pulls rarely
+            # and keeps the dispatch queue async.
+            sync_every = (1 if jax.devices()[0].platform == "cpu"
+                          and c["mesh"] is not None else 50)
             last_metrics: Dict[str, Any] = {}
             running: Dict[str, float] = {}
             count = 0
             for batch in prefetch_to_device(iter(dataset), sharding=sharding):
                 self.state, last_metrics = c["train_step"](self.state, batch)
                 count += 1
-                if count % 50 == 0 or count == len(dataset):
+                if count % sync_every == 0 or count == len(dataset):
                     for k, v in last_metrics.items():
                         running[k] = float(v)
             logs = dict(running)

@@ -54,10 +54,15 @@ def main() -> int:
                   metrics=["accuracy"], mesh=mesh, seed=FLAGS.seed)
 
     tensorboard = models.TensorBoard(log_dir=FLAGS.log_dir.format(time()))
+    # Standard CIFAR recipe: pad-reflect crop + horizontal flip, host-side,
+    # overlapped with device compute by the prefetch queue.
+    train_augment = data.augment.compose(data.augment.random_crop(4),
+                                         data.augment.random_flip_lr())
     model.fit(x_train, y_train, epochs=FLAGS.epochs,
               batch_size=FLAGS.batch_size,
               validation_data=(x_val[:4096], y_val[:4096]),
-              callbacks=[tensorboard], seed=FLAGS.seed)
+              callbacks=[tensorboard], seed=FLAGS.seed,
+              augment=train_augment)
 
     final = model.evaluate(x_val, y_val, batch_size=FLAGS.batch_size,
                            verbose=0)

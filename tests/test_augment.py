@@ -1,0 +1,84 @@
+"""Augmentation pipeline tests."""
+import numpy as np
+
+from distributed_tensorflow_tpu import data
+from distributed_tensorflow_tpu.data import augment
+
+
+def _images(b=16, h=8, w=8, c=3, seed=0):
+    return np.random.default_rng(seed).random((b, h, w, c)).astype(np.float32)
+
+
+def test_flip_preserves_content():
+    rng = np.random.default_rng(0)
+    x = _images()
+    (out,) = augment.random_flip_lr(prob=1.0)(rng, (x,))
+    np.testing.assert_array_equal(out, x[:, :, ::-1])
+
+
+def test_crop_shape_and_content_domain():
+    rng = np.random.default_rng(0)
+    x = _images()
+    (out,) = augment.random_crop(padding=2)(rng, (x,))
+    assert out.shape == x.shape
+    # reflect-padding means every output pixel exists in the input's value set
+    assert np.isin(np.round(out, 6), np.round(x, 6)).all()
+
+
+def test_crop_zero_offset_possible_and_varies():
+    rng = np.random.default_rng(3)
+    x = _images(b=64)
+    (out,) = augment.random_crop(padding=2)(rng, (x,))
+    same = [np.array_equal(out[i], x[i]) for i in range(64)]
+    assert any(same) and not all(same)  # center crop happens; offsets vary
+
+
+def test_normalize():
+    rng = np.random.default_rng(0)
+    x = np.ones((4, 2, 2, 3), np.float32)
+    (out,) = augment.normalize([1.0, 1.0, 1.0], [2.0, 2.0, 2.0])(rng, (x,))
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_cutout_zeroes_a_patch():
+    rng = np.random.default_rng(0)
+    x = _images() + 1.0  # strictly positive
+    (out,) = augment.cutout(size=4)(rng, (x,))
+    assert (out == 0).any()
+    assert out.shape == x.shape
+
+
+def test_compose_and_labels_untouched():
+    rng = np.random.default_rng(0)
+    x = _images()
+    y = np.arange(16)
+    t = augment.compose(augment.random_flip_lr(0.5),
+                        augment.normalize([0.5] * 3, [0.5] * 3))
+    ox, oy = t(rng, (x, y))
+    np.testing.assert_array_equal(oy, y)
+    assert ox.dtype == np.float32
+
+
+def test_dataset_transform_applied_and_deterministic():
+    x = _images(b=32)
+    y = np.arange(32)
+    t = augment.compose(augment.random_crop(2), augment.random_flip_lr())
+    ds1 = data.Dataset([x, y], 8, seed=7, transform=t)
+    ds2 = data.Dataset([x, y], 8, seed=7, transform=t)
+    b1 = [b for b in ds1]
+    b2 = [b for b in ds2]
+    for (x1, y1), (x2, y2) in zip(b1, b2):
+        np.testing.assert_array_equal(x1, x2)   # same seed -> same batches
+        np.testing.assert_array_equal(y1, y2)
+    ds3 = data.Dataset([x, y], 8, seed=7)       # no transform differs
+    raw = next(iter(ds3))[0]
+    assert not np.array_equal(b1[0][0], raw)
+
+
+def test_cutout_full_size_patch_odd_size():
+    rng = np.random.default_rng(0)
+    x = np.ones((8, 16, 16, 3), np.float32)
+    (out,) = augment.cutout(size=5, prob=1.0)(rng, (x,))
+    for i in range(8):
+        zeros = int((out[i] == 0).sum())
+        assert zeros == 5 * 5 * 3  # exact square even for odd sizes
