@@ -106,6 +106,47 @@ def _histogram_proto(values) -> bytes:
             + _packed_doubles(6, limits) + _packed_doubles(7, counts))
 
 
+def _png_encode(arr) -> bytes:
+    """Minimal PNG writer (8-bit grey/RGB/RGBA, no filtering) — enough for
+    TensorBoard image summaries without an image library dependency."""
+    import zlib
+
+    import numpy as np
+    a = np.asarray(arr)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if np.issubdtype(a.dtype, np.integer):
+        a = np.clip(a, 0, 255).astype(np.uint8)   # integer pixels are 0-255
+    elif a.dtype != np.uint8:
+        # float convention follows tf.summary.image: values in [0, 1]
+        a = (np.clip(a.astype(np.float64), 0.0, 1.0) * 255).astype(np.uint8)
+    h, w, c = a.shape
+    color_type = {1: 0, 3: 2, 4: 6}[c]
+    raw = b"".join(b"\x00" + a[i].tobytes() for i in range(h))
+
+    def chunk(typ: bytes, data: bytes) -> bytes:
+        return (struct.pack(">I", len(data)) + typ + data +
+                struct.pack(">I", zlib.crc32(typ + data) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, color_type, 0, 0, 0)
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr) +
+            chunk(b"IDAT", zlib.compress(raw)) + chunk(b"IEND", b""))
+
+
+def _image_event(wall_time: float, step: int, tag: str, image) -> bytes:
+    """Summary.Value{tag=1, image=4}; Image{height=1, width=2,
+    colorspace=3, encoded_image_string=4} (TF summary.proto)."""
+    import numpy as np
+    a = np.asarray(image)
+    h, w = a.shape[0], a.shape[1]
+    c = 1 if a.ndim == 2 else a.shape[2]
+    img = (_field_varint(1, h) + _field_varint(2, w) + _field_varint(3, c) +
+           _field_bytes(4, _png_encode(a)))
+    value = _field_bytes(1, tag.encode("utf-8")) + _field_bytes(4, img)
+    return (_field_double(1, wall_time) + _field_varint(2, int(step)) +
+            _field_bytes(5, _field_bytes(1, value)))
+
+
 def _histogram_event(wall_time: float, step: int, tag: str, values) -> bytes:
     # Summary.Value: tag=1, simple_value=2, image=4, histo=5 (TF
     # summary.proto oneof) — histograms MUST land in field 5.
@@ -146,6 +187,14 @@ class EventFileWriter:
             wall_time if wall_time is not None else time.time(),
             int(step), tag, values))
 
+    def add_image(self, tag: str, image, step: Union[int, float],
+                  wall_time: Optional[float] = None) -> None:
+        """Image summary: [h, w], [h, w, 1|3|4]; uint8 as-is, floats
+        clipped from [0, 1] (tf.summary.image conventions)."""
+        self._write_record(_image_event(
+            wall_time if wall_time is not None else time.time(),
+            int(step), tag, image))
+
     def flush(self) -> None:
         self._file.flush()
 
@@ -179,6 +228,10 @@ class SummaryWriter:
     def add_scalars(self, scalars: Dict[str, float],
                     step: Union[int, float]) -> None:
         self._writer.add_scalars(scalars, step)
+
+    def add_image(self, tag: str, image,
+                  step: Union[int, float]) -> None:
+        self._writer.add_image(tag, image, step)
 
     def add_histogram(self, tag: str, values,
                       step: Union[int, float]) -> None:
