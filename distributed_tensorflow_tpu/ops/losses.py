@@ -16,7 +16,7 @@ import jax.numpy as jnp
 
 __all__ = ["mean_squared_error", "binary_cross_entropy",
            "softmax_cross_entropy", "softmax_cross_entropy_with_integer_labels",
-           "get"]
+           "smoothed_cross_entropy", "get"]
 
 
 def mean_squared_error(preds, targets):
@@ -46,6 +46,25 @@ def softmax_cross_entropy_with_integer_labels(logits, labels, where=None):
     return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
+def smoothed_cross_entropy(smoothing: float = 0.1):
+    """Factory: XE with label smoothing (the ResNet/ImageNet recipe).
+
+    Targets become ``(1 - s)`` on the true class and ``s / C`` elsewhere —
+    equivalently ``(1-s)·NLL + s·mean(-logp)``, which is how it's computed
+    (no one-hot materialization).
+    """
+    s = float(smoothing)
+
+    def loss(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        uniform = -jnp.mean(logp, axis=-1)
+        return jnp.mean((1.0 - s) * nll + s * uniform)
+
+    loss.__name__ = f"smoothed_cross_entropy_{s}"
+    return loss
+
+
 _REGISTRY = {
     "mse": mean_squared_error,
     "mean_squared_error": mean_squared_error,
@@ -53,6 +72,8 @@ _REGISTRY = {
     "categorical_crossentropy": softmax_cross_entropy,
     "sparse_categorical_crossentropy":
         softmax_cross_entropy_with_integer_labels,
+    # by-name form uses the standard s=0.1; call the factory for custom s
+    "smoothed_cross_entropy": smoothed_cross_entropy(0.1),
 }
 
 

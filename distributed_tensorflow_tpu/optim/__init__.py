@@ -3,8 +3,9 @@
 from . import schedules
 from .ema import EMAState, ema, ema_params, with_ema
 from .optimizers import (Optimizer, OptState, adam, adamw, apply_updates,
-                         clip_by_global_norm, get, global_norm, momentum, sgd)
+                         clip_by_global_norm, get, global_norm, lamb,
+                         momentum, sgd)
 
 __all__ = ["schedules", "Optimizer", "OptState", "adam", "adamw",
            "apply_updates", "clip_by_global_norm", "get", "global_norm",
-           "momentum", "sgd", "EMAState", "ema", "ema_params", "with_ema"]
+           "lamb", "momentum", "sgd", "EMAState", "ema", "ema_params", "with_ema"]

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Optimizer", "OptState", "sgd", "momentum", "adam", "adamw",
-           "apply_updates", "clip_by_global_norm", "global_norm", "get"]
+           "lamb", "apply_updates", "clip_by_global_norm", "global_norm",
+           "get"]
 
 Schedule = Callable[[jnp.ndarray], jnp.ndarray]
 ScalarOrSchedule = Union[float, Schedule]
@@ -107,6 +108,25 @@ def momentum(learning_rate: ScalarOrSchedule = 0.01, beta: float = 0.9,
     return Optimizer(init, update)
 
 
+def _moments_init(params) -> OptState:
+    """Adam-family state: f32 first/second moments + step count."""
+    zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+    return OptState(jnp.zeros((), jnp.int32),
+                    {"m": jax.tree.map(zeros, params),
+                     "v": jax.tree.map(zeros, params)})
+
+
+def _moments_update(inner, grads, b1: float, b2: float):
+    """One EMA step of the (m, v) pair, accumulated in f32."""
+    m = jax.tree.map(
+        lambda m_, g: b1 * m_ + (1 - b1) * g.astype(jnp.float32),
+        inner["m"], grads)
+    v = jax.tree.map(
+        lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+        inner["v"], grads)
+    return m, v
+
+
 def adam(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
          b2: float = 0.999, eps: float = 1e-8,
          fused: bool = False) -> Optimizer:
@@ -118,12 +138,6 @@ def adam(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
     (bias correction folded into scalar prefactors).  Requires ``params``
     at ``update`` time; off-TPU the kernel runs in interpret mode.
     """
-
-    def init(params):
-        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
-        return OptState(jnp.zeros((), jnp.int32),
-                        {"m": jax.tree.map(zeros, params),
-                         "v": jax.tree.map(zeros, params)})
 
     def update(grads, state: OptState, params=None):
         count = state.count + 1
@@ -149,16 +163,12 @@ def adam(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
         t = count.astype(jnp.float32)
         lr_t = _lr_at(learning_rate, count) * jnp.sqrt(
             1.0 - jnp.power(b2, t)) / (1.0 - jnp.power(b1, t))
-        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g.astype(jnp.float32),
-                         state.inner["m"], grads)
-        v = jax.tree.map(
-            lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g.astype(jnp.float32)),
-            state.inner["v"], grads)
+        m, v = _moments_update(state.inner, grads, b1, b2)
         updates = jax.tree.map(lambda m_, v_: -lr_t * m_ / (jnp.sqrt(v_) + eps),
                                m, v)
         return updates, OptState(count, {"m": m, "v": v})
 
-    return Optimizer(init, update)
+    return Optimizer(_moments_init, update)
 
 
 def adamw(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
@@ -187,11 +197,56 @@ def adamw(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
     return Optimizer(base.init, update)
 
 
+def lamb(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
+         b2: float = 0.999, eps: float = 1e-6,
+         weight_decay: float = 0.01,
+         mask: Optional[Callable[[Any], Any]] = None,
+         min_trust: float = 0.0, max_trust: float = 10.0) -> Optimizer:
+    """LAMB (You et al. 2020): layer-wise trust-ratio Adam for LARGE-batch
+    training — the optimizer behind 76-minute BERT on TPU pods, where the
+    global batch grows with the mesh's data axis and plain Adam diverges.
+
+    Per leaf: Adam direction r = m̂/(√v̂+eps) (+ decoupled weight decay),
+    scaled by trust ratio ‖p‖/‖r‖ so every layer takes a step proportional
+    to its own weight norm.  ``mask`` selects leaves that get weight decay
+    AND trust scaling (default: ndim > 1, i.e. not biases/norm scales —
+    those fall back to the plain Adam step).
+    """
+
+    def update(grads, state: OptState, params):
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        lr = _lr_at(learning_rate, count)
+        bc1 = 1.0 - jnp.power(b1, t)
+        bc2 = 1.0 - jnp.power(b2, t)
+        m, v = _moments_update(state.inner, grads, b1, b2)
+        decay_mask = (mask(params) if mask is not None
+                      else jax.tree.map(lambda p: p.ndim > 1, params))
+
+        def step(m_, v_, p, use_trust):
+            r = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps)
+            if use_trust:
+                r = r + weight_decay * p.astype(jnp.float32)
+                w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+                r_norm = jnp.linalg.norm(r)
+                trust = jnp.where(
+                    (w_norm > 0) & (r_norm > 0),
+                    jnp.clip(w_norm / r_norm, min_trust, max_trust), 1.0)
+                return -lr * trust * r
+            return -lr * r
+
+        updates = jax.tree.map(step, m, v, params, decay_mask)
+        return updates, OptState(count, {"m": m, "v": v})
+
+    return Optimizer(_moments_init, update)
+
+
 _REGISTRY = {
     "sgd": sgd,
     "momentum": momentum,
     "adam": adam,
     "adamw": adamw,
+    "lamb": lamb,
 }
 
 

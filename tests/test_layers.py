@@ -140,3 +140,17 @@ def test_layernorm_fused_matches_reference():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
     with pytest.raises(ValueError, match="fused=True"):
         ops.LayerNorm(scale=False, fused=True)
+
+
+def test_smoothed_cross_entropy():
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu.ops import losses
+
+    logits = jnp.asarray([[2.0, -1.0, 0.5], [0.0, 3.0, -2.0]])
+    labels = jnp.asarray([0, 1])
+    plain = losses.softmax_cross_entropy_with_integer_labels(logits, labels)
+    zero_smooth = losses.smoothed_cross_entropy(0.0)(logits, labels)
+    np.testing.assert_allclose(float(zero_smooth), float(plain), rtol=1e-6)
+    smoothed = losses.smoothed_cross_entropy(0.1)(logits, labels)
+    assert float(smoothed) > float(plain)  # smoothing adds uniform penalty

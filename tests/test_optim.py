@@ -145,3 +145,40 @@ def test_fused_adam_requires_params():
     s = opt.init({"w": jnp.zeros((4,))})
     with pytest.raises(ValueError, match="needs params"):
         opt.update({"w": jnp.ones((4,))}, s, None)
+
+
+def test_lamb_trains_and_trust_ratio_behaves():
+    """LAMB: converges on a toy problem; biases skip the trust ratio."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu import models, optim, train
+
+    model = models.mnist_mlp(num_classes=4)
+    opt = optim.lamb(1e-2)
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (784,))
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 opt)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 784))
+    y = (jax.random.uniform(jax.random.PRNGKey(2), (64,)) * 4).astype(
+        jnp.int32)
+    losses = []
+    for _ in range(30):
+        state, m = step(state, (x, y))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.5
+    assert np.isfinite(losses[-1])
+
+
+def test_lamb_registry_and_zero_param_safety():
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu import optim
+
+    opt = optim.get("lamb")
+    params = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}
+    s = opt.init(params)
+    g = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    updates, s = opt.update(g, s, params)
+    # zero-norm params: trust ratio must fall back to 1, not 0/inf
+    assert bool(jnp.isfinite(updates["w"]).all())
+    assert float(jnp.abs(updates["w"]).max()) > 0
