@@ -1,0 +1,127 @@
+"""End-to-end distributed GPT training — the full subsystem stack in one
+script.
+
+The transformer-family analogue of ``example.py``: causal-LM training on a
+deterministic synthetic corpus (no downloads), exercising
+
+  * mesh construction with data+fsdp axes and ZeRO state placement,
+  * mixed bf16 compute over an f32 master copy (``policy``),
+  * EMA parameter averaging riding in opt_state,
+  * TrainSession with stop/checkpoint/summary/logging hooks and sharded
+    per-process checkpoints,
+  * KV-cache generation from the trained weights at the end.
+
+Run (CPU mesh): ``XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+python examples/train_gpt.py --device=cpu --steps=60``
+Run (TPU): ``python examples/train_gpt.py``
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_tensorflow_tpu.utils import flags as flags_lib
+
+flags_lib.DEFINE_string("device", "", "cpu|tpu override (config-level)")
+flags_lib.DEFINE_integer("steps", 200, "training steps")
+flags_lib.DEFINE_integer("batch_size", 32, "global batch size")
+flags_lib.DEFINE_integer("seq_len", 64, "sequence length")
+flags_lib.DEFINE_string("log_dir", "/tmp/dttpu_gpt", "checkpoints + events")
+flags_lib.DEFINE_integer("seed", 0, "data/init seed")
+FLAGS = flags_lib.FLAGS
+
+
+def main() -> int:
+    if FLAGS.device:
+        import jax
+        jax.config.update("jax_platforms", FLAGS.device)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_tensorflow_tpu import data, optim, parallel, summary, train
+    from distributed_tensorflow_tpu.data.datasets import (lm_sequences,
+                                                          synthetic_lm_corpus)
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+
+    n = len(jax.devices())
+    fsdp = 2 if n % 2 == 0 and n > 1 else 1
+    mesh = parallel.make_mesh({"data": n // fsdp, "fsdp": fsdp})
+    print(f"devices: {n} ({jax.devices()[0].platform}), "
+          f"mesh={dict(mesh.shape)}", file=sys.stderr)
+
+    config = GPTConfig(vocab_size=256, num_layers=2, num_heads=4,
+                       hidden_size=128, max_position=FLAGS.seq_len,
+                       dtype=jnp.bfloat16)
+    model = GPT(config)
+    optimizer = optim.with_ema(optim.adamw(3e-3), decay=0.99)
+
+    params = model.init(jax.random.PRNGKey(FLAGS.seed))
+    state = train.TrainState.create(params, optimizer.init(params))
+    state = train.shard_train_state(state, mesh,
+                                    model.partition_rules(fsdp=fsdp > 1))
+
+    step = train.make_custom_train_step(model.lm_loss_fn(), optimizer,
+                                        grad_clip_norm=1.0,
+                                        policy="mixed_bfloat16")
+
+    # order-1 (bigram) chain: strongly learnable, so short runs show a real
+    # drop below the uniform baseline
+    rows = lm_sequences(synthetic_lm_corpus(config.vocab_size, 200_000,
+                                            seed=FLAGS.seed, order=1),
+                        FLAGS.seq_len)
+    batch = parallel.round_batch_to_mesh(FLAGS.batch_size, mesh)
+    ds = data.Dataset([rows], batch, seed=FLAGS.seed)
+    bsh = NamedSharding(mesh, P(("data", "fsdp")) if fsdp > 1 else P("data"))
+
+    writer = summary.SummaryWriter(FLAGS.log_dir) if parallel.is_chief() \
+        else None
+    hooks = [train.StopAtStepHook(FLAGS.steps),
+             train.LoggingHook(every_steps=20),
+             train.NaNHook(every_steps=20)]
+    if writer is not None:
+        hooks.append(train.SummaryHook(writer, every_steps=10))
+
+    sync_every = 1 if jax.devices()[0].platform == "cpu" else 20
+    with train.TrainSession(state, step, checkpoint_dir=FLAGS.log_dir,
+                            hooks=hooks, sharded_checkpoint=True) as sess:
+        it = 0
+        while not sess.should_stop():
+            for (b,) in ds:
+                if sess.should_stop():
+                    break
+                m = sess.run_step({"input_ids": jax.device_put(b, bsh)})
+                it += 1
+                if it % sync_every == 0:
+                    float(m["loss"])   # CPU collectives need a shallow queue
+        final = sess.state
+    if writer is not None:
+        writer.close()
+
+    # Evaluate both live and EMA weights on held-out rows; generate a sample.
+    eval_rows = rows[-64:]
+    loss_fn = model.lm_loss_fn()
+    def eval_loss(params):
+        loss, (metrics, _) = loss_fn(params, (), {
+            "input_ids": jnp.asarray(eval_rows)}, None, False)
+        return float(loss), float(metrics["token_accuracy"])
+    live = eval_loss(final.params)
+    ema = eval_loss(optim.ema_params(final.opt_state))
+    uniform = float(np.log(config.vocab_size))
+    print(f"eval loss: live={live[0]:.3f} ema={ema[0]:.3f} "
+          f"(uniform={uniform:.3f}); token acc live={live[1]:.3f}")
+
+    prompt = jnp.asarray(eval_rows[:2, :8])
+    out = model.generate(final.params, prompt, max_new_tokens=16)
+    print(f"generated: {np.asarray(out)[0].tolist()}")
+    if live[0] >= uniform:
+        print("WARNING: did not beat the uniform baseline", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

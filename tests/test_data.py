@@ -81,3 +81,34 @@ def test_mnist_partial_idx_falls_back(tmp_path):
         (xt, yt), _ = data.mnist(str(tmp_path))
     assert xt.shape == (60000, 28, 28, 1)  # synthetic fallback
     assert any("missing" in str(w.message) for w in caught)
+
+
+def test_synthetic_lm_corpus_and_sequences():
+    from distributed_tensorflow_tpu.data.datasets import (lm_sequences,
+                                                          synthetic_lm_corpus)
+
+    c1 = synthetic_lm_corpus(vocab_size=64, length=5000, seed=3, order=1)
+    c2 = synthetic_lm_corpus(vocab_size=64, length=5000, seed=3, order=1)
+    np.testing.assert_array_equal(c1, c2)          # deterministic
+    assert c1.dtype == np.int32
+    assert c1.min() >= 0 and c1.max() < 64
+    # order-1 structure: the modal continuation of a frequent token
+    # dominates (80% deterministic chain)
+    tok = np.bincount(c1).argmax()
+    nxt = c1[1:][c1[:-1] == tok]
+    assert (np.bincount(nxt).max() / len(nxt)) > 0.5
+
+    rows = lm_sequences(c1, seq_len=16)
+    assert rows.shape == ((5000 - 1) // 16, 17)
+    np.testing.assert_array_equal(rows[0], c1[:17])
+    np.testing.assert_array_equal(rows[1], c1[16:33])
+
+
+def test_lm_sequences_short_corpus_and_big_vocab_bounded():
+    from distributed_tensorflow_tpu.data.datasets import (lm_sequences,
+                                                          synthetic_lm_corpus)
+
+    assert lm_sequences(np.arange(10), seq_len=16).shape == (0, 17)
+    # 50k-vocab corpus must not allocate a vocab^2 table
+    c = synthetic_lm_corpus(vocab_size=50_000, length=2000, seed=0)
+    assert c.max() < 50_000 and len(c) == 2000

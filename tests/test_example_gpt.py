@@ -1,0 +1,18 @@
+"""Smoke test for the distributed GPT example (full-stack script)."""
+import os
+import subprocess
+import sys
+
+
+def test_train_gpt_example_smoke(tmp_path):
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "examples/train_gpt.py", "--device=cpu",
+         "--steps=8", "--batch_size=16", f"--log_dir={tmp_path}"],
+        env=env, capture_output=True, text=True, timeout=900,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode in (0, 1), proc.stderr[-2000:]
+    assert "eval loss:" in proc.stdout
+    assert any(p.startswith("ckpt-") for p in os.listdir(tmp_path))
