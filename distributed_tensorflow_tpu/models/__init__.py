@@ -3,7 +3,8 @@
 from . import bert, callbacks, gpt, resnet, zoo
 from .bert import Bert, BertConfig, bert_base, bert_tiny
 from .gpt import GPT, GPTConfig, gpt_small, gpt_tiny
-from .callbacks import Callback, EarlyStopping, History, TensorBoard
+from .callbacks import (Callback, EarlyStopping, History, ModelCheckpoint,
+                        TensorBoard)
 from .resnet import ResNet, resnet18, resnet50, resnet_cifar
 from .sequential import Sequential
 from .zoo import cifar_cnn, mnist_mlp, xor_mlp
@@ -11,5 +12,6 @@ from .zoo import cifar_cnn, mnist_mlp, xor_mlp
 __all__ = ["bert", "callbacks", "gpt", "resnet", "zoo", "Bert", "BertConfig",
            "GPT", "GPTConfig", "gpt_small", "gpt_tiny",
            "bert_base", "bert_tiny", "Callback", "EarlyStopping", "History",
+           "ModelCheckpoint",
            "TensorBoard", "ResNet", "resnet18", "resnet50", "resnet_cifar",
            "Sequential", "cifar_cnn", "mnist_mlp", "xor_mlp"]

@@ -11,7 +11,8 @@ from typing import Dict, Optional
 
 from ..summary import SummaryWriter
 
-__all__ = ["Callback", "TensorBoard", "History", "EarlyStopping"]
+__all__ = ["Callback", "TensorBoard", "History", "EarlyStopping",
+           "ModelCheckpoint"]
 
 
 class Callback:
@@ -59,6 +60,34 @@ class History(Callback):
         self.epochs.append(epoch)
         for k, v in logs.items():
             self.history.setdefault(k, []).append(v)
+
+
+class ModelCheckpoint(Callback):
+    """Per-epoch checkpoint save, optionally only on metric improvement
+    (Keras ``ModelCheckpoint`` parity, backed by ``train.checkpoint``)."""
+
+    def __init__(self, ckpt_dir: str, monitor: str = "val_loss",
+                 save_best_only: bool = False, mode: str = "min",
+                 max_to_keep: int = 5):
+        self.ckpt_dir = ckpt_dir
+        self.monitor = monitor
+        self.save_best_only = save_best_only
+        self.sign = 1.0 if mode == "min" else -1.0
+        self.max_to_keep = max_to_keep
+        self.best = float("inf")
+
+    def on_epoch_end(self, model, epoch, logs) -> None:
+        if self.save_best_only:
+            value = logs.get(self.monitor)
+            if value is None:
+                return
+            score = self.sign * float(value)
+            if score >= self.best:
+                return
+            self.best = score
+        from ..train import checkpoint as ck
+        ck.save(self.ckpt_dir, int(model.state.step), model.state,
+                max_to_keep=self.max_to_keep)
 
 
 class EarlyStopping(Callback):

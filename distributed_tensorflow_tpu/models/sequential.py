@@ -200,6 +200,33 @@ class Sequential:
             print(f"evaluate: {parts}", flush=True)
         return out
 
+    # -- weights IO (Keras save_weights/load_weights parity) -------------
+    def save_weights(self, ckpt_dir: str) -> str:
+        """Write {params, model_state} (not optimizer state) as a
+        step-stamped checkpoint under ``ckpt_dir``."""
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        from ..train import checkpoint as ck
+        return ck.save(ckpt_dir, int(self.state.step),
+                       {"params": self.state.params,
+                        "model_state": self.state.model_state})
+
+    def load_weights(self, ckpt_dir: str) -> None:
+        """Restore the latest weights checkpoint from ``ckpt_dir`` into the
+        (built) model — optimizer state is untouched."""
+        if self.state is None:
+            raise RuntimeError("build the model (compile + build/fit) "
+                               "before load_weights")
+        from ..train import checkpoint as ck
+        latest = ck.latest_checkpoint(ckpt_dir)
+        if latest is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+        restored = ck.restore({"params": self.state.params,
+                               "model_state": self.state.model_state},
+                              latest)
+        self.state = self.state._replace(params=restored["params"],
+                                         model_state=restored["model_state"])
+
     def predict(self, x, batch_size: int = 256) -> np.ndarray:
         if self.state is None:
             raise RuntimeError("model has no state; call fit or build first")

@@ -69,3 +69,33 @@ def test_summary(capsys):
     model.build((64,))
     text = model.summary()
     assert "Total params" in text
+
+
+def test_save_load_weights_and_model_checkpoint(tmp_path):
+    import numpy as np
+    from distributed_tensorflow_tpu import models, ops
+    from distributed_tensorflow_tpu.models.callbacks import ModelCheckpoint
+
+    rng = np.random.default_rng(0)
+    x = rng.random((128, 8), np.float32)
+    y = (x.sum(axis=1) > 4).astype(np.int32)
+
+    m = models.Sequential([ops.Dense(16, activation="relu"), ops.Dense(2)])
+    m.compile("sparse_categorical_crossentropy", metrics=["accuracy"])
+    ckdir = str(tmp_path / "cb")
+    m.fit(x, y, epochs=2, batch_size=32, verbose=0,
+          validation_data=(x, y),
+          callbacks=[ModelCheckpoint(ckdir, save_best_only=True)])
+    import os
+    assert any(p.startswith("ckpt-") for p in os.listdir(ckdir))
+
+    wdir = str(tmp_path / "w")
+    m.save_weights(wdir)
+    preds = m.predict(x[:8])
+
+    m2 = models.Sequential([ops.Dense(16, activation="relu"), ops.Dense(2)])
+    m2.compile("sparse_categorical_crossentropy")
+    m2.build((8,), seed=123)          # different init
+    m2.load_weights(wdir)
+    np.testing.assert_allclose(np.asarray(m2.predict(x[:8])),
+                               np.asarray(preds), rtol=1e-5)
