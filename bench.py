@@ -404,8 +404,41 @@ def main():
     if config not in CONFIGS:
         log(f"unknown config {config!r}; choices: {sorted(CONFIGS)}")
         sys.exit(2)
+
+    # The axon TPU tunnel can hang indefinitely (even jax.devices() blocks).
+    # A hung bench leaves the driver with nothing; emit a failure JSON line
+    # instead if the backend doesn't come up within the timeout.
+    import threading
+    ready = threading.Event()
+    timeout_s = float(os.environ.get("DTTPU_BENCH_INIT_TIMEOUT", "240"))
+    # Exactly ONE JSON line may reach stdout: the watchdog and the main
+    # thread race for this flag; the loser stays silent.
+    report_lock = threading.Lock()
+    claimed = [False]
+
+    def claim_report() -> bool:
+        with report_lock:
+            if claimed[0]:
+                return False
+            claimed[0] = True
+            return True
+
+    def watchdog():
+        if not ready.wait(timeout_s) and claim_report():
+            log(f"backend init exceeded {timeout_s:.0f}s (tunnel hung?)")
+            print(json.dumps(dict(
+                metric=config + "_BACKEND_INIT_TIMEOUT", value=0.0,
+                unit="examples/sec/chip", vs_baseline=0.0)), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+    n = len(jax.devices())   # blocks here when the tunnel is hung
+    ready.set()
+    log(f"backend up: {n} device(s)")
     result = CONFIGS[config]()
-    print(json.dumps(result), flush=True)
+    if claim_report():
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -62,41 +62,60 @@ class History(Callback):
             self.history.setdefault(k, []).append(v)
 
 
+def _monitor_sign(mode: str, monitor: str) -> float:
+    """+1 = lower is better.  Keras modes: min / max / auto (auto infers
+    max for accuracy-ish monitors); anything else is an error, not a
+    silent max."""
+    if mode == "auto":
+        mode = "max" if ("acc" in monitor or monitor.startswith("fmeasure")) \
+            else "min"
+    if mode == "min":
+        return 1.0
+    if mode == "max":
+        return -1.0
+    raise ValueError(f"mode must be 'min', 'max', or 'auto'; got {mode!r}")
+
+
 class ModelCheckpoint(Callback):
-    """Per-epoch checkpoint save, optionally only on metric improvement
-    (Keras ``ModelCheckpoint`` parity, backed by ``train.checkpoint``)."""
+    """Per-epoch weights save, optionally only on metric improvement
+    (Keras ``ModelCheckpoint`` parity).  Writes the same
+    ``{params, model_state}`` payload as ``Sequential.save_weights``, so
+    ``load_weights`` reads these checkpoints back."""
 
     def __init__(self, ckpt_dir: str, monitor: str = "val_loss",
-                 save_best_only: bool = False, mode: str = "min",
+                 save_best_only: bool = False, mode: str = "auto",
                  max_to_keep: int = 5):
         self.ckpt_dir = ckpt_dir
         self.monitor = monitor
         self.save_best_only = save_best_only
-        self.sign = 1.0 if mode == "min" else -1.0
+        self.sign = _monitor_sign(mode, monitor)
         self.max_to_keep = max_to_keep
         self.best = float("inf")
 
     def on_epoch_end(self, model, epoch, logs) -> None:
+        import math
         if self.save_best_only:
             value = logs.get(self.monitor)
-            if value is None:
-                return
+            if value is None or not math.isfinite(float(value)):
+                return     # a NaN epoch must never become "best"
             score = self.sign * float(value)
             if score >= self.best:
                 return
             self.best = score
         from ..train import checkpoint as ck
-        ck.save(self.ckpt_dir, int(model.state.step), model.state,
+        ck.save(self.ckpt_dir, int(model.state.step),
+                {"params": model.state.params,
+                 "model_state": model.state.model_state},
                 max_to_keep=self.max_to_keep)
 
 
 class EarlyStopping(Callback):
     def __init__(self, monitor: str = "val_loss", patience: int = 3,
-                 min_delta: float = 0.0, mode: str = "min"):
+                 min_delta: float = 0.0, mode: str = "auto"):
         self.monitor = monitor
         self.patience = patience
         self.min_delta = min_delta
-        self.sign = 1.0 if mode == "min" else -1.0
+        self.sign = _monitor_sign(mode, monitor)
         self.best = float("inf")
         self.wait = 0
 

@@ -99,3 +99,39 @@ def test_save_load_weights_and_model_checkpoint(tmp_path):
     m2.load_weights(wdir)
     np.testing.assert_allclose(np.asarray(m2.predict(x[:8])),
                                np.asarray(preds), rtol=1e-5)
+
+
+def test_model_checkpoint_loadable_mode_auto_and_nan_guard(tmp_path):
+    import math
+    import numpy as np
+    import pytest
+    from distributed_tensorflow_tpu import models, ops
+    from distributed_tensorflow_tpu.models.callbacks import (ModelCheckpoint,
+                                                             _monitor_sign)
+
+    assert _monitor_sign("auto", "val_loss") == 1.0
+    assert _monitor_sign("auto", "val_accuracy") == -1.0
+    with pytest.raises(ValueError, match="mode"):
+        _monitor_sign("bogus", "val_loss")
+
+    rng = np.random.default_rng(0)
+    x = rng.random((64, 8), np.float32)
+    y = (x.sum(axis=1) > 4).astype(np.int32)
+    m = models.Sequential([ops.Dense(8, activation="relu"), ops.Dense(2)])
+    m.compile("sparse_categorical_crossentropy")
+    ckdir = str(tmp_path)
+    cb = ModelCheckpoint(ckdir, save_best_only=True)
+    m.fit(x, y, epochs=1, batch_size=32, verbose=0, validation_data=(x, y),
+          callbacks=[cb])
+    # these checkpoints load back through the Sequential weights API
+    preds = m.predict(x[:4])
+    m2 = models.Sequential([ops.Dense(8, activation="relu"), ops.Dense(2)])
+    m2.compile("sparse_categorical_crossentropy")
+    m2.build((8,), seed=99)
+    m2.load_weights(ckdir)
+    np.testing.assert_allclose(np.asarray(m2.predict(x[:4])),
+                               np.asarray(preds), rtol=1e-5)
+    # NaN epochs never become "best"
+    best = cb.best
+    cb.on_epoch_end(m, 5, {"val_loss": float("nan")})
+    assert cb.best == best and math.isfinite(best)
