@@ -1,17 +1,20 @@
 """Model zoo + high-level Sequential/compile/fit API."""
 
-from . import bert, callbacks, gpt, resnet, zoo
+from . import bert, callbacks, gpt, resnet, seq2seq, zoo
 from .bert import Bert, BertConfig, bert_base, bert_tiny
 from .gpt import GPT, GPTConfig, gpt_small, gpt_tiny
+from .seq2seq import Seq2Seq, Seq2SeqConfig, seq2seq_tiny
 from .callbacks import (Callback, EarlyStopping, History, ModelCheckpoint,
                         TensorBoard)
 from .resnet import ResNet, resnet18, resnet50, resnet_cifar
 from .sequential import Sequential
 from .zoo import cifar_cnn, mnist_mlp, xor_mlp
 
-__all__ = ["bert", "callbacks", "gpt", "resnet", "zoo", "Bert", "BertConfig",
+__all__ = ["bert", "callbacks", "gpt", "resnet", "seq2seq", "zoo",
+           "Bert", "BertConfig",
            "GPT", "GPTConfig", "gpt_small", "gpt_tiny",
-           "bert_base", "bert_tiny", "Callback", "EarlyStopping", "History",
+           "bert_base", "bert_tiny", "Seq2Seq", "Seq2SeqConfig", "seq2seq_tiny",
+           "Callback", "EarlyStopping", "History",
            "ModelCheckpoint",
            "TensorBoard", "ResNet", "resnet18", "resnet50", "resnet_cifar",
            "Sequential", "cifar_cnn", "mnist_mlp", "xor_mlp"]

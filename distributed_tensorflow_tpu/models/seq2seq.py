@@ -1,0 +1,325 @@
+"""Encoder-decoder transformer (seq2seq) — completes the transformer
+family next to BERT (encoder-only, models/bert.py) and GPT (decoder-only,
+models/gpt.py).
+
+Vanilla pre-LN architecture (Vaswani et al.; T5-style tied embeddings,
+learned absolute positions): a bidirectional encoder over the source, a
+causal decoder with cross-attention into the encoder memory, teacher-forced
+next-token training, and a jittable greedy/sampling ``generate``.
+
+TPU design notes: both stacks scan one vmap-initialized layer pytree
+(weights stay stacked [L, ...] — one XLA while-loop per stack, no
+per-layer unrolled HLO); projections keep the TP-ready [d, heads, head_dim]
+layout shared with BERT/GPT so one ``partition_rules`` table serves the
+whole transformer family.  The reference has no attention at all
+(64-bit MLP, reference example.py:149-155) — this family is part of the
+"complete framework" surface, not reference parity.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops import attention as attn_lib
+from ..ops import initializers as init_lib
+from ..ops import losses as loss_lib
+from ..parallel.sharding import PartitionRules
+from .bert import _dropout, _layer_norm  # one LN/dropout impl family-wide
+
+__all__ = ["Seq2SeqConfig", "Seq2Seq", "seq2seq_tiny"]
+
+
+@dataclass
+class Seq2SeqConfig:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    intermediate_size: int = 2048
+    max_position: int = 512
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def seq2seq_tiny(**kw) -> "Seq2Seq":
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_encoder_layers", 2)
+    kw.setdefault("num_decoder_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position", 64)
+    return Seq2Seq(Seq2SeqConfig(**kw))
+
+
+def _ln_params(d):
+    return {"gamma": jnp.ones((d,), jnp.float32),
+            "beta": jnp.zeros((d,), jnp.float32)}
+
+
+class Seq2Seq:
+    """Functional encoder-decoder: ``init(key) -> params``;
+    ``encode`` / ``decode`` / ``seq2seq_loss_fn`` / ``generate``."""
+
+    def __init__(self, config: Seq2SeqConfig):
+        self.config = config
+
+    # -- init -------------------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        c = self.config
+        trunc = init_lib.truncated_normal(0.02)
+        d, h, hd, i = c.hidden_size, c.num_heads, c.head_dim, \
+            c.intermediate_size
+        k_emb, k_enc, k_dec = jax.random.split(key, 3)
+        ke = jax.random.split(k_emb, 3)
+
+        def attn(k):
+            ks = jax.random.split(k, 4)
+            return {
+                "query": {"kernel": trunc(ks[0], (d, h, hd)),
+                          "bias": jnp.zeros((h, hd), jnp.float32)},
+                "key": {"kernel": trunc(ks[1], (d, h, hd)),
+                        "bias": jnp.zeros((h, hd), jnp.float32)},
+                "value": {"kernel": trunc(ks[2], (d, h, hd)),
+                          "bias": jnp.zeros((h, hd), jnp.float32)},
+                "out": {"kernel": trunc(ks[3], (h, hd, d)),
+                        "bias": jnp.zeros((d,), jnp.float32)},
+            }
+
+        def ffn(k):
+            k1, k2 = jax.random.split(k)
+            return {"w_in": {"kernel": trunc(k1, (d, i)),
+                             "bias": jnp.zeros((i,), jnp.float32)},
+                    "w_out": {"kernel": trunc(k2, (i, d)),
+                              "bias": jnp.zeros((d,), jnp.float32)}}
+
+        def enc_layer(k):
+            k1, k2 = jax.random.split(k)
+            return {"ln_1": _ln_params(d), "attention": attn(k1),
+                    "ln_2": _ln_params(d), "ffn": ffn(k2)}
+
+        def dec_layer(k):
+            k1, k2, k3 = jax.random.split(k, 3)
+            return {"ln_1": _ln_params(d), "self_attention": attn(k1),
+                    "ln_x": _ln_params(d), "cross_attention": attn(k2),
+                    "ln_2": _ln_params(d), "ffn": ffn(k3)}
+
+        return {
+            "embeddings": {
+                "word": trunc(ke[0], (c.vocab_size, d)),
+                "enc_position": trunc(ke[1], (c.max_position, d)),
+                "dec_position": trunc(ke[2], (c.max_position, d)),
+            },
+            "encoder": jax.vmap(enc_layer)(
+                jax.random.split(k_enc, c.num_encoder_layers)),
+            "decoder": jax.vmap(dec_layer)(
+                jax.random.split(k_dec, c.num_decoder_layers)),
+            "ln_enc_f": _ln_params(d),
+            "ln_dec_f": _ln_params(d),
+        }
+
+    # -- blocks -----------------------------------------------------------
+    def _ffn(self, p, x):
+        dtype = x.dtype
+        h = jax.nn.gelu(
+            jnp.einsum("bsd,di->bsi", x, p["w_in"]["kernel"].astype(dtype))
+            + p["w_in"]["bias"].astype(dtype))
+        return (jnp.einsum("bsi,id->bsd", h,
+                           p["w_out"]["kernel"].astype(dtype))
+                + p["w_out"]["bias"].astype(dtype))
+
+    def _enc_block(self, p, x, src_mask, rng, train):
+        c = self.config
+        r1, r2, r3 = jax.random.split(rng, 3)
+        a = attn_lib.attention_core(
+            p["attention"], _layer_norm(p["ln_1"], x, c.layer_norm_eps),
+            mask=src_mask, dropout_rate=c.dropout_rate, rng=r1, train=train)
+        x = x + _dropout(a, c.dropout_rate, r2, train)
+        f = self._ffn(p["ffn"], _layer_norm(p["ln_2"], x, c.layer_norm_eps))
+        return x + _dropout(f, c.dropout_rate, r3, train)
+
+    def _dec_block(self, p, x, memory, self_mask, cross_mask, rng, train):
+        c = self.config
+        r1, r2, r3, r4, r5 = jax.random.split(rng, 5)
+        a = attn_lib.attention_core(
+            p["self_attention"],
+            _layer_norm(p["ln_1"], x, c.layer_norm_eps),
+            mask=self_mask, dropout_rate=c.dropout_rate, rng=r1, train=train)
+        x = x + _dropout(a, c.dropout_rate, r2, train)
+        ca = attn_lib.attention_core(
+            p["cross_attention"],
+            _layer_norm(p["ln_x"], x, c.layer_norm_eps),
+            kv=memory, mask=cross_mask, dropout_rate=c.dropout_rate,
+            rng=r3, train=train)
+        x = x + _dropout(ca, c.dropout_rate, r4, train)
+        f = self._ffn(p["ffn"], _layer_norm(p["ln_2"], x, c.layer_norm_eps))
+        return x + _dropout(f, c.dropout_rate, r5, train)
+
+    # -- forward ----------------------------------------------------------
+    def encode(self, params, src_ids, src_valid=None, *, train=False,
+               rng=None):
+        """-> memory [b, s, d].  ``src_valid``: [b, s] 1/0 padding mask."""
+        c = self.config
+        if rng is None:
+            if train:
+                raise ValueError("encode(train=True) requires rng")
+            rng = jax.random.PRNGKey(0)
+        b, s = src_ids.shape
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], src_ids, axis=0)
+        x = x + emb["enc_position"][None, :s, :]
+        r_emb, r_layers = jax.random.split(rng)
+        x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
+        mask = None if src_valid is None else attn_lib.padding_mask(src_valid)
+
+        layer_fn = self._enc_block
+        if c.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
+
+        def body(carry, inputs):
+            lp, lk = inputs
+            return layer_fn(lp, carry, mask, lk, train), None
+
+        keys = jax.random.split(r_layers, c.num_encoder_layers)
+        x, _ = lax.scan(body, x, (params["encoder"], keys))
+        return _layer_norm(params["ln_enc_f"], x, c.layer_norm_eps)
+
+    def decode(self, params, memory, tgt_ids, src_valid=None, *,
+               train=False, rng=None):
+        """-> hidden [b, t, d]; causal self-attention + cross-attention."""
+        c = self.config
+        if rng is None:
+            if train:
+                raise ValueError("decode(train=True) requires rng")
+            rng = jax.random.PRNGKey(0)
+        b, t = tgt_ids.shape
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], tgt_ids, axis=0)
+        x = x + emb["dec_position"][None, :t, :]
+        r_emb, r_layers = jax.random.split(rng)
+        x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
+        self_mask = attn_lib.causal_mask(t)
+        cross_mask = (None if src_valid is None
+                      else attn_lib.padding_mask(src_valid))
+
+        layer_fn = self._dec_block
+        if c.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(6,))
+
+        def body(carry, inputs):
+            lp, lk = inputs
+            return layer_fn(lp, carry, memory, self_mask, cross_mask, lk,
+                            train), None
+
+        keys = jax.random.split(r_layers, c.num_decoder_layers)
+        x, _ = lax.scan(body, x, (params["decoder"], keys))
+        return _layer_norm(params["ln_dec_f"], x, c.layer_norm_eps)
+
+    def logits(self, params, hidden):
+        """Tied head -> [b, t, vocab] f32."""
+        w = params["embeddings"]["word"].T.astype(hidden.dtype)
+        return (hidden @ w).astype(jnp.float32)
+
+    # -- training ---------------------------------------------------------
+    def seq2seq_loss_fn(self):
+        """``make_custom_train_step`` contract.  Batch dict:
+        ``src_ids`` [b, s], ``tgt_ids`` [b, t] (BOS-prefixed; next-token
+        targets are the shifted ids), optional ``src_valid`` [b, s] and
+        ``loss_mask`` [b, t-1]."""
+
+        def loss_fn(params, model_state, batch, rng, train):
+            # rng passes through untouched: encode/decode raise on
+            # (train=True, rng=None) — never silently reuse a fixed key
+            r_enc = r_dec = None
+            if rng is not None:
+                r_enc, r_dec = jax.random.split(rng)
+            memory = self.encode(params, batch["src_ids"],
+                                 batch.get("src_valid"), train=train,
+                                 rng=r_enc)
+            hidden = self.decode(params, memory, batch["tgt_ids"][:, :-1],
+                                 batch.get("src_valid"), train=train,
+                                 rng=r_dec)
+            logits = self.logits(params, hidden)
+            targets = batch["tgt_ids"][:, 1:]
+            mask = batch.get("loss_mask")
+            loss = loss_lib.softmax_cross_entropy_with_integer_labels(
+                logits, targets, where=mask)
+            hits = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+            if mask is not None:
+                acc = jnp.sum(hits * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+                metrics = {"token_accuracy": acc,
+                           "loss_weight": jnp.sum(mask).astype(jnp.float32)}
+            else:
+                metrics = {"token_accuracy": jnp.mean(hits)}
+            return loss, (metrics, model_state)
+
+        return loss_fn
+
+    # -- generation -------------------------------------------------------
+    def generate(self, params, src_ids, max_new_tokens: int,
+                 bos_id: int = 0, temperature: float = 0.0, rng=None,
+                 src_valid=None) -> jnp.ndarray:
+        """Greedy/sampled decode: encode once, then one ``lax.scan`` over
+        target positions (full decoder recompute per step — O(t²) but
+        cache-free and jittable at any length; fine at eval scale).
+        Returns [b, max_new_tokens] (BOS not included)."""
+        c = self.config
+        if max_new_tokens > c.max_position:
+            raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
+                             f"max_position {c.max_position}")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        b = src_ids.shape[0]
+        memory = self.encode(params, src_ids, src_valid)
+        tgt = jnp.full((b, max_new_tokens + 1), bos_id, jnp.int32)
+
+        def step(carry, i):
+            tgt, rng = carry
+            hidden = self.decode(params, memory, tgt[:, :-1], src_valid)
+            # select the d-wide row FIRST, project only it to vocab
+            row = jnp.take_along_axis(
+                hidden, i[None, None, None], axis=1)
+            logits = self.logits(params, row)[:, 0, :]
+            rng, sub = jax.random.split(rng)
+            if temperature > 0:
+                nxt = jax.random.categorical(sub, logits / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            tgt = lax.dynamic_update_slice_in_dim(
+                tgt, nxt[:, None].astype(jnp.int32), i + 1, axis=1)
+            return (tgt, rng), None
+
+        (tgt, _), _ = lax.scan(step, (tgt, rng),
+                               jnp.arange(max_new_tokens))
+        return tgt[:, 1:]
+
+    # -- sharding ---------------------------------------------------------
+    def partition_rules(self, fsdp: bool = False) -> PartitionRules:
+        """Megatron TP over heads/intermediate, same table shape as
+        BERT/GPT; ``fsdp=True`` adds the ZeRO axis on the other dim."""
+        f = "fsdp" if fsdp else None
+        return PartitionRules([
+            (r"embeddings/word", P("tensor", f)),
+            (r"embeddings/(enc|dec)_position", P(None, None)),
+            (r"(self_|cross_)?attention/(query|key|value)/kernel",
+             P(None, f, "tensor", None)),
+            (r"(self_|cross_)?attention/(query|key|value)/bias",
+             P(None, "tensor", None)),
+            (r"(self_|cross_)?attention/out/kernel",
+             P(None, "tensor", None, f)),
+            (r"ffn/w_in/kernel", P(None, f, "tensor")),
+            (r"ffn/w_in/bias", P(None, "tensor")),
+            (r"ffn/w_out/kernel", P(None, "tensor", f)),
+        ])

@@ -64,7 +64,8 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
                    rng=None, train: bool = False,
-                   attention_fn=dot_product_attention) -> jnp.ndarray:
+                   attention_fn=dot_product_attention,
+                   kv=None) -> jnp.ndarray:
     """The shared multi-head attention body.
 
     ``params``: {query,key,value: {kernel [d,h,hd], bias [h,hd]},
@@ -72,17 +73,19 @@ def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
     ``MultiHeadAttention`` layer and the scanned BERT stack, so projection/
     dtype/dropout fixes land in exactly one place.  ``attention_fn``
     swaps the inner kernel (full softmax, ring attention, a Pallas flash
-    kernel) behind the same signature.
+    kernel) behind the same signature.  ``kv``: optional memory sequence
+    for cross-attention (keys/values project from it; queries from ``x``).
     """
     dtype = x.dtype
 
-    def project(p):
-        return (jnp.einsum("bsd,dhk->bshk", x, p["kernel"].astype(dtype))
+    def project(p, src):
+        return (jnp.einsum("bsd,dhk->bshk", src, p["kernel"].astype(dtype))
                 + p["bias"].astype(dtype))
 
-    q = project(params["query"])
-    k = project(params["key"])
-    v = project(params["value"])
+    memory = x if kv is None else kv.astype(dtype)
+    q = project(params["query"], x)
+    k = project(params["key"], memory)
+    v = project(params["value"], memory)
     ctx = attention_fn(q, k, v, mask=mask)
     if train and dropout_rate > 0.0:
         if rng is None:
