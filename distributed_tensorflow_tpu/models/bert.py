@@ -186,13 +186,7 @@ class Bert:
         x = _layer_norm(p["attention"]["ln"],
                         x + _dropout(attn_out, c.dropout_rate, r2, train),
                         c.layer_norm_eps)
-        dtype = x.dtype
-        hmid = jax.nn.gelu(
-            jnp.einsum("bsd,di->bsi", x, p["ffn"]["w_in"]["kernel"].astype(dtype))
-            + p["ffn"]["w_in"]["bias"].astype(dtype))
-        ffn_out = (jnp.einsum("bsi,id->bsd", hmid,
-                              p["ffn"]["w_out"]["kernel"].astype(dtype))
-                   + p["ffn"]["w_out"]["bias"].astype(dtype))
+        ffn_out = attn_lib.ffn_core(p["ffn"], x)
         return _layer_norm(p["ffn"]["ln"],
                            x + _dropout(ffn_out, c.dropout_rate, r3, train),
                            c.layer_norm_eps)

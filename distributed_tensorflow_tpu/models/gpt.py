@@ -179,15 +179,7 @@ class GPT:
             aux = (c.moe_aux_weight * m["aux_loss"]
                    + c.moe_z_weight * m["router_z_loss"])
             return y, aux
-        dtype = x.dtype
-        h = jax.nn.gelu(
-            jnp.einsum("bsd,di->bsi", h,
-                       p["ffn"]["w_in"]["kernel"].astype(dtype))
-            + p["ffn"]["w_in"]["bias"].astype(dtype))
-        out = (jnp.einsum("bsi,id->bsd", h,
-                          p["ffn"]["w_out"]["kernel"].astype(dtype))
-               + p["ffn"]["w_out"]["bias"].astype(dtype))
-        return out, jnp.zeros((), jnp.float32)
+        return attn_lib.ffn_core(p["ffn"], h), jnp.zeros((), jnp.float32)
 
     def _block(self, p, x, mask, rng, train):
         c = self.config

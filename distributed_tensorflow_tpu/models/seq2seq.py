@@ -131,15 +131,6 @@ class Seq2Seq:
         }
 
     # -- blocks -----------------------------------------------------------
-    def _ffn(self, p, x):
-        dtype = x.dtype
-        h = jax.nn.gelu(
-            jnp.einsum("bsd,di->bsi", x, p["w_in"]["kernel"].astype(dtype))
-            + p["w_in"]["bias"].astype(dtype))
-        return (jnp.einsum("bsi,id->bsd", h,
-                           p["w_out"]["kernel"].astype(dtype))
-                + p["w_out"]["bias"].astype(dtype))
-
     def _enc_block(self, p, x, src_mask, rng, train):
         c = self.config
         r1, r2, r3 = jax.random.split(rng, 3)
@@ -147,7 +138,8 @@ class Seq2Seq:
             p["attention"], _layer_norm(p["ln_1"], x, c.layer_norm_eps),
             mask=src_mask, dropout_rate=c.dropout_rate, rng=r1, train=train)
         x = x + _dropout(a, c.dropout_rate, r2, train)
-        f = self._ffn(p["ffn"], _layer_norm(p["ln_2"], x, c.layer_norm_eps))
+        f = attn_lib.ffn_core(p["ffn"],
+                              _layer_norm(p["ln_2"], x, c.layer_norm_eps))
         return x + _dropout(f, c.dropout_rate, r3, train)
 
     def _dec_block(self, p, x, memory, self_mask, cross_mask, rng, train):
@@ -164,7 +156,8 @@ class Seq2Seq:
             kv=memory, mask=cross_mask, dropout_rate=c.dropout_rate,
             rng=r3, train=train)
         x = x + _dropout(ca, c.dropout_rate, r4, train)
-        f = self._ffn(p["ffn"], _layer_norm(p["ln_2"], x, c.layer_norm_eps))
+        f = attn_lib.ffn_core(p["ffn"],
+                              _layer_norm(p["ln_2"], x, c.layer_norm_eps))
         return x + _dropout(f, c.dropout_rate, r5, train)
 
     # -- forward ----------------------------------------------------------

@@ -98,6 +98,23 @@ def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
     return out + params["out"]["bias"].astype(dtype)
 
 
+def ffn_core(params, x, activation=jax.nn.gelu) -> jnp.ndarray:
+    """The shared transformer FFN body: w_in -> activation -> w_out, matmuls
+    in the input dtype (MXU path) with params cast to match.
+
+    ``params``: {w_in: {kernel [d, i], bias [i]}, w_out: {kernel [i, d],
+    bias [d]}} — like ``attention_core``, one implementation serves
+    BERT/GPT/seq2seq so dtype/numerics fixes land in exactly one place.
+    """
+    dtype = x.dtype
+    h = activation(
+        jnp.einsum("bsd,di->bsi", x, params["w_in"]["kernel"].astype(dtype))
+        + params["w_in"]["bias"].astype(dtype))
+    return (jnp.einsum("bsi,id->bsd", h,
+                       params["w_out"]["kernel"].astype(dtype))
+            + params["w_out"]["bias"].astype(dtype))
+
+
 class MultiHeadAttention(Layer):
     """Self-attention with TP-ready [d, heads, head_dim] projections."""
 

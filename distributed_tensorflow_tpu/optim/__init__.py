@@ -1,11 +1,12 @@
 """Optimizers and LR schedules (pure-functional, shardable opt_state)."""
 
 from . import schedules
+from .adafactor import adafactor
 from .ema import EMAState, ema, ema_params, with_ema
 from .optimizers import (Optimizer, OptState, adam, adamw, apply_updates,
                          clip_by_global_norm, get, global_norm, lamb,
                          momentum, sgd)
 
-__all__ = ["schedules", "Optimizer", "OptState", "adam", "adamw",
+__all__ = ["schedules", "adafactor", "Optimizer", "OptState", "adam", "adamw",
            "apply_updates", "clip_by_global_norm", "get", "global_norm",
            "lamb", "momentum", "sgd", "EMAState", "ema", "ema_params", "with_ema"]

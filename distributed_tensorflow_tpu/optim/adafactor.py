@@ -1,0 +1,133 @@
+"""Adafactor (Shazeer & Stern, 2018) — sublinear-memory Adam for pod-scale
+training.
+
+The TPU-era optimizer behind T5: for a [r, c] weight matrix it keeps one
+row EMA [r] and one column EMA [c] of squared gradients instead of the full
+[r, c] second moment (their outer product over mean reconstructs it), so
+optimizer memory for matrices drops from O(rc) to O(r + c).  Scalars and
+vectors keep a full second moment.  No first moment by default.
+
+Implemented pieces (paper sections 3-5): factored second moments with the
+time-dependent decay β2_t = 1 − t^−0.8, per-tensor update RMS clipping
+(d = 1.0), and the relative step size max(ε₂, RMS(p)) · min(10⁻², 1/√t)
+when no explicit learning rate is given.
+
+State layout: ``inner = {"vr": tree, "vc": tree, "v": tree}`` where every
+tree shares the params treedef and non-applicable slots hold zeros((0,)) —
+uniform structure keeps ``jax.tree.map`` and ZeRO placement simple (the
+factored vectors are O(r + c), so replicating them costs ~nothing).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .optimizers import Optimizer, OptState, ScalarOrSchedule, _lr_at
+
+__all__ = ["adafactor"]
+
+
+def _rms(x):
+    return jnp.sqrt(jnp.mean(jnp.square(x)))
+
+
+def _slice_rms(x):
+    """Per-tensor RMS, treating dim 0 of rank>=3 tensors as a layer stack.
+
+    This repo's transformer stacks are vmap-initialized [L, ...] pytrees
+    (one scanned XLA loop per stack), so the paper's per-tensor clipping /
+    relative-step rule maps to per-leading-slice reductions there; plain
+    matrices and vectors reduce whole-tensor.  Returns a shape
+    broadcastable against ``x``.
+    """
+    if x.ndim >= 3:
+        axes = tuple(range(1, x.ndim))
+        return jnp.sqrt(jnp.mean(jnp.square(x), axis=axes, keepdims=True))
+    return _rms(x)
+
+
+def adafactor(learning_rate: Optional[ScalarOrSchedule] = None,
+              min_dim_size_to_factor: int = 128,
+              decay_exponent: float = 0.8,
+              clipping_threshold: float = 1.0,
+              eps1: float = 1e-30, eps2: float = 1e-3,
+              relative_step_cap: float = 1e-2) -> Optimizer:
+    """``learning_rate=None`` uses the paper's relative step size
+    (``max(eps2, RMS(p)) * min(relative_step_cap, 1/sqrt(t))``); a float or
+    schedule overrides it.  Tensors whose two trailing dims are both at
+    least ``min_dim_size_to_factor`` get factored second moments."""
+
+    def _factored(p) -> bool:
+        return (p.ndim >= 2 and p.shape[-1] >= min_dim_size_to_factor
+                and p.shape[-2] >= min_dim_size_to_factor)
+
+    def init(params) -> OptState:
+        def vr(p):
+            return (jnp.zeros(p.shape[:-1], jnp.float32) if _factored(p)
+                    else jnp.zeros((0,), jnp.float32))
+
+        def vc(p):
+            return (jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)
+                    if _factored(p) else jnp.zeros((0,), jnp.float32))
+
+        def v(p):
+            return (jnp.zeros((0,), jnp.float32) if _factored(p)
+                    else jnp.zeros_like(p, jnp.float32))
+
+        return OptState(jnp.zeros((), jnp.int32),
+                        {"vr": jax.tree.map(vr, params),
+                         "vc": jax.tree.map(vc, params),
+                         "v": jax.tree.map(v, params)})
+
+    def update(grads, state: OptState, params):
+        if params is None:
+            raise ValueError("adafactor needs params at update() (relative "
+                             "step + factored reconstruction)")
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        beta2 = 1.0 - jnp.power(t, -decay_exponent)
+
+        def one(g, p, vr, vc, v):
+            g = g.astype(jnp.float32)
+            g2 = jnp.square(g) + eps1
+            if _factored(p):
+                new_vr = beta2 * vr + (1 - beta2) * jnp.mean(g2, axis=-1)
+                new_vc = beta2 * vc + (1 - beta2) * jnp.mean(g2, axis=-2)
+                # v̂ = outer(vr, vc) / mean(vr): reconstruct rsqrt directly
+                r_inv = jax.lax.rsqrt(
+                    new_vr / jnp.mean(new_vr, axis=-1, keepdims=True))
+                c_inv = jax.lax.rsqrt(new_vc)
+                u = g * r_inv[..., None] * c_inv[..., None, :]
+                new_v = v
+            else:
+                new_v = beta2 * v + (1 - beta2) * g2
+                u = g * jax.lax.rsqrt(new_v)
+                new_vr, new_vc = vr, vc
+            u = u / jnp.maximum(1.0, _slice_rms(u) / clipping_threshold)
+            if learning_rate is None:
+                step_size = (jnp.maximum(eps2,
+                                         _slice_rms(p.astype(jnp.float32)))
+                             * jnp.minimum(relative_step_cap,
+                                           1.0 / jnp.sqrt(t)))
+            else:
+                step_size = _lr_at(learning_rate, count)
+            return -step_size * u, new_vr, new_vc, new_v
+
+        moved = jax.tree.map(one, grads, params, state.inner["vr"],
+                             state.inner["vc"], state.inner["v"])
+        pick = lambda i: jax.tree.map(
+            lambda x: x[i], moved,
+            is_leaf=lambda x: isinstance(x, tuple) and len(x) == 4)
+        return pick(0), OptState(count, {"vr": pick(1), "vc": pick(2),
+                                         "v": pick(3)})
+
+    return Optimizer(init, update)
+
+
+# by-name registration ("adafactor" in optim.get / compile(optimizer=...));
+# here rather than in optimizers.py so the module dependency stays one-way
+from .optimizers import _REGISTRY  # noqa: E402
+
+_REGISTRY["adafactor"] = adafactor

@@ -55,9 +55,15 @@ def shard_train_state(state: "TrainState", mesh: Mesh, rules) -> "TrainState":
         recurse; scalars/leftovers replicate."""
         # Params-shaped FIRST: momentum's mu IS a params-shaped pytree
         # (dict or bare array) and must shard with the params, not fall
-        # into the container branches and replicate.
+        # into the container branches and replicate.  Leaf-by-leaf shape
+        # check: adafactor's factored moment trees share the params
+        # TREEDEF but hold rank-reduced vectors — those replicate (they
+        # are O(r + c); replication costs ~nothing).
         if jax.tree_util.tree_structure(subtree) == params_def:
-            return jax.device_put(subtree, params_sh)
+            def put(leaf, sh, p_leaf):
+                ok = tuple(jnp.shape(leaf)) == tuple(jnp.shape(p_leaf))
+                return jax.device_put(leaf, sh if ok else replicated)
+            return jax.tree.map(put, subtree, params_sh, state.params)
         if isinstance(subtree, dict):
             return {k: place(v) for k, v in subtree.items()}
         if isinstance(subtree, opt_lib.OptState):

@@ -182,3 +182,59 @@ def test_lamb_registry_and_zero_param_safety():
     # zero-norm params: trust ratio must fall back to 1, not 0/inf
     assert bool(jnp.isfinite(updates["w"]).all())
     assert float(jnp.abs(updates["w"]).max()) > 0
+
+
+def test_adafactor_memory_layout_and_convergence():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu import models, optim, train
+
+    model = models.mnist_mlp(num_classes=4)
+    opt = optim.adafactor()           # relative-step mode
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (784,))
+    # factored: [784,128] kernel keeps [784]+[128] vectors, no full moment
+    vr = state.opt_state.inner["vr"]["dense"]["kernel"]
+    vc = state.opt_state.inner["vc"]["dense"]["kernel"]
+    v = state.opt_state.inner["v"]["dense"]["kernel"]
+    assert vr.shape == (784,) and vc.shape == (128,) and v.shape == (0,)
+    # biases keep a full moment
+    assert state.opt_state.inner["v"]["dense"]["bias"].shape == (128,)
+
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 opt)
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 784))
+    y = (jax.random.uniform(jax.random.PRNGKey(2), (64,)) * 4).astype(
+        jnp.int32)
+    losses = []
+    for _ in range(40):
+        state, m = step(state, (x, y))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.7 and np.isfinite(losses[-1])
+
+
+def test_adafactor_explicit_lr_and_zero_placement():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from distributed_tensorflow_tpu import models, optim, train
+    from distributed_tensorflow_tpu.parallel import make_mesh
+    from distributed_tensorflow_tpu.parallel.sharding import PartitionRules
+
+    mesh = make_mesh({"fsdp": 8})
+    model = models.mnist_mlp(num_classes=4)
+    opt = optim.adafactor(1e-3)
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (784,))
+    rules = PartitionRules([(r"kernel", P("fsdp", None))])
+    state = train.shard_train_state(state, mesh, rules)  # must not crash
+    # params sharded; factored vectors replicated
+    assert "fsdp" in str(state.params["dense"]["kernel"].sharding.spec)
+    assert state.opt_state.inner["vr"]["dense"]["kernel"].sharding.spec \
+        == P()
+    step = train.make_train_step(model, "sparse_categorical_crossentropy",
+                                 opt)
+    x = jnp.ones((8, 784))
+    y = jnp.zeros((8,), jnp.int32)
+    state, m = step(state, (x, y))
+    assert np.isfinite(float(m["loss"]))
