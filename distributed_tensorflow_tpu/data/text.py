@@ -1,0 +1,197 @@
+"""Text tokenization: byte-level base + trainable BPE.
+
+The LM-framework complement to the synthetic corpora in ``datasets``: a
+dependency-free tokenizer pair (no downloads, no external vocab files).
+
+  * ``ByteTokenizer`` — the trivial reversible base: one id per byte, plus
+    reserved special ids appended AFTER the byte range.
+  * ``BPETokenizer`` — classic byte-pair encoding trained on raw text
+    (Sennrich et al., 2016): repeatedly merge the most frequent adjacent
+    pair; encode applies merges in training order (rank order), which is
+    the same greedy scheme GPT-2's tokenizer uses.
+
+Both produce int32 numpy arrays ready for ``datasets.lm_sequences`` /
+the GPT/seq2seq batch dicts.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ByteTokenizer", "BPETokenizer"]
+
+
+def _special_id(specials: Dict[str, int], name: str) -> int:
+    """Special-token lookup that REFUSES to guess: a missing special must
+    raise, not alias byte 0 (NUL) and silently corrupt the stream."""
+    try:
+        return specials[name]
+    except KeyError:
+        raise KeyError(f"tokenizer has no {name!r} special token; "
+                       f"configured: {sorted(specials)}") from None
+
+
+def _apply_merge(seq: List[int], pair: Tuple[int, int],
+                 new_id: int) -> List[int]:
+    """Replace every non-overlapping occurrence of ``pair`` with
+    ``new_id`` (left-to-right) — the single merge step shared by train
+    and encode so their segmentation can never diverge."""
+    out: List[int] = []
+    i = 0
+    n = len(seq)
+    while i < n:
+        if i + 1 < n and seq[i] == pair[0] and seq[i + 1] == pair[1]:
+            out.append(new_id)
+            i += 2
+        else:
+            out.append(seq[i])
+            i += 1
+    return out
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: ids 0-255 are bytes; special
+    tokens (``pad``, ``bos``, ``eos`` by default) get ids 256+."""
+
+    def __init__(self, specials: Sequence[str] = ("<pad>", "<bos>", "<eos>")):
+        self.specials = {name: 256 + i for i, name in enumerate(specials)}
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.specials)
+
+    @property
+    def pad_id(self) -> int:
+        return _special_id(self.specials, "<pad>")
+
+    @property
+    def bos_id(self) -> int:
+        return _special_id(self.specials, "<bos>")
+
+    @property
+    def eos_id(self) -> int:
+        return _special_id(self.specials, "<eos>")
+
+    def encode(self, text: str, bos: bool = False,
+               eos: bool = False) -> np.ndarray:
+        ids = list(text.encode("utf-8"))
+        if bos:
+            ids = [self.bos_id] + ids
+        if eos:
+            ids = ids + [self.eos_id]
+        return np.asarray(ids, np.int32)
+
+    def decode(self, ids) -> str:
+        by = bytes(int(i) for i in np.asarray(ids).ravel() if int(i) < 256)
+        return by.decode("utf-8", errors="replace")
+
+
+class BPETokenizer:
+    """Byte-pair encoding over the byte alphabet.
+
+    ``train`` learns ``vocab_size - 256 - len(specials)`` merges from text;
+    ``encode`` applies them greedily by rank.  Serializable via
+    ``save``/``load`` (one JSON file).
+    """
+
+    def __init__(self, merges: Optional[List[Tuple[int, int]]] = None,
+                 specials: Sequence[str] = ("<pad>", "<bos>", "<eos>")):
+        self.merges: List[Tuple[int, int]] = list(merges or [])
+        self.specials_names = list(specials)
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        # merged token ids are allocated after bytes+specials, in rank order
+        self._base = 256 + len(self.specials_names)
+        self.specials = {n: 256 + i for i, n in
+                         enumerate(self.specials_names)}
+        self._ranks: Dict[Tuple[int, int], int] = {
+            tuple(pair): r for r, pair in enumerate(self.merges)}
+        # id -> byte expansion, for decode
+        self._expand: Dict[int, bytes] = {i: bytes([i]) for i in range(256)}
+        for r, (a, b) in enumerate(self.merges):
+            self._expand[self._base + r] = (
+                self._expand_id(a) + self._expand_id(b))
+
+    def _expand_id(self, i: int) -> bytes:
+        return self._expand.get(int(i), b"")
+
+    @property
+    def vocab_size(self) -> int:
+        return self._base + len(self.merges)
+
+    @property
+    def pad_id(self) -> int:
+        return _special_id(self.specials, "<pad>")
+
+    @property
+    def bos_id(self) -> int:
+        return _special_id(self.specials, "<bos>")
+
+    @property
+    def eos_id(self) -> int:
+        return _special_id(self.specials, "<eos>")
+
+    @classmethod
+    def train(cls, texts: Iterable[str], vocab_size: int,
+              specials: Sequence[str] = ("<pad>", "<bos>", "<eos>")
+              ) -> "BPETokenizer":
+        """Learn merges until ``vocab_size`` is reached (or no pair repeats).
+        Deterministic: ties break on the smaller pair tuple."""
+        base = 256 + len(specials)
+        if vocab_size < base:
+            raise ValueError(f"vocab_size {vocab_size} < byte+special "
+                             f"base {base}")
+        seqs = [list(t.encode("utf-8")) for t in texts]
+        merges: List[Tuple[int, int]] = []
+        next_id = base
+        while next_id < vocab_size:
+            counts: Counter = Counter()
+            for s in seqs:
+                counts.update(zip(s, s[1:]))
+            if not counts:
+                break
+            best, n = max(counts.items(), key=lambda kv: (kv[1], tuple(-x for x in kv[0])))
+            if n < 2:
+                break
+            merges.append((int(best[0]), int(best[1])))
+            seqs = [_apply_merge(s, best, next_id) for s in seqs]
+            next_id += 1
+        return cls(merges, specials)
+
+    def encode(self, text: str, bos: bool = False,
+               eos: bool = False) -> np.ndarray:
+        s = list(text.encode("utf-8"))
+        while len(s) > 1:
+            # the lowest-rank applicable merge, applied everywhere
+            ranked = [(self._ranks[p], p) for p in set(zip(s, s[1:]))
+                      if p in self._ranks]
+            if not ranked:
+                break
+            rank, pair = min(ranked)
+            s = _apply_merge(s, pair, self._base + rank)
+        if bos:
+            s = [self.bos_id] + s
+        if eos:
+            s = s + [self.eos_id]
+        return np.asarray(s, np.int32)
+
+    def decode(self, ids) -> str:
+        out = b"".join(self._expand_id(i) for i in np.asarray(ids).ravel()
+                       if int(i) not in self.specials.values())
+        return out.decode("utf-8", errors="replace")
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"merges": self.merges,
+                       "specials": self.specials_names}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            d = json.load(f)
+        return cls([tuple(m) for m in d["merges"]], d["specials"])

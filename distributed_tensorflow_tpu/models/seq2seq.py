@@ -298,6 +298,77 @@ class Seq2Seq:
                                jnp.arange(max_new_tokens))
         return tgt[:, 1:]
 
+    def beam_search(self, params, src_ids, max_new_tokens: int,
+                    beam_size: int = 4, bos_id: int = 0,
+                    eos_id: Optional[int] = None,
+                    length_penalty: float = 0.6,
+                    src_valid=None) -> jnp.ndarray:
+        """Jittable beam search: one ``lax.scan`` over target positions,
+        beams flattened into the batch dim for the decoder.
+
+        Scores are sum-of-logprobs; finished beams (emitted ``eos_id``)
+        freeze their score and can only extend with EOS.  Final ranking
+        divides by ``length^length_penalty`` (GNMT convention).  Returns
+        the best sequence per batch row, [b, max_new_tokens]."""
+        c = self.config
+        if max_new_tokens > c.max_position:
+            raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
+                             f"max_position {c.max_position}")
+        b = src_ids.shape[0]
+        k = beam_size
+        V = c.vocab_size
+        memory = self.encode(params, src_ids, src_valid)
+        mem_k = jnp.repeat(memory, k, axis=0)           # [b*k, s, d]
+        valid_k = (None if src_valid is None
+                   else jnp.repeat(src_valid, k, axis=0))
+
+        T = max_new_tokens
+        seqs = jnp.full((b, k, T + 1), bos_id, jnp.int32)
+        # only beam 0 is alive at step 0 (identical beams would collapse)
+        scores = jnp.where(jnp.arange(k)[None, :] == 0, 0.0,
+                           -jnp.inf) * jnp.ones((b, 1))
+        finished = jnp.zeros((b, k), bool)
+
+        def step(carry, i):
+            seqs, scores, finished = carry
+            flat = seqs.reshape(b * k, T + 1)[:, :-1]
+            hidden = self.decode(params, mem_k, flat, valid_k)
+            row = jnp.take_along_axis(hidden, i[None, None, None], axis=1)
+            logits = self.logits(params, row)[:, 0, :]      # [b*k, V]
+            logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, k, V)
+            if eos_id is not None:
+                # finished beams: only EOS continues, at zero added cost
+                frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], frozen[None, None],
+                                 logp)
+            total = scores[:, :, None] + logp               # [b, k, V]
+            top, idx = lax.top_k(total.reshape(b, k * V), k)
+            beam = idx // V
+            tok = (idx % V).astype(jnp.int32)
+            seqs = jnp.take_along_axis(seqs, beam[:, :, None], axis=1)
+            seqs = lax.dynamic_update_slice_in_dim(
+                seqs, tok[:, :, None], i + 1, axis=2)
+            finished = jnp.take_along_axis(finished, beam, axis=1)
+            if eos_id is not None:
+                finished = finished | (tok == eos_id)
+            return (seqs, top, finished), None
+
+        (seqs, scores, finished), _ = lax.scan(
+            step, (seqs, scores, finished), jnp.arange(T))
+        if eos_id is not None:
+            # effective length = position of first EOS (else T)
+            body = seqs[:, :, 1:]
+            is_eos = body == eos_id
+            lengths = jnp.where(is_eos.any(-1),
+                                jnp.argmax(is_eos, -1) + 1, T)
+        else:
+            lengths = jnp.full((b, k), T)
+        ranked = scores / jnp.power(lengths.astype(jnp.float32),
+                                    length_penalty)
+        best = jnp.argmax(ranked, axis=1)
+        return jnp.take_along_axis(
+            seqs[:, :, 1:], best[:, None, None], axis=1)[:, 0, :]
+
     # -- sharding ---------------------------------------------------------
     def partition_rules(self, fsdp: bool = False) -> PartitionRules:
         """Megatron TP over heads/intermediate, same table shape as

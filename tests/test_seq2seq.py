@@ -136,3 +136,55 @@ def test_bf16_and_remat_forward():
     logits = m.logits(params, m.decode(params, mem, tgt))
     assert logits.dtype == jnp.float32
     assert bool(jnp.isfinite(logits).all())
+
+
+def test_beam_search_beats_or_matches_greedy():
+    """Beam-1 equals greedy; larger beams return a (log-prob) score at
+    least as good on a trained model."""
+    m = _model()
+    optimizer = optim.adam(3e-3)
+    params = m.init(jax.random.PRNGKey(0))
+    state = train.TrainState.create(params, optimizer.init(params))
+    step = train.make_custom_train_step(m.seq2seq_loss_fn(), optimizer)
+    rng = np.random.default_rng(0)
+    src = rng.integers(1, 16, (64, 6)).astype(np.int32)
+    tgt = np.concatenate([np.zeros((64, 1), np.int32), src], axis=1)
+    for _ in range(120):
+        state, _ = step(state, {"src_ids": jnp.asarray(src),
+                                "tgt_ids": jnp.asarray(tgt)})
+
+    test_src = jnp.asarray(src[:4])
+    greedy = m.generate(state.params, test_src, max_new_tokens=6)
+    beam1 = m.beam_search(state.params, test_src, max_new_tokens=6,
+                          beam_size=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(beam1))
+    beam4 = m.beam_search(state.params, test_src, max_new_tokens=6,
+                          beam_size=4)
+
+    def seq_logprob(out):
+        mem = m.encode(state.params, test_src)
+        bos = jnp.concatenate(
+            [jnp.zeros((4, 1), jnp.int32), out[:, :-1]], axis=1)
+        logits = m.logits(state.params,
+                          m.decode(state.params, mem, bos))
+        lp = jax.nn.log_softmax(logits, -1)
+        return np.asarray(jnp.take_along_axis(
+            lp, out[:, :, None], axis=-1)[..., 0].sum(-1))
+
+    assert (seq_logprob(beam4) >= seq_logprob(greedy) - 1e-4).all()
+
+
+def test_beam_search_eos_stops_and_jits():
+    m = _model()
+    params = m.init(jax.random.PRNGKey(2))
+    src = jnp.ones((2, 5), jnp.int32)
+    fn = jax.jit(lambda p, s: m.beam_search(p, s, max_new_tokens=6,
+                                            beam_size=3, eos_id=7))
+    out = np.asarray(fn(params, src))
+    assert out.shape == (2, 6)
+    assert out.dtype == np.int32
+    # EOS freeze: once a sequence emits eos_id, every later token is eos_id
+    for row in out:
+        hits = np.flatnonzero(row == 7)
+        if hits.size:
+            assert (row[hits[0]:] == 7).all(), row

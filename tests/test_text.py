@@ -1,0 +1,54 @@
+"""Tokenizer tests (data/text.py)."""
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.data.text import BPETokenizer, ByteTokenizer
+
+
+def test_byte_tokenizer_roundtrip():
+    t = ByteTokenizer()
+    s = "héllo wörld 123"
+    ids = t.encode(s, bos=True, eos=True)
+    assert int(ids[0]) == t.bos_id and int(ids[-1]) == t.eos_id
+    assert t.decode(ids) == s
+    assert t.vocab_size == 259
+
+
+def test_bpe_learns_frequent_pairs_and_roundtrips():
+    corpus = ["the cat sat on the mat"] * 50 + ["the dog"] * 20
+    t = BPETokenizer.train(corpus, vocab_size=300)
+    assert t.vocab_size > 259  # learned some merges
+    for s in ["the cat", "a dog on the mat", "unseen zebra!"]:
+        assert t.decode(t.encode(s)) == s
+    # "the" (with following space) should compress well
+    ids_the = t.encode("the the the the")
+    ids_xyz = t.encode("xq zj vk pw")     # no trained pairs
+    assert len(ids_the) < len(ids_xyz)
+
+
+def test_bpe_deterministic_and_serializable(tmp_path):
+    corpus = ["abab abab", "ababab"] * 10
+    t1 = BPETokenizer.train(corpus, vocab_size=270)
+    t2 = BPETokenizer.train(corpus, vocab_size=270)
+    assert t1.merges == t2.merges
+    s = "ababab and more"
+    np.testing.assert_array_equal(t1.encode(s), t2.encode(s))
+    p = str(tmp_path / "bpe.json")
+    t1.save(p)
+    t3 = BPETokenizer.load(p)
+    assert t3.merges == t1.merges
+    np.testing.assert_array_equal(t3.encode(s), t1.encode(s))
+    assert t3.decode(t3.encode(s, bos=True, eos=True)) == s
+
+
+def test_bpe_vocab_size_validation():
+    with pytest.raises(ValueError, match="vocab_size"):
+        BPETokenizer.train(["x"], vocab_size=10)
+
+
+def test_bpe_feeds_lm_pipeline():
+    from distributed_tensorflow_tpu.data.datasets import lm_sequences
+    t = BPETokenizer.train(["hello world " * 40], vocab_size=280)
+    ids = t.encode("hello world " * 40)
+    rows = lm_sequences(ids, seq_len=8)
+    assert rows.dtype == np.int32 and rows.shape[1] == 9
