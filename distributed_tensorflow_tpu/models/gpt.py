@@ -52,6 +52,9 @@ class GPTConfig:
     remat: bool = False
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
     use_flash: bool = False
+    # "learned" absolute positions (GPT-2) or "rope" rotary embeddings
+    # (relative; extrapolates past trained length, no position table)
+    position_embedding: str = "learned"
     # Sparse (MoE) FFN: 0 = dense.  With experts > 0 every block's FFN is a
     # grouped top-k MoE bank (ops.moe) shardable over the ``expert`` axis;
     # the router aux losses are folded into lm_loss_fn automatically.
@@ -129,18 +132,40 @@ class GPT:
                 }
             return layer
 
+        embeddings = {"word": trunc(ke[0], (c.vocab_size, c.hidden_size))}
+        if c.position_embedding == "learned":
+            embeddings["position"] = trunc(
+                ke[1], (c.max_position, c.hidden_size))
+        elif c.position_embedding != "rope":
+            raise ValueError("position_embedding must be 'learned' or "
+                             f"'rope'; got {c.position_embedding!r}")
         return {
-            "embeddings": {
-                "word": trunc(ke[0], (c.vocab_size, c.hidden_size)),
-                "position": trunc(ke[1], (c.max_position, c.hidden_size)),
-            },
+            "embeddings": embeddings,
             "decoder": jax.vmap(one_layer)(
                 jax.random.split(k_layers, c.num_layers)),
             "ln_f": ln(),
         }
 
     # -- blocks -----------------------------------------------------------
-    def _attention(self, p, x, mask, rng, train):
+    def _rope_transform(self, local_seq_len: int):
+        """qk_transform for this forward, or None.  Built ONCE per forward
+        (apply hoists it out of the layer scan — cos/sin tables are
+        identical across layers).  Under the in-shard_map ring path the
+        local shard restarts at 0, so positions get the shard's global
+        offset from its axis index."""
+        c = self.config
+        if c.position_embedding != "rope":
+            return None
+        positions = jnp.arange(local_seq_len)
+        if c.seq_axis is not None and self.mesh is None:
+            # traced inside an existing shard_map over seq_axis
+            positions = (jax.lax.axis_index(c.seq_axis) * local_seq_len
+                         + positions)
+        cos, sin = attn_lib.rope_tables(positions, c.head_dim)
+        return lambda q, k: (attn_lib.apply_rope(q, cos, sin),
+                             attn_lib.apply_rope(k, cos, sin))
+
+    def _attention(self, p, x, mask, rng, train, qk_transform=None):
         c = self.config
         if c.seq_axis is not None and self.mesh is not None:
             from ..parallel.ring import ring_attention_sharded
@@ -158,7 +183,8 @@ class GPT:
             attention_fn = attn_lib.dot_product_attention
         return attn_lib.attention_core(
             p, x, mask=mask, dropout_rate=c.dropout_rate, rng=rng,
-            train=train, attention_fn=attention_fn)
+            train=train, attention_fn=attention_fn,
+            qk_transform=qk_transform)
 
     def _ffn(self, p, x, rng=None, train=False):
         """Pre-LN FFN (dense or MoE): shared by the full-sequence and
@@ -181,12 +207,12 @@ class GPT:
             return y, aux
         return attn_lib.ffn_core(p["ffn"], h), jnp.zeros((), jnp.float32)
 
-    def _block(self, p, x, mask, rng, train):
+    def _block(self, p, x, mask, rng, train, qk_transform=None):
         c = self.config
         r_attn, r_res, r_moe, r_drop = jax.random.split(rng, 4)
         attn_out = self._attention(
             p["attention"], _layer_norm(p["ln_1"], x, c.layer_norm_eps),
-            mask, r_attn, train)
+            mask, r_attn, train, qk_transform=qk_transform)
         x = x + _dropout(attn_out, c.dropout_rate, r_res, train)
         ffn_out, aux = self._ffn(p, x, rng=r_moe, train=train)
         return x + _dropout(ffn_out, c.dropout_rate, r_drop, train), aux
@@ -204,7 +230,8 @@ class GPT:
         b, s = input_ids.shape
         emb = params["embeddings"]
         x = jnp.take(emb["word"], input_ids, axis=0)
-        x = x + emb["position"][None, :s, :]
+        if c.position_embedding == "learned":
+            x = x + emb["position"][None, :s, :]
         r_emb, r_layers = jax.random.split(rng)
         x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
 
@@ -213,7 +240,11 @@ class GPT:
         mask = (None if (c.seq_axis is not None or c.use_flash)
                 else attn_lib.causal_mask(s))
 
-        layer_fn = self._block
+        # the transform is bound via partial (not a call argument): it's a
+        # callable, which jax.checkpoint can't accept as a traced arg
+        from functools import partial
+        layer_fn = partial(self._block,
+                           qk_transform=self._rope_transform(s))
         if c.remat:
             layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
 
@@ -285,7 +316,8 @@ class GPT:
         pos = cache["pos"]
         emb = params["embeddings"]
         x = jnp.take(emb["word"], token_ids, axis=0)[:, None, :]   # [b,1,d]
-        x = x + lax.dynamic_slice_in_dim(emb["position"], pos, 1)[None]
+        if c.position_embedding == "learned":
+            x = x + lax.dynamic_slice_in_dim(emb["position"], pos, 1)[None]
         x = x.astype(c.dtype)
 
         max_len = cache["k"].shape[2]
@@ -310,6 +342,12 @@ class GPT:
             v = (jnp.einsum("bsd,dhk->bshk", h,
                             a["value"]["kernel"].astype(dtype))
                  + a["value"]["bias"].astype(dtype))
+            if c.position_embedding == "rope":
+                # rotate q and THIS k at its own position; cached keys were
+                # rotated when written, matching the full-sequence path
+                pos1 = jnp.full((1,), pos)
+                q = attn_lib.rotary_embedding(q, pos1)
+                k = attn_lib.rotary_embedding(k, pos1)
             k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
             v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
             attn = attn_lib.dot_product_attention(q, k_cache, v_cache,
@@ -341,7 +379,8 @@ class GPT:
         b, plen = prompt_ids.shape
         total = plen + max_new_tokens
         max_len = max_len or max(total, 1)
-        if max_len > c.max_position:
+        if max_len > c.max_position and c.position_embedding == "learned":
+            # only the learned table runs out of rows; RoPE extrapolates
             raise ValueError(f"generation length {max_len} exceeds "
                              f"max_position {c.max_position}")
         if total > max_len:

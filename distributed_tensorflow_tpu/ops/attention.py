@@ -29,7 +29,8 @@ from . import initializers as init_lib
 from .layers import Layer
 
 __all__ = ["dot_product_attention", "causal_mask", "padding_mask",
-           "attention_core", "MultiHeadAttention"]
+           "attention_core", "ffn_core", "rotary_embedding", "rope_tables",
+           "apply_rope", "MultiHeadAttention"]
 
 NEG_INF = -1e9  # finite -inf stand-in: keeps softmax well-defined in f32
 
@@ -62,10 +63,54 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def rope_tables(positions: jnp.ndarray, head_dim: int,
+                base: float = 10000.0):
+    """(cos, sin) angle tables for RoPE, shaped to broadcast against
+    [b, s, h, hd/2].  Compute ONCE per forward and reuse across layers —
+    the tables are position-only, identical for every layer in a scan."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim} — "
+                         "pick hidden_size/num_heads with an even quotient")
+    half = head_dim // 2
+    freqs = jnp.power(base, -jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., half]
+    if angles.ndim == 2:                 # [s, half] -> [1, s, 1, half]
+        angles = angles[None, :, None, :]
+    else:                                # [b, s, half] -> [b, s, 1, half]
+        angles = angles[:, :, None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray,
+               sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate [b, s, h, hd] feature pairs by precomputed tables (f32 math,
+    result cast back to x.dtype)."""
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def rotary_embedding(x: jnp.ndarray, positions: jnp.ndarray,
+                     base: float = 10000.0) -> jnp.ndarray:
+    """RoPE (Su et al., 2021): rotate feature pairs by position-dependent
+    angles so q·k depends only on RELATIVE distance.
+
+    ``x``: [b, s, h, hd] (hd even); ``positions``: [s] (shared across the
+    batch) or [b, s].  One-shot convenience over
+    ``rope_tables``/``apply_rope`` (use those to share tables across a
+    layer scan).
+    """
+    cos, sin = rope_tables(positions, x.shape[-1], base)
+    return apply_rope(x, cos, sin)
+
+
 def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
                    rng=None, train: bool = False,
                    attention_fn=dot_product_attention,
-                   kv=None) -> jnp.ndarray:
+                   kv=None, qk_transform=None) -> jnp.ndarray:
     """The shared multi-head attention body.
 
     ``params``: {query,key,value: {kernel [d,h,hd], bias [h,hd]},
@@ -86,6 +131,9 @@ def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
     q = project(params["query"], x)
     k = project(params["key"], memory)
     v = project(params["value"], memory)
+    if qk_transform is not None:
+        # positional rotation (RoPE) — applied post-projection, pre-kernel
+        q, k = qk_transform(q, k)
     ctx = attention_fn(q, k, v, mask=mask)
     if train and dropout_rate > 0.0:
         if rng is None:

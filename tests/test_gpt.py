@@ -203,3 +203,113 @@ def test_bf16_forward_and_training():
     assert np.isfinite(float(m["loss"])) and float(m["loss"]) < first
     # master params stay f32
     assert state.params["decoder"]["ffn"]["w_in"]["kernel"].dtype == jnp.float32
+
+
+def test_rope_relative_invariance():
+    """RoPE logits depend only on relative distance: rotating at positions
+    p and p+delta gives the same q.k as 0 and delta."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu.ops.attention import rotary_embedding
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (1, 1, 2, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 2, 8))
+
+    def dot_at(pq, pk):
+        qr = rotary_embedding(q, jnp.asarray([pq]))
+        kr = rotary_embedding(k, jnp.asarray([pk]))
+        return float(jnp.sum(qr * kr))
+
+    np.testing.assert_allclose(dot_at(7, 3), dot_at(14, 10), rtol=1e-5)
+    np.testing.assert_allclose(dot_at(5, 5), dot_at(0, 0), rtol=1e-5)
+    assert abs(dot_at(7, 3) - dot_at(7, 5)) > 1e-6  # distance matters
+
+
+def test_rope_gpt_trains_and_decode_matches_forward():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu import optim, train
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    m = gpt_tiny(position_embedding="rope", dropout_rate=0.0)
+    params = m.init(jax.random.PRNGKey(0))
+    assert "position" not in params["embeddings"]  # no table with RoPE
+
+    # KV-cache decode must match the full-sequence forward exactly
+    ids = jnp.asarray([[5, 9, 2, 7, 1, 3]], jnp.int32)
+    full = m.logits(params, m.apply(params, ids))
+    cache = m.init_cache(1, max_len=8)
+    outs = []
+    for t in range(ids.shape[1]):
+        logits, cache = m.decode_step(params, cache, ids[:, t])
+        outs.append(logits)
+    stepped = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(stepped),
+                               rtol=2e-4, atol=2e-4)
+
+    # and it trains
+    opt = optim.adam(3e-3)
+    state = train.TrainState.create(params, opt.init(params))
+    step = train.make_custom_train_step(m.lm_loss_fn(), opt)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, 512, (16, 12)).astype(np.int32))}
+    l0 = None
+    for i in range(25):
+        state, metrics = step(state, batch)
+        l0 = l0 or float(metrics["loss"])
+    assert float(metrics["loss"]) < l0
+
+
+def test_rope_generates_past_max_position():
+    """RoPE has no position table — generation may exceed max_position."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    m = gpt_tiny(position_embedding="rope", max_position=16,
+                 dropout_rate=0.0)
+    params = m.init(jax.random.PRNGKey(0))
+    out = m.generate(params, jnp.ones((1, 4), jnp.int32),
+                     max_new_tokens=20, max_len=24)  # 24 > 16
+    assert out.shape == (1, 24)
+
+    # the learned table still refuses
+    m2 = gpt_tiny(max_position=16, dropout_rate=0.0)
+    params2 = m2.init(jax.random.PRNGKey(0))
+    import pytest
+    with pytest.raises(ValueError, match="max_position"):
+        m2.generate(params2, jnp.ones((1, 4), jnp.int32),
+                    max_new_tokens=20, max_len=24)
+
+
+def test_rope_odd_head_dim_rejected():
+    import jax.numpy as jnp
+    import pytest
+    from distributed_tensorflow_tpu.ops.attention import rope_tables
+    with pytest.raises(ValueError, match="even head_dim"):
+        rope_tables(jnp.arange(4), head_dim=7)
+
+
+def test_rope_with_ring_attention_matches_dense():
+    """RoPE composes with the sharded ring-attention (SP) path."""
+    import jax
+    import numpy as np
+    from jax.sharding import PartitionSpec as P  # noqa: F401
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+    from distributed_tensorflow_tpu.parallel import make_mesh
+
+    kw = dict(vocab_size=512, hidden_size=128, num_layers=2, num_heads=2,
+              intermediate_size=512, max_position=128, dropout_rate=0.0,
+              position_embedding="rope")
+    dense = GPT(GPTConfig(**kw))
+    params = dense.init(jax.random.PRNGKey(0))
+    mesh = make_mesh({"seq": 8})
+    ring = GPT(GPTConfig(**kw, seq_axis="seq"), mesh=mesh)
+    ids = _ids(b=2, s=32)
+    np.testing.assert_allclose(np.asarray(ring.apply(params, ids)),
+                               np.asarray(dense.apply(params, ids)),
+                               atol=2e-4)
