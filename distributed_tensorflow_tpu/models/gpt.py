@@ -379,16 +379,7 @@ class GPT:
         b, plen = prompt_ids.shape
         total = plen + max_new_tokens
         max_len = max_len or max(total, 1)
-        if max_len > c.max_position and c.position_embedding == "learned":
-            # only the learned table runs out of rows; RoPE extrapolates
-            raise ValueError(f"generation length {max_len} exceeds "
-                             f"max_position {c.max_position}")
-        if total > max_len:
-            # dynamic_update_slice would silently clamp cache writes at
-            # max_len and corrupt every later token — refuse instead.
-            raise ValueError(f"prompt ({plen}) + max_new_tokens "
-                             f"({max_new_tokens}) = {total} exceeds "
-                             f"max_len {max_len}")
+        self._check_gen_lengths(plen, max_new_tokens, max_len)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         cache = self.init_cache(b, max_len)
@@ -416,6 +407,93 @@ class GPT:
         (tokens, _, _), _ = lax.scan(step, (tokens, cache, rng),
                                      jnp.arange(total - 1))
         return tokens
+
+    def _check_gen_lengths(self, plen: int, max_new_tokens: int,
+                           max_len: int) -> None:
+        """Shared generate/beam_search length rules."""
+        c = self.config
+        if max_len > c.max_position and c.position_embedding == "learned":
+            # only the learned table runs out of rows; RoPE extrapolates
+            raise ValueError(f"generation length {max_len} exceeds "
+                             f"max_position {c.max_position}")
+        if plen + max_new_tokens > max_len:
+            # dynamic_update_slice would silently clamp cache writes at
+            # max_len and corrupt every later token — refuse instead.
+            raise ValueError(f"prompt ({plen}) + max_new_tokens "
+                             f"({max_new_tokens}) = {plen + max_new_tokens} "
+                             f"exceeds max_len {max_len}")
+
+    def beam_search(self, params, prompt_ids, max_new_tokens: int,
+                    beam_size: int = 4, eos_id: Optional[int] = None,
+                    length_penalty: float = 0.6,
+                    max_len: Optional[int] = None) -> jnp.ndarray:
+        """Jittable beam search over the KV cache.
+
+        Two phases, each one ``lax.scan``: the prompt prefills the cache at
+        batch ``b`` (no beam-fold waste), then the cache rows are repeated
+        ``beam_size``-fold and every expansion REORDERS them by gather (the
+        standard KV-cache beam trick).  Shared bookkeeping lives in
+        ``ops.decoding``.  Returns the best row per batch element,
+        [b, plen + max_new_tokens].
+        """
+        from ..ops import decoding as dec
+
+        c = self.config
+        b, plen = prompt_ids.shape
+        k = beam_size
+        total = plen + max_new_tokens
+        max_len = max_len or max(total, 1)
+        self._check_gen_lengths(plen, max_new_tokens, max_len)
+
+        # phase 1 — prefill positions 0..plen-2 at batch b
+        cache = self.init_cache(b, max_len)
+
+        def prefill(cache, tok):
+            _, cache = self.decode_step(params, cache, tok)
+            return cache, None
+
+        if plen > 1:
+            cache, _ = lax.scan(prefill, cache,
+                                prompt_ids[:, :-1].T)
+        # fold beams into the batch dim: row r of batch i -> i*k + r
+        cache = {"k": jnp.repeat(cache["k"], k, axis=1),
+                 "v": jnp.repeat(cache["v"], k, axis=1),
+                 "pos": cache["pos"]}
+
+        tokens = jnp.zeros((b, k, total), jnp.int32)
+        tokens = tokens.at[:, :, :plen].set(prompt_ids[:, None, :])
+        scores = dec.init_beam_scores(b, k)
+        finished = jnp.zeros((b, k), bool)
+        batch_base = jnp.arange(b)[:, None] * k            # [b, 1]
+
+        def step(carry, i):
+            tokens, cache, scores, finished = carry
+            tok = lax.dynamic_slice_in_dim(
+                tokens.reshape(b * k, total), i, 1, axis=1)[:, 0]
+            logits, cache = self.decode_step(params, cache, tok)
+            logp = jax.nn.log_softmax(logits, -1).reshape(b, k, -1)
+            logp = dec.freeze_finished(logp, finished, eos_id)
+            scores, beam, nxt = dec.expand_beams(scores, logp)
+            tokens = jnp.take_along_axis(tokens, beam[:, :, None], axis=1)
+            tokens = lax.dynamic_update_slice_in_dim(
+                tokens, nxt[:, :, None], i + 1, axis=2)
+            finished = jnp.take_along_axis(finished, beam, axis=1)
+            if eos_id is not None:
+                finished = finished | (nxt == eos_id)
+            flat = (batch_base + beam).reshape(-1)
+            cache = {"k": jnp.take(cache["k"], flat, axis=1),
+                     "v": jnp.take(cache["v"], flat, axis=1),
+                     "pos": cache["pos"]}
+            return (tokens, cache, scores, finished), None
+
+        # phase 2 — beam expansion from position plen-1 onward
+        (tokens, _, scores, finished), _ = lax.scan(
+            step, (tokens, cache, scores, finished),
+            jnp.arange(plen - 1, total - 1))
+        best = dec.rank_beams(scores, tokens[:, :, plen:], eos_id,
+                              max_new_tokens, length_penalty)
+        return jnp.take_along_axis(tokens, best[:, None, None],
+                                   axis=1)[:, 0, :]
 
     # -- sharding ---------------------------------------------------------
     def partition_rules(self, fsdp: bool = False) -> PartitionRules:

@@ -322,11 +322,11 @@ class Seq2Seq:
         valid_k = (None if src_valid is None
                    else jnp.repeat(src_valid, k, axis=0))
 
+        from ..ops import decoding as dec
+
         T = max_new_tokens
         seqs = jnp.full((b, k, T + 1), bos_id, jnp.int32)
-        # only beam 0 is alive at step 0 (identical beams would collapse)
-        scores = jnp.where(jnp.arange(k)[None, :] == 0, 0.0,
-                           -jnp.inf) * jnp.ones((b, 1))
+        scores = dec.init_beam_scores(b, k)
         finished = jnp.zeros((b, k), bool)
 
         def step(carry, i):
@@ -336,36 +336,20 @@ class Seq2Seq:
             row = jnp.take_along_axis(hidden, i[None, None, None], axis=1)
             logits = self.logits(params, row)[:, 0, :]      # [b*k, V]
             logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, k, V)
-            if eos_id is not None:
-                # finished beams: only EOS continues, at zero added cost
-                frozen = jnp.full((V,), -jnp.inf).at[eos_id].set(0.0)
-                logp = jnp.where(finished[:, :, None], frozen[None, None],
-                                 logp)
-            total = scores[:, :, None] + logp               # [b, k, V]
-            top, idx = lax.top_k(total.reshape(b, k * V), k)
-            beam = idx // V
-            tok = (idx % V).astype(jnp.int32)
+            logp = dec.freeze_finished(logp, finished, eos_id)
+            scores, beam, tok = dec.expand_beams(scores, logp)
             seqs = jnp.take_along_axis(seqs, beam[:, :, None], axis=1)
             seqs = lax.dynamic_update_slice_in_dim(
                 seqs, tok[:, :, None], i + 1, axis=2)
             finished = jnp.take_along_axis(finished, beam, axis=1)
             if eos_id is not None:
                 finished = finished | (tok == eos_id)
-            return (seqs, top, finished), None
+            return (seqs, scores, finished), None
 
         (seqs, scores, finished), _ = lax.scan(
             step, (seqs, scores, finished), jnp.arange(T))
-        if eos_id is not None:
-            # effective length = position of first EOS (else T)
-            body = seqs[:, :, 1:]
-            is_eos = body == eos_id
-            lengths = jnp.where(is_eos.any(-1),
-                                jnp.argmax(is_eos, -1) + 1, T)
-        else:
-            lengths = jnp.full((b, k), T)
-        ranked = scores / jnp.power(lengths.astype(jnp.float32),
-                                    length_penalty)
-        best = jnp.argmax(ranked, axis=1)
+        best = dec.rank_beams(scores, seqs[:, :, 1:], eos_id, T,
+                              length_penalty)
         return jnp.take_along_axis(
             seqs[:, :, 1:], best[:, None, None], axis=1)[:, 0, :]
 

@@ -1,0 +1,62 @@
+"""Shared beam-search machinery for the autoregressive model families.
+
+GPT (KV-cache) and seq2seq (cache-free) drive different decoders but the
+beam bookkeeping is identical; keeping it here means a scoring/freeze fix
+lands in one place (same rationale as ``attention_core``/``ffn_core``).
+All functions are jit-friendly (static shapes, no Python branching on
+traced values).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["init_beam_scores", "freeze_finished", "expand_beams",
+           "rank_beams"]
+
+
+def init_beam_scores(batch: int, beam: int) -> jnp.ndarray:
+    """[b, k] scores with only beam 0 alive — identical start beams would
+    otherwise collapse the search to k copies of one hypothesis."""
+    return jnp.where(jnp.arange(beam)[None, :] == 0, 0.0,
+                     -jnp.inf) * jnp.ones((batch, 1))
+
+
+def freeze_finished(logp: jnp.ndarray, finished: jnp.ndarray,
+                    eos_id: Optional[int]) -> jnp.ndarray:
+    """Finished beams may only extend with EOS, at zero added cost —
+    their score is frozen while still competing in the top-k."""
+    if eos_id is None:
+        return logp
+    vocab = logp.shape[-1]
+    frozen = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
+    return jnp.where(finished[:, :, None], frozen[None, None], logp)
+
+
+def expand_beams(scores: jnp.ndarray, logp: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One beam expansion: ``scores`` [b, k] + ``logp`` [b, k, V] ->
+    (new_scores [b, k], source beam [b, k], token [b, k] int32)."""
+    b, k, vocab = logp.shape
+    top, idx = lax.top_k((scores[:, :, None] + logp).reshape(b, k * vocab),
+                         k)
+    return top, idx // vocab, (idx % vocab).astype(jnp.int32)
+
+
+def rank_beams(scores: jnp.ndarray, generated: jnp.ndarray,
+               eos_id: Optional[int], max_new_tokens: int,
+               length_penalty: float) -> jnp.ndarray:
+    """Best beam index per batch row (GNMT ``score / len^alpha``; length =
+    position of the first EOS in ``generated`` [b, k, T], else T)."""
+    b, k = scores.shape
+    if eos_id is not None:
+        is_eos = generated == eos_id
+        lengths = jnp.where(is_eos.any(-1), jnp.argmax(is_eos, -1) + 1,
+                            max_new_tokens)
+    else:
+        lengths = jnp.full((b, k), max_new_tokens)
+    ranked = scores / jnp.power(lengths.astype(jnp.float32), length_penalty)
+    return jnp.argmax(ranked, axis=1)
