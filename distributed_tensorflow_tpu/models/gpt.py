@@ -55,6 +55,10 @@ class GPTConfig:
     # "learned" absolute positions (GPT-2) or "rope" rotary embeddings
     # (relative; extrapolates past trained length, no position table)
     position_embedding: str = "learned"
+    # Grouped-query attention: number of key/value heads (None = num_heads
+    # i.e. plain MHA; 1 = MQA).  Shrinks the KV cache num_heads/num_kv_heads
+    # fold — the serving-memory lever for long-context decode.
+    num_kv_heads: Optional[int] = None
     # Sparse (MoE) FFN: 0 = dense.  With experts > 0 every block's FFN is a
     # grouped top-k MoE bank (ops.moe) shardable over the ``expert`` axis;
     # the router aux losses are folded into lm_loss_fn automatically.
@@ -67,6 +71,13 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        # explicit None check: 0 must be rejected (at init), not silently
+        # fall back to full MHA
+        return (self.num_heads if self.num_kv_heads is None
+                else self.num_kv_heads)
 
 
 def gpt_small(**kw) -> "GPT":
@@ -104,6 +115,10 @@ class GPT:
 
         h, hd, d, i = c.num_heads, c.head_dim, c.hidden_size, \
             c.intermediate_size
+        kv = c.kv_heads
+        if kv < 1 or h % kv:
+            raise ValueError(f"num_kv_heads must be a positive divisor of "
+                             f"num_heads {h}; got {kv}")
 
         def one_layer(k):
             ks = jax.random.split(k, 6)
@@ -112,10 +127,10 @@ class GPT:
                 "attention": {
                     "query": {"kernel": trunc(ks[0], (d, h, hd)),
                               "bias": jnp.zeros((h, hd), jnp.float32)},
-                    "key": {"kernel": trunc(ks[1], (d, h, hd)),
-                            "bias": jnp.zeros((h, hd), jnp.float32)},
-                    "value": {"kernel": trunc(ks[2], (d, h, hd)),
-                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "key": {"kernel": trunc(ks[1], (d, kv, hd)),
+                            "bias": jnp.zeros((kv, hd), jnp.float32)},
+                    "value": {"kernel": trunc(ks[2], (d, kv, hd)),
+                              "bias": jnp.zeros((kv, hd), jnp.float32)},
                     "out": {"kernel": trunc(ks[3], (h, hd, d)),
                             "bias": jnp.zeros((d,), jnp.float32)},
                 },
@@ -300,7 +315,8 @@ class GPT:
     def init_cache(self, batch_size: int, max_len: Optional[int] = None):
         c = self.config
         max_len = max_len or c.max_position
-        shape = (c.num_layers, batch_size, max_len, c.num_heads, c.head_dim)
+        # kv_heads, not num_heads: GQA's cache is the whole point
+        shape = (c.num_layers, batch_size, max_len, c.kv_heads, c.head_dim)
         return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
                 "pos": jnp.zeros((), jnp.int32)}
 
@@ -350,6 +366,8 @@ class GPT:
                 k = attn_lib.rotary_embedding(k, pos1)
             k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
             v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+            # GQA handled natively by the dense kernel (grouped einsum
+            # against the unrepeated cache — no full-head materialization)
             attn = attn_lib.dot_product_attention(q, k_cache, v_cache,
                                                   mask=kv_mask)
             attn_out = (jnp.einsum("bshk,hkd->bsd", attn,
@@ -498,15 +516,26 @@ class GPT:
     # -- sharding ---------------------------------------------------------
     def partition_rules(self, fsdp: bool = False) -> PartitionRules:
         """Megatron-style TP specs; tied head sharding comes free with the
-        word embedding (vocab on ``tensor``)."""
+        word embedding (vocab on ``tensor``).
+
+        GQA/MQA: the kv head axis can be smaller than the TP degree, so
+        key/value projections follow the standard MQA recipe — queries
+        shard over heads, keys/values replicate across the tensor axis.
+        """
         f = "fsdp" if fsdp else None
+        kv_spec = (P(None, f, "tensor", None)
+                   if self.config.kv_heads == self.config.num_heads
+                   else P(None, f, None, None))
+        kv_bias = (P(None, "tensor", None)
+                   if self.config.kv_heads == self.config.num_heads
+                   else P(None, None, None))
         return PartitionRules([
             (r"embeddings/word$", P("tensor", f)),
             (r"embeddings/position$", P(None, None)),
-            (r"decoder/attention/(query|key|value)/kernel",
-             P(None, f, "tensor", None)),
-            (r"decoder/attention/(query|key|value)/bias",
-             P(None, "tensor", None)),
+            (r"decoder/attention/query/kernel", P(None, f, "tensor", None)),
+            (r"decoder/attention/query/bias", P(None, "tensor", None)),
+            (r"decoder/attention/(key|value)/kernel", kv_spec),
+            (r"decoder/attention/(key|value)/bias", kv_bias),
             (r"decoder/attention/out/kernel", P(None, "tensor", None, f)),
             (r"decoder/ffn/w_in/kernel", P(None, f, "tensor")),
             (r"decoder/ffn/w_in/bias", P(None, "tensor")),

@@ -49,18 +49,37 @@ def padding_mask(valid: jnp.ndarray) -> jnp.ndarray:
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           mask: Optional[jnp.ndarray] = None,
                           scale: Optional[float] = None) -> jnp.ndarray:
-    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
+    """q: [batch, seq, heads, head_dim]; k,v: same, or with FEWER heads
+    (grouped-query attention) -> [batch, seq, heads, head_dim].
 
     Logit/softmax math in f32; matmuls stay in the input dtype for the MXU.
+    The GQA path contracts each kv head against its query group directly —
+    the kv tensors are never materialized at full head count.
     """
     head_dim = q.shape[-1]
     if scale is None:
         scale = 1.0 / math.sqrt(head_dim)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    hq, hk = q.shape[2], k.shape[2]
+    if hq == hk:
+        logits = (jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+                  * scale)
+        if mask is not None:
+            logits = logits + mask
+        weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    if hq % hk:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hk}")
+    group = hq // hk
+    b, s = q.shape[0], q.shape[1]
+    qg = q.reshape(b, s, hk, group, head_dim)
+    logits = (jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+              * scale)
     if mask is not None:
-        logits = logits + mask
+        # masks are [b|1, 1, q, s]; insert the group axis
+        logits = logits + mask[:, :, None, :, :]
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    ctx = jnp.einsum("bhgqk,bkhd->bqhgd", weights, v)
+    return ctx.reshape(b, s, hq, head_dim)
 
 
 def rope_tables(positions: jnp.ndarray, head_dim: int,
@@ -134,6 +153,17 @@ def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
     if qk_transform is not None:
         # positional rotation (RoPE) — applied post-projection, pre-kernel
         q, k = qk_transform(q, k)
+    if (k.shape[2] != q.shape[2]
+            and attention_fn is not dot_product_attention):
+        # grouped-query attention with a swapped kernel (flash/ring) that
+        # expects equal head counts: broadcast kv head groups here.  The
+        # default dense kernel handles grouping natively (no repeat).
+        if q.shape[2] % k.shape[2]:
+            raise ValueError(f"query heads {q.shape[2]} not a multiple of "
+                             f"kv heads {k.shape[2]}")
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     ctx = attention_fn(q, k, v, mask=mask)
     if train and dropout_rate > 0.0:
         if rng is None:
