@@ -385,14 +385,18 @@ class GPT:
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None,
-                 max_len: Optional[int] = None) -> jnp.ndarray:
+                 max_len: Optional[int] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> jnp.ndarray:
         """Autoregressive sampling with the KV cache.
 
-        prompt_ids: [b, p] int32.  temperature 0 = greedy.  Returns
+        prompt_ids: [b, p] int32.  temperature 0 = greedy; ``top_k`` /
+        ``top_p`` filter the sampled distribution (ops.decoding).  Returns
         [b, p + max_new_tokens].  The whole loop is one ``lax.scan`` (prompt
         positions are teacher-forced), so generation jits with no per-token
         recompilation.
         """
+        from ..ops import decoding as dec
         c = self.config
         b, plen = prompt_ids.shape
         total = plen + max_new_tokens
@@ -409,15 +413,13 @@ class GPT:
             tok = lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
             logits, cache = self.decode_step(params, cache, tok)
             rng, sub = jax.random.split(rng)
-            if temperature > 0:
-                nxt = jax.random.categorical(sub, logits / temperature)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt = dec.sample_logits(sub, logits, temperature,
+                                    top_k=top_k, top_p=top_p)
             # Teacher-force while still inside the prompt.
             inside = i + 1 < plen
             target = lax.dynamic_slice_in_dim(
                 tokens, jnp.minimum(i + 1, total - 1), 1, axis=1)[:, 0]
-            nxt = jnp.where(inside, target, nxt.astype(jnp.int32))
+            nxt = jnp.where(inside, target, nxt)  # sample_logits returns int32
             tokens = lax.dynamic_update_slice_in_dim(
                 tokens, nxt[:, None], i + 1, axis=1)
             return (tokens, cache, rng), None
@@ -514,20 +516,24 @@ class GPT:
                                    axis=1)[:, 0, :]
 
     # -- sharding ---------------------------------------------------------
-    def partition_rules(self, fsdp: bool = False) -> PartitionRules:
+    def partition_rules(self, fsdp: bool = False,
+                        shard_kv: Optional[bool] = None) -> PartitionRules:
         """Megatron-style TP specs; tied head sharding comes free with the
         word embedding (vocab on ``tensor``).
 
         GQA/MQA: the kv head axis can be smaller than the TP degree, so
-        key/value projections follow the standard MQA recipe — queries
-        shard over heads, keys/values replicate across the tensor axis.
+        by default key/value projections follow the standard MQA recipe —
+        queries shard over heads, keys/values replicate across the tensor
+        axis.  Pass ``shard_kv=True`` when the tensor degree divides
+        kv_heads (e.g. GQA 4 kv heads on tensor=2) to shard them too;
+        the table is mesh-agnostic so it cannot decide this itself.
         """
         f = "fsdp" if fsdp else None
-        kv_spec = (P(None, f, "tensor", None)
-                   if self.config.kv_heads == self.config.num_heads
+        kv_on_tensor = (shard_kv if shard_kv is not None
+                        else self.config.kv_heads == self.config.num_heads)
+        kv_spec = (P(None, f, "tensor", None) if kv_on_tensor
                    else P(None, f, None, None))
-        kv_bias = (P(None, "tensor", None)
-                   if self.config.kv_heads == self.config.num_heads
+        kv_bias = (P(None, "tensor", None) if kv_on_tensor
                    else P(None, None, None))
         return PartitionRules([
             (r"embeddings/word$", P("tensor", f)),

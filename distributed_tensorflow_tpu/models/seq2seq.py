@@ -263,7 +263,8 @@ class Seq2Seq:
     # -- generation -------------------------------------------------------
     def generate(self, params, src_ids, max_new_tokens: int,
                  bos_id: int = 0, temperature: float = 0.0, rng=None,
-                 src_valid=None) -> jnp.ndarray:
+                 src_valid=None, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> jnp.ndarray:
         """Greedy/sampled decode: encode once, then one ``lax.scan`` over
         target positions (full decoder recompute per step — O(t²) but
         cache-free and jittable at any length; fine at eval scale).
@@ -272,6 +273,7 @@ class Seq2Seq:
         if max_new_tokens > c.max_position:
             raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
                              f"max_position {c.max_position}")
+        from ..ops import decoding as dec
         if rng is None:
             rng = jax.random.PRNGKey(0)
         b = src_ids.shape[0]
@@ -286,12 +288,10 @@ class Seq2Seq:
                 hidden, i[None, None, None], axis=1)
             logits = self.logits(params, row)[:, 0, :]
             rng, sub = jax.random.split(rng)
-            if temperature > 0:
-                nxt = jax.random.categorical(sub, logits / temperature)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt = dec.sample_logits(sub, logits, temperature,
+                                    top_k=top_k, top_p=top_p)
             tgt = lax.dynamic_update_slice_in_dim(
-                tgt, nxt[:, None].astype(jnp.int32), i + 1, axis=1)
+                tgt, nxt[:, None], i + 1, axis=1)
             return (tgt, rng), None
 
         (tgt, _), _ = lax.scan(step, (tgt, rng),

@@ -15,7 +15,40 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["init_beam_scores", "freeze_finished", "expand_beams",
-           "rank_beams"]
+           "rank_beams", "sample_logits"]
+
+
+def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jnp.ndarray:
+    """Next-token selection from [b, V] logits (shared by every generate).
+
+    ``temperature <= 0`` is greedy argmax.  ``top_k`` keeps the k highest
+    logits; ``top_p`` (nucleus) keeps the smallest prefix of the sorted
+    distribution whose cumulative probability reaches p (always at least
+    the top token).  Filters compose (k first, then p).  Static config —
+    jit recompiles per setting, as with temperature.
+    """
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    neg = jnp.asarray(-jnp.inf, logits.dtype)
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p is not None and top_p < 1.0:
+        b, vocab = logits.shape
+        sorted_logits, sorted_idx = lax.top_k(logits, vocab)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # keep while the EXCLUSIVE prefix mass is < p; the top token stays
+        # unconditionally (top_p <= 0 must degrade to greedy, not to an
+        # all--inf row that categorical() collapses to id 0)
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        keep = keep.at[..., 0].set(True)
+        filtered = jnp.where(keep, sorted_logits, neg)
+        logits = jnp.full_like(logits, neg).at[
+            jnp.arange(b)[:, None], sorted_idx].set(filtered)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
 def init_beam_scores(batch: int, beam: int) -> jnp.ndarray:

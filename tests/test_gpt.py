@@ -435,3 +435,20 @@ def test_gqa_tensor_parallel_rules_and_step():
         jnp.ones((8, 12), jnp.int32), NamedSharding(mesh, P("data")))
     state, metrics = step(state, {"input_ids": ids})
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_gqa_shard_kv_override():
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+    from distributed_tensorflow_tpu.parallel import make_mesh
+    from distributed_tensorflow_tpu.parallel.sharding import shard_pytree
+
+    mesh = make_mesh({"data": 4, "tensor": 2})
+    m = gpt_tiny(num_heads=4, hidden_size=128, num_kv_heads=2,
+                 dropout_rate=0.0)
+    params = m.init(jax.random.PRNGKey(0))
+    sharded = shard_pytree(params, mesh,
+                           m.partition_rules(shard_kv=True))
+    spec = sharded["decoder"]["attention"]["key"]["kernel"].sharding.spec
+    assert "tensor" in str(spec)  # 2 kv heads shard over tensor=2
