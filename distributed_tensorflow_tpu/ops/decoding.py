@@ -33,12 +33,19 @@ def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     neg = jnp.asarray(-jnp.inf, logits.dtype)
-    if top_k is not None and top_k < logits.shape[-1]:
-        kth = lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, neg, logits)
-    if top_p is not None and top_p < 1.0:
-        b, vocab = logits.shape
+    b, vocab = logits.shape
+    need_k = top_k is not None and top_k < vocab
+    need_p = top_p is not None and top_p < 1.0
+    if need_p:
+        # One full sort serves both filters: positions >= k are exactly the
+        # tokens a top-k threshold would drop, so the k filter is a
+        # positional mask on the sorted array, applied BEFORE the softmax so
+        # the nucleus mass is measured on the k-renormalized distribution
+        # (the documented k-then-p composition).
         sorted_logits, sorted_idx = lax.top_k(logits, vocab)
+        if need_k:
+            sorted_logits = jnp.where(jnp.arange(vocab)[None, :] < top_k,
+                                      sorted_logits, neg)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         # keep while the EXCLUSIVE prefix mass is < p; the top token stays
         # unconditionally (top_p <= 0 must degrade to greedy, not to an
@@ -48,6 +55,9 @@ def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,
         filtered = jnp.where(keep, sorted_logits, neg)
         logits = jnp.full_like(logits, neg).at[
             jnp.arange(b)[:, None], sorted_idx].set(filtered)
+    elif need_k:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 

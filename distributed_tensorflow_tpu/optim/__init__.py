@@ -3,10 +3,11 @@
 from . import schedules
 from .adafactor import adafactor
 from .ema import EMAState, ema, ema_params, with_ema
-from .optimizers import (Optimizer, OptState, adam, adamw, apply_updates,
-                         clip_by_global_norm, get, global_norm, lamb,
-                         momentum, sgd)
+from .optimizers import (Optimizer, OptState, adadelta, adagrad, adam, adamw,
+                         apply_updates, clip_by_global_norm, ftrl, get,
+                         global_norm, lamb, momentum, rmsprop, sgd)
 
-__all__ = ["schedules", "adafactor", "Optimizer", "OptState", "adam", "adamw",
-           "apply_updates", "clip_by_global_norm", "get", "global_norm",
-           "lamb", "momentum", "sgd", "EMAState", "ema", "ema_params", "with_ema"]
+__all__ = ["schedules", "adafactor", "Optimizer", "OptState", "adadelta",
+           "adagrad", "adam", "adamw", "apply_updates", "clip_by_global_norm",
+           "ftrl", "get", "global_norm", "lamb", "momentum", "rmsprop", "sgd",
+           "EMAState", "ema", "ema_params", "with_ema"]

@@ -26,8 +26,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Optimizer", "OptState", "sgd", "momentum", "adam", "adamw",
-           "lamb", "apply_updates", "clip_by_global_norm", "global_norm",
-           "get"]
+           "lamb", "rmsprop", "adagrad", "adadelta", "ftrl",
+           "apply_updates", "clip_by_global_norm", "global_norm", "get"]
 
 Schedule = Callable[[jnp.ndarray], jnp.ndarray]
 ScalarOrSchedule = Union[float, Schedule]
@@ -241,12 +241,182 @@ def lamb(learning_rate: ScalarOrSchedule = 1e-3, b1: float = 0.9,
     return Optimizer(_moments_init, update)
 
 
+def rmsprop(learning_rate: ScalarOrSchedule = 0.001, decay: float = 0.9,
+            momentum: float = 0.0, eps: float = 1e-10,
+            centered: bool = False) -> Optimizer:
+    """RMSProp with the tf.train.RMSPropOptimizer update rule (TF-1.4-era
+    defaults: decay=0.9, momentum=0.0, epsilon=1e-10; epsilon sits INSIDE
+    the sqrt denominator's accumulator, i.e. g / sqrt(ms + eps)).
+
+    ``centered=True`` additionally tracks the gradient mean and divides by
+    the estimated variance (sqrt(ms - mg^2 + eps)).
+    """
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+        inner = {"ms": jax.tree.map(zeros, params),
+                 "mom": jax.tree.map(zeros, params)}
+        if centered:
+            inner["mg"] = jax.tree.map(zeros, params)
+        return OptState(jnp.zeros((), jnp.int32), inner)
+
+    def update(grads, state: OptState, params=None):
+        del params
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        ms = jax.tree.map(
+            lambda s, g: decay * s + (1 - decay) * jnp.square(
+                g.astype(jnp.float32)),
+            state.inner["ms"], grads)
+        if centered:
+            mg = jax.tree.map(
+                lambda a, g: decay * a + (1 - decay) * g.astype(jnp.float32),
+                state.inner["mg"], grads)
+            denom = jax.tree.map(
+                lambda s, a: jnp.sqrt(s - jnp.square(a) + eps), ms, mg)
+        else:
+            denom = jax.tree.map(lambda s: jnp.sqrt(s + eps), ms)
+        mom = jax.tree.map(
+            lambda mo, g, d: momentum * mo + lr * g.astype(jnp.float32) / d,
+            state.inner["mom"], grads, denom)
+        updates = jax.tree.map(lambda mo: -mo, mom)
+        inner = {"ms": ms, "mom": mom}
+        if centered:
+            inner["mg"] = mg
+        return updates, OptState(count, inner)
+
+    return Optimizer(init, update)
+
+
+def adagrad(learning_rate: ScalarOrSchedule = 0.01,
+            initial_accumulator_value: float = 0.1) -> Optimizer:
+    """Adagrad matching tf.train.AdagradOptimizer: the squared-gradient
+    accumulator starts at ``initial_accumulator_value`` (0.1, which is what
+    keeps the very first steps finite — TF 1.4 has no epsilon here) and the
+    step is ``-lr * g / sqrt(acc)``.
+    """
+    if initial_accumulator_value <= 0:
+        raise ValueError("adagrad needs initial_accumulator_value > 0 "
+                         "(it is the only thing keeping step 1 finite)")
+
+    def init(params):
+        acc = jax.tree.map(
+            lambda p: jnp.full(p.shape, initial_accumulator_value,
+                               jnp.float32), params)
+        return OptState(jnp.zeros((), jnp.int32), acc)
+
+    def update(grads, state: OptState, params=None):
+        del params
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        acc = jax.tree.map(
+            lambda a, g: a + jnp.square(g.astype(jnp.float32)),
+            state.inner, grads)
+        updates = jax.tree.map(
+            lambda g, a: -lr * g.astype(jnp.float32) / jnp.sqrt(a),
+            grads, acc)
+        return updates, OptState(count, acc)
+
+    return Optimizer(init, update)
+
+
+def adadelta(learning_rate: ScalarOrSchedule = 0.001, rho: float = 0.95,
+             eps: float = 1e-8) -> Optimizer:
+    """Adadelta (Zeiler 2012) with tf.train.AdadeltaOptimizer semantics:
+    two EMAs (squared grads, squared updates); the unit-correcting step is
+    ``sqrt(acc_delta + eps) / sqrt(acc_grad + eps) * g`` scaled by ``lr``.
+    """
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, jnp.float32)
+        return OptState(jnp.zeros((), jnp.int32),
+                        {"acc_g": jax.tree.map(zeros, params),
+                         "acc_d": jax.tree.map(zeros, params)})
+
+    def update(grads, state: OptState, params=None):
+        del params
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+        acc_g = jax.tree.map(
+            lambda a, g: rho * a + (1 - rho) * jnp.square(
+                g.astype(jnp.float32)),
+            state.inner["acc_g"], grads)
+        delta = jax.tree.map(
+            lambda g, ag, ad: (jnp.sqrt(ad + eps) / jnp.sqrt(ag + eps)
+                               ) * g.astype(jnp.float32),
+            grads, acc_g, state.inner["acc_d"])
+        acc_d = jax.tree.map(
+            lambda a, d: rho * a + (1 - rho) * jnp.square(d),
+            state.inner["acc_d"], delta)
+        updates = jax.tree.map(lambda d: -lr * d, delta)
+        return updates, OptState(count, {"acc_g": acc_g, "acc_d": acc_d})
+
+    return Optimizer(init, update)
+
+
+def ftrl(learning_rate: ScalarOrSchedule = 0.001,
+         learning_rate_power: float = -0.5,
+         initial_accumulator_value: float = 0.1,
+         l1_regularization_strength: float = 0.0,
+         l2_regularization_strength: float = 0.0) -> Optimizer:
+    """FTRL-Proximal (McMahan et al. 2013), the tf.train.FtrlOptimizer
+    surface: per-coordinate adaptive rates with L1 (sparsity) / L2 shrinkage
+    applied in closed form at each step.  Unlike the delta-style optimizers
+    above, FTRL recomputes the weight from its (z, n) state, so ``params``
+    is required at update() and the returned update is ``w_new - p``.
+    """
+    if initial_accumulator_value < 0:
+        raise ValueError("ftrl needs initial_accumulator_value >= 0")
+    l1, l2 = l1_regularization_strength, l2_regularization_strength
+    p_pow = -learning_rate_power  # 0.5 for the default inverse-sqrt rate
+
+    def init(params):
+        n = jax.tree.map(
+            lambda p: jnp.full(p.shape, initial_accumulator_value,
+                               jnp.float32), params)
+        z = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return OptState(jnp.zeros((), jnp.int32), {"n": n, "z": z})
+
+    def update(grads, state: OptState, params=None):
+        if params is None:
+            raise ValueError("ftrl needs params at update()")
+        count = state.count + 1
+        lr = _lr_at(learning_rate, count)
+
+        # Three structure-validated tree.maps (XLA CSEs the shared
+        # subexpressions) instead of a flatten/zip that could silently
+        # misalign leaves on a grads/params structure mismatch.
+        n_new = jax.tree.map(
+            lambda n, g: n + jnp.square(g.astype(jnp.float32)),
+            state.inner["n"], grads)
+        z_new = jax.tree.map(
+            lambda z, g, n, nn, p: (
+                z + g.astype(jnp.float32)
+                - (jnp.power(nn, p_pow) - jnp.power(n, p_pow)) / lr
+                * p.astype(jnp.float32)),
+            state.inner["z"], grads, state.inner["n"], n_new, params)
+        updates = jax.tree.map(
+            lambda z, nn, p: jnp.where(
+                jnp.abs(z) <= l1, 0.0,
+                -(z - jnp.sign(z) * l1)
+                / (jnp.power(nn, p_pow) / lr + 2.0 * l2)
+            ) - p.astype(jnp.float32),
+            z_new, n_new, params)
+        return updates, OptState(count, {"n": n_new, "z": z_new})
+
+    return Optimizer(init, update)
+
+
 _REGISTRY = {
     "sgd": sgd,
     "momentum": momentum,
     "adam": adam,
     "adamw": adamw,
     "lamb": lamb,
+    "rmsprop": rmsprop,
+    "adagrad": adagrad,
+    "adadelta": adadelta,
+    "ftrl": ftrl,
 }
 
 

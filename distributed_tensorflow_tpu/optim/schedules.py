@@ -9,7 +9,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 __all__ = ["constant", "exponential_decay", "cosine_decay",
-           "warmup_cosine_decay", "warmup_linear_decay", "piecewise_constant"]
+           "warmup_cosine_decay", "warmup_linear_decay", "piecewise_constant",
+           "polynomial_decay"]
 
 
 def constant(value: float):
@@ -58,6 +59,27 @@ def warmup_linear_decay(peak_value: float, warmup_steps: int,
         frac = jnp.clip((t - warmup_steps) /
                         jnp.maximum(total_steps - warmup_steps, 1), 0.0, 1.0)
         return jnp.where(t < warmup_steps, warm, peak_value * (1.0 - frac))
+    return schedule
+
+
+def polynomial_decay(init_value: float, decay_steps: int,
+                     end_value: float = 1e-4, power: float = 1.0,
+                     cycle: bool = False):
+    """tf.train.polynomial_decay semantics: decay from ``init_value`` to
+    ``end_value`` over ``decay_steps`` following ``(1 - t/T)^power``; with
+    ``cycle=True`` the horizon T expands to the next multiple of
+    ``decay_steps`` past the current step instead of clamping.
+    """
+    def schedule(count):
+        t = count.astype(jnp.float32)
+        if cycle:
+            mult = jnp.maximum(jnp.ceil(t / decay_steps), 1.0)
+            horizon = decay_steps * mult
+        else:
+            horizon = jnp.asarray(decay_steps, jnp.float32)
+            t = jnp.minimum(t, horizon)
+        frac = 1.0 - t / horizon
+        return (init_value - end_value) * jnp.power(frac, power) + end_value
     return schedule
 
 

@@ -61,12 +61,17 @@ def test_expand_beams_and_rank():
     # beam 1 starts at -inf: both winners come from beam 0
     np.testing.assert_array_equal(np.asarray(beam), [[0, 0]])
     np.testing.assert_array_equal(np.asarray(tok), [[0, 1]])
-    best = dec.rank_beams(jnp.asarray([[-1.0, -0.5]]),
-                          jnp.asarray([[[3, 7], [7, 7]]]), eos_id=7,
-                          max_new_tokens=2, length_penalty=1.0)
-    # beam0: -1/2^1; beam1: -0.5/1 -> beam0 wins (-0.5 == -0.5 tie? no:
-    # beam0 length 2 -> -0.5, beam1 length 1 -> -0.5; argmax picks first)
-    assert int(best[0]) in (0, 1)
+    # lengths via first EOS: beam0 ends at position 3 (len 4), beam1 at
+    # position 0 (len 1).  With penalty 1.0 beam0 ranks -2/4 = -0.5 vs
+    # beam1 -1.8/1; with penalty 0 raw scores decide and beam1 wins.
+    scores = jnp.asarray([[-2.0, -1.8]])
+    gen = jnp.asarray([[[3, 3, 3, 7], [7, 0, 0, 0]]])
+    best = dec.rank_beams(scores, gen, eos_id=7, max_new_tokens=4,
+                          length_penalty=1.0)
+    assert int(best[0]) == 0
+    best = dec.rank_beams(scores, gen, eos_id=7, max_new_tokens=4,
+                          length_penalty=0.0)
+    assert int(best[0]) == 1
 
 
 def test_top_p_zero_degrades_to_greedy():

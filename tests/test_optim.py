@@ -238,3 +238,97 @@ def test_adafactor_explicit_lr_and_zero_placement():
     y = jnp.zeros((8,), jnp.int32)
     state, m = step(state, (x, y))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_rmsprop_matches_tf_formula():
+    """tf.train.RMSPropOptimizer rule: ms = d*ms+(1-d)*g^2;
+    mom = mu*mom + lr*g/sqrt(ms+eps); p -= mom."""
+    lr, d, mu, eps = 0.1, 0.9, 0.5, 1e-10
+    grads = [1.0, 0.5, -0.25]
+    p, ms, mom = 1.0, 0.0, 0.0
+    for g in grads:
+        ms = d * ms + (1 - d) * g * g
+        mom = mu * mom + lr * g / np.sqrt(ms + eps)
+        p -= mom
+    got, state = _run(optim.rmsprop(lr, decay=d, momentum=mu, eps=eps), grads)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+    assert int(state.count) == 3
+
+
+def test_rmsprop_centered_finite_and_trains():
+    got, _ = _run(optim.rmsprop(0.1, centered=True), [1.0] * 5)
+    assert np.isfinite(got) and got < 1.0
+
+
+def test_adagrad_matches_tf_formula():
+    """tf.train.AdagradOptimizer: acc starts at 0.1; p -= lr*g/sqrt(acc)."""
+    lr, iav = 0.1, 0.1
+    grads = [1.0, 1.0, -2.0]
+    p, acc = 1.0, iav
+    for g in grads:
+        acc += g * g
+        p -= lr * g / np.sqrt(acc)
+    got, _ = _run(optim.adagrad(lr, initial_accumulator_value=iav), grads)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_adadelta_matches_formula():
+    lr, rho, eps = 1.0, 0.95, 1e-6
+    grads = [1.0, -0.5, 2.0]
+    p, ag, ad = 1.0, 0.0, 0.0
+    for g in grads:
+        ag = rho * ag + (1 - rho) * g * g
+        delta = np.sqrt(ad + eps) / np.sqrt(ag + eps) * g
+        ad = rho * ad + (1 - rho) * delta * delta
+        p -= lr * delta
+    got, _ = _run(optim.adadelta(lr, rho=rho, eps=eps), grads)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_ftrl_l1_produces_exact_zeros():
+    """FTRL-Proximal closed form: small gradients with l1 > 0 pin the
+    weight at exactly 0 (the sparsity property Ftrl exists for)."""
+    opt = optim.ftrl(0.1, l1_regularization_strength=10.0)
+    got, _ = _run(opt, [0.01, -0.02, 0.01], p0=0.0)
+    assert got == 0.0
+    # and with no regularization it moves like a (per-coord) adaptive step
+    got, _ = _run(optim.ftrl(0.1), [1.0, 1.0])
+    assert 0.0 < got < 1.0
+
+
+def test_ftrl_requires_params():
+    import pytest
+    opt = optim.ftrl()
+    s = opt.init({"w": jnp.zeros((4,))})
+    with pytest.raises(ValueError, match="needs params"):
+        opt.update({"w": jnp.ones((4,))}, s, None)
+
+
+def test_new_optimizers_in_registry_and_jit():
+    for name in ("rmsprop", "adagrad", "adadelta", "ftrl"):
+        opt = optim.get(name)
+        params = {"w": jnp.ones((4, 4))}
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state, opt=opt):
+            g = jax.tree.map(jnp.ones_like, params)
+            updates, state = opt.update(g, state, params)
+            return optim.apply_updates(params, updates), state
+
+        params, state = step(params, state)
+        assert int(state.count) == 1
+        assert bool(jnp.isfinite(params["w"]).all())
+
+
+def test_polynomial_decay_schedule():
+    s = schedules.polynomial_decay(1.0, 100, end_value=0.1, power=2.0)
+    np.testing.assert_allclose(float(s(jnp.asarray(0))), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(s(jnp.asarray(50))),
+                               0.9 * 0.25 + 0.1, atol=1e-6)
+    np.testing.assert_allclose(float(s(jnp.asarray(100))), 0.1, atol=1e-6)
+    # clamp past the horizon
+    np.testing.assert_allclose(float(s(jnp.asarray(500))), 0.1, atol=1e-6)
+    # cycle=True restarts the horizon instead of clamping
+    c = schedules.polynomial_decay(1.0, 100, end_value=0.1, cycle=True)
+    assert float(c(jnp.asarray(150))) > 0.1
