@@ -12,7 +12,8 @@ from typing import Dict, Optional
 from ..summary import SummaryWriter
 
 __all__ = ["Callback", "TensorBoard", "History", "EarlyStopping",
-           "ModelCheckpoint"]
+           "ModelCheckpoint", "LearningRateScheduler", "ReduceLROnPlateau",
+           "CSVLogger", "TerminateOnNaN", "LambdaCallback"]
 
 
 class Callback:
@@ -107,6 +108,135 @@ class ModelCheckpoint(Callback):
                 {"params": model.state.params,
                  "model_state": model.state.model_state},
                 max_to_keep=self.max_to_keep)
+
+
+class LearningRateScheduler(Callback):
+    """Epoch-indexed LR control (Keras ``LearningRateScheduler`` analogue).
+
+    ``schedule(epoch) -> multiplier`` of the COMPILED base learning rate
+    (the functional twist on Keras's absolute-LR setter: the base LR is
+    baked into the jitted step; the callback moves the ``with_lr_scale``
+    device scalar, which costs nothing and recompiles nothing).
+    """
+
+    def __init__(self, schedule, verbose: int = 0):
+        self.schedule = schedule
+        self.verbose = verbose
+
+    def on_epoch_begin(self, model, epoch) -> None:
+        scale = float(self.schedule(epoch))
+        model.lr_scale = scale
+        if self.verbose:
+            print(f"LearningRateScheduler: epoch {epoch} lr_scale={scale:g}",
+                  flush=True)
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the LR multiplier when the monitored metric stalls (Keras
+    ``ReduceLROnPlateau`` parity: factor/patience/cooldown/min)."""
+
+    def __init__(self, monitor: str = "val_loss", factor: float = 0.1,
+                 patience: int = 10, min_delta: float = 1e-4,
+                 cooldown: int = 0, min_scale: float = 0.0,
+                 mode: str = "auto", verbose: int = 0):
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau needs factor < 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_scale = min_scale
+        self.sign = _monitor_sign(mode, monitor)
+        self.verbose = verbose
+        self.best = float("inf")
+        self.wait = 0
+        self.cooldown_left = 0
+
+    def on_epoch_end(self, model, epoch, logs) -> None:
+        value = logs.get(self.monitor)
+        if value is None:
+            return
+        if self.cooldown_left > 0:
+            self.cooldown_left -= 1
+            self.wait = 0
+        score = self.sign * float(value)
+        if score < self.best - self.min_delta:
+            self.best = score
+            self.wait = 0
+        elif self.cooldown_left == 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                new = max(model.lr_scale * self.factor, self.min_scale)
+                if new < model.lr_scale:
+                    model.lr_scale = new
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: epoch {epoch} "
+                              f"lr_scale -> {new:g}", flush=True)
+                self.cooldown_left = self.cooldown
+                self.wait = 0
+
+
+class CSVLogger(Callback):
+    """Append per-epoch logs to a CSV file (Keras ``CSVLogger`` parity).
+    The column set is fixed by the first logged epoch."""
+
+    def __init__(self, filename: str, append: bool = False):
+        self.filename = filename
+        self.append = append
+        self._file = None
+        self._keys = None
+
+    def on_train_begin(self, model) -> None:
+        import os
+        os.makedirs(os.path.dirname(self.filename) or ".", exist_ok=True)
+        if not self.append:
+            self._keys = None   # truncated file needs its header rewritten
+        self._file = open(self.filename, "a" if self.append else "w")
+
+    def on_epoch_end(self, model, epoch, logs) -> None:
+        if self._file is None:
+            return
+        if self._keys is None:
+            self._keys = sorted(logs)
+            self._file.write(",".join(["epoch"] + self._keys) + "\n")
+        row = [str(epoch)] + [f"{logs.get(k, float('nan'))}"
+                              for k in self._keys]
+        self._file.write(",".join(row) + "\n")
+        self._file.flush()
+
+    def on_train_end(self, model) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class TerminateOnNaN(Callback):
+    """Stop training when the epoch loss goes non-finite (Keras parity;
+    the per-step fail-fast variant is ``train.NaNHook``)."""
+
+    def on_epoch_end(self, model, epoch, logs) -> None:
+        import math
+        loss = logs.get("loss")
+        if loss is not None and not math.isfinite(float(loss)):
+            print(f"TerminateOnNaN: non-finite loss at epoch {epoch}, "
+                  "stopping", flush=True)
+            model.stop_training = True
+
+
+class LambdaCallback(Callback):
+    """Ad-hoc callbacks from plain functions (Keras ``LambdaCallback``)."""
+
+    def __init__(self, on_train_begin=None, on_epoch_begin=None,
+                 on_epoch_end=None, on_train_end=None):
+        if on_train_begin:
+            self.on_train_begin = on_train_begin
+        if on_epoch_begin:
+            self.on_epoch_begin = on_epoch_begin
+        if on_epoch_end:
+            self.on_epoch_end = on_epoch_end
+        if on_train_end:
+            self.on_train_end = on_train_end
 
 
 class EarlyStopping(Callback):

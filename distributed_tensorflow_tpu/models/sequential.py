@@ -43,6 +43,8 @@ class Sequential:
         self.state: Optional[TrainState] = None
         self.stop_training = False
         self._compiled = None
+        self._compile_config = None   # JSON-able compile args (for save)
+        self._in_shape = None         # recorded at build (for load)
 
     # -- construction ----------------------------------------------------
     def add(self, layer: layer_lib.Layer) -> None:
@@ -69,7 +71,9 @@ class Sequential:
         to both the train and eval steps — see train/precision.py.
         """
         loss_fn = loss_lib.get(loss)
-        opt = opt_lib.get(optimizer)
+        # with_lr_scale: LearningRateScheduler / ReduceLROnPlateau mutate a
+        # device scalar in opt_state between steps — no recompilation.
+        opt = opt_lib.with_lr_scale(opt_lib.get(optimizer))
         metric_fns = {}
         for m in metrics:
             fn = metric_lib.get(m)
@@ -84,6 +88,22 @@ class Sequential:
                 self.stack, loss_fn, metric_fns=metric_fns, mesh=mesh,
                 policy=policy),
         )
+        # Record the compile call for model.save when every piece is a
+        # JSON-able registry name (a mesh or callable can't round-trip).
+        serializable = (isinstance(loss, str) and isinstance(optimizer, str)
+                        and all(isinstance(m, str) for m in metrics)
+                        and (policy is None or isinstance(policy, str))
+                        and mesh is None and params_spec is None)
+        self._compile_config = dict(
+            loss=loss, optimizer=optimizer, metrics=list(metrics),
+            seed=seed, grad_clip_norm=grad_clip_norm,
+            policy=policy) if serializable else None
+        # Recompile keeps the weights but resets the optimizer state for
+        # the new optimizer (Keras recompile semantics) — also what lets
+        # load_model restore weights before the user's own compile().
+        if self.state is not None:
+            self.state = self.state._replace(
+                opt_state=opt.init(self.state.params))
 
     def _require_compiled(self) -> dict:
         if self._compiled is None:
@@ -94,6 +114,7 @@ class Sequential:
         """Initialize parameters for per-example feature shape ``in_shape``."""
         c = self._require_compiled()
         key = jax.random.PRNGKey(seed)
+        self._in_shape = tuple(int(d) for d in in_shape)
         self.state = step_lib.init_train_state(self.stack, c["optimizer"],
                                                key, in_shape)
         if c["mesh"] is not None:
@@ -240,6 +261,38 @@ class Sequential:
                 self.state.params, self.state.model_state,
                 x[lo:lo + batch_size])))
         return np.concatenate(outs, axis=0)
+
+    # -- full-model IO (Keras model.save / load_model / to_json parity) --
+    def save(self, path: str) -> str:
+        """Architecture + weights under ``path`` (see models.saving)."""
+        from . import saving
+        return saving.save_model(self, path)
+
+    def to_json(self, **dump_kwargs) -> str:
+        from . import saving
+        import json
+        return json.dumps(saving.model_to_config(self), **dump_kwargs)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Sequential":
+        from . import saving
+        import json
+        return saving.model_from_config(json.loads(text))
+
+    # -- learning-rate control (Keras optimizer.lr mutation analogue) ----
+    @property
+    def lr_scale(self) -> float:
+        """Multiplier on the compiled optimizer's learning rate."""
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        return opt_lib.get_lr_scale(self.state.opt_state)
+
+    @lr_scale.setter
+    def lr_scale(self, value: float) -> None:
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        self.state = self.state._replace(
+            opt_state=opt_lib.set_lr_scale(self.state.opt_state, value))
 
     # -- introspection ---------------------------------------------------
     def summary(self) -> str:

@@ -1,0 +1,230 @@
+"""Vision Transformer (ViT) — image classification on the transformer core.
+
+The reference has no vision models (its model is a 3-layer MLP on bit
+vectors, reference example.py:149-155); ViT extends the framework's model
+zoo to the modern image-classification architecture while reusing the same
+building blocks as BERT/GPT/seq2seq: ``attention_core``/``ffn_core``
+(ops/attention.py), scanned encoder layers (compile time O(1) in depth),
+megatron-style partition rules, optional flash attention and remat.
+
+TPU-first choices:
+  * Patchify is ONE strided conv (maps to the MXU) instead of
+    reshape+gather shuffles.
+  * Pre-LN blocks (ViT convention, unlike BERT's post-LN) — residuals
+    stay in the compute dtype, norms in f32.
+  * Learned position embeddings over ``(1 + n_patches)`` tokens; CLS token
+    carries the classification signal (standard ViT head).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import attention as attn_lib
+from ..ops import initializers as init_lib
+from ..ops import losses as loss_lib
+from ..parallel.sharding import PartitionRules
+from .bert import _dropout, _layer_norm
+
+__all__ = ["ViTConfig", "ViT", "vit_base", "vit_tiny"]
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    dropout_rate: float = 0.0
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.float32
+    remat: bool = False
+    use_flash: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def n_patches(self) -> int:
+        side = self.image_size // self.patch_size
+        return side * side
+
+
+def vit_base(**kw) -> "ViT":
+    return ViT(ViTConfig(**kw))
+
+
+def vit_tiny(**kw) -> "ViT":
+    kw.setdefault("image_size", 32)
+    kw.setdefault("patch_size", 8)
+    kw.setdefault("num_classes", 10)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("intermediate_size", 128)
+    return ViT(ViTConfig(**kw))
+
+
+class ViT:
+    """Functional ViT: ``init(key) -> params``, ``apply(params, images)``."""
+
+    def __init__(self, config: ViTConfig):
+        self.config = config
+
+    # -- init -------------------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        c = self.config
+        if c.image_size % c.patch_size:
+            raise ValueError(f"image_size {c.image_size} not divisible by "
+                             f"patch_size {c.patch_size}")
+        trunc = init_lib.truncated_normal(0.02)
+        lecun = init_lib.lecun_normal()
+        k_patch, k_pos, k_cls, k_layers, k_head = jax.random.split(key, 5)
+
+        def ln():
+            return {"gamma": jnp.ones((c.hidden_size,), jnp.float32),
+                    "beta": jnp.zeros((c.hidden_size,), jnp.float32)}
+
+        d, h, hd, i = (c.hidden_size, c.num_heads, c.head_dim,
+                       c.intermediate_size)
+        params: Dict[str, Any] = {
+            "patch_embed": {
+                "kernel": lecun(k_patch, (c.patch_size, c.patch_size,
+                                          c.channels, d)),
+                "bias": jnp.zeros((d,), jnp.float32),
+            },
+            "cls_token": jnp.zeros((1, 1, d), jnp.float32),
+            "pos_embed": trunc(k_pos, (1, 1 + c.n_patches, d)),
+        }
+        del k_cls  # cls token is zero-init (BERT/ViT convention)
+
+        def one_layer(k):
+            ks = jax.random.split(k, 6)
+            return {
+                "attention": {
+                    "query": {"kernel": trunc(ks[0], (d, h, hd)),
+                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "key": {"kernel": trunc(ks[1], (d, h, hd)),
+                            "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "value": {"kernel": trunc(ks[2], (d, h, hd)),
+                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                    "out": {"kernel": trunc(ks[3], (h, hd, d)),
+                            "bias": jnp.zeros((d,), jnp.float32)},
+                    "ln": ln(),
+                },
+                "ffn": {
+                    "w_in": {"kernel": trunc(ks[4], (d, i)),
+                             "bias": jnp.zeros((i,), jnp.float32)},
+                    "w_out": {"kernel": trunc(ks[5], (i, d)),
+                              "bias": jnp.zeros((d,), jnp.float32)},
+                    "ln": ln(),
+                },
+            }
+
+        params["encoder"] = jax.vmap(one_layer)(
+            jax.random.split(k_layers, c.num_layers))
+        params["final_ln"] = ln()
+        params["head"] = {"kernel": jnp.zeros((d, c.num_classes),
+                                              jnp.float32),
+                          "bias": jnp.zeros((c.num_classes,), jnp.float32)}
+        return params
+
+    # -- encoder ----------------------------------------------------------
+    def _encoder_layer(self, p, x, rng, train):
+        """Pre-LN block: x + attn(LN(x)); x + ffn(LN(x))."""
+        c = self.config
+        r1, r2, r3 = jax.random.split(rng, 3)
+        if c.use_flash:
+            from ..ops.pallas import flash_attention
+            attention_fn = lambda q, k, v, mask=None: flash_attention(q, k, v)
+        else:
+            attention_fn = attn_lib.dot_product_attention
+        y = _layer_norm(p["attention"]["ln"], x, c.layer_norm_eps)
+        y = attn_lib.attention_core(p["attention"], y, mask=None,
+                                    dropout_rate=c.dropout_rate, rng=r1,
+                                    train=train, attention_fn=attention_fn)
+        x = x + _dropout(y, c.dropout_rate, r2, train)
+        y = _layer_norm(p["ffn"]["ln"], x, c.layer_norm_eps)
+        y = attn_lib.ffn_core(p["ffn"], y)
+        return x + _dropout(y, c.dropout_rate, r3, train)
+
+    def apply(self, params, images, *, train: bool = False, rng=None):
+        """NHWC images -> [batch, num_classes] f32 logits."""
+        c = self.config
+        if rng is None:
+            if train and c.dropout_rate > 0.0:
+                raise ValueError("ViT.apply(train=True) requires an rng key")
+            rng = jax.random.PRNGKey(0)
+        x = jax.lax.conv_general_dilated(
+            images.astype(c.dtype),
+            params["patch_embed"]["kernel"].astype(c.dtype),
+            window_strides=(c.patch_size, c.patch_size), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        b = x.shape[0]
+        x = x.reshape(b, -1, c.hidden_size)
+        x = x + params["patch_embed"]["bias"].astype(c.dtype)
+        cls = jnp.broadcast_to(params["cls_token"].astype(c.dtype),
+                               (b, 1, c.hidden_size))
+        x = jnp.concatenate([cls, x], axis=1)
+        x = x + params["pos_embed"].astype(c.dtype)
+        r_emb, r_layers = jax.random.split(rng)
+        x = _dropout(x, c.dropout_rate, r_emb, train)
+
+        layer_fn = self._encoder_layer
+        if c.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(3,))
+
+        def body(carry, inputs):
+            layer_params, layer_key = inputs
+            return layer_fn(layer_params, carry, layer_key, train), None
+
+        layer_keys = jax.random.split(r_layers, c.num_layers)
+        x, _ = jax.lax.scan(body, x, (params["encoder"], layer_keys))
+        x = _layer_norm(params["final_ln"], x, c.layer_norm_eps)
+        cls_out = x[:, 0, :]
+        logits = (cls_out @ params["head"]["kernel"].astype(cls_out.dtype)
+                  + params["head"]["bias"].astype(cls_out.dtype))
+        return logits.astype(jnp.float32)
+
+    # -- loss -------------------------------------------------------------
+    def loss_fn(self):
+        """Contract for ``train.make_custom_train_step``: batch is
+        ``(images, integer_labels)``."""
+
+        def loss_fn(params, model_state, batch, rng, train):
+            images, labels = batch
+            logits = self.apply(params, images, train=train, rng=rng)
+            loss = loss_lib.softmax_cross_entropy_with_integer_labels(
+                logits, labels)
+            accuracy = jnp.mean(
+                (jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+            return loss, ({"accuracy": accuracy}, model_state)
+
+        return loss_fn
+
+    # -- sharding ---------------------------------------------------------
+    def partition_rules(self, fsdp: bool = False) -> PartitionRules:
+        """Same megatron TP layout as the BERT table (heads and FFN hidden
+        on ``tensor``); patch conv and head shard their output dim."""
+        f = "fsdp" if fsdp else None
+        return PartitionRules([
+            (r"patch_embed/kernel", P(None, None, None, "tensor")),
+            (r"encoder/attention/(query|key|value)/kernel",
+             P(None, f, "tensor", None)),
+            (r"encoder/attention/(query|key|value)/bias",
+             P(None, "tensor", None)),
+            (r"encoder/attention/out/kernel", P(None, "tensor", None, f)),
+            (r"encoder/ffn/w_in/kernel", P(None, f, "tensor")),
+            (r"encoder/ffn/w_in/bias", P(None, "tensor")),
+            (r"encoder/ffn/w_out/kernel", P(None, "tensor", f)),
+            (r"head/kernel", P(f, "tensor")),
+        ])

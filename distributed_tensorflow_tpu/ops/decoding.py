@@ -33,10 +33,10 @@ def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     neg = jnp.asarray(-jnp.inf, logits.dtype)
-    b, vocab = logits.shape
-    need_k = top_k is not None and top_k < vocab
+    need_k = top_k is not None and top_k < logits.shape[-1]
     need_p = top_p is not None and top_p < 1.0
     if need_p:
+        b, vocab = logits.shape  # nucleus scatter-back needs [b, V] here
         # One full sort serves both filters: positions >= k are exactly the
         # tokens a top-k threshold would drop, so the k filter is a
         # positional mask on the sorted array, applied BEFORE the softmax so

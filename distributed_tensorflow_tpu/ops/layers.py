@@ -50,11 +50,33 @@ def _pair(v: Union[int, Sequence[int]]) -> Tuple[int, int]:
     return (int(a), int(b))
 
 
+def _by_name(value, what: str, layer: "Layer"):
+    """Serialization guard: config entries must be registry names, not
+    callables (a callable can't round-trip through JSON)."""
+    if value is None or isinstance(value, str):
+        return value
+    raise ValueError(
+        f"{type(layer).__name__} {layer.name!r} was constructed with a "
+        f"callable {what}; pass it by registry name to serialize the model")
+
+
+def _dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
 class Layer:
     """Base layer: stateless identity."""
 
     def __init__(self, name: Optional[str] = None):
         self.name = name or type(self).__name__.lower()
+
+    def get_config(self) -> Dict[str, Any]:
+        """JSON-able constructor kwargs; ``type(self)(**config)`` rebuilds
+        the layer (Keras ``get_config``/``from_config`` convention, the
+        serialization half of ``model.save``)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement get_config; the "
+            "model can't be serialized with this layer")
 
     def init(self, key, in_shape: Shape) -> Tuple[Params, State]:
         del key, in_shape
@@ -90,6 +112,20 @@ class Dense(Layer):
         self.kernel_init = init_lib.get(kernel_init)
         self.bias_init = init_lib.get(bias_init)
         self.param_dtype = param_dtype
+        self._raw = dict(activation=activation, kernel_init=kernel_init,
+                         bias_init=bias_init)
+
+    def get_config(self):
+        return dict(units=self.units,
+                    activation=_by_name(self._raw["activation"],
+                                        "activation", self),
+                    use_bias=self.use_bias,
+                    kernel_init=_by_name(self._raw["kernel_init"],
+                                         "kernel_init", self),
+                    bias_init=_by_name(self._raw["bias_init"],
+                                       "bias_init", self),
+                    param_dtype=_dtype_name(self.param_dtype),
+                    name=self.name)
 
     def init(self, key, in_shape):
         in_dim = in_shape[-1]
@@ -129,6 +165,9 @@ class Dropout(Layer):
             raise ValueError(f"dropout rate must be in [0, 1), got {rate}")
         self.rate = float(rate)
 
+    def get_config(self):
+        return dict(rate=self.rate, name=self.name)
+
     def apply(self, params, state, x, *, train=False, rng=None):
         if not train or self.rate == 0.0:
             return x, state
@@ -143,6 +182,9 @@ class Dropout(Layer):
 
 
 class Flatten(Layer):
+    def get_config(self):
+        return dict(name=self.name)
+
     def out_shape(self, in_shape):
         return (math.prod(in_shape),)
 
@@ -154,6 +196,10 @@ class Activation(Layer):
     def __init__(self, fn, name: Optional[str] = None):
         super().__init__(name)
         self.fn = act_lib.get(fn)
+        self._raw_fn = fn
+
+    def get_config(self):
+        return dict(fn=_by_name(self._raw_fn, "fn", self), name=self.name)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         return self.fn(x), state
@@ -179,6 +225,22 @@ class Conv2D(Layer):
         self.kernel_init = init_lib.get(kernel_init)
         self.bias_init = init_lib.get(bias_init)
         self.param_dtype = param_dtype
+        self._raw = dict(activation=activation, kernel_init=kernel_init,
+                         bias_init=bias_init)
+
+    def get_config(self):
+        return dict(filters=self.filters,
+                    kernel_size=list(self.kernel_size),
+                    strides=list(self.strides), padding=self.padding,
+                    activation=_by_name(self._raw["activation"],
+                                        "activation", self),
+                    use_bias=self.use_bias,
+                    kernel_init=_by_name(self._raw["kernel_init"],
+                                         "kernel_init", self),
+                    bias_init=_by_name(self._raw["bias_init"],
+                                       "bias_init", self),
+                    param_dtype=_dtype_name(self.param_dtype),
+                    name=self.name)
 
     def init(self, key, in_shape):
         h, w, c = in_shape
@@ -224,6 +286,11 @@ class _Pool2D(Layer):
         self.strides = _pair(strides) if strides is not None else self.pool_size
         self.padding = padding
 
+    def get_config(self):
+        return dict(pool_size=list(self.pool_size),
+                    strides=list(self.strides), padding=self.padding,
+                    name=self.name)
+
     def out_shape(self, in_shape):
         h, w, c = in_shape
         (kh, kw), (sh, sw) = self.pool_size, self.strides
@@ -259,6 +326,9 @@ class AvgPool2D(_Pool2D):
 class GlobalAvgPool(Layer):
     """NHWC -> NC mean over spatial dims."""
 
+    def get_config(self):
+        return dict(name=self.name)
+
     def out_shape(self, in_shape):
         return (in_shape[-1],)
 
@@ -285,6 +355,11 @@ class BatchNorm(Layer):
         self.scale = scale
         self.center = center
         self.axis_name = axis_name
+
+    def get_config(self):
+        return dict(momentum=self.momentum, epsilon=self.epsilon,
+                    scale=self.scale, center=self.center,
+                    axis_name=self.axis_name, name=self.name)
 
     def init(self, key, in_shape):
         del key
@@ -342,6 +417,10 @@ class LayerNorm(Layer):
                              "center (the kernel applies gamma and beta)")
         self.fused = fused
 
+    def get_config(self):
+        return dict(epsilon=self.epsilon, scale=self.scale,
+                    center=self.center, fused=self.fused, name=self.name)
+
     def init(self, key, in_shape):
         del key
         dim = in_shape[-1]
@@ -378,6 +457,16 @@ class Embedding(Layer):
         self.vocab_size = int(vocab_size)
         self.dim = int(dim)
         self.embedding_init = init_lib.get(embedding_init)
+        self._raw_init = embedding_init
+
+    def get_config(self):
+        cfg = dict(vocab_size=self.vocab_size, dim=self.dim, name=self.name)
+        # the class default is a callable created at def time; omitting it
+        # from the config round-trips to the same default
+        if self._raw_init is not Embedding.__init__.__defaults__[0]:
+            cfg["embedding_init"] = _by_name(self._raw_init,
+                                             "embedding_init", self)
+        return cfg
 
     def init(self, key, in_shape):
         del in_shape

@@ -5,9 +5,11 @@ from .adafactor import adafactor
 from .ema import EMAState, ema, ema_params, with_ema
 from .optimizers import (Optimizer, OptState, adadelta, adagrad, adam, adamw,
                          apply_updates, clip_by_global_norm, ftrl, get,
-                         global_norm, lamb, momentum, rmsprop, sgd)
+                         get_lr_scale, global_norm, lamb, momentum, rmsprop,
+                         set_lr_scale, sgd, with_lr_scale)
 
 __all__ = ["schedules", "adafactor", "Optimizer", "OptState", "adadelta",
            "adagrad", "adam", "adamw", "apply_updates", "clip_by_global_norm",
-           "ftrl", "get", "global_norm", "lamb", "momentum", "rmsprop", "sgd",
+           "ftrl", "get", "get_lr_scale", "global_norm", "lamb", "momentum",
+           "rmsprop", "set_lr_scale", "sgd", "with_lr_scale",
            "EMAState", "ema", "ema_params", "with_ema"]

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 __all__ = ["Optimizer", "OptState", "sgd", "momentum", "adam", "adamw",
            "lamb", "rmsprop", "adagrad", "adadelta", "ftrl",
-           "apply_updates", "clip_by_global_norm", "global_norm", "get"]
+           "apply_updates", "clip_by_global_norm", "global_norm", "get",
+           "with_lr_scale", "get_lr_scale", "set_lr_scale"]
 
 Schedule = Callable[[jnp.ndarray], jnp.ndarray]
 ScalarOrSchedule = Union[float, Schedule]
@@ -405,6 +406,58 @@ def ftrl(learning_rate: ScalarOrSchedule = 0.001,
         return updates, OptState(count, {"n": n_new, "z": z_new})
 
     return Optimizer(init, update)
+
+
+def with_lr_scale(optimizer: Optimizer) -> Optimizer:
+    """Wrap an optimizer with a host-settable learning-rate multiplier.
+
+    The scale lives in ``opt_state.inner["scale"]`` — a device scalar, so
+    changing it between steps (``set_lr_scale``) is pure state surgery with
+    NO recompilation: the jitted step reads whatever scalar the state
+    carries.  This is the functional replacement for mutating
+    ``optimizer.lr`` the way Keras's LearningRateScheduler /
+    ReduceLROnPlateau callbacks do on a stateful optimizer object.
+
+    Exactness: scaling the returned update by s is identical to scaling the
+    learning rate by s for every delta-style optimizer here (sgd, momentum,
+    adam(w), lamb, rmsprop, adagrad, adadelta, adafactor's explicit-lr
+    mode) because their update is linear in lr.  ftrl recomputes weights
+    from (z, n) state, so for ftrl the scale damps the step toward the
+    FTRL target rather than re-deriving it at a lower rate.
+    """
+
+    def init(params):
+        inner = optimizer.init(params)
+        return OptState(inner.count,
+                        {"scale": jnp.ones((), jnp.float32), "inner": inner})
+
+    def update(grads, state: OptState, params=None):
+        scale = state.inner["scale"]
+        updates, new_inner = optimizer.update(grads, state.inner["inner"],
+                                              params)
+        updates = jax.tree.map(lambda u: u * scale, updates)
+        return updates, OptState(new_inner.count,
+                                 {"scale": scale, "inner": new_inner})
+
+    return Optimizer(init, update)
+
+
+def get_lr_scale(opt_state: OptState) -> float:
+    """Current multiplier of a ``with_lr_scale``-wrapped opt_state."""
+    try:
+        return float(opt_state.inner["scale"])
+    except (TypeError, KeyError, IndexError):
+        raise ValueError("opt_state was not created by a with_lr_scale-"
+                         "wrapped optimizer") from None
+
+
+def set_lr_scale(opt_state: OptState, value: float) -> OptState:
+    """Return the opt_state with the LR multiplier replaced (pure; the
+    caller re-threads it into its TrainState)."""
+    get_lr_scale(opt_state)  # structure check
+    inner = dict(opt_state.inner)
+    inner["scale"] = jnp.asarray(value, jnp.float32)
+    return OptState(opt_state.count, inner)
 
 
 _REGISTRY = {

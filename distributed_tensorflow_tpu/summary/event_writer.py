@@ -147,6 +147,26 @@ def _image_event(wall_time: float, step: int, tag: str, image) -> bytes:
             _field_bytes(5, _field_bytes(1, value)))
 
 
+def _text_event(wall_time: float, step: int, tag: str, text: str) -> bytes:
+    """tf.summary.text parity: Summary.Value{tag=1, metadata=9, tensor=8}
+    where the tensor is a DT_STRING TensorProto and the metadata routes the
+    value to TensorBoard's text plugin (markdown-rendered).
+
+    Protos: SummaryMetadata{plugin_data=1 PluginData{plugin_name=1}};
+    TensorProto{dtype=1 (DT_STRING=7), tensor_shape=2
+    TensorShapeProto{dim=2 {size=1}}, string_val=8}.
+    """
+    payload = text.encode("utf-8")
+    tensor = (_field_varint(1, 7)
+              + _field_bytes(2, _field_bytes(2, _field_varint(1, 1)))
+              + _field_bytes(8, payload))
+    metadata = _field_bytes(1, _field_bytes(1, b"text"))
+    value = (_field_bytes(1, tag.encode("utf-8")) + _field_bytes(8, tensor)
+             + _field_bytes(9, metadata))
+    return (_field_double(1, wall_time) + _field_varint(2, int(step)) +
+            _field_bytes(5, _field_bytes(1, value)))
+
+
 def _histogram_event(wall_time: float, step: int, tag: str, values) -> bytes:
     # Summary.Value: tag=1, simple_value=2, image=4, histo=5 (TF
     # summary.proto oneof) — histograms MUST land in field 5.
@@ -195,6 +215,14 @@ class EventFileWriter:
             wall_time if wall_time is not None else time.time(),
             int(step), tag, image))
 
+    def add_text(self, tag: str, text: str, step: Union[int, float],
+                 wall_time: Optional[float] = None) -> None:
+        """Text summary (markdown, TB text plugin) — tf.summary.text
+        parity; e.g. run config dumps or sample generations."""
+        self._write_record(_text_event(
+            wall_time if wall_time is not None else time.time(),
+            int(step), tag, text))
+
     def flush(self) -> None:
         self._file.flush()
 
@@ -236,6 +264,10 @@ class SummaryWriter:
     def add_histogram(self, tag: str, values,
                       step: Union[int, float]) -> None:
         self._writer.add_histogram(tag, values, step)
+
+    def add_text(self, tag: str, text: str,
+                 step: Union[int, float]) -> None:
+        self._writer.add_text(tag, text, step)
 
     def flush(self) -> None:
         self._writer.flush()

@@ -79,3 +79,14 @@ def test_top_p_zero_degrades_to_greedy():
     out = dec.sample_logits(jax.random.PRNGKey(0), logits,
                             temperature=1.0, top_p=0.0)
     assert int(out[0]) == 1  # the argmax token, never id 0
+
+
+def test_sample_logits_rank_agnostic_without_top_p():
+    """top_k-only and plain-temperature paths accept leading dims beyond
+    batch (e.g. [b, beams, V]); only nucleus needs the 2D form."""
+    logits = jnp.zeros((2, 3, 8)).at[..., 1].set(5.0)
+    out = dec.sample_logits(jax.random.PRNGKey(0), logits,
+                            temperature=0.5, top_k=2)
+    assert out.shape == (2, 3)
+    out = dec.sample_logits(jax.random.PRNGKey(0), logits, temperature=0.5)
+    assert out.shape == (2, 3)

@@ -212,3 +212,27 @@ def test_image_summary_integer_dtypes():
     raw = zlib.decompress(b[idat + 4:idat + 4 + length])
     # rows: filter byte + 12 pixel bytes; every pixel must be 128, not 255
     assert set(raw[1:13]) == {128}
+
+
+def test_text_summary_roundtrip(tmp_path):
+    """add_text emits a DT_STRING TensorProto routed to the text plugin."""
+    from distributed_tensorflow_tpu.summary import EventFileWriter
+
+    md = "## run config\n- lr: 1e-3\n- batch: 64"
+    with EventFileWriter(str(tmp_path)) as w:
+        w.add_text("notes", md, step=7)
+    import glob
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)
+    assert len(records) == 2  # version + text event
+    event = parse_event(records[1])
+    assert event[2] == [7]                       # step
+    summary = parse_event(event[5][0])
+    value = parse_event(summary[1][0])
+    assert value[1] == [b"notes"]                # tag
+    tensor = parse_event(value[8][0])
+    assert tensor[1] == [7]                      # DT_STRING
+    assert tensor[8] == [md.encode("utf-8")]     # string_val
+    metadata = parse_event(value[9][0])
+    plugin = parse_event(metadata[1][0])
+    assert plugin[1] == [b"text"]                # plugin_name
