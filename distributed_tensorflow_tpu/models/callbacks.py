@@ -190,7 +190,15 @@ class CSVLogger(Callback):
     def on_train_begin(self, model) -> None:
         import os
         os.makedirs(os.path.dirname(self.filename) or ".", exist_ok=True)
-        if not self.append:
+        if self.append:
+            # appending to a file with content: its header already exists —
+            # never write a second one mid-file (Keras CSVLogger behavior)
+            if self._keys is None and os.path.exists(self.filename):
+                with open(self.filename) as f:
+                    header = f.readline().strip()
+                if header.startswith("epoch,"):
+                    self._keys = header.split(",")[1:]
+        else:
             self._keys = None   # truncated file needs its header rewritten
         self._file = open(self.filename, "a" if self.append else "w")
 

@@ -16,7 +16,10 @@ import jax.numpy as jnp
 
 __all__ = ["mean_squared_error", "binary_cross_entropy",
            "softmax_cross_entropy", "softmax_cross_entropy_with_integer_labels",
-           "smoothed_cross_entropy", "get"]
+           "smoothed_cross_entropy", "mean_absolute_error",
+           "mean_absolute_percentage_error", "mean_squared_logarithmic_error",
+           "hinge", "squared_hinge", "kullback_leibler_divergence", "poisson",
+           "cosine_proximity", "huber", "get"]
 
 
 def mean_squared_error(preds, targets):
@@ -46,6 +49,74 @@ def softmax_cross_entropy_with_integer_labels(logits, labels, where=None):
     return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
+def mean_absolute_error(preds, targets):
+    return jnp.mean(jnp.abs(preds.astype(jnp.float32)
+                            - targets.astype(jnp.float32)))
+
+
+def mean_absolute_percentage_error(preds, targets, epsilon: float = 1e-7):
+    """Keras MAPE: 100 * mean(|t - p| / max(|t|, eps))."""
+    t = targets.astype(jnp.float32)
+    diff = jnp.abs(t - preds.astype(jnp.float32))
+    return 100.0 * jnp.mean(diff / jnp.maximum(jnp.abs(t), epsilon))
+
+
+def mean_squared_logarithmic_error(preds, targets):
+    """Keras MSLE over non-negative predictions/targets."""
+    p = jnp.log1p(jnp.maximum(preds.astype(jnp.float32), 0.0))
+    t = jnp.log1p(jnp.maximum(targets.astype(jnp.float32), 0.0))
+    return jnp.mean(jnp.square(p - t))
+
+
+def hinge(preds, targets):
+    """Targets in {-1, +1} (Keras hinge convention)."""
+    return jnp.mean(jnp.maximum(
+        1.0 - targets.astype(jnp.float32) * preds.astype(jnp.float32), 0.0))
+
+
+def squared_hinge(preds, targets):
+    return jnp.mean(jnp.square(jnp.maximum(
+        1.0 - targets.astype(jnp.float32) * preds.astype(jnp.float32), 0.0)))
+
+
+def kullback_leibler_divergence(preds, targets, epsilon: float = 1e-7):
+    """KL(targets || preds) over probability rows, summed across the last
+    axis then averaged (Keras kld)."""
+    p = jnp.clip(preds.astype(jnp.float32), epsilon, 1.0)
+    t = jnp.clip(targets.astype(jnp.float32), epsilon, 1.0)
+    return jnp.mean(jnp.sum(t * jnp.log(t / p), axis=-1))
+
+
+def poisson(preds, targets, epsilon: float = 1e-7):
+    p = preds.astype(jnp.float32)
+    return jnp.mean(p - targets.astype(jnp.float32)
+                    * jnp.log(p + epsilon))
+
+
+def cosine_proximity(preds, targets, epsilon: float = 1e-12):
+    """Negative mean cosine similarity along the last axis (minimizing it
+    aligns predictions with targets — Keras 2.0 sign convention)."""
+    p = preds.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    p = p / jnp.maximum(jnp.linalg.norm(p, axis=-1, keepdims=True), epsilon)
+    t = t / jnp.maximum(jnp.linalg.norm(t, axis=-1, keepdims=True), epsilon)
+    return -jnp.mean(jnp.sum(p * t, axis=-1))
+
+
+def huber(delta: float = 1.0):
+    """Factory: quadratic within ``delta``, linear outside (robust MSE)."""
+    d = float(delta)
+
+    def loss(preds, targets):
+        err = jnp.abs(preds.astype(jnp.float32)
+                      - targets.astype(jnp.float32))
+        quad = jnp.minimum(err, d)
+        return jnp.mean(0.5 * jnp.square(quad) + d * (err - quad))
+
+    loss.__name__ = f"huber_{d}"
+    return loss
+
+
 def smoothed_cross_entropy(smoothing: float = 0.1):
     """Factory: XE with label smoothing (the ResNet/ImageNet recipe).
 
@@ -68,12 +139,25 @@ def smoothed_cross_entropy(smoothing: float = 0.1):
 _REGISTRY = {
     "mse": mean_squared_error,
     "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
     "binary_crossentropy": binary_cross_entropy,
     "categorical_crossentropy": softmax_cross_entropy,
     "sparse_categorical_crossentropy":
         softmax_cross_entropy_with_integer_labels,
-    # by-name form uses the standard s=0.1; call the factory for custom s
+    # factories by name use their standard settings; call for custom ones
     "smoothed_cross_entropy": smoothed_cross_entropy(0.1),
+    "huber": huber(1.0),
 }
 
 

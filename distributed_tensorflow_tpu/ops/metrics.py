@@ -11,7 +11,11 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["bitwise_accuracy", "accuracy", "top_k_accuracy", "get"]
+from .losses import mean_absolute_error  # one definition serves both tables
+
+__all__ = ["bitwise_accuracy", "accuracy", "top_k_accuracy",
+           "binary_accuracy", "mean_absolute_error", "precision", "recall",
+           "f1_score", "get"]
 
 
 def bitwise_accuracy(preds, targets):
@@ -38,9 +42,49 @@ def top_k_accuracy(k: int):
     return metric
 
 
+def binary_accuracy(preds, targets, threshold: float = 0.5):
+    """Keras binary_accuracy: thresholded sigmoid outputs vs 0/1 targets."""
+    hits = (preds.astype(jnp.float32) > threshold) == (
+        targets.astype(jnp.float32) > threshold)
+    return jnp.mean(hits.astype(jnp.float32))
+
+
+def _binary_counts(preds, targets, threshold: float):
+    p = (preds.astype(jnp.float32) > threshold).astype(jnp.float32)
+    t = (targets.astype(jnp.float32) > threshold).astype(jnp.float32)
+    tp = jnp.sum(p * t)
+    return tp, jnp.sum(p), jnp.sum(t)
+
+
+def precision(preds, targets, threshold: float = 0.5, epsilon: float = 1e-7):
+    """Batch precision over thresholded binary outputs (per-batch, the
+    jit-friendly form; exact dataset-level values need streamed counts)."""
+    tp, pred_pos, _ = _binary_counts(preds, targets, threshold)
+    return tp / jnp.maximum(pred_pos, epsilon)
+
+
+def recall(preds, targets, threshold: float = 0.5, epsilon: float = 1e-7):
+    tp, _, actual_pos = _binary_counts(preds, targets, threshold)
+    return tp / jnp.maximum(actual_pos, epsilon)
+
+
+def f1_score(preds, targets, threshold: float = 0.5, epsilon: float = 1e-7):
+    tp, pred_pos, actual_pos = _binary_counts(preds, targets, threshold)
+    return 2.0 * tp / jnp.maximum(pred_pos + actual_pos, epsilon)
+
+
 _REGISTRY = {
     "accuracy": accuracy,
+    "categorical_accuracy": accuracy,
+    "sparse_categorical_accuracy": accuracy,
+    "binary_accuracy": binary_accuracy,
     "bitwise_accuracy": bitwise_accuracy,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "precision": precision,
+    "recall": recall,
+    "f1": f1_score,
+    "f1_score": f1_score,
     "top_5_accuracy": top_k_accuracy(5),
 }
 

@@ -4,7 +4,7 @@ from . import cluster, data_parallel, mesh, pipeline, ring, sharding
 from .data_parallel import make_psum_train_step
 from .cluster import ClusterConfig, cluster_from_env, initialize, is_chief
 from .pipeline import (pipeline_apply, pipeline_rules_spec,
-                       stack_pipeline_params)
+                       pipeline_value_and_grad, stack_pipeline_params)
 from .ring import ring_attention, ring_attention_sharded
 from .sharding import PartitionRules, shard_pytree
 from .mesh import (AXIS_ORDER, data_parallel_mesh, data_shards,
@@ -13,7 +13,8 @@ from .mesh import (AXIS_ORDER, data_parallel_mesh, data_shards,
 
 __all__ = ["cluster", "data_parallel", "make_psum_train_step",
            "mesh", "pipeline", "ring", "sharding",
-           "pipeline_apply", "pipeline_rules_spec", "stack_pipeline_params",
+           "pipeline_apply", "pipeline_rules_spec", "pipeline_value_and_grad",
+           "stack_pipeline_params",
            "ClusterConfig",
            "cluster_from_env", "initialize", "is_chief", "ring_attention",
            "ring_attention_sharded", "PartitionRules", "shard_pytree",

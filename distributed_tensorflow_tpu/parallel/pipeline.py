@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["pipeline_apply", "stack_pipeline_params", "pipeline_rules_spec"]
+__all__ = ["pipeline_apply", "stack_pipeline_params", "pipeline_rules_spec",
+           "pipeline_value_and_grad"]
 
 
 def stack_pipeline_params(stage_params: Sequence[Any]):
@@ -128,3 +129,126 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         out_specs=P(),
         axis_names=frozenset({axis}),
         check_vma=False)(stacked_params, x)
+
+
+def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
+                                               jnp.ndarray],
+                            loss_fn: Callable[[jnp.ndarray, jnp.ndarray],
+                                              jnp.ndarray],
+                            stacked_params, x: jnp.ndarray, y: jnp.ndarray,
+                            mesh: Mesh, num_microbatches: int,
+                            axis: str = "pipe"):
+    """Hand-scheduled **1F1B** pipeline training pass -> ``(loss, grads)``.
+
+    GPipe via ``jax.grad(pipeline_apply)`` runs all M forwards, then all M
+    backwards — autodiff keeps every microbatch's residuals live, so
+    activation memory grows O(M).  The 1F1B schedule (PipeDream-flush /
+    Megatron) starts each microbatch's backward as soon as its forward
+    clears the last stage, holding at most ``2*num_stages - 1`` microbatch
+    inputs in flight — O(S), independent of M.  Residuals are not stored at
+    all: the backward tick RECOMPUTES its stage forward from the stashed
+    stage INPUT under ``jax.vjp`` (same FLOPs as GPipe-with-remat, which is
+    how pipelines run in practice anyway).
+
+    Schedule (lockstep SPMD, one fwd + one bwd sub-tick per tick): stage
+    ``s`` forwards microbatch ``m`` at tick ``m + s`` (activations ppermute
+    down the ring) and backwards it at tick ``m + 2(S-1) - s`` (cotangents
+    ppermute back up), so the last stage's backward fires the very tick its
+    forward completes — the "1F1B" interleave.  Total ``M + 2S - 2`` ticks.
+
+    ``loss_fn(out_mb, y_mb) -> scalar`` (a per-microbatch mean); the
+    returned loss is the mean over microbatches and the grads are exactly
+    ``d(loss)/d(stacked_params)``, sharded ``P(axis)`` like the params.
+    The last stage seeds both its own cotangent and the loss value through
+    ONE combined ``jax.vjp`` over ``(out, loss)``, so every stage runs an
+    identical program — no per-device branching.
+    """
+    n_stages = mesh.shape[axis]
+    leading = {p.shape[0] for p in jax.tree.leaves(stacked_params)}
+    if leading != {n_stages}:
+        raise ValueError(
+            f"stacked params have leading dim(s) {sorted(leading)} but the "
+            f"'{axis}' mesh axis has {n_stages} stages")
+    if x.shape[0] % num_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {num_microbatches} "
+            "microbatches")
+    mb = x.shape[0] // num_microbatches
+    n_ticks = num_microbatches + 2 * (n_stages - 1)
+    n_slots = min(num_microbatches, 2 * n_stages - 1)
+
+    one_stage = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(p.shape[1:], p.dtype), stacked_params)
+    mb_in = jax.ShapeDtypeStruct((mb, *x.shape[1:]), x.dtype)
+    act_dtype = jnp.result_type(
+        x.dtype, jax.eval_shape(stage_fn, one_stage, mb_in).dtype)
+
+    def inner(params, x, y):
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = lax.axis_index(axis)
+        is_first = idx == 0
+        is_last = idx == n_stages - 1
+        mbs = x.reshape(num_microbatches, mb, *x.shape[1:])
+        mbs_y = y.reshape(num_microbatches, mb, *y.shape[1:])
+
+        fwd_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+        bwd_perm = [(j, (j - 1) % n_stages) for j in range(n_stages)]
+        seed = jnp.float32(1.0 / num_microbatches)
+
+        def fwd_and_loss(p, xin, y_mb):
+            out = stage_fn(p, xin)
+            return out, loss_fn(out, y_mb).astype(jnp.float32)
+
+        def tick(carry, t):
+            fwd_state, bwd_state, stash, gacc, loss_sum = carry
+
+            # ---- F sub-tick: stage s forwards microbatch t - s ----------
+            mf = t - idx
+            active_f = (mf >= 0) & (mf < num_microbatches)
+            feed = mbs[jnp.clip(mf, 0, num_microbatches - 1)]
+            xin = jnp.where(is_first, feed.astype(act_dtype), fwd_state)
+            out = stage_fn(params, xin).astype(act_dtype)
+            slot_f = jnp.clip(mf, 0, num_microbatches - 1) % n_slots
+            stash = stash.at[slot_f].set(
+                jnp.where(active_f, xin, stash[slot_f]))
+            fwd_state = lax.ppermute(out, axis, fwd_perm)
+
+            # ---- B sub-tick: stage s backwards t - 2(S-1) + s -----------
+            mb_i = t - 2 * (n_stages - 1) + idx
+            active_b = (mb_i >= 0) & (mb_i < num_microbatches)
+            mb_c = jnp.clip(mb_i, 0, num_microbatches - 1)
+            xin_b = stash[mb_c % n_slots]
+            y_mb = mbs_y[mb_c]
+            (out_b, loss_b), vjp = jax.vjp(
+                lambda p, x_: fwd_and_loss(p, x_, y_mb), params, xin_b)
+            del out_b
+            # last stage: seed d(loss); others: incoming cotangent on out
+            g_out = jnp.where(is_last, jnp.zeros_like(bwd_state), bwd_state)
+            g_loss = jnp.where(is_last, seed, jnp.float32(0.0))
+            gp, gx = vjp((g_out, g_loss))
+            gacc = jax.tree.map(
+                lambda a, g: a + jnp.where(active_b, g, 0.0).astype(a.dtype),
+                gacc, gp)
+            loss_sum = loss_sum + jnp.where(
+                is_last & active_b, loss_b, 0.0) / num_microbatches
+            bwd_state = lax.ppermute(gx.astype(act_dtype), axis, bwd_perm)
+            return (fwd_state, bwd_state, stash, gacc, loss_sum), None
+
+        fwd0 = jnp.zeros((mb, *x.shape[1:]), act_dtype)
+        stash0 = jnp.zeros((n_slots, mb, *x.shape[1:]), act_dtype)
+        gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                             params)
+        carry0 = (fwd0, fwd0, stash0, gacc0, jnp.float32(0.0))
+        (_, _, _, gacc, loss_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(n_ticks))
+        loss = lax.psum(jnp.where(is_last, loss_sum, 0.0), axis)
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype)[None],
+                             gacc, params)
+        return loss, grads
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis), stacked_params), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(axis), stacked_params)),
+        axis_names=frozenset({axis}),
+        check_vma=False)(stacked_params, x, y)

@@ -154,3 +154,69 @@ def test_smoothed_cross_entropy():
     np.testing.assert_allclose(float(zero_smooth), float(plain), rtol=1e-6)
     smoothed = losses.smoothed_cross_entropy(0.1)(logits, labels)
     assert float(smoothed) > float(plain)  # smoothing adds uniform penalty
+
+
+def test_keras2_loss_family():
+    """The Keras-2 loss registry: values verified against the closed forms."""
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.ops import losses
+
+    p = jnp.asarray([[0.5, 2.0], [1.0, 1.0]])
+    t = jnp.asarray([[1.0, 1.0], [1.0, 1.0]])
+    np.testing.assert_allclose(float(losses.mean_absolute_error(p, t)),
+                               np.mean([0.5, 1.0, 0.0, 0.0]), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(losses.mean_absolute_percentage_error(p, t)),
+        100 * np.mean([0.5, 1.0, 0.0, 0.0]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(losses.mean_squared_logarithmic_error(p, t)),
+        np.mean((np.log1p([0.5, 2.0, 1.0, 1.0])
+                 - np.log1p([1.0, 1.0, 1.0, 1.0])) ** 2), rtol=1e-5)
+    # hinge with y in {-1, 1}
+    yh = jnp.asarray([[1.0, -1.0]])
+    ph = jnp.asarray([[0.3, 0.5]])
+    np.testing.assert_allclose(float(losses.hinge(ph, yh)),
+                               np.mean([0.7, 1.5]), rtol=1e-6)
+    np.testing.assert_allclose(float(losses.squared_hinge(ph, yh)),
+                               np.mean([0.49, 2.25]), rtol=1e-6)
+    # kld of identical distributions is 0
+    q = jnp.asarray([[0.25, 0.75]])
+    assert abs(float(losses.kullback_leibler_divergence(q, q))) < 1e-6
+    # huber: quadratic inside delta, linear outside
+    hb = losses.huber(1.0)
+    np.testing.assert_allclose(
+        float(hb(jnp.asarray([0.5, 3.0]), jnp.zeros(2))),
+        np.mean([0.125, 0.5 + 2.0]), rtol=1e-6)
+    # cosine proximity of aligned vectors is -1
+    v = jnp.asarray([[3.0, 4.0]])
+    np.testing.assert_allclose(float(losses.cosine_proximity(v, 2 * v)),
+                               -1.0, rtol=1e-6)
+    # poisson at p == t is its known value
+    np.testing.assert_allclose(
+        float(losses.poisson(jnp.asarray([2.0]), jnp.asarray([2.0]))),
+        2.0 - 2.0 * np.log(2.0 + 1e-7), rtol=1e-6)
+    # registry lookups resolve
+    for name in ("mae", "mape", "msle", "hinge", "squared_hinge", "kld",
+                 "poisson", "cosine_proximity", "huber"):
+        assert callable(losses.get(name))
+
+
+def test_keras2_metric_family():
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.ops import metrics
+
+    p = jnp.asarray([0.9, 0.2, 0.7, 0.1])
+    t = jnp.asarray([1.0, 0.0, 0.0, 1.0])
+    np.testing.assert_allclose(float(metrics.binary_accuracy(p, t)), 0.5)
+    # tp=1 (first), predicted pos = 2, actual pos = 2
+    np.testing.assert_allclose(float(metrics.precision(p, t)), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(metrics.recall(p, t)), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(metrics.f1_score(p, t)), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(metrics.mean_absolute_error(p, t)),
+        np.mean([0.1, 0.2, 0.7, 0.9]), rtol=1e-5)
+    for name in ("binary_accuracy", "categorical_accuracy", "precision",
+                 "recall", "f1", "mae"):
+        assert callable(metrics.get(name))

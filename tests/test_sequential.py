@@ -361,3 +361,19 @@ def test_csv_logger_rewrites_header_on_reuse(tmp_path):
     lines = open(path).read().strip().splitlines()
     assert len(lines) == 2                  # truncated: header + 1 epoch
     assert lines[0].startswith("epoch,")    # header present after reuse
+
+
+def test_csv_logger_append_no_duplicate_header(tmp_path):
+    """append=True onto an existing CSV (e.g. a resumed run in a fresh
+    process) must not write a second header row mid-file."""
+    (xt, yt), _ = data.xor_data(200, val_size=8, seed=0)
+    path = str(tmp_path / "log.csv")
+    model = xor_model()
+    model.fit(xt, yt, epochs=2, batch_size=50, verbose=0,
+              callbacks=[models.CSVLogger(path)])
+    model2 = xor_model()  # fresh callback object = fresh process analogue
+    model2.fit(xt, yt, epochs=1, batch_size=50, verbose=0,
+               callbacks=[models.CSVLogger(path, append=True)])
+    lines = open(path).read().strip().splitlines()
+    assert len(lines) == 4                       # 1 header + 3 epoch rows
+    assert sum(1 for l in lines if l.startswith("epoch,")) == 1
