@@ -126,6 +126,7 @@ class Sequential:
     # -- training --------------------------------------------------------
     def fit(self, x, y, epochs: int = 1, batch_size: int = 32,
             validation_data: Optional[Tuple] = None,
+            validation_split: float = 0.0,
             callbacks: Sequence[Callback] = (),
             shuffle: bool = True, seed: int = 0,
             verbose: int = 1, augment=None) -> History:
@@ -134,8 +135,22 @@ class Sequential:
         ``augment``: per-batch transform from ``data.augment`` (host-side,
         overlapped with device compute via the prefetch queue); applied to
         training batches only, never to validation.
+
+        ``validation_split``: fraction (0, 1) held out from the END of
+        ``(x, y)`` before shuffling (Keras semantics) when no explicit
+        ``validation_data`` is given.
         """
         c = self._require_compiled()
+        if validation_split and validation_data is None:
+            if not 0.0 < validation_split < 1.0:
+                raise ValueError(
+                    f"validation_split must be in (0, 1); got "
+                    f"{validation_split}")
+            n = int(np.shape(x)[0])
+            split = n - max(1, int(n * validation_split))
+            x, y = np.asarray(x), np.asarray(y)
+            validation_data = (x[split:], y[split:])
+            x, y = x[:split], y[:split]
         if self.state is None:
             self.build(tuple(np.shape(x)[1:]), seed=seed)
 
@@ -193,6 +208,49 @@ class Sequential:
         for cb in callbacks:
             cb.on_train_end(self)
         return history
+
+    # -- single-batch steps (Keras train/test/predict_on_batch parity) ---
+    def _mesh_batch(self, x, y, train: bool):
+        """Shard an on-batch pair for a mesh-compiled model.  The train
+        step pins ``P('data')`` in_shardings, so its batch MUST divide the
+        data shards; the eval step propagates shardings and accepts either."""
+        c = self._require_compiled()
+        batch = (np.asarray(x), np.asarray(y))
+        mesh = c["mesh"]
+        if mesh is None:
+            return batch
+        shards = mesh.shape["data"]
+        if batch[0].shape[0] % shards == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+            return jax.device_put(
+                batch, NamedSharding(mesh, PartitionSpec("data")))
+        if train:
+            raise ValueError(
+                f"train_on_batch with a mesh-compiled model needs the batch "
+                f"({batch[0].shape[0]}) divisible by the mesh's data shards "
+                f"({shards})")
+        return batch
+
+    def train_on_batch(self, x, y) -> Dict[str, float]:
+        """One optimizer step on one batch -> metric dict."""
+        c = self._require_compiled()
+        if self.state is None:
+            self.build(tuple(np.shape(x)[1:]))
+        self.state, metrics = c["train_step"](
+            self.state, self._mesh_batch(x, y, train=True))
+        return {k: float(v) for k, v in metrics.items()}
+
+    def test_on_batch(self, x, y) -> Dict[str, float]:
+        """Loss/metrics on one batch, no state change."""
+        c = self._require_compiled()
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        metrics = c["eval_step"](self.state,
+                                 self._mesh_batch(x, y, train=False))
+        return {k: float(v) for k, v in metrics.items()}
+
+    def predict_on_batch(self, x) -> np.ndarray:
+        return self.predict(np.asarray(x), batch_size=int(np.shape(x)[0]))
 
     def evaluate(self, x, y, batch_size: int = 32,
                  verbose: int = 1) -> Dict[str, float]:

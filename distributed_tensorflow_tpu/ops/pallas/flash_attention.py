@@ -44,8 +44,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref,
                   block_q: int, block_k: int):
     """One (batch, head, q_block, k_block) grid step.
 
-    Refs: q [1,1,bq,d], k/v [1,1,bk,d], valid [1,bk] float (1=real key),
-    o [1,1,bq,d]; scratch acc [bq,d] f32, m/l [bq,1] f32.
+    Refs: q [1,1,bq,d], k/v [1,1,bk,d], valid [1,1,bk] float (1=real key;
+    the singleton middle axis keeps the block's trailing-2 shape (1, bk)
+    equal-or-tiled against Mosaic's (8, 128) rule), o [1,1,bq,d]; scratch
+    acc [bq,d] f32, m/l [bq,1] f32.
     """
     # program_id must be read at kernel top level: the HLO interpreter used
     # off-TPU cannot lower it from inside a pl.when body.
@@ -66,7 +68,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
 
-        valid = valid_ref[0, :] > 0.5                   # [bk]
+        valid = valid_ref[0, 0, :] > 0.5                # [bk]
         logits = jnp.where(valid[None, :], logits, NEG_INF)
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -129,6 +131,7 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
     k = _pad_to(k, 2, bk)
     v = _pad_to(v, 2, bk)
     valid = _pad_to(valid, 1, bk)          # padded keys arrive masked
+    valid = valid[:, None, :]              # [b, 1, sk]: Mosaic-tileable
     sq_p, sk_p = q.shape[2], k.shape[2]
     grid = (b, h, sq_p // bq, sk_p // bk)
 
@@ -144,7 +147,7 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
                          lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
             pl.BlockSpec((1, 1, bk, d),
                          lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
-            pl.BlockSpec((1, bk), lambda ib, ih, iq, ik: (ib, ik)),
+            pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, ik: (ib, 0, ik)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda ib, ih, iq, ik: (ib, ih, iq, 0)),

@@ -196,7 +196,10 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
         seed = jnp.float32(1.0 / num_microbatches)
 
         def fwd_and_loss(p, xin, y_mb):
-            out = stage_fn(p, xin)
+            # cast as the forward sub-tick does: the vjp's `out` cotangent
+            # must be act_dtype or mixed-precision stages (bf16 compute on
+            # f32 carries) reject the incoming bwd_state
+            out = stage_fn(p, xin).astype(act_dtype)
             return out, loss_fn(out, y_mb).astype(jnp.float32)
 
         def tick(carry, t):

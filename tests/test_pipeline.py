@@ -196,3 +196,26 @@ def test_1f1b_sgd_training_converges():
         losses.append(float(loss))
         stacked = jax.tree.map(lambda p, g: p - 0.1 * g, stacked, grads)
     assert losses[-1] < losses[0] * 0.7
+
+
+def test_1f1b_mixed_precision_stage():
+    """bf16-compute stages on f32 carries: the backward's recomputed output
+    must cast to the carry dtype or the cotangent is rejected."""
+    from distributed_tensorflow_tpu.parallel.pipeline import (
+        pipeline_value_and_grad)
+    mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+    stacked = stack_pipeline_params(_stages(4, key=21))
+
+    def bf16_stage(params, x):
+        return jnp.tanh(x.astype(jnp.bfloat16)
+                        @ params["w"].astype(jnp.bfloat16)
+                        + params["b"].astype(jnp.bfloat16))
+
+    x = jax.random.normal(jax.random.PRNGKey(22), (8, HID))
+    y = jax.random.normal(jax.random.PRNGKey(23), (8, HID))
+    loss, grads = pipeline_value_and_grad(
+        bf16_stage, lambda o, yy: ((o.astype(jnp.float32) - yy) ** 2).mean(),
+        stacked, x, y, mesh, num_microbatches=2)
+    assert np.isfinite(float(loss))
+    assert all(bool(jnp.isfinite(g).all())
+               for g in jax.tree_util.tree_leaves(grads))

@@ -377,3 +377,44 @@ def test_csv_logger_append_no_duplicate_header(tmp_path):
     lines = open(path).read().strip().splitlines()
     assert len(lines) == 4                       # 1 header + 3 epoch rows
     assert sum(1 for l in lines if l.startswith("epoch,")) == 1
+
+
+def test_validation_split():
+    (xt, yt), _ = data.xor_data(300, val_size=8, seed=0)
+    model = xor_model()
+    hist = model.fit(xt, yt, epochs=2, batch_size=50, verbose=0,
+                     validation_split=0.2)
+    assert "val_loss" in hist.history and len(hist.history["val_loss"]) == 2
+    import pytest
+    with pytest.raises(ValueError, match="validation_split"):
+        xor_model().fit(xt, yt, epochs=1, verbose=0, validation_split=1.5)
+
+
+def test_on_batch_apis():
+    (xt, yt), _ = data.xor_data(128, val_size=8, seed=0)
+    model = xor_model()
+    m1 = model.train_on_batch(xt[:32], yt[:32])
+    assert "loss" in m1 and np.isfinite(m1["loss"])
+    step_after = int(model.state.step)
+    assert step_after == 1
+    m2 = model.test_on_batch(xt[32:64], yt[32:64])
+    assert "loss" in m2 and int(model.state.step) == 1  # no state change
+    preds = model.predict_on_batch(xt[:16])
+    assert preds.shape == (16, 32)
+
+
+def test_on_batch_with_mesh():
+    from distributed_tensorflow_tpu import parallel
+    import pytest
+    (xt, yt), _ = data.xor_data(128, val_size=8, seed=0)
+    model = models.Sequential([ops.Dense(32, "relu"),
+                               ops.Dense(32, "sigmoid")])
+    model.compile(loss="mse", optimizer="adam",
+                  mesh=parallel.data_parallel_mesh())
+    m = model.train_on_batch(xt[:64], yt[:64])      # divisible by 8
+    assert np.isfinite(m["loss"])
+    with pytest.raises(ValueError, match="divisible"):
+        model.train_on_batch(xt[:12], yt[:12])
+    # eval accepts a non-divisible remainder batch (sharding propagates)
+    m = model.test_on_batch(xt[:12], yt[:12])
+    assert np.isfinite(m["loss"])
