@@ -9,8 +9,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["relu", "sigmoid", "tanh", "gelu", "silu", "softmax",
-           "log_softmax", "identity", "get"]
+__all__ = ["relu", "sigmoid", "hard_sigmoid", "tanh", "gelu", "silu",
+           "softmax", "log_softmax", "identity", "get"]
 
 relu = jax.nn.relu
 sigmoid = jax.nn.sigmoid
@@ -25,9 +25,16 @@ def identity(x):
     return x
 
 
+def hard_sigmoid(x):
+    """Keras-2 hard_sigmoid: clip(0.2x + 0.5, 0, 1) — the piecewise-linear
+    gate activation the reference-era LSTM/GRU default to."""
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
 _REGISTRY = {
     "relu": relu,
     "sigmoid": sigmoid,
+    "hard_sigmoid": hard_sigmoid,
     "tanh": tanh,
     "gelu": gelu,
     "silu": silu,

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 __all__ = ["zeros", "ones", "constant", "normal", "truncated_normal",
            "uniform", "glorot_uniform", "glorot_normal", "he_normal",
-           "he_uniform", "lecun_normal", "get"]
+           "he_uniform", "lecun_normal", "orthogonal", "get"]
 
 
 def zeros(key, shape, dtype=jnp.float32):
@@ -110,6 +110,27 @@ def lecun_normal():
     return _variance_scaling(1.0, "fan_in", "truncated_normal")
 
 
+def orthogonal(scale: float = 1.0):
+    """Orthogonal init via QR of a normal matrix (Keras recurrent-kernel
+    default — keeps recurrent spectra near 1 so long scans don't explode).
+    QR of the (max, min) rectangle, not (max, max): same distribution,
+    min/max-fold cheaper for the wide recurrent kernels this serves."""
+    def init(key, shape, dtype=jnp.float32):
+        if len(shape) < 2:
+            raise ValueError(f"orthogonal needs >= 2 dims, got {shape}")
+        rows = math.prod(shape[:-1])
+        cols = shape[-1]
+        big, small = max(rows, cols), min(rows, cols)
+        a = jax.random.normal(key, (big, small))
+        q, r = jnp.linalg.qr(a)          # q: [big, small], orthonormal cols
+        # sign-correct so the distribution is uniform over orthogonal mats
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if rows < cols:
+            q = q.T
+        return (scale * q).reshape(shape).astype(dtype)
+    return init
+
+
 _REGISTRY = {
     "zeros": zeros,
     "ones": ones,
@@ -118,6 +139,7 @@ _REGISTRY = {
     "he_normal": he_normal(),
     "he_uniform": he_uniform(),
     "lecun_normal": lecun_normal(),
+    "orthogonal": orthogonal(),
 }
 
 

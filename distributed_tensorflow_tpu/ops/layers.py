@@ -36,7 +36,7 @@ from . import initializers as init_lib
 
 __all__ = ["Layer", "Dense", "Dropout", "Flatten", "Activation", "Conv2D",
            "MaxPool2D", "AvgPool2D", "GlobalAvgPool", "BatchNorm",
-           "LayerNorm", "Embedding", "serial", "Stack"]
+           "LayerNorm", "Embedding", "LSTM", "GRU", "serial", "Stack"]
 
 Params = Dict[str, Any]
 State = Dict[str, Any]
@@ -482,6 +482,149 @@ class Embedding(Layer):
     def attend(self, params, x):
         """Tied-softmax logits: x @ E^T (BERT MLM head)."""
         return x @ params["embedding"].T.astype(x.dtype)
+
+
+class _Recurrent(Layer):
+    """Shared recurrent machinery: [b, t, f] -> [b, t, u] or [b, u].
+
+    The time loop is ONE ``lax.scan`` (compiled once, O(1) trace in t);
+    per-step math is a single [b, f+u] x [f+u, gates*u] matmul so the MXU
+    sees one large GEMM per step.  All recurrent arithmetic runs in f32
+    regardless of input dtype (carry stability).  Transformers are the
+    TPU-preferred sequence architecture — these exist for Keras-2 API
+    parity (keras.layers.LSTM/GRU) and small-model workloads.
+    """
+
+    gates = 1
+
+    def __init__(self, units: int, return_sequences: bool = False,
+                 activation="tanh", recurrent_activation="hard_sigmoid",
+                 kernel_init="glorot_uniform",
+                 recurrent_init="orthogonal",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.units = int(units)
+        self.return_sequences = bool(return_sequences)
+        # Keras-2 defaults: tanh candidate/output, hard_sigmoid gates —
+        # weights ported from the reference-era stack reproduce exactly.
+        self.act = act_lib.get(activation)
+        self.rec_act = act_lib.get(recurrent_activation)
+        self.kernel_init = init_lib.get(kernel_init)
+        self.recurrent_init = init_lib.get(recurrent_init)
+        self._raw = dict(activation=activation,
+                         recurrent_activation=recurrent_activation,
+                         kernel_init=kernel_init,
+                         recurrent_init=recurrent_init)
+
+    def get_config(self):
+        return dict(units=self.units,
+                    return_sequences=self.return_sequences,
+                    activation=_by_name(self._raw["activation"],
+                                        "activation", self),
+                    recurrent_activation=_by_name(
+                        self._raw["recurrent_activation"],
+                        "recurrent_activation", self),
+                    kernel_init=_by_name(self._raw["kernel_init"],
+                                         "kernel_init", self),
+                    recurrent_init=_by_name(self._raw["recurrent_init"],
+                                            "recurrent_init", self),
+                    name=self.name)
+
+    def init(self, key, in_shape):
+        t, f = in_shape
+        del t
+        k1, k2 = jax.random.split(key)
+        g = self.gates
+        params = {
+            "kernel": self.kernel_init(k1, (f, g * self.units), jnp.float32),
+            "recurrent_kernel": self.recurrent_init(
+                k2, (self.units, g * self.units), jnp.float32),
+            "bias": self._bias_init(),
+        }
+        return params, {}
+
+    def _bias_init(self):
+        return jnp.zeros((self.gates * self.units,), jnp.float32)
+
+    def out_shape(self, in_shape):
+        t, _ = in_shape
+        return (t, self.units) if self.return_sequences else (self.units,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        u = self.units
+        xf = x.astype(jnp.float32)
+        # Precompute the input projections for ALL steps as one big GEMM
+        # ([b*t, f] @ [f, g*u]) — the scan then only does the [b,u]x[u,g*u]
+        # recurrent matmul per step.
+        xin = xf @ params["kernel"] + params["bias"]
+        xin = jnp.swapaxes(xin, 0, 1)                   # [t, b, g*u]
+        b = x.shape[0]
+        carry0 = self._carry0(b, u)
+
+        def step(carry, x_t):
+            return self._step(params, carry, x_t, u)
+
+        carry, ys = jax.lax.scan(step, carry0, xin)
+        if self.return_sequences:
+            return jnp.swapaxes(ys, 0, 1).astype(x.dtype), state
+        return self._last(carry).astype(x.dtype), state
+
+
+class LSTM(_Recurrent):
+    """Keras-2 LSTM (gate order i, f, c, o; forget bias 1.0)."""
+
+    gates = 4
+
+    def _bias_init(self):
+        u = self.units
+        return jnp.zeros((4 * u,), jnp.float32).at[u:2 * u].set(1.0)
+
+    def _carry0(self, b, u):
+        return (jnp.zeros((b, u), jnp.float32),
+                jnp.zeros((b, u), jnp.float32))
+
+    def _step(self, params, carry, x_t, u):
+        h, c = carry
+        z = x_t + h @ params["recurrent_kernel"]
+        i = self.rec_act(z[:, :u])
+        f = self.rec_act(z[:, u:2 * u])
+        g = self.act(z[:, 2 * u:3 * u])
+        o = self.rec_act(z[:, 3 * u:])
+        c = f * c + i * g
+        h = o * self.act(c)
+        return (h, c), h
+
+    def _last(self, carry):
+        return carry[0]
+
+    def __repr__(self):
+        return f"LSTM({self.units})"
+
+
+class GRU(_Recurrent):
+    """Keras-2 GRU (gate order z, r, h; reset gate applied to the
+    recurrent contribution before the candidate, reset_after=False)."""
+
+    gates = 3
+
+    def _carry0(self, b, u):
+        return jnp.zeros((b, u), jnp.float32)
+
+    def _step(self, params, carry, x_t, u):
+        h = carry
+        rk = params["recurrent_kernel"]
+        rec_zr = h @ rk[:, :2 * u]
+        z = self.rec_act(x_t[:, :u] + rec_zr[:, :u])
+        r = self.rec_act(x_t[:, u:2 * u] + rec_zr[:, u:])
+        hh = self.act(x_t[:, 2 * u:] + (r * h) @ rk[:, 2 * u:])
+        h = z * h + (1.0 - z) * hh
+        return h, h
+
+    def _last(self, carry):
+        return carry
+
+    def __repr__(self):
+        return f"GRU({self.units})"
 
 
 class Stack(Layer):

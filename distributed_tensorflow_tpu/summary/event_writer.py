@@ -167,6 +167,43 @@ def _text_event(wall_time: float, step: int, tag: str, text: str) -> bytes:
             _field_bytes(5, _field_bytes(1, value)))
 
 
+def _wav_encode(samples, sample_rate: int) -> bytes:
+    """Minimal PCM-16 WAV writer ([frames] or [frames, channels] floats in
+    [-1, 1]) — enough for TB audio summaries without an audio library."""
+    import numpy as np
+    a = np.asarray(samples, np.float64)
+    if a.ndim == 1:
+        a = a[:, None]
+    pcm = (np.clip(a, -1.0, 1.0) * 32767.0).astype("<i2")
+    frames, channels = pcm.shape
+    data = pcm.tobytes()
+    byte_rate = sample_rate * channels * 2
+    header = (b"RIFF" + struct.pack("<I", 36 + len(data)) + b"WAVE"
+              + b"fmt " + struct.pack("<IHHIIHH", 16, 1, channels,
+                                      sample_rate, byte_rate,
+                                      channels * 2, 16)
+              + b"data" + struct.pack("<I", len(data)))
+    return header + data
+
+
+def _audio_event(wall_time: float, step: int, tag: str, audio,
+                 sample_rate: int) -> bytes:
+    """Summary.Value{tag=1, audio=6}; Audio{sample_rate=1 (float),
+    num_channels=2, length_frames=3, encoded_audio_string=4,
+    content_type=5} (TF summary.proto)."""
+    import numpy as np
+    a = np.asarray(audio)
+    frames = a.shape[0]
+    channels = 1 if a.ndim == 1 else a.shape[1]
+    proto = (_field_float(1, float(sample_rate))
+             + _field_varint(2, channels) + _field_varint(3, frames)
+             + _field_bytes(4, _wav_encode(a, sample_rate))
+             + _field_bytes(5, b"audio/wav"))
+    value = _field_bytes(1, tag.encode("utf-8")) + _field_bytes(6, proto)
+    return (_field_double(1, wall_time) + _field_varint(2, int(step)) +
+            _field_bytes(5, _field_bytes(1, value)))
+
+
 def _histogram_event(wall_time: float, step: int, tag: str, values) -> bytes:
     # Summary.Value: tag=1, simple_value=2, image=4, histo=5 (TF
     # summary.proto oneof) — histograms MUST land in field 5.
@@ -223,6 +260,15 @@ class EventFileWriter:
             wall_time if wall_time is not None else time.time(),
             int(step), tag, text))
 
+    def add_audio(self, tag: str, audio, sample_rate: int,
+                  step: Union[int, float],
+                  wall_time: Optional[float] = None) -> None:
+        """Audio summary (tf.summary.audio parity): float samples in
+        [-1, 1], [frames] or [frames, channels]; written as PCM-16 WAV."""
+        self._write_record(_audio_event(
+            wall_time if wall_time is not None else time.time(),
+            int(step), tag, audio, int(sample_rate)))
+
     def flush(self) -> None:
         self._file.flush()
 
@@ -268,6 +314,10 @@ class SummaryWriter:
     def add_text(self, tag: str, text: str,
                  step: Union[int, float]) -> None:
         self._writer.add_text(tag, text, step)
+
+    def add_audio(self, tag: str, audio, sample_rate: int,
+                  step: Union[int, float]) -> None:
+        self._writer.add_audio(tag, audio, sample_rate, step)
 
     def flush(self) -> None:
         self._writer.flush()

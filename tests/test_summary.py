@@ -236,3 +236,30 @@ def test_text_summary_roundtrip(tmp_path):
     metadata = parse_event(value[9][0])
     plugin = parse_event(metadata[1][0])
     assert plugin[1] == [b"text"]                # plugin_name
+
+
+def test_audio_summary_roundtrip(tmp_path):
+    """add_audio emits a WAV-encoded Audio proto in Summary.Value field 6."""
+    import numpy as np
+    from distributed_tensorflow_tpu.summary import EventFileWriter
+
+    t = np.linspace(0, 1, 16000, endpoint=False)
+    tone = (0.5 * np.sin(2 * np.pi * 440 * t)).astype("float32")
+    with EventFileWriter(str(tmp_path)) as w:
+        w.add_audio("tone", tone, sample_rate=16000, step=3)
+    import glob
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)
+    event = parse_event(records[1])
+    assert event[2] == [3]
+    summary = parse_event(event[5][0])
+    value = parse_event(summary[1][0])
+    assert value[1] == [b"tone"]
+    audio = parse_event(value[6][0])
+    assert audio[2] == [1] and audio[3] == [16000]   # channels, frames
+    assert audio[5] == [b"audio/wav"]
+    wav = audio[4][0]
+    assert wav[:4] == b"RIFF" and wav[8:12] == b"WAVE"
+    # PCM data round-trips to ~the original samples
+    pcm = np.frombuffer(wav[44:], dtype="<i2").astype(np.float64) / 32767.0
+    np.testing.assert_allclose(pcm, tone, atol=1e-3)
