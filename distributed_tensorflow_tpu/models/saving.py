@@ -32,7 +32,9 @@ LAYER_CLASSES = {
                 layer_lib.Activation, layer_lib.Conv2D, layer_lib.MaxPool2D,
                 layer_lib.AvgPool2D, layer_lib.GlobalAvgPool,
                 layer_lib.BatchNorm, layer_lib.LayerNorm,
-                layer_lib.Embedding, layer_lib.LSTM, layer_lib.GRU)
+                layer_lib.Embedding, layer_lib.LSTM, layer_lib.GRU,
+                layer_lib.Conv1D, layer_lib.DepthwiseConv2D,
+                layer_lib.SeparableConv2D)
 }
 
 

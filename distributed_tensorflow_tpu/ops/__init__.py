@@ -3,15 +3,17 @@
 from . import activations, attention, initializers, losses, metrics, moe
 from .attention import MultiHeadAttention, causal_mask, dot_product_attention
 from .moe import apply_moe, init_moe, moe_partition_rules
-from .layers import (GRU, LSTM, Activation, AvgPool2D, BatchNorm, Conv2D,
-                     Dense, Dropout, Embedding, Flatten, GlobalAvgPool,
-                     Layer, LayerNorm, MaxPool2D, Stack, serial)
+from .layers import (GRU, LSTM, Activation, AvgPool2D, BatchNorm, Conv1D,
+                     Conv2D, Dense, DepthwiseConv2D, Dropout, Embedding,
+                     Flatten, GlobalAvgPool, Layer, LayerNorm, MaxPool2D,
+                     SeparableConv2D, Stack, serial)
 
 __all__ = [
     "activations", "attention", "initializers", "losses", "metrics", "moe",
     "apply_moe", "init_moe", "moe_partition_rules",
     "MultiHeadAttention", "causal_mask", "dot_product_attention",
-    "Activation", "AvgPool2D", "BatchNorm", "Conv2D", "Dense", "Dropout",
-    "Embedding", "Flatten", "GlobalAvgPool", "GRU", "LSTM", "Layer",
-    "LayerNorm", "MaxPool2D", "Stack", "serial",
+    "Activation", "AvgPool2D", "BatchNorm", "Conv1D", "Conv2D", "Dense",
+    "DepthwiseConv2D", "Dropout", "Embedding", "Flatten", "GlobalAvgPool",
+    "GRU", "LSTM", "Layer", "LayerNorm", "MaxPool2D", "SeparableConv2D",
+    "Stack", "serial",
 ]

@@ -35,6 +35,7 @@ from . import activations as act_lib
 from . import initializers as init_lib
 
 __all__ = ["Layer", "Dense", "Dropout", "Flatten", "Activation", "Conv2D",
+           "Conv1D", "DepthwiseConv2D", "SeparableConv2D",
            "MaxPool2D", "AvgPool2D", "GlobalAvgPool", "BatchNorm",
            "LayerNorm", "Embedding", "LSTM", "GRU", "serial", "Stack"]
 
@@ -62,6 +63,14 @@ def _by_name(value, what: str, layer: "Layer"):
 
 def _dtype_name(dtype) -> str:
     return jnp.dtype(dtype).name
+
+
+def _conv_out(size: int, k: int, s: int, padding: str) -> int:
+    """Spatial output extent for SAME/VALID — the one formula every conv
+    and pool variant shares (== Keras floor((t-k)/s)+1 for VALID)."""
+    if padding == "SAME":
+        return -(-size // s)
+    return -(-(size - k + 1) // s)
 
 
 class Layer:
@@ -254,16 +263,11 @@ class Conv2D(Layer):
                 k_bias, (self.filters,), self.param_dtype)
         return params, {}
 
-    def _spatial_out(self, size: int, k: int, s: int) -> int:
-        if self.padding == "SAME":
-            return -(-size // s)
-        return -(-(size - k + 1) // s)
-
     def out_shape(self, in_shape):
         h, w, _ = in_shape
         (kh, kw), (sh, sw) = self.kernel_size, self.strides
-        return (self._spatial_out(h, kh, sh), self._spatial_out(w, kw, sw),
-                self.filters)
+        return (_conv_out(h, kh, sh, self.padding),
+                _conv_out(w, kw, sw, self.padding), self.filters)
 
     def apply(self, params, state, x, *, train=False, rng=None):
         kernel = params["kernel"].astype(x.dtype)
@@ -276,6 +280,207 @@ class Conv2D(Layer):
 
     def __repr__(self):
         return f"Conv2D({self.filters}, {self.kernel_size})"
+
+
+class Conv1D(Layer):
+    """NWC 1-D conv (sequence/temporal features) via the same
+    ``conv_general_dilated`` lowering as Conv2D."""
+
+    def __init__(self, filters: int, kernel_size: int, strides: int = 1,
+                 padding: str = "SAME", activation=None,
+                 use_bias: bool = True, kernel_init="he_normal",
+                 bias_init="zeros", param_dtype=jnp.float32,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = int(filters)
+        self.kernel_size = int(kernel_size)
+        self.strides = int(strides)
+        self.padding = padding
+        self.activation = act_lib.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = init_lib.get(kernel_init)
+        self.bias_init = init_lib.get(bias_init)
+        self.param_dtype = param_dtype
+        self._raw = dict(activation=activation, kernel_init=kernel_init,
+                         bias_init=bias_init)
+
+    def get_config(self):
+        return dict(filters=self.filters, kernel_size=self.kernel_size,
+                    strides=self.strides, padding=self.padding,
+                    activation=_by_name(self._raw["activation"],
+                                        "activation", self),
+                    use_bias=self.use_bias,
+                    kernel_init=_by_name(self._raw["kernel_init"],
+                                         "kernel_init", self),
+                    bias_init=_by_name(self._raw["bias_init"],
+                                       "bias_init", self),
+                    param_dtype=_dtype_name(self.param_dtype),
+                    name=self.name)
+
+    def init(self, key, in_shape):
+        t, c = in_shape
+        del t
+        k_kernel, k_bias = jax.random.split(key)
+        params = {"kernel": self.kernel_init(
+            k_kernel, (self.kernel_size, c, self.filters), self.param_dtype)}
+        if self.use_bias:
+            params["bias"] = self.bias_init(
+                k_bias, (self.filters,), self.param_dtype)
+        return params, {}
+
+    def out_shape(self, in_shape):
+        t, _ = in_shape
+        return (_conv_out(t, self.kernel_size, self.strides, self.padding),
+                self.filters)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        kernel = params["kernel"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, kernel, window_strides=(self.strides,), padding=self.padding,
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self.activation(y), state
+
+    def __repr__(self):
+        return f"Conv1D({self.filters}, {self.kernel_size})"
+
+
+class DepthwiseConv2D(Layer):
+    """Per-channel spatial conv (``feature_group_count = channels``) —
+    the depthwise half of separable convs (MobileNet-style)."""
+
+    def __init__(self, kernel_size, strides=1, padding="SAME",
+                 depth_multiplier: int = 1, activation=None,
+                 use_bias: bool = True, kernel_init="he_normal",
+                 bias_init="zeros", param_dtype=jnp.float32,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = act_lib.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = init_lib.get(kernel_init)
+        self.bias_init = init_lib.get(bias_init)
+        self.param_dtype = param_dtype
+        self._raw = dict(activation=activation, kernel_init=kernel_init,
+                         bias_init=bias_init)
+
+    def get_config(self):
+        return dict(kernel_size=list(self.kernel_size),
+                    strides=list(self.strides), padding=self.padding,
+                    depth_multiplier=self.depth_multiplier,
+                    activation=_by_name(self._raw["activation"],
+                                        "activation", self),
+                    use_bias=self.use_bias,
+                    kernel_init=_by_name(self._raw["kernel_init"],
+                                         "kernel_init", self),
+                    bias_init=_by_name(self._raw["bias_init"],
+                                       "bias_init", self),
+                    param_dtype=_dtype_name(self.param_dtype),
+                    name=self.name)
+
+    def init(self, key, in_shape):
+        _, _, c = in_shape
+        k_kernel, k_bias = jax.random.split(key)
+        kh, kw = self.kernel_size
+        out = c * self.depth_multiplier
+        params = {"kernel": self.kernel_init(
+            k_kernel, (kh, kw, 1, out), self.param_dtype)}
+        if self.use_bias:
+            params["bias"] = self.bias_init(k_bias, (out,), self.param_dtype)
+        return params, {}
+
+    def out_shape(self, in_shape):
+        h, w, c = in_shape
+        (kh, kw), (sh, sw) = self.kernel_size, self.strides
+        return (_conv_out(h, kh, sh, self.padding),
+                _conv_out(w, kw, sw, self.padding),
+                c * self.depth_multiplier)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        kernel = params["kernel"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x, kernel, window_strides=self.strides, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1])
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return self.activation(y), state
+
+    def __repr__(self):
+        return f"DepthwiseConv2D({self.kernel_size})"
+
+
+class SeparableConv2D(Layer):
+    """Depthwise + pointwise factorized conv (Keras SeparableConv2D):
+    ~k^2/filters of the FLOPs of a full conv at similar accuracy."""
+
+    def __init__(self, filters: int, kernel_size, strides=1, padding="SAME",
+                 depth_multiplier: int = 1, activation=None,
+                 use_bias: bool = True, kernel_init="he_normal",
+                 bias_init="zeros", param_dtype=jnp.float32,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.filters = int(filters)
+        self.depthwise = DepthwiseConv2D(
+            kernel_size, strides=strides, padding=padding,
+            depth_multiplier=depth_multiplier, use_bias=False,
+            kernel_init=kernel_init, param_dtype=param_dtype)
+        self.activation = act_lib.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = init_lib.get(kernel_init)
+        self.bias_init = init_lib.get(bias_init)
+        self.param_dtype = param_dtype
+        self._raw = dict(activation=activation, kernel_init=kernel_init,
+                         bias_init=bias_init)
+
+    def get_config(self):
+        d = self.depthwise
+        return dict(filters=self.filters,
+                    kernel_size=list(d.kernel_size),
+                    strides=list(d.strides), padding=d.padding,
+                    depth_multiplier=d.depth_multiplier,
+                    activation=_by_name(self._raw["activation"],
+                                        "activation", self),
+                    use_bias=self.use_bias,
+                    kernel_init=_by_name(self._raw["kernel_init"],
+                                         "kernel_init", self),
+                    bias_init=_by_name(self._raw["bias_init"],
+                                       "bias_init", self),
+                    param_dtype=_dtype_name(self.param_dtype),
+                    name=self.name)
+
+    def init(self, key, in_shape):
+        k_dw, k_pw, k_bias = jax.random.split(key, 3)
+        dw_params, _ = self.depthwise.init(k_dw, in_shape)
+        mid = in_shape[-1] * self.depthwise.depth_multiplier
+        params = {"depthwise": dw_params,
+                  "pointwise": {"kernel": self.kernel_init(
+                      k_pw, (1, 1, mid, self.filters), self.param_dtype)}}
+        if self.use_bias:
+            params["bias"] = self.bias_init(
+                k_bias, (self.filters,), self.param_dtype)
+        return params, {}
+
+    def out_shape(self, in_shape):
+        h, w, _ = self.depthwise.out_shape(in_shape)
+        return (h, w, self.filters)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        y, _ = self.depthwise.apply(params["depthwise"], {}, x)
+        y = lax.conv_general_dilated(
+            y, params["pointwise"]["kernel"].astype(y.dtype),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), state
+
+    def __repr__(self):
+        return f"SeparableConv2D({self.filters})"
 
 
 class _Pool2D(Layer):
@@ -294,9 +499,8 @@ class _Pool2D(Layer):
     def out_shape(self, in_shape):
         h, w, c = in_shape
         (kh, kw), (sh, sw) = self.pool_size, self.strides
-        if self.padding == "SAME":
-            return (-(-h // sh), -(-w // sw), c)
-        return (-(-(h - kh + 1) // sh), -(-(w - kw + 1) // sw), c)
+        return (_conv_out(h, kh, sh, self.padding),
+                _conv_out(w, kw, sw, self.padding), c)
 
     def _reduce(self, x, init, op):
         return lax.reduce_window(

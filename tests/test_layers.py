@@ -220,3 +220,90 @@ def test_keras2_metric_family():
     for name in ("binary_accuracy", "categorical_accuracy", "precision",
                  "recall", "f1", "mae"):
         assert callable(metrics.get(name))
+
+
+def test_conv1d_shapes_and_values():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu import ops
+
+    layer = ops.Conv1D(8, 3, padding="SAME")
+    params, _ = layer.init(jax.random.PRNGKey(0), (10, 4))
+    assert params["kernel"].shape == (3, 4, 8)
+    assert layer.out_shape((10, 4)) == (10, 8)
+    x = jnp.ones((2, 10, 4))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (2, 10, 8)
+    # VALID strides shrink
+    v = ops.Conv1D(8, 3, strides=2, padding="VALID")
+    assert v.out_shape((10, 4)) == (4, 8)
+    # identity-kernel check: kernel_size 1, manually set to identity
+    ident = ops.Conv1D(4, 1, use_bias=False)
+    p, _ = ident.init(jax.random.PRNGKey(0), (5, 4))
+    p = {"kernel": jnp.eye(4)[None]}
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 5, 4), jnp.float32)
+    y, _ = ident.apply(p, {}, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+
+
+def test_depthwise_conv_is_per_channel():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu import ops
+
+    layer = ops.DepthwiseConv2D(3, use_bias=False)
+    params, _ = layer.init(jax.random.PRNGKey(0), (8, 8, 2))
+    assert params["kernel"].shape == (3, 3, 1, 2)
+    # zero one channel's kernel: that output channel must be all zeros
+    k = np.asarray(params["kernel"]).copy()
+    k[..., 1] = 0.0
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 8, 8, 2), jnp.float32)
+    y, _ = layer.apply({"kernel": jnp.asarray(k)}, {}, x)
+    assert float(jnp.abs(y[..., 1]).max()) == 0.0
+    assert float(jnp.abs(y[..., 0]).max()) > 0.0
+
+
+def test_separable_conv_matches_composed():
+    """SeparableConv2D == depthwise then 1x1 pointwise, and has the
+    factorized parameter count."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu import ops
+
+    layer = ops.SeparableConv2D(6, 3, use_bias=False)
+    params, _ = layer.init(jax.random.PRNGKey(0), (8, 8, 4))
+    assert params["depthwise"]["kernel"].shape == (3, 3, 1, 4)
+    assert params["pointwise"]["kernel"].shape == (1, 1, 4, 6)
+    assert layer.out_shape((8, 8, 4)) == (8, 8, 6)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 8, 8, 4), jnp.float32)
+    y, _ = layer.apply(params, {}, x)
+    dw = ops.DepthwiseConv2D(3, use_bias=False)
+    mid, _ = dw.apply(params["depthwise"], {}, x)
+    ref = jax.lax.conv_general_dilated(
+        mid, params["pointwise"]["kernel"], (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+
+def test_new_conv_layers_serialize(tmp_path):
+    import numpy as np
+    from distributed_tensorflow_tpu import models, ops
+
+    model = models.Sequential([
+        ops.SeparableConv2D(8, 3, activation="relu"),
+        ops.DepthwiseConv2D(3),
+        ops.GlobalAvgPool(),
+        ops.Dense(2),
+    ])
+    model.compile(loss="sparse_categorical_crossentropy", optimizer="adam")
+    x = np.random.RandomState(0).randn(16, 8, 8, 3).astype("float32")
+    y = np.random.RandomState(1).randint(0, 2, 16).astype("int32")
+    model.fit(x, y, epochs=1, batch_size=8, verbose=0)
+    path = str(tmp_path / "sep")
+    model.save(path)
+    loaded = models.load_model(path)
+    np.testing.assert_allclose(np.asarray(loaded.predict(x[:4])),
+                               np.asarray(model.predict(x[:4])), atol=1e-6)
