@@ -1,6 +1,6 @@
 """Functional op/layer library (compute tier: everything lowers to XLA HLO)."""
 
-from . import activations, attention, initializers, losses, metrics, moe
+from . import activations, attention, initializers, losses, metrics, moe, quant
 from .attention import MultiHeadAttention, causal_mask, dot_product_attention
 from .moe import apply_moe, init_moe, moe_partition_rules
 from .layers import (GRU, LSTM, Activation, AvgPool2D, BatchNorm, Conv1D,
@@ -10,6 +10,7 @@ from .layers import (GRU, LSTM, Activation, AvgPool2D, BatchNorm, Conv1D,
 
 __all__ = [
     "activations", "attention", "initializers", "losses", "metrics", "moe",
+    "quant",
     "apply_moe", "init_moe", "moe_partition_rules",
     "MultiHeadAttention", "causal_mask", "dot_product_attention",
     "Activation", "AvgPool2D", "BatchNorm", "Conv1D", "Conv2D", "Dense",

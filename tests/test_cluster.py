@@ -111,15 +111,27 @@ def test_two_process_bootstrap_cross_process_psum(tmp_path):
                                 stderr=subprocess.STDOUT, text=True)
 
     # bind-then-close port picking races against other processes; retry on
-    # a fresh port rather than flake
+    # a fresh port rather than flake.  A stolen port can also HANG the
+    # non-coordinator worker, so a timeout is a retryable symptom too (and
+    # both children must be killed, not leaked).
     for _ in range(3):
         with socket.socket() as s:
             s.bind(("localhost", 0))
             port = s.getsockname()[1]
         procs = [launch(0, port), launch(1, port)]
-        outs = [p.communicate(timeout=180)[0] for p in procs]
-        if all(p.returncode == 0 for p in procs):
+        outs = []
+        try:
+            outs = [p.communicate(timeout=180)[0] for p in procs]
+        except subprocess.TimeoutExpired:
+            pass
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        if len(outs) == 2 and all(p.returncode == 0 for p in procs):
             break
+    assert len(outs) == 2, "both workers timed out on every attempt"
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
     # 4 global devices hold [1, 2, 3, 4] -> sum 10 on every process
