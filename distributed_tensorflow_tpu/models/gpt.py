@@ -387,17 +387,29 @@ class GPT:
                  temperature: float = 0.0, rng=None,
                  max_len: Optional[int] = None,
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None) -> jnp.ndarray:
+                 top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 pad_id: Optional[int] = None) -> jnp.ndarray:
         """Autoregressive sampling with the KV cache.
 
         prompt_ids: [b, p] int32.  temperature 0 = greedy; ``top_k`` /
         ``top_p`` filter the sampled distribution (ops.decoding).  Returns
-        [b, p + max_new_tokens].  The whole loop is one ``lax.scan`` (prompt
-        positions are teacher-forced), so generation jits with no per-token
-        recompilation.
+        [b, p + max_new_tokens].  Without ``eos_id`` the whole loop is one
+        ``lax.scan`` (prompt positions are teacher-forced), so generation
+        jits with no per-token recompilation.
+
+        ``eos_id``: rows that sample EOS (after the prompt) are finished —
+        they emit ``pad_id`` (default: ``eos_id``) from then on, and the
+        loop becomes a ``lax.while_loop`` that EXITS EARLY once every row
+        has finished: a batch whose longest answer is 10 tokens pays for
+        10 decode steps, not ``max_new_tokens``.  Output shape stays
+        static ([b, p + max_new_tokens], padded).
         """
         from ..ops import decoding as dec
         c = self.config
+        if pad_id is not None and eos_id is None:
+            raise ValueError("pad_id requires eos_id (nothing finishes "
+                             "without an EOS to detect)")
         b, plen = prompt_ids.shape
         total = plen + max_new_tokens
         max_len = max_len or max(total, 1)
@@ -406,10 +418,12 @@ class GPT:
             rng = jax.random.PRNGKey(0)
         cache = self.init_cache(b, max_len)
         tokens = jnp.zeros((b, total), jnp.int32)
+        if eos_id is not None:
+            pad = eos_id if pad_id is None else pad_id
+            tokens = jnp.full((b, total), pad, jnp.int32)
         tokens = tokens.at[:, :plen].set(prompt_ids)
 
-        def step(carry, i):
-            tokens, cache, rng = carry
+        def advance(tokens, cache, rng, finished, i):
             tok = lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
             logits, cache = self.decode_step(params, cache, tok)
             rng, sub = jax.random.split(rng)
@@ -420,12 +434,37 @@ class GPT:
             target = lax.dynamic_slice_in_dim(
                 tokens, jnp.minimum(i + 1, total - 1), 1, axis=1)[:, 0]
             nxt = jnp.where(inside, target, nxt)  # sample_logits returns int32
+            if eos_id is not None:
+                nxt = jnp.where(finished, pad, nxt)
+                finished = finished | ((nxt == eos_id) & ~inside)
             tokens = lax.dynamic_update_slice_in_dim(
                 tokens, nxt[:, None], i + 1, axis=1)
-            return (tokens, cache, rng), None
+            return tokens, cache, rng, finished
 
-        (tokens, _, _), _ = lax.scan(step, (tokens, cache, rng),
-                                     jnp.arange(total - 1))
+        no_finish = jnp.zeros((b,), bool)
+        if eos_id is None:
+            def step(carry, i):
+                tokens, cache, rng = carry
+                tokens, cache, rng, _ = advance(tokens, cache, rng,
+                                                no_finish, i)
+                return (tokens, cache, rng), None
+
+            (tokens, _, _), _ = lax.scan(step, (tokens, cache, rng),
+                                         jnp.arange(total - 1))
+            return tokens
+
+        def cond(carry):
+            _, _, _, finished, i = carry
+            return (i < total - 1) & ~jnp.all(finished)
+
+        def body(carry):
+            tokens, cache, rng, finished, i = carry
+            tokens, cache, rng, finished = advance(tokens, cache, rng,
+                                                   finished, i)
+            return (tokens, cache, rng, finished, i + 1)
+
+        tokens, _, _, _, _ = lax.while_loop(
+            cond, body, (tokens, cache, rng, no_finish, jnp.int32(0)))
         return tokens
 
     def _check_gen_lengths(self, plen: int, max_new_tokens: int,

@@ -10,8 +10,15 @@ error bounded by scale/2, and every model family's ``apply`` works
 unchanged on ``dequantize_tree`` output.
 
 Symmetric per-channel scheme: ``q = round(w / scale)`` with
-``scale = max|w| / 127`` along every axis except ``axis`` (the output
-channel), so each output channel keeps its own dynamic range.
+``scale = max|w| / 127``.  The default reduction keeps the FIRST and LAST
+axes of >=3-D kernels (and the last axis of matrices): the last axis is
+the output channel, and the first axis of the scanned model families'
+kernels is the ``[L, ...]`` layer-stacking dim — one stack-wide scale
+would let the widest layer set the range for all L, inflating everyone
+else's rounding error, so each layer slice keeps its own scale (at
+O(L x out_channels) extra floats, negligible).  Scale granularity never
+affects correctness (dequantize is elementwise); it only tightens the
+per-slice error bound.
 """
 from __future__ import annotations
 
@@ -31,17 +38,28 @@ class QTensor(NamedTuple):
     scale: jnp.ndarray      # f32, broadcastable against q
 
 
-def quantize_tensor(w: jnp.ndarray, axis: Optional[int] = -1) -> QTensor:
-    """Symmetric int8 quantization; ``axis`` is the per-channel dim
-    (None = one scale for the whole tensor)."""
+def _auto_reduce_axes(ndim: int) -> tuple:
+    """Keep first+last axes of >=3-D kernels ([L, ...] stacks, output
+    channels); matrices keep only the output channel."""
+    if ndim <= 2:
+        return tuple(range(ndim - 1))
+    return tuple(range(1, ndim - 1))
+
+
+def quantize_tensor(w: jnp.ndarray, reduce_axes="auto") -> QTensor:
+    """Symmetric int8 quantization.  ``reduce_axes``: axes the scale's
+    max-reduction runs over — every other axis keeps a per-slice scale.
+    ``"auto"`` (default) applies the module's first+last-keep rule;
+    ``None`` = one scale for the whole tensor."""
     wf = w.astype(jnp.float32)
-    if axis is None:
+    if reduce_axes is None:
         amax = jnp.max(jnp.abs(wf))
         scale = jnp.maximum(amax / 127.0, 1e-12)
     else:
-        reduce_axes = tuple(i for i in range(wf.ndim)
-                            if i != (axis % wf.ndim))
-        amax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
+        if reduce_axes == "auto":
+            reduce_axes = _auto_reduce_axes(wf.ndim)
+        axes = tuple(a % wf.ndim for a in reduce_axes)
+        amax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
         scale = jnp.maximum(amax / 127.0, 1e-12)
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return QTensor(q=q, scale=scale.astype(jnp.float32))
@@ -58,7 +76,7 @@ def _is_quantizable(leaf, min_size: int) -> bool:
 
 
 def quantize_tree(params: Any, min_size: int = 1024,
-                  axis: int = -1) -> Any:
+                  reduce_axes="auto") -> Any:
     """Quantize every float matrix/conv kernel leaf with >= ``min_size``
     elements (biases, norm scales, and tiny tensors stay full precision —
     they are O(channels) and carry the model's calibration-sensitive
@@ -68,7 +86,7 @@ def quantize_tree(params: Any, min_size: int = 1024,
         if isinstance(leaf, QTensor):   # idempotent on re-quantization
             return leaf
         if _is_quantizable(leaf, min_size):
-            return quantize_tensor(leaf, axis=axis)
+            return quantize_tensor(leaf, reduce_axes=reduce_axes)
         return leaf
     return jax.tree.map(visit, params,
                         is_leaf=lambda l: isinstance(l, QTensor))

@@ -5,8 +5,8 @@ from .precision import (DynamicLossScale, Policy, StaticLossScale,
                         attach_loss_scale)
 from .sharded_checkpoint import restore_sharded, save_sharded
 from .hooks import (CheckpointHook, EvalHook, Hook, LoggingHook, NaNHook,
-                    PreemptionHook, ProfilerHook, StopAtStepHook,
-                    SummaryHook, WatchdogHook)
+                    PreemptionHook, ProfilerHook, StepCounterHook,
+                    StopAtStepHook, SummaryHook, WatchdogHook)
 from .session import TrainSession, TrainState
 from .step import (init_train_state, make_custom_train_step, make_eval_step,
                    make_multi_train_step, make_train_step,
@@ -17,7 +17,7 @@ __all__ = ["checkpoint", "hooks", "precision", "sharded_checkpoint",
            "DynamicLossScale", "attach_loss_scale",
            "CheckpointHook", "EvalHook", "Hook",
            "LoggingHook",
-           "NaNHook", "PreemptionHook", "ProfilerHook", "StopAtStepHook",
-           "SummaryHook", "WatchdogHook",
+           "NaNHook", "PreemptionHook", "ProfilerHook", "StepCounterHook",
+           "StopAtStepHook", "SummaryHook", "WatchdogHook",
            "TrainSession", "TrainState", "init_train_state", "make_multi_train_step", "shard_train_state",
            "make_custom_train_step", "make_eval_step", "make_train_step"]

@@ -24,7 +24,7 @@ log = logging.getLogger(__name__)
 
 __all__ = ["Hook", "StopAtStepHook", "CheckpointHook", "SummaryHook",
            "LoggingHook", "NaNHook", "ProfilerHook", "PreemptionHook",
-           "WatchdogHook", "EvalHook"]
+           "WatchdogHook", "EvalHook", "StepCounterHook"]
 
 
 class Hook:
@@ -134,6 +134,24 @@ class SummaryHook(Hook):
         self.writer.flush()
 
 
+class _RateWindow:
+    """Steps/sec over the window since the last reading — the one tracker
+    both LoggingHook and StepCounterHook report from."""
+
+    def __init__(self):
+        self._t0 = time.time()
+        self._step0 = 0
+
+    def reset(self, step: int) -> None:
+        self._t0, self._step0 = time.time(), step
+
+    def rate(self, step: int) -> float:
+        now = time.time()
+        out = (step - self._step0) / max(now - self._t0, 1e-9)
+        self._t0, self._step0 = now, step
+        return out
+
+
 class LoggingHook(Hook):
     """Console progress lines (reference example.py:222-226 prints every
     ``print_rate`` epochs); includes steps/sec like TF's LoggingTensorHook."""
@@ -142,19 +160,15 @@ class LoggingHook(Hook):
                  formatter: Optional[Callable[[int, Dict], str]] = None):
         self.every_steps = max(1, every_steps)
         self.formatter = formatter
-        self._t0 = time.time()
-        self._step0 = 0
+        self._window = _RateWindow()
 
     def begin(self, session) -> None:
-        self._t0 = time.time()
-        self._step0 = session.step
+        self._window.reset(session.step)
 
     def after_step(self, session, metrics) -> None:
         if session.step % self.every_steps:
             return
-        now = time.time()
-        rate = (session.step - self._step0) / max(now - self._t0, 1e-9)
-        self._t0, self._step0 = now, session.step
+        rate = self._window.rate(session.step)
         if self.formatter:
             line = self.formatter(session.step, metrics)
         else:
@@ -163,6 +177,39 @@ class LoggingHook(Hook):
             line = f"step {session.step}: " + ", ".join(parts)
         log.info("%s (%.1f steps/s)", line, rate)
         print(f"{line} ({rate:.1f} steps/s)", flush=True)
+
+
+class StepCounterHook(Hook):
+    """Periodic steps/sec (and examples/sec when ``batch_size`` is given)
+    to a summary writer and/or the log — tf.train.StepCounterHook parity.
+
+    Distinct from LoggingHook: this is the THROUGHPUT channel (its scalars
+    land in TensorBoard under ``steps_per_sec``/``examples_per_sec``),
+    not the metrics console line.
+    """
+
+    def __init__(self, every_steps: int = 100, writer=None,
+                 batch_size: Optional[int] = None):
+        self.every_steps = max(1, every_steps)
+        self.writer = writer
+        self.batch_size = batch_size
+        self._window = _RateWindow()
+
+    def begin(self, session) -> None:
+        self._window.reset(session.step)
+
+    def after_step(self, session, metrics) -> None:
+        if session.step % self.every_steps:
+            return
+        rate = self._window.rate(session.step)
+        scalars = {"steps_per_sec": rate}
+        if self.batch_size:
+            scalars["examples_per_sec"] = rate * self.batch_size
+        if self.writer is not None:
+            self.writer.add_scalars(scalars, session.step)
+        log.info("step %d: %.1f steps/s%s", session.step, rate,
+                 f" ({scalars.get('examples_per_sec', 0):,.0f} ex/s)"
+                 if self.batch_size else "")
 
 
 class NaNHook(Hook):

@@ -120,18 +120,18 @@ def test_two_process_bootstrap_cross_process_psum(tmp_path):
             port = s.getsockname()[1]
         procs = [launch(0, port), launch(1, port)]
         outs = []
-        try:
-            outs = [p.communicate(timeout=180)[0] for p in procs]
-        except subprocess.TimeoutExpired:
-            pass
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-                    p.communicate()
-        if len(outs) == 2 and all(p.returncode == 0 for p in procs):
+        for p in procs:
+            try:
+                outs.append(p.communicate(timeout=180)[0])
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate()[0] + "\n<TIMED OUT>")
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        if all(p.returncode == 0 for p in procs):
             break
-    assert len(outs) == 2, "both workers timed out on every attempt"
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
     # 4 global devices hold [1, 2, 3, 4] -> sum 10 on every process

@@ -452,3 +452,45 @@ def test_gqa_shard_kv_override():
                            m.partition_rules(shard_kv=True))
     spec = sharded["decoder"]["attention"]["key"]["kernel"].sharding.spec
     assert "tensor" in str(spec)  # 2 kv heads shard over tensor=2
+
+
+def test_generate_eos_early_stop():
+    """eos_id: finished rows pad; the while_loop path matches the scan
+    path token-for-token up to each row's EOS."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    g = gpt_tiny(dropout_rate=0.0)
+    params = g.init(jax.random.PRNGKey(0))
+    prompt = jnp.ones((2, 3), jnp.int32)
+    # greedy: scan path and eos path must agree before any EOS is hit
+    base = g.generate(params, prompt, max_new_tokens=6)
+    # use an id that greedy decoding never emits in `base`
+    emitted = set(np.asarray(base[:, 3:]).ravel().tolist())
+    eos_free = next(i for i in range(g.config.vocab_size)
+                    if i not in emitted)
+    out = g.generate(params, prompt, max_new_tokens=6, eos_id=eos_free)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+    # now force an immediate EOS: the very first sampled token
+    first = int(base[0, 3])
+    out2 = g.generate(params, prompt, max_new_tokens=6, eos_id=first,
+                      pad_id=0)
+    row = np.asarray(out2[0, 3:])
+    assert row[0] == first            # the EOS token itself is kept
+    assert (row[1:] == 0).all()       # everything after is pad
+
+
+def test_generate_eos_jits():
+    import jax
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    g = gpt_tiny(dropout_rate=0.0)
+    params = g.init(jax.random.PRNGKey(0))
+    fn = jax.jit(lambda p, ids: g.generate(p, ids, max_new_tokens=4,
+                                           eos_id=5, pad_id=0))
+    out = fn(params, jnp.ones((2, 3), jnp.int32))
+    assert out.shape == (2, 7)

@@ -18,7 +18,7 @@ def test_quantize_roundtrip_error_bound():
     bound = np.asarray(t.scale) / 2 + 1e-6
     assert (err <= bound).all()
     # per-tensor mode
-    t2 = quant.quantize_tensor(w, axis=None)
+    t2 = quant.quantize_tensor(w, reduce_axes=None)
     assert t2.scale.shape == ()
 
 
@@ -83,3 +83,40 @@ def test_quantize_tree_idempotent():
     np.testing.assert_array_equal(np.asarray(once["w"].q),
                                   np.asarray(twice["w"].q))
     quant.dequantize_tree(twice)   # still dequantizes cleanly
+
+
+def test_stacked_kernels_get_per_layer_scales():
+    """Scanned model families stack kernels on a leading L axis; each
+    layer slice (and head) must quantize against ITS OWN max, not the
+    stack-wide one."""
+    k1 = jax.random.normal(jax.random.PRNGKey(0), (16, 32)) * 0.1
+    k2 = jax.random.normal(jax.random.PRNGKey(1), (16, 32)) * 10.0
+    stacked = jnp.stack([k1, k2])                      # [L=2, in, out]
+    t = quant.quantize_tensor(stacked)       # auto: keep first+last axes
+    assert t.scale.shape == (2, 1, 32)
+    # layer 0's scale reflects its own small range, ~100x below layer 1's
+    s0 = float(np.asarray(t.scale)[0].max())
+    s1 = float(np.asarray(t.scale)[1].max())
+    assert s1 / s0 > 20
+    # per-slice rounding error bound holds for the SMALL layer too
+    back = quant.dequantize_tensor(t)
+    err0 = np.abs(np.asarray(back)[0] - np.asarray(stacked)[0])
+    assert (err0 <= np.asarray(t.scale)[0] / 2 + 1e-6).all()
+
+
+def test_quantized_gpt_generates():
+    """4-D attention kernels ([L, d, h, hd]) quantize per layer/head and
+    the quantized model still generates identically-shaped output."""
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+    g = gpt_tiny(dropout_rate=0.0)
+    params = g.init(jax.random.PRNGKey(0))
+    qk = quant.quantize_tree(params, min_size=512)
+    qkv = qk["decoder"]["attention"]["query"]["kernel"]
+    # [L, d, h, hd] kernel: per-layer + per-hd-channel scales, d/h reduced
+    assert isinstance(qkv, quant.QTensor)
+    L, d, h, hd = qkv.q.shape
+    assert qkv.scale.shape == (L, 1, 1, hd)
+    assert L == g.config.num_layers
+    deq = quant.dequantize_tree(qk)
+    out = g.generate(deq, jnp.ones((1, 3), jnp.int32), max_new_tokens=4)
+    assert out.shape == (1, 7)

@@ -271,3 +271,36 @@ def test_eval_hook_runs_periodically_and_at_end():
     assert len(calls) == 3
     assert hook.last_metrics is not None
     assert set(hook.last_metrics) == {"val_loss", "val_acc"}
+
+
+def test_step_counter_hook(tmp_path):
+    """StepCounterHook writes steps_per_sec/examples_per_sec scalars
+    (tf.train.StepCounterHook parity)."""
+    import jax
+    from distributed_tensorflow_tpu import data, models, optim, summary, train
+
+    model = models.xor_mlp()
+    opt = optim.adam()
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    step = train.make_train_step(model, "mse", opt)
+    (xt, yt), _ = data.xor_data(200, val_size=8, seed=0)
+    writer = summary.SummaryWriter(str(tmp_path))
+    with train.TrainSession(state, step,
+                            hooks=[train.StopAtStepHook(6),
+                                   train.StepCounterHook(
+                                       every_steps=2, writer=writer,
+                                       batch_size=50)]) as sess:
+        while not sess.should_stop():
+            sess.run_step((xt[:50], yt[:50]))
+    writer.close()
+    import glob
+    from tests.test_summary import parse_event, read_records
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)
+    tags = []
+    for rec in records[1:]:
+        ev = parse_event(rec)
+        summ = parse_event(ev[5][0])
+        for v in summ.get(1, []):
+            tags.append(parse_event(v)[1][0])
+    assert b"steps_per_sec" in tags and b"examples_per_sec" in tags
