@@ -6,7 +6,7 @@ devices are available (the real TPU chip under the driver; the virtual CPU
 mesh in tests), plus a convergence gate (final eval accuracy must clear 0.9
 on the synthetic set or the result is reported as failed).
 
-Other BASELINE configs: ``python bench.py --config=cifar_cnn|resnet50|bert``
+Other configs: ``python bench.py --config=cifar_cnn|resnet50|bert|gpt``
 measure those rows (same JSON shape; resnet50/bert are throughput+finite-loss
 benches, no convergence gate).  ``DTTPU_BENCH_SMOKE=1`` shrinks model/batch
 sizes so every config path smoke-runs on the CPU mesh.
@@ -427,11 +427,64 @@ def bench_mnist_mlp():
     }
 
 
+def bench_gpt():
+    """Causal-LM training throughput (tokens/s/chip) on a GPT-2-small-
+    shaped decoder, bf16, adamw — the LM-family row next to BERT's MLM."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import optim, train, parallel
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+
+    n_chips = len(jax.devices())
+    mesh = parallel.data_parallel_mesh()
+    seq = 256
+    config = (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=2, intermediate_size=512,
+                        max_position=seq, dtype=jnp.bfloat16,
+                        dropout_rate=0.0) if SMOKE
+              else GPTConfig(vocab_size=50257, hidden_size=768,
+                             num_layers=12, num_heads=12,
+                             intermediate_size=3072, max_position=seq,
+                             dtype=jnp.bfloat16, dropout_rate=0.0))
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    optimizer = optim.adamw(1e-4)
+    step = train.make_custom_train_step(model.lm_loss_fn(), optimizer,
+                                        grad_clip_norm=1.0)
+    rng = np.random.default_rng(0)
+    bsh = NamedSharding(mesh, P("data"))
+
+    def build(batch):
+        state = train.TrainState.create(params, optimizer.init(params))
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        tokens = rng.integers(0, config.vocab_size,
+                              (batch, seq + 1)).astype(np.int32)
+        # lm_loss_fn shifts internally: inputs ids[:, :-1], targets [:, 1:]
+        bench_batch = jax.device_put({"input_ids": tokens}, bsh)
+        return state, bench_batch
+
+    rate, loss, ms, batch = _run_batch_ladder(
+        "gpt", [4] if SMOKE else [48, 24, 12], mesh, build, step,
+        warmup=2, steps=4 if SMOKE else 10)
+    tokens_s = rate * batch * seq / n_chips
+    log(f"gpt: {tokens_s:,.0f} tokens/s/chip ({ms*1e3:.1f} ms/step, "
+        f"loss={loss:.3f})")
+    finite = np.isfinite(loss)
+    return dict(metric="gpt_lm_train_tokens_per_sec_per_chip"
+                       + ("" if finite else "_NONFINITE_LOSS"),
+                value=round(tokens_s, 1), unit="tokens/sec/chip",
+                vs_baseline=1.0,  # no reference-era GPT baseline exists
+                seq_len=seq, batch=batch)
+
+
 CONFIGS = {
     "mnist_mlp": bench_mnist_mlp,
     "cifar_cnn": bench_cifar_cnn,
     "resnet50": bench_resnet50,
     "bert": bench_bert,
+    "gpt": bench_gpt,
 }
 
 

@@ -22,7 +22,7 @@ from typing import Any, Dict, Optional
 from ..ops import layers as layer_lib
 
 __all__ = ["model_to_config", "model_from_config", "save_model",
-           "load_model", "LAYER_CLASSES"]
+           "load_model", "build_layer", "LAYER_CLASSES"]
 
 # Every serializable layer class, keyed by class name (the Keras
 # ``class_name`` convention).
@@ -38,15 +38,33 @@ LAYER_CLASSES = {
 }
 
 
+def _check_spec(spec: Dict[str, Any]) -> None:
+    name = spec["class_name"]
+    if name == "Stack":
+        for sub in spec["config"]["layers"]:
+            _check_spec(sub)
+        return
+    if name not in LAYER_CLASSES:
+        raise ValueError(
+            f"{name} is not a registered serializable layer "
+            f"(known: {sorted(LAYER_CLASSES)} + Stack)")
+
+
+def build_layer(spec: Dict[str, Any]):
+    """One layer from its {class_name, config} spec; Stack recurses (zoo
+    models are Stacks, so they serialize through Sequential too)."""
+    name, cfg = spec["class_name"], spec["config"]
+    if name == "Stack":
+        return layer_lib.Stack([build_layer(s) for s in cfg["layers"]],
+                               name=cfg.get("name"))
+    return LAYER_CLASSES[name](**cfg)
+
+
 def model_to_config(model) -> Dict[str, Any]:
     """Sequential -> JSON-able dict (architecture + compile + input shape)."""
-    layers = [{"class_name": type(l).__name__, "config": l.get_config()}
-              for l in model._layers]
+    layers = [layer_lib.layer_spec(l) for l in model._layers]
     for spec in layers:
-        if spec["class_name"] not in LAYER_CLASSES:
-            raise ValueError(
-                f"{spec['class_name']} is not a registered serializable "
-                f"layer (known: {sorted(LAYER_CLASSES)})")
+        _check_spec(spec)
     cfg: Dict[str, Any] = {"format": "dttpu-sequential-v1",
                            "name": model.name, "layers": layers}
     if model._compile_config is not None:
@@ -63,8 +81,7 @@ def model_from_config(cfg: Dict[str, Any]):
     if cfg.get("format") != "dttpu-sequential-v1":
         raise ValueError(f"not a saved Sequential config: "
                          f"format={cfg.get('format')!r}")
-    layers = [LAYER_CLASSES[spec["class_name"]](**spec["config"])
-              for spec in cfg["layers"]]
+    layers = [build_layer(spec) for spec in cfg["layers"]]
     model = Sequential(layers, name=cfg.get("name", "sequential"))
     compile_cfg = cfg.get("compile")
     if compile_cfg is not None:

@@ -34,7 +34,8 @@ from jax import lax
 from . import activations as act_lib
 from . import initializers as init_lib
 
-__all__ = ["Layer", "Dense", "Dropout", "Flatten", "Activation", "Conv2D",
+__all__ = ["Layer", "layer_spec",
+           "Dense", "Dropout", "Flatten", "Activation", "Conv2D",
            "Conv1D", "DepthwiseConv2D", "SeparableConv2D",
            "MaxPool2D", "AvgPool2D", "GlobalAvgPool", "BatchNorm",
            "LayerNorm", "Embedding", "LSTM", "GRU", "serial", "Stack"]
@@ -63,6 +64,12 @@ def _by_name(value, what: str, layer: "Layer"):
 
 def _dtype_name(dtype) -> str:
     return jnp.dtype(dtype).name
+
+
+def layer_spec(layer: "Layer") -> Dict[str, Any]:
+    """The one {class_name, config} serialization spec shape — shared by
+    Stack.get_config and models.saving.model_to_config."""
+    return {"class_name": type(layer).__name__, "config": layer.get_config()}
 
 
 def _conv_out(size: int, k: int, s: int, padding: str) -> int:
@@ -845,6 +852,10 @@ class Stack(Layer):
             n = counts.get(base, 0)
             counts[base] = n + 1
             self.keys.append(base if n == 0 else f"{base}_{n}")
+
+    def get_config(self):
+        return dict(layers=[layer_spec(l) for l in self.layers],
+                    name=self.name)
 
     def init(self, key, in_shape):
         params, state = {}, {}

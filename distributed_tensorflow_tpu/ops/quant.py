@@ -38,11 +38,15 @@ class QTensor(NamedTuple):
     scale: jnp.ndarray      # f32, broadcastable against q
 
 
-def _auto_reduce_axes(ndim: int) -> tuple:
+def _auto_reduce_axes(ndim: int) -> Optional[tuple]:
     """Keep first+last axes of >=3-D kernels ([L, ...] stacks, output
-    channels); matrices keep only the output channel."""
-    if ndim <= 2:
-        return tuple(range(ndim - 1))
+    channels); matrices keep only the output channel; scalars/vectors get
+    one whole-tensor scale (per-element scales would be larger than the
+    f32 input)."""
+    if ndim <= 1:
+        return None
+    if ndim == 2:
+        return (0,)
     return tuple(range(1, ndim - 1))
 
 
@@ -52,12 +56,12 @@ def quantize_tensor(w: jnp.ndarray, reduce_axes="auto") -> QTensor:
     ``"auto"`` (default) applies the module's first+last-keep rule;
     ``None`` = one scale for the whole tensor."""
     wf = w.astype(jnp.float32)
+    if reduce_axes == "auto":
+        reduce_axes = _auto_reduce_axes(wf.ndim)
     if reduce_axes is None:
         amax = jnp.max(jnp.abs(wf))
         scale = jnp.maximum(amax / 127.0, 1e-12)
     else:
-        if reduce_axes == "auto":
-            reduce_axes = _auto_reduce_axes(wf.ndim)
         axes = tuple(a % wf.ndim for a in reduce_axes)
         amax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
         scale = jnp.maximum(amax / 127.0, 1e-12)

@@ -120,16 +120,20 @@ def test_two_process_bootstrap_cross_process_psum(tmp_path):
             port = s.getsockname()[1]
         procs = [launch(0, port), launch(1, port)]
         outs = []
-        for p in procs:
-            try:
-                outs.append(p.communicate(timeout=180)[0])
-            except subprocess.TimeoutExpired:
-                p.kill()
-                outs.append(p.communicate()[0] + "\n<TIMED OUT>")
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+        try:
+            for p in procs:
+                try:
+                    outs.append(p.communicate(timeout=180)[0])
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    outs.append(p.communicate()[0] + "\n<TIMED OUT>")
+        finally:
+            # exception-safe: no child survives this attempt, whatever
+            # interrupted it (pytest-timeout, KeyboardInterrupt, ...)
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
         if all(p.returncode == 0 for p in procs):
             break
     for p, out in zip(procs, outs):

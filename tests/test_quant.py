@@ -120,3 +120,14 @@ def test_quantized_gpt_generates():
     deq = quant.dequantize_tree(qk)
     out = g.generate(deq, jnp.ones((1, 3), jnp.int32), max_new_tokens=4)
     assert out.shape == (1, 7)
+
+
+def test_vector_quantization_gets_whole_tensor_scale():
+    """1-D inputs through the public API must not get degenerate
+    per-element scales (which would be bigger than the f32 input)."""
+    v = jax.random.normal(jax.random.PRNGKey(0), (128,))
+    t = quant.quantize_tensor(v)
+    assert t.scale.shape == ()
+    back = quant.dequantize_tensor(t)
+    err = np.abs(np.asarray(back) - np.asarray(v))
+    assert (err <= float(t.scale) / 2 + 1e-6).all()

@@ -418,3 +418,24 @@ def test_on_batch_with_mesh():
     # eval accepts a non-divisible remainder batch (sharding propagates)
     m = model.test_on_batch(xt[:12], yt[:12])
     assert np.isfinite(m["loss"])
+
+
+def test_zoo_stack_serializes_through_sequential(tmp_path):
+    """zoo models are Stacks; Sequential([stack]) round-trips through
+    model.save via nested Stack specs."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8, 8, 3).astype("float32")
+    y = rng.randint(0, 10, 32).astype("int32")
+    inner = models.Sequential([models.cifar_cnn(num_classes=10)])
+    inner.compile(loss="sparse_categorical_crossentropy", optimizer="adam")
+    inner.fit(x, y, epochs=1, batch_size=16, verbose=0)
+    path = str(tmp_path / "zoo")
+    inner.save(path)
+    loaded = models.load_model(path)
+    np.testing.assert_allclose(np.asarray(loaded.predict(x[:4])),
+                               np.asarray(inner.predict(x[:4])), atol=1e-6)
+    import json
+    spec = json.load(open(path + "/model.json"))
+    assert spec["layers"][0]["class_name"] == "Stack"
+    nested = spec["layers"][0]["config"]["layers"]
+    assert nested[0]["class_name"] == "Conv2D"
