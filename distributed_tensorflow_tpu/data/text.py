@@ -110,6 +110,12 @@ class BPETokenizer:
                          enumerate(self.specials_names)}
         self._ranks: Dict[Tuple[int, int], int] = {
             tuple(pair): r for r, pair in enumerate(self.merges)}
+        # cached int32 [n_merges, 2] for the native encoder (merges are
+        # immutable after construction; per-call conversion would dominate
+        # short-text encodes)
+        self._merge_array = (np.asarray(self.merges, np.int32)
+                             if self.merges
+                             else np.zeros((0, 2), np.int32))
         # id -> byte expansion, for decode
         self._expand: Dict[int, bytes] = {i: bytes([i]) for i in range(256)}
         for r, (a, b) in enumerate(self.merges):
@@ -163,21 +169,40 @@ class BPETokenizer:
         return cls(merges, specials)
 
     def encode(self, text: str, bos: bool = False,
-               eos: bool = False) -> np.ndarray:
-        s = list(text.encode("utf-8"))
-        while len(s) > 1:
-            # the lowest-rank applicable merge, applied everywhere
-            ranked = [(self._ranks[p], p) for p in set(zip(s, s[1:]))
-                      if p in self._ranks]
-            if not ranked:
-                break
-            rank, pair = min(ranked)
-            s = _apply_merge(s, pair, self._base + rank)
+               eos: bool = False, backend: str = "auto") -> np.ndarray:
+        """``backend``: "auto" uses the native C++ encoder when the
+        library is built (identical segmentation, ~25x faster on long
+        text), falling back to Python; "native" requires it; "python"
+        forces the reference loop."""
+        if backend not in ("auto", "native", "python"):
+            raise ValueError(f"unknown backend {backend!r}")
+        raw = text.encode("utf-8")
+        ids: Optional[np.ndarray] = None
+        if backend in ("auto", "native") and self.merges:
+            from ..utils import native
+            if native.native_available():
+                ids = native.bpe_encode(raw, self._merge_array, self._base)
+            elif backend == "native":
+                raise RuntimeError("backend='native' but the native "
+                                   "library is unavailable")
+        if ids is None:
+            s = list(raw)
+            while len(s) > 1:
+                # the lowest-rank applicable merge, applied everywhere
+                ranked = [(self._ranks[p], p) for p in set(zip(s, s[1:]))
+                          if p in self._ranks]
+                if not ranked:
+                    break
+                rank, pair = min(ranked)
+                s = _apply_merge(s, pair, self._base + rank)
+            ids = np.asarray(s, np.int32)
+        parts = []
         if bos:
-            s = [self.bos_id] + s
+            parts.append(np.asarray([self.bos_id], np.int32))
+        parts.append(ids)
         if eos:
-            s = s + [self.eos_id]
-        return np.asarray(s, np.int32)
+            parts.append(np.asarray([self.eos_id], np.int32))
+        return np.concatenate(parts) if len(parts) > 1 else ids
 
     def decode(self, ids) -> str:
         out = b"".join(self._expand_id(i) for i in np.asarray(ids).ravel()

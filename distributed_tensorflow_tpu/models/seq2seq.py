@@ -264,24 +264,36 @@ class Seq2Seq:
     def generate(self, params, src_ids, max_new_tokens: int,
                  bos_id: int = 0, temperature: float = 0.0, rng=None,
                  src_valid=None, top_k: Optional[int] = None,
-                 top_p: Optional[float] = None) -> jnp.ndarray:
+                 top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None,
+                 pad_id: Optional[int] = None) -> jnp.ndarray:
         """Greedy/sampled decode: encode once, then one ``lax.scan`` over
         target positions (full decoder recompute per step — O(t²) but
         cache-free and jittable at any length; fine at eval scale).
-        Returns [b, max_new_tokens] (BOS not included)."""
+        Returns [b, max_new_tokens] (BOS not included).
+
+        ``eos_id``: rows that emit EOS are finished — they pad with
+        ``pad_id`` (default: ``eos_id``) and the loop becomes a
+        ``lax.while_loop`` exiting once every row finished (the GPT
+        ``generate`` early-exit, see models/gpt.py).
+        """
         c = self.config
         if max_new_tokens > c.max_position:
             raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
                              f"max_position {c.max_position}")
+        if pad_id is not None and eos_id is None:
+            raise ValueError("pad_id requires eos_id")
         from ..ops import decoding as dec
         if rng is None:
             rng = jax.random.PRNGKey(0)
         b = src_ids.shape[0]
         memory = self.encode(params, src_ids, src_valid)
+        pad = eos_id if eos_id is not None and pad_id is None else pad_id
+        # BOS everywhere keeps the scan path identical; the eos path's
+        # untouched tail positions are overwritten with pad on the fly.
         tgt = jnp.full((b, max_new_tokens + 1), bos_id, jnp.int32)
 
-        def step(carry, i):
-            tgt, rng = carry
+        def advance(tgt, rng, finished, i):
             hidden = self.decode(params, memory, tgt[:, :-1], src_valid)
             # select the d-wide row FIRST, project only it to vocab
             row = jnp.take_along_axis(
@@ -290,12 +302,39 @@ class Seq2Seq:
             rng, sub = jax.random.split(rng)
             nxt = dec.sample_logits(sub, logits, temperature,
                                     top_k=top_k, top_p=top_p)
+            if eos_id is not None:
+                nxt = jnp.where(finished, pad, nxt)
+                finished = finished | (nxt == eos_id)
             tgt = lax.dynamic_update_slice_in_dim(
                 tgt, nxt[:, None], i + 1, axis=1)
-            return (tgt, rng), None
+            return tgt, rng, finished
 
-        (tgt, _), _ = lax.scan(step, (tgt, rng),
-                               jnp.arange(max_new_tokens))
+        no_finish = jnp.zeros((b,), bool)
+        if eos_id is None:
+            def step(carry, i):
+                tgt, rng = carry
+                tgt, rng, _ = advance(tgt, rng, no_finish, i)
+                return (tgt, rng), None
+
+            (tgt, _), _ = lax.scan(step, (tgt, rng),
+                                   jnp.arange(max_new_tokens))
+            return tgt[:, 1:]
+
+        def cond(carry):
+            _, _, finished, i = carry
+            return (i < max_new_tokens) & ~jnp.all(finished)
+
+        def body(carry):
+            tgt, rng, finished, i = carry
+            tgt, rng, finished = advance(tgt, rng, finished, i)
+            return (tgt, rng, finished, i + 1)
+
+        tgt, _, finished, stop_i = lax.while_loop(
+            cond, body, (tgt, rng, no_finish, jnp.int32(0)))
+        # early exit leaves the tail at bos_id — pad it explicitly
+        pos = jnp.arange(1, max_new_tokens + 1)[None, :]
+        tgt = tgt.at[:, 1:].set(
+            jnp.where(pos > stop_i, pad, tgt[:, 1:]))
         return tgt[:, 1:]
 
     def beam_search(self, params, src_ids, max_new_tokens: int,

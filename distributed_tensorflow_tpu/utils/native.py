@@ -55,6 +55,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dt_loader_batches_per_epoch.argtypes = [ctypes.c_void_p]
     lib.dt_loader_destroy.restype = None
     lib.dt_loader_destroy.argtypes = [ctypes.c_void_p]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.dt_bpe_encode.restype = ctypes.c_int64
+    lib.dt_bpe_encode.argtypes = [u8p, ctypes.c_int64, i32p,
+                                  ctypes.c_int64, ctypes.c_int32,
+                                  i32p, ctypes.c_int64]
     return lib
 
 
@@ -125,6 +130,25 @@ def masked_crc32c(data: bytes) -> int:
 
 def _f32p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def bpe_encode(data: bytes, merge_pairs: np.ndarray,
+               base_id: int) -> np.ndarray:
+    """Native BPE encode: ``merge_pairs`` [n_merges, 2] int32 in rank
+    order; returns int32 ids (bytes + base_id+rank merged tokens).  Exact
+    same segmentation as the Python loop in data.text."""
+    lib = load_native()
+    assert lib is not None
+    arr = np.frombuffer(data, np.uint8)
+    pairs = np.ascontiguousarray(merge_pairs, np.int32)
+    out = np.empty(max(len(arr), 1), np.int32)
+    n = lib.dt_bpe_encode(
+        _u8p(arr) if len(arr) else _u8(b"\0"), len(arr),
+        pairs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        pairs.shape[0], int(base_id),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), out.shape[0])
+    assert n >= 0
+    return out[:n].copy()
 
 
 def xor_generate(n: int, bits: int = 32,

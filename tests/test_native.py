@@ -141,3 +141,47 @@ def test_loader_stress_many_threads_and_epochs():
         rows = np.concatenate([xb[0].ravel() for xb in ld])
         assert len(np.unique(rows)) == n
     ld.close()
+
+
+def test_native_bpe_matches_python():
+    """dt_bpe_encode produces the exact segmentation of the Python loop
+    (rank-greedy, left-to-right non-overlapping) on trained merges."""
+    import numpy as np
+    import pytest
+    from distributed_tensorflow_tpu.data.text import BPETokenizer
+    from distributed_tensorflow_tpu.utils import native
+
+    if not native.native_available():
+        pytest.skip("native library unavailable")
+    corpus = ["the quick brown fox jumps over the lazy dog " * 20,
+              "pack my box with five dozen liquor jugs " * 20]
+    tok = BPETokenizer.train(corpus, vocab_size=300)
+    assert tok.merges   # learned something
+    for text in corpus + ["the fox", "zzz unseen bytes éü",
+                          "", "a"]:
+        py = tok.encode(text, backend="python")
+        nat = tok.encode(text, backend="auto")
+        np.testing.assert_array_equal(np.asarray(nat), np.asarray(py))
+        # and both decode back to the input
+        assert tok.decode(nat) == text
+
+
+def test_native_bpe_bos_eos_and_speed():
+    import time
+    import numpy as np
+    import pytest
+    from distributed_tensorflow_tpu.data.text import BPETokenizer
+    from distributed_tensorflow_tpu.utils import native
+
+    if not native.native_available():
+        pytest.skip("native library unavailable")
+    tok = BPETokenizer.train(["ababababab abab " * 50], vocab_size=270)
+    out = tok.encode("abab", bos=True, eos=True)
+    assert out[0] == tok.bos_id and out[-1] == tok.eos_id
+    # the native path should not be slower on a long text
+    text = "ababababab abab " * 2000
+    t0 = time.perf_counter(); tok.encode(text, backend="python")
+    t_py = time.perf_counter() - t0
+    t0 = time.perf_counter(); tok.encode(text, backend="auto")
+    t_nat = time.perf_counter() - t0
+    assert t_nat < t_py * 1.5   # loose: just prove it's wired + not broken

@@ -182,3 +182,31 @@ def test_beam_search_eos_stops_and_jits():
         hits = np.flatnonzero(row == 7)
         if hits.size:
             assert (row[hits[0]:] == 7).all(), row
+
+
+def test_generate_eos_early_stop_and_padding():
+    """eos_id pads finished rows and the while_loop path matches the scan
+    path before any EOS appears."""
+    import numpy as np
+    from distributed_tensorflow_tpu.models.seq2seq import seq2seq_tiny
+
+    s = seq2seq_tiny(dropout_rate=0.0)
+    params = s.init(jax.random.PRNGKey(0))
+    src = jnp.ones((2, 4), jnp.int32)
+    base = s.generate(params, src, max_new_tokens=5)
+    emitted = set(np.asarray(base).ravel().tolist())
+    eos_free = next(i for i in range(s.config.vocab_size)
+                    if i not in emitted)
+    out = s.generate(params, src, max_new_tokens=5, eos_id=eos_free)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+    # force immediate EOS: first emitted token of row 0
+    first = int(base[0, 0])
+    out2 = s.generate(params, src, max_new_tokens=5, eos_id=first,
+                      pad_id=0)
+    row = np.asarray(out2[0])
+    assert row[0] == first
+    assert (row[1:] == 0).all()
+    # misuse is loud
+    import pytest
+    with pytest.raises(ValueError, match="pad_id requires eos_id"):
+        s.generate(params, src, max_new_tokens=3, pad_id=0)

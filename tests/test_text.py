@@ -52,3 +52,21 @@ def test_bpe_feeds_lm_pipeline():
     ids = t.encode("hello world " * 40)
     rows = lm_sequences(ids, seq_len=8)
     assert rows.dtype == np.int32 and rows.shape[1] == 9
+
+
+def test_encode_backend_validation():
+    import pytest
+    from distributed_tensorflow_tpu.data.text import BPETokenizer
+    tok = BPETokenizer.train(["ab ab ab ab"], vocab_size=262)
+    with pytest.raises(ValueError, match="unknown backend"):
+        tok.encode("ab", backend="Auto")
+    # backend="native" either runs the C++ encoder or raises loudly
+    from distributed_tensorflow_tpu.utils import native
+    if native.native_available():
+        import numpy as np
+        np.testing.assert_array_equal(
+            tok.encode("ab ab", backend="native"),
+            tok.encode("ab ab", backend="python"))
+    else:
+        with pytest.raises(RuntimeError, match="native"):
+            tok.encode("ab", backend="native")
