@@ -178,13 +178,14 @@ class BPETokenizer:
             raise ValueError(f"unknown backend {backend!r}")
         raw = text.encode("utf-8")
         ids: Optional[np.ndarray] = None
-        if backend in ("auto", "native") and self.merges:
+        if backend in ("auto", "native"):
             from ..utils import native
-            if native.native_available():
+            if not native.native_available():
+                if backend == "native":
+                    raise RuntimeError("backend='native' but the native "
+                                       "library is unavailable")
+            elif self.merges:
                 ids = native.bpe_encode(raw, self._merge_array, self._base)
-            elif backend == "native":
-                raise RuntimeError("backend='native' but the native "
-                                   "library is unavailable")
         if ids is None:
             s = list(raw)
             while len(s) > 1:

@@ -320,12 +320,19 @@ class GPT:
         return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
                 "pos": jnp.zeros((), jnp.int32)}
 
-    def decode_step(self, params, cache, token_ids):
+    def decode_step(self, params, cache, token_ids, kv_valid=None,
+                    positions=None):
         """One token through the stack against the cache.
 
         token_ids: [b] int32 — the token at position ``cache['pos']``.
         Returns (logits [b, vocab] f32, new cache).  Static shapes: cache
         reads are masked by position, writes are ``dynamic_update_slice``.
+
+        Ragged-prompt serving (``generate(prompt_valid=...)``): ``kv_valid``
+        [b, max_len] additionally masks per-row cache positions (left-pad
+        slots), and ``positions`` [b] supplies per-row position indices
+        (cache position minus the row's pad length) so learned/RoPE
+        embeddings see each row's REAL token positions.
         """
         c = self.config
         b = token_ids.shape[0]
@@ -333,7 +340,12 @@ class GPT:
         emb = params["embeddings"]
         x = jnp.take(emb["word"], token_ids, axis=0)[:, None, :]   # [b,1,d]
         if c.position_embedding == "learned":
-            x = x + lax.dynamic_slice_in_dim(emb["position"], pos, 1)[None]
+            if positions is not None:
+                x = x + jnp.take(emb["position"], positions,
+                                 axis=0)[:, None, :]
+            else:
+                x = x + lax.dynamic_slice_in_dim(emb["position"], pos,
+                                                 1)[None]
         x = x.astype(c.dtype)
 
         max_len = cache["k"].shape[2]
@@ -341,6 +353,9 @@ class GPT:
         # (additive 0/-inf convention of ops.attention)
         kv_mask = jnp.where(jnp.arange(max_len) <= pos, 0.0,
                             attn_lib.NEG_INF)[None, None, None, :]
+        if kv_valid is not None:
+            kv_mask = kv_mask + jnp.where(kv_valid, 0.0, attn_lib.NEG_INF
+                                          )[:, None, None, :]
 
         def body(carry, inputs):
             x = carry
@@ -361,7 +376,8 @@ class GPT:
             if c.position_embedding == "rope":
                 # rotate q and THIS k at its own position; cached keys were
                 # rotated when written, matching the full-sequence path
-                pos1 = jnp.full((1,), pos)
+                pos1 = (positions[:, None] if positions is not None
+                        else jnp.full((1,), pos))
                 q = attn_lib.rotary_embedding(q, pos1)
                 k = attn_lib.rotary_embedding(k, pos1)
             k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
@@ -389,7 +405,8 @@ class GPT:
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
                  eos_id: Optional[int] = None,
-                 pad_id: Optional[int] = None) -> jnp.ndarray:
+                 pad_id: Optional[int] = None,
+                 prompt_valid=None) -> jnp.ndarray:
         """Autoregressive sampling with the KV cache.
 
         prompt_ids: [b, p] int32.  temperature 0 = greedy; ``top_k`` /
@@ -404,12 +421,16 @@ class GPT:
         has finished: a batch whose longest answer is 10 tokens pays for
         10 decode steps, not ``max_new_tokens``.  Output shape stays
         static ([b, p + max_new_tokens], padded).
+
+        ``prompt_valid`` [b, p]: ragged prompts, LEFT-padded so every row's
+        last prompt token sits at column p-1 (1 = real token).  Pad slots
+        are masked out of attention and each row's position indices are
+        shifted by its pad length, so learned and RoPE models both see the
+        row's true positions — batch serving for unequal prompt lengths.
         """
         from ..ops import decoding as dec
         c = self.config
-        if pad_id is not None and eos_id is None:
-            raise ValueError("pad_id requires eos_id (nothing finishes "
-                             "without an EOS to detect)")
+        pad = dec.resolve_pad(eos_id, pad_id)
         b, plen = prompt_ids.shape
         total = plen + max_new_tokens
         max_len = max_len or max(total, 1)
@@ -417,15 +438,34 @@ class GPT:
         if rng is None:
             rng = jax.random.PRNGKey(0)
         cache = self.init_cache(b, max_len)
-        tokens = jnp.zeros((b, total), jnp.int32)
-        if eos_id is not None:
-            pad = eos_id if pad_id is None else pad_id
-            tokens = jnp.full((b, total), pad, jnp.int32)
+        tokens = (jnp.zeros((b, total), jnp.int32) if eos_id is None
+                  else jnp.full((b, total), pad, jnp.int32))
         tokens = tokens.at[:, :plen].set(prompt_ids)
+
+        if prompt_valid is not None:
+            if prompt_valid.shape != (b, plen):
+                raise ValueError(f"prompt_valid shape {prompt_valid.shape} "
+                                 f"!= prompt shape {(b, plen)}")
+            pv = prompt_valid.astype(bool)
+            # only checkable on concrete masks; under jit the caller owns it
+            if not isinstance(pv, jax.core.Tracer) and \
+                    not bool(jnp.all(pv[:, -1])):
+                raise ValueError("prompt_valid must be LEFT-padded: the "
+                                 "last prompt column must be all valid")
+            pad_len = plen - jnp.sum(pv, axis=1).astype(jnp.int32)  # [b]
+            kv_valid = jnp.concatenate(
+                [pv, jnp.ones((b, max_len - plen), bool)], axis=1)
+        else:
+            pad_len = kv_valid = None
 
         def advance(tokens, cache, rng, finished, i):
             tok = lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
-            logits, cache = self.decode_step(params, cache, tok)
+            if prompt_valid is not None:
+                logits, cache = self.decode_step(
+                    params, cache, tok, kv_valid=kv_valid,
+                    positions=jnp.maximum(i - pad_len, 0))
+            else:
+                logits, cache = self.decode_step(params, cache, tok)
             rng, sub = jax.random.split(rng)
             nxt = dec.sample_logits(sub, logits, temperature,
                                     top_k=top_k, top_p=top_p)
@@ -435,8 +475,8 @@ class GPT:
                 tokens, jnp.minimum(i + 1, total - 1), 1, axis=1)[:, 0]
             nxt = jnp.where(inside, target, nxt)  # sample_logits returns int32
             if eos_id is not None:
-                nxt = jnp.where(finished, pad, nxt)
-                finished = finished | ((nxt == eos_id) & ~inside)
+                nxt, finished = dec.finish_step(nxt, finished, eos_id, pad,
+                                                eligible=~inside)
             tokens = lax.dynamic_update_slice_in_dim(
                 tokens, nxt[:, None], i + 1, axis=1)
             return tokens, cache, rng, finished
@@ -453,18 +493,9 @@ class GPT:
                                          jnp.arange(total - 1))
             return tokens
 
-        def cond(carry):
-            _, _, _, finished, i = carry
-            return (i < total - 1) & ~jnp.all(finished)
-
-        def body(carry):
-            tokens, cache, rng, finished, i = carry
-            tokens, cache, rng, finished = advance(tokens, cache, rng,
-                                                   finished, i)
-            return (tokens, cache, rng, finished, i + 1)
-
-        tokens, _, _, _, _ = lax.while_loop(
-            cond, body, (tokens, cache, rng, no_finish, jnp.int32(0)))
+        (tokens, _, _, _), _ = dec.decode_loop(
+            lambda carry, i: advance(*carry, i),
+            (tokens, cache, rng, no_finish), total - 1)
         return tokens
 
     def _check_gen_lengths(self, plen: int, max_new_tokens: int,

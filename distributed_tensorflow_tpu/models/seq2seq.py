@@ -281,14 +281,12 @@ class Seq2Seq:
         if max_new_tokens > c.max_position:
             raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
                              f"max_position {c.max_position}")
-        if pad_id is not None and eos_id is None:
-            raise ValueError("pad_id requires eos_id")
         from ..ops import decoding as dec
+        pad = dec.resolve_pad(eos_id, pad_id)
         if rng is None:
             rng = jax.random.PRNGKey(0)
         b = src_ids.shape[0]
         memory = self.encode(params, src_ids, src_valid)
-        pad = eos_id if eos_id is not None and pad_id is None else pad_id
         # BOS everywhere keeps the scan path identical; the eos path's
         # untouched tail positions are overwritten with pad on the fly.
         tgt = jnp.full((b, max_new_tokens + 1), bos_id, jnp.int32)
@@ -303,8 +301,7 @@ class Seq2Seq:
             nxt = dec.sample_logits(sub, logits, temperature,
                                     top_k=top_k, top_p=top_p)
             if eos_id is not None:
-                nxt = jnp.where(finished, pad, nxt)
-                finished = finished | (nxt == eos_id)
+                nxt, finished = dec.finish_step(nxt, finished, eos_id, pad)
             tgt = lax.dynamic_update_slice_in_dim(
                 tgt, nxt[:, None], i + 1, axis=1)
             return tgt, rng, finished
@@ -320,17 +317,9 @@ class Seq2Seq:
                                    jnp.arange(max_new_tokens))
             return tgt[:, 1:]
 
-        def cond(carry):
-            _, _, finished, i = carry
-            return (i < max_new_tokens) & ~jnp.all(finished)
-
-        def body(carry):
-            tgt, rng, finished, i = carry
-            tgt, rng, finished = advance(tgt, rng, finished, i)
-            return (tgt, rng, finished, i + 1)
-
-        tgt, _, finished, stop_i = lax.while_loop(
-            cond, body, (tgt, rng, no_finish, jnp.int32(0)))
+        (tgt, _, finished), stop_i = dec.decode_loop(
+            lambda carry, i: advance(*carry, i),
+            (tgt, rng, no_finish), max_new_tokens)
         # early exit leaves the tail at bos_id — pad it explicitly
         pos = jnp.arange(1, max_new_tokens + 1)[None, :]
         tgt = tgt.at[:, 1:].set(

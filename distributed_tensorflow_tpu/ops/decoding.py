@@ -15,7 +15,47 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["init_beam_scores", "freeze_finished", "expand_beams",
-           "rank_beams", "sample_logits"]
+           "rank_beams", "sample_logits", "resolve_pad", "finish_step",
+           "decode_loop"]
+
+
+def resolve_pad(eos_id: Optional[int], pad_id: Optional[int]) -> Optional[int]:
+    """Shared generate() argument contract: ``pad_id`` defaults to
+    ``eos_id`` and is meaningless without one."""
+    if pad_id is not None and eos_id is None:
+        raise ValueError("pad_id requires eos_id (nothing finishes "
+                         "without an EOS to detect)")
+    return eos_id if pad_id is None else pad_id
+
+
+def finish_step(nxt: jnp.ndarray, finished: jnp.ndarray, eos_id: int,
+                pad: int, eligible=None):
+    """One sampling step's finished-row bookkeeping: rows already finished
+    emit ``pad``; rows emitting ``eos_id`` (while ``eligible`` — e.g. past
+    the prompt) join the finished set.  Returns (next_tokens, finished)."""
+    nxt = jnp.where(finished, pad, nxt)
+    newly = nxt == eos_id
+    if eligible is not None:
+        newly = newly & eligible
+    return nxt, finished | newly
+
+
+def decode_loop(advance, carry, n_steps: int):
+    """Early-exit autoregressive driver: ``carry = advance(carry, i)`` for
+    ``i`` in [0, n_steps), stopping as soon as every row has finished.
+    ``carry[-1]`` must be the finished mask [b].  Returns
+    (final carry, steps_taken) — the shared while_loop half of
+    GPT/seq2seq ``generate(eos_id=...)``.
+    """
+    def cond(state):
+        carry, i = state
+        return (i < n_steps) & ~jnp.all(carry[-1])
+
+    def body(state):
+        carry, i = state
+        return advance(carry, i), i + 1
+
+    return lax.while_loop(cond, body, (carry, jnp.int32(0)))
 
 
 def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,

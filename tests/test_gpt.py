@@ -494,3 +494,68 @@ def test_generate_eos_jits():
                                            eos_id=5, pad_id=0))
     out = fn(params, jnp.ones((2, 3), jnp.int32))
     assert out.shape == (2, 7)
+
+
+def test_ragged_prompt_left_padding_matches_solo_rows():
+    """A left-padded batch of unequal prompts generates, row for row, what
+    each prompt generates alone (greedy) — pad slots masked from
+    attention, positions shifted per row.  Checked for BOTH position
+    embeddings (RoPE is shift-invariant; learned needs the explicit
+    per-row positions)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    for pe in ("learned", "rope"):
+        g = gpt_tiny(dropout_rate=0.0, position_embedding=pe)
+        params = g.init(jax.random.PRNGKey(0))
+        short = jnp.asarray([[7, 8]], jnp.int32)            # len 2
+        long = jnp.asarray([[3, 4, 5, 6]], jnp.int32)       # len 4
+        solo_short = g.generate(params, short, max_new_tokens=4)
+        solo_long = g.generate(params, long, max_new_tokens=4)
+
+        # batch them left-padded to len 4 (pad id value is arbitrary:
+        # masked out of attention)
+        batch = jnp.asarray([[0, 0, 7, 8], [3, 4, 5, 6]], jnp.int32)
+        valid = jnp.asarray([[0, 0, 1, 1], [1, 1, 1, 1]], jnp.int32)
+        out = g.generate(params, batch, max_new_tokens=4,
+                         prompt_valid=valid)
+        np.testing.assert_array_equal(np.asarray(out[0, 4:]),
+                                      np.asarray(solo_short[0, 2:]),
+                                      err_msg=f"pe={pe} short row")
+        np.testing.assert_array_equal(np.asarray(out[1, 4:]),
+                                      np.asarray(solo_long[0, 4:]),
+                                      err_msg=f"pe={pe} long row")
+
+
+def test_ragged_prompt_validation():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    g = gpt_tiny(dropout_rate=0.0)
+    params = g.init(jax.random.PRNGKey(0))
+    prompt = jnp.ones((2, 3), jnp.int32)
+    with pytest.raises(ValueError, match="shape"):
+        g.generate(params, prompt, max_new_tokens=2,
+                   prompt_valid=jnp.ones((2, 5), jnp.int32))
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        g.generate(params, prompt, max_new_tokens=2,
+                   prompt_valid=jnp.asarray([[1, 1, 0], [1, 1, 1]],
+                                            jnp.int32))
+
+
+def test_ragged_prompt_jits():
+    import jax
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+
+    g = gpt_tiny(dropout_rate=0.0)
+    params = g.init(jax.random.PRNGKey(0))
+    fn = jax.jit(lambda p, ids, v: g.generate(p, ids, max_new_tokens=3,
+                                              prompt_valid=v))
+    out = fn(params, jnp.ones((2, 4), jnp.int32),
+             jnp.ones((2, 4), jnp.int32))
+    assert out.shape == (2, 7)
