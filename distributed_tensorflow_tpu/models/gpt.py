@@ -556,7 +556,7 @@ class GPT:
         finished = jnp.zeros((b, k), bool)
         batch_base = jnp.arange(b)[:, None] * k            # [b, 1]
 
-        def step(carry, i):
+        def advance(carry, i):
             tokens, cache, scores, finished = carry
             tok = lax.dynamic_slice_in_dim(
                 tokens.reshape(b * k, total), i, 1, axis=1)[:, 0]
@@ -574,12 +574,23 @@ class GPT:
             cache = {"k": jnp.take(cache["k"], flat, axis=1),
                      "v": jnp.take(cache["v"], flat, axis=1),
                      "pos": cache["pos"]}
-            return (tokens, cache, scores, finished), None
+            return (tokens, cache, scores, finished)
 
         # phase 2 — beam expansion from position plen-1 onward
-        (tokens, _, scores, finished), _ = lax.scan(
-            step, (tokens, cache, scores, finished),
-            jnp.arange(plen - 1, total - 1))
+        carry0 = (tokens, cache, scores, finished)
+        if eos_id is None:
+            (tokens, _, scores, finished), _ = lax.scan(
+                lambda carry, i: (advance(carry, i), None), carry0,
+                jnp.arange(plen - 1, total - 1))
+        else:
+            # early exit once every beam of every row finished; unwritten
+            # tail positions get EOS — exactly what the full run writes
+            # (frozen beams only ever extend with EOS, dec.freeze_finished)
+            (tokens, _, scores, finished), steps = dec.decode_loop(
+                lambda carry, j: advance(carry, plen - 1 + j),
+                carry0, max_new_tokens)
+            pos = jnp.arange(total)[None, None, :]
+            tokens = jnp.where(pos > plen - 1 + steps, eos_id, tokens)
         best = dec.rank_beams(scores, tokens[:, :, plen:], eos_id,
                               max_new_tokens, length_penalty)
         return jnp.take_along_axis(tokens, best[:, None, None],

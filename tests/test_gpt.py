@@ -559,3 +559,29 @@ def test_ragged_prompt_jits():
     out = fn(params, jnp.ones((2, 4), jnp.int32),
              jnp.ones((2, 4), jnp.int32))
     assert out.shape == (2, 7)
+
+
+def test_beam_search_eos_early_exit_pads_with_eos():
+    """The early-exit beam loop produces the same output as the full run:
+    once every beam finished, trailing positions read EOS (what frozen
+    beams would have kept emitting)."""
+    g = gpt_tiny(dropout_rate=0.0)
+    params = g.init(jax.random.PRNGKey(0))
+    prompt = jnp.ones((2, 3), jnp.int32)
+    # no-eos baseline: pure scan path
+    base = g.beam_search(params, prompt, max_new_tokens=5, beam_size=2)
+    assert base.shape == (2, 8)
+    # pick the first generated token of row 0's best beam as EOS: that row
+    # finishes immediately; the loop still runs until row 1 finishes or
+    # steps run out, and the output stays [b, total] with EOS-padded tails
+    eos = int(base[0, 3])
+    out = g.beam_search(params, prompt, max_new_tokens=5, beam_size=2,
+                        eos_id=eos)
+    assert out.shape == (2, 8)
+    row = np.asarray(out[0])
+    first_eos = int(np.argmax(row[3:] == eos)) + 3
+    assert (row[first_eos:] == eos).all()
+    # and the whole thing jits
+    fn = jax.jit(lambda p, ids: g.beam_search(p, ids, max_new_tokens=4,
+                                              beam_size=2, eos_id=eos))
+    assert fn(params, prompt).shape == (2, 7)
