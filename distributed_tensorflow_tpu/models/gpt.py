@@ -443,18 +443,8 @@ class GPT:
         tokens = tokens.at[:, :plen].set(prompt_ids)
 
         if prompt_valid is not None:
-            if prompt_valid.shape != (b, plen):
-                raise ValueError(f"prompt_valid shape {prompt_valid.shape} "
-                                 f"!= prompt shape {(b, plen)}")
-            pv = prompt_valid.astype(bool)
-            # only checkable on concrete masks; under jit the caller owns it
-            if not isinstance(pv, jax.core.Tracer) and \
-                    not bool(jnp.all(pv[:, -1])):
-                raise ValueError("prompt_valid must be LEFT-padded: the "
-                                 "last prompt column must be all valid")
-            pad_len = plen - jnp.sum(pv, axis=1).astype(jnp.int32)  # [b]
-            kv_valid = jnp.concatenate(
-                [pv, jnp.ones((b, max_len - plen), bool)], axis=1)
+            pad_len, kv_valid = dec.ragged_prompt_masks(
+                prompt_valid, (b, plen), max_len)
         else:
             pad_len = kv_valid = None
 
@@ -516,7 +506,8 @@ class GPT:
     def beam_search(self, params, prompt_ids, max_new_tokens: int,
                     beam_size: int = 4, eos_id: Optional[int] = None,
                     length_penalty: float = 0.6,
-                    max_len: Optional[int] = None) -> jnp.ndarray:
+                    max_len: Optional[int] = None,
+                    prompt_valid=None) -> jnp.ndarray:
         """Jittable beam search over the KV cache.
 
         Two phases, each one ``lax.scan``: the prompt prefills the cache at
@@ -525,6 +516,10 @@ class GPT:
         standard KV-cache beam trick).  Shared bookkeeping lives in
         ``ops.decoding``.  Returns the best row per batch element,
         [b, plen + max_new_tokens].
+
+        ``prompt_valid``: LEFT-padded ragged prompts, same contract as
+        ``generate`` — pad slots masked from attention, per-row position
+        shift through prefill and expansion.
         """
         from ..ops import decoding as dec
 
@@ -535,16 +530,40 @@ class GPT:
         max_len = max_len or max(total, 1)
         self._check_gen_lengths(plen, max_new_tokens, max_len)
 
+        if prompt_valid is not None:
+            pad_len, kv_valid = dec.ragged_prompt_masks(
+                prompt_valid, (b, plen), max_len)
+            # loop-invariant beam folds, hoisted out of the expansion loop
+            # (lax.while_loop gives no hoisting guarantee)
+            kv_valid_folded = jnp.repeat(kv_valid, k, axis=0)
+            pad_len_folded = jnp.repeat(pad_len, k, axis=0)
+        else:
+            pad_len = kv_valid = None
+
+        def step_kwargs(i, fold=1):
+            """decode_step kwargs for position i (beam-folded when the
+            cache rows are repeated k-fold)."""
+            if prompt_valid is None:
+                return {}
+            if fold == 1:
+                return dict(kv_valid=kv_valid,
+                            positions=jnp.maximum(i - pad_len, 0))
+            return dict(kv_valid=kv_valid_folded,
+                        positions=jnp.maximum(i - pad_len_folded, 0))
+
         # phase 1 — prefill positions 0..plen-2 at batch b
         cache = self.init_cache(b, max_len)
 
-        def prefill(cache, tok):
-            _, cache = self.decode_step(params, cache, tok)
+        def prefill(cache, inputs):
+            tok, i = inputs
+            _, cache = self.decode_step(params, cache, tok,
+                                        **step_kwargs(i))
             return cache, None
 
         if plen > 1:
             cache, _ = lax.scan(prefill, cache,
-                                prompt_ids[:, :-1].T)
+                                (prompt_ids[:, :-1].T,
+                                 jnp.arange(plen - 1)))
         # fold beams into the batch dim: row r of batch i -> i*k + r
         cache = {"k": jnp.repeat(cache["k"], k, axis=1),
                  "v": jnp.repeat(cache["v"], k, axis=1),
@@ -560,7 +579,8 @@ class GPT:
             tokens, cache, scores, finished = carry
             tok = lax.dynamic_slice_in_dim(
                 tokens.reshape(b * k, total), i, 1, axis=1)[:, 0]
-            logits, cache = self.decode_step(params, cache, tok)
+            logits, cache = self.decode_step(params, cache, tok,
+                                             **step_kwargs(i, fold=k))
             logp = jax.nn.log_softmax(logits, -1).reshape(b, k, -1)
             logp = dec.freeze_finished(logp, finished, eos_id)
             scores, beam, nxt = dec.expand_beams(scores, logp)

@@ -16,7 +16,28 @@ from jax import lax
 
 __all__ = ["init_beam_scores", "freeze_finished", "expand_beams",
            "rank_beams", "sample_logits", "resolve_pad", "finish_step",
-           "decode_loop"]
+           "decode_loop", "ragged_prompt_masks"]
+
+
+def ragged_prompt_masks(prompt_valid, prompt_shape: Tuple[int, int],
+                        max_len: int):
+    """Validate a LEFT-padded ``prompt_valid`` mask and derive the decode
+    quantities both ``generate`` and ``beam_search`` need:
+    ``pad_len`` [b] (per-row pad count, for position shifting) and
+    ``kv_valid`` [b, max_len] (pad slots False, generated slots True)."""
+    b, plen = prompt_shape
+    if prompt_valid.shape != (b, plen):
+        raise ValueError(f"prompt_valid shape {prompt_valid.shape} "
+                         f"!= prompt shape {(b, plen)}")
+    pv = prompt_valid.astype(bool)
+    # only checkable on concrete masks; under jit the caller owns it
+    if not isinstance(pv, jax.core.Tracer) and not bool(jnp.all(pv[:, -1])):
+        raise ValueError("prompt_valid must be LEFT-padded: the last "
+                         "prompt column must be all valid")
+    pad_len = plen - jnp.sum(pv, axis=1).astype(jnp.int32)
+    kv_valid = jnp.concatenate(
+        [pv, jnp.ones((b, max_len - plen), bool)], axis=1)
+    return pad_len, kv_valid
 
 
 def resolve_pad(eos_id: Optional[int], pad_id: Optional[int]) -> Optional[int]:

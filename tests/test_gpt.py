@@ -585,3 +585,54 @@ def test_beam_search_eos_early_exit_pads_with_eos():
     fn = jax.jit(lambda p, ids: g.beam_search(p, ids, max_new_tokens=4,
                                               beam_size=2, eos_id=eos))
     assert fn(params, prompt).shape == (2, 7)
+
+
+def test_beam_search_ragged_prompts_match_solo():
+    """Left-padded beam search equals per-row solo beam search (both
+    position embeddings)."""
+    for pe in ("learned", "rope"):
+        g = gpt_tiny(dropout_rate=0.0, position_embedding=pe)
+        params = g.init(jax.random.PRNGKey(0))
+        short = jnp.asarray([[7, 8]], jnp.int32)
+        long = jnp.asarray([[3, 4, 5, 6]], jnp.int32)
+        solo_short = g.beam_search(params, short, max_new_tokens=4,
+                                   beam_size=2)
+        solo_long = g.beam_search(params, long, max_new_tokens=4,
+                                  beam_size=2)
+        batch = jnp.asarray([[0, 0, 7, 8], [3, 4, 5, 6]], jnp.int32)
+        valid = jnp.asarray([[0, 0, 1, 1], [1, 1, 1, 1]], jnp.int32)
+        out = g.beam_search(params, batch, max_new_tokens=4, beam_size=2,
+                            prompt_valid=valid)
+        np.testing.assert_array_equal(np.asarray(out[0, 4:]),
+                                      np.asarray(solo_short[0, 2:]),
+                                      err_msg=f"pe={pe} short")
+        np.testing.assert_array_equal(np.asarray(out[1, 4:]),
+                                      np.asarray(solo_long[0, 4:]),
+                                      err_msg=f"pe={pe} long")
+
+
+def test_beam_search_ragged_plus_eos_compose():
+    """prompt_valid + eos_id together: folded kv_valid/positions inside
+    the early-exit while_loop still match the solo runs."""
+    g = gpt_tiny(dropout_rate=0.0)
+    params = g.init(jax.random.PRNGKey(0))
+    short = jnp.asarray([[7, 8]], jnp.int32)
+    long = jnp.asarray([[3, 4, 5, 6]], jnp.int32)
+    # choose an EOS id that greedy beams don't emit so the outputs align
+    base_s = g.beam_search(params, short, max_new_tokens=4, beam_size=2)
+    base_l = g.beam_search(params, long, max_new_tokens=4, beam_size=2)
+    emitted = set(np.asarray(base_s[:, 2:]).ravel().tolist()) | \
+        set(np.asarray(base_l[:, 4:]).ravel().tolist())
+    eos = next(i for i in range(g.config.vocab_size) if i not in emitted)
+    solo_short = g.beam_search(params, short, max_new_tokens=4,
+                               beam_size=2, eos_id=eos)
+    solo_long = g.beam_search(params, long, max_new_tokens=4,
+                              beam_size=2, eos_id=eos)
+    batch = jnp.asarray([[0, 0, 7, 8], [3, 4, 5, 6]], jnp.int32)
+    valid = jnp.asarray([[0, 0, 1, 1], [1, 1, 1, 1]], jnp.int32)
+    out = g.beam_search(params, batch, max_new_tokens=4, beam_size=2,
+                        eos_id=eos, prompt_valid=valid)
+    np.testing.assert_array_equal(np.asarray(out[0, 4:]),
+                                  np.asarray(solo_short[0, 2:]))
+    np.testing.assert_array_equal(np.asarray(out[1, 4:]),
+                                  np.asarray(solo_long[0, 4:]))
