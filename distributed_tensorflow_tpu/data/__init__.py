@@ -4,11 +4,13 @@ from . import augment, datasets, pipeline, text, tfrecord, xor
 from .datasets import cifar10, mnist, synthetic_image_classes
 from .pipeline import Dataset, prefetch_to_device
 from .text import BPETokenizer, ByteTokenizer
-from .tfrecord import RecordWriter, read_tfrecord, write_tfrecord
+from .tfrecord import (RecordWriter, read_tfrecord,
+                       tfrecord_batches, write_tfrecord)
 from .xor import get_data as xor_data
 
 __all__ = ["augment", "datasets", "pipeline", "text", "tfrecord", "xor",
            "BPETokenizer", "ByteTokenizer",
-           "RecordWriter", "read_tfrecord", "write_tfrecord", "cifar10", "mnist",
+           "RecordWriter", "read_tfrecord", "tfrecord_batches",
+           "write_tfrecord", "cifar10", "mnist",
            "synthetic_image_classes", "Dataset", "prefetch_to_device",
            "xor_data"]

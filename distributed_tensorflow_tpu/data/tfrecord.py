@@ -19,7 +19,8 @@ from typing import Iterable, Iterator, List, Optional, Sequence
 
 from ..summary.crc32c import masked_crc32c
 
-__all__ = ["write_tfrecord", "read_tfrecord", "RecordWriter", "write_framed"]
+__all__ = ["write_tfrecord", "read_tfrecord", "RecordWriter", "write_framed",
+           "tfrecord_batches"]
 
 
 def write_framed(f, payload: bytes) -> None:
@@ -101,3 +102,58 @@ def read_tfrecord(path: str, verify: bool = True) -> Iterator[bytes]:
                     f"{path}: data crc mismatch at offset {offset}")
             offset += 8 + 4 + length + 4
             yield payload
+
+
+def tfrecord_batches(paths, parse_fn, batch_size: int,
+                     shuffle_buffer: int = 0, seed: int = 0,
+                     epoch: int = 0, drop_remainder: bool = True,
+                     verify: bool = True):
+    """Stream record files into training batches (the tf.data
+    ``TFRecordDataset -> map -> shuffle -> batch`` pipeline shape, sized
+    for host feeding + ``prefetch_to_device``).
+
+    ``parse_fn(record_bytes) -> pytree of numpy arrays`` (one example);
+    batches are the same pytree with a stacked leading dim.
+    ``shuffle_buffer > 0``: streaming reservoir-window shuffle — each
+    incoming example swaps with a uniformly random slot of a ``buffer``-
+    sized window (approximate global shuffle at O(buffer) memory, the
+    tf.data ``shuffle(buffer_size)`` semantics).  The shuffle stream is
+    seeded by ``(seed, epoch)``: pass the epoch number on each re-
+    iteration for the per-epoch reshuffle contract ``pipeline.Dataset``
+    keeps (a fixed (seed, epoch) pair replays the same order).
+    """
+    import numpy as np
+
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+
+    def examples():
+        for p in paths:
+            for rec in read_tfrecord(str(p), verify=verify):
+                yield parse_fn(rec)
+
+    def shuffled():
+        if shuffle_buffer <= 0:
+            yield from examples()
+            return
+        rng = np.random.default_rng((seed, epoch))
+        buf: List = []
+        for ex in examples():
+            if len(buf) < shuffle_buffer:
+                buf.append(ex)
+                continue
+            j = rng.integers(0, shuffle_buffer)
+            out, buf[j] = buf[j], ex
+            yield out
+        rng.shuffle(buf)
+        yield from buf
+
+    import jax
+    batch: List = []
+    for ex in shuffled():
+        batch.append(ex)
+        if len(batch) == batch_size:
+            yield jax.tree.map(lambda *xs: np.stack(xs), *batch)
+            batch = []
+    if batch and not drop_remainder:
+        yield jax.tree.map(lambda *xs: np.stack(xs), *batch)

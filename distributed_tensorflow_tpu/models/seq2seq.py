@@ -331,8 +331,11 @@ class Seq2Seq:
                     eos_id: Optional[int] = None,
                     length_penalty: float = 0.6,
                     src_valid=None) -> jnp.ndarray:
-        """Jittable beam search: one ``lax.scan`` over target positions,
-        beams flattened into the batch dim for the decoder.
+        """Jittable beam search: one loop over target positions, beams
+        flattened into the batch dim for the decoder — a ``lax.scan``
+        without ``eos_id``, or an early-exit ``lax.while_loop``
+        (``ops.decoding.decode_loop``) that stops once every beam
+        finished, with the unwritten tail filled with EOS.
 
         Scores are sum-of-logprobs; finished beams (emitted ``eos_id``)
         freeze their score and can only extend with EOS.  Final ranking
@@ -357,7 +360,7 @@ class Seq2Seq:
         scores = dec.init_beam_scores(b, k)
         finished = jnp.zeros((b, k), bool)
 
-        def step(carry, i):
+        def advance(carry, i):
             seqs, scores, finished = carry
             flat = seqs.reshape(b * k, T + 1)[:, :-1]
             hidden = self.decode(params, mem_k, flat, valid_k)
@@ -372,10 +375,19 @@ class Seq2Seq:
             finished = jnp.take_along_axis(finished, beam, axis=1)
             if eos_id is not None:
                 finished = finished | (tok == eos_id)
-            return (seqs, scores, finished), None
+            return (seqs, scores, finished)
 
-        (seqs, scores, finished), _ = lax.scan(
-            step, (seqs, scores, finished), jnp.arange(T))
+        if eos_id is None:
+            (seqs, scores, finished), _ = lax.scan(
+                lambda carry, i: (advance(carry, i), None),
+                (seqs, scores, finished), jnp.arange(T))
+        else:
+            # early exit once every beam finished; unwritten tail = EOS
+            # (what frozen beams keep emitting on the full run)
+            (seqs, scores, finished), steps = dec.decode_loop(
+                advance, (seqs, scores, finished), T)
+            pos = jnp.arange(T + 1)[None, None, :]
+            seqs = jnp.where(pos > steps, eos_id, seqs)
         best = dec.rank_beams(scores, seqs[:, :, 1:], eos_id, T,
                               length_penalty)
         return jnp.take_along_axis(

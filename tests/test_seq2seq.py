@@ -210,3 +210,26 @@ def test_generate_eos_early_stop_and_padding():
     import pytest
     with pytest.raises(ValueError, match="pad_id requires eos_id"):
         s.generate(params, src, max_new_tokens=3, pad_id=0)
+
+
+def test_beam_search_eos_early_exit_pads_with_eos():
+    """Early-exit seq2seq beam loop: trailing positions read EOS once all
+    beams finished, matching the frozen-beam behavior of the full scan."""
+    import numpy as np
+    from distributed_tensorflow_tpu.models.seq2seq import seq2seq_tiny
+
+    s = seq2seq_tiny(dropout_rate=0.0)
+    params = s.init(jax.random.PRNGKey(0))
+    src = jnp.ones((2, 4), jnp.int32)
+    base = s.beam_search(params, src, max_new_tokens=5, beam_size=2)
+    assert base.shape == (2, 5)
+    eos = int(base[0, 0])         # first emitted token: row 0 dies fast
+    out = s.beam_search(params, src, max_new_tokens=5, beam_size=2,
+                        eos_id=eos)
+    assert out.shape == (2, 5)
+    row = np.asarray(out[0])
+    first = int(np.argmax(row == eos))
+    assert (row[first:] == eos).all()
+    fn = jax.jit(lambda p, ids: s.beam_search(p, ids, max_new_tokens=4,
+                                              beam_size=2, eos_id=eos))
+    assert fn(params, src).shape == (2, 4)
