@@ -80,6 +80,13 @@ class Sequential:
             metric_fns[getattr(fn, "__name__", str(m))] = fn
         self._compiled = dict(
             loss=loss_fn, optimizer=opt, metric_fns=metric_fns, mesh=mesh,
+            # raw loss name + step kwargs kept for fit(class_weight=...),
+            # which compiles a weighted sibling step on demand
+            loss_name=loss if isinstance(loss, str) else None,
+            step_kwargs=dict(metric_fns=metric_fns, seed=seed, mesh=mesh,
+                             params_spec=params_spec,
+                             grad_clip_norm=grad_clip_norm, policy=policy),
+            weighted_steps={},
             train_step=step_lib.make_train_step(
                 self.stack, loss_fn, opt, metric_fns=metric_fns, seed=seed,
                 mesh=mesh, params_spec=params_spec,
@@ -129,7 +136,8 @@ class Sequential:
             validation_split: float = 0.0,
             callbacks: Sequence[Callback] = (),
             shuffle: bool = True, seed: int = 0,
-            verbose: int = 1, augment=None) -> History:
+            verbose: int = 1, augment=None,
+            class_weight=None) -> History:
         """reference example2.py:197-200 parity (sync-DP underneath).
 
         ``augment``: per-batch transform from ``data.augment`` (host-side,
@@ -139,8 +147,25 @@ class Sequential:
         ``validation_split``: fraction (0, 1) held out from the END of
         ``(x, y)`` before shuffling (Keras semantics) when no explicit
         ``validation_data`` is given.
+
+        ``class_weight``: {class_id: weight} applied to the TRAINING loss
+        (Keras semantics; validation stays unweighted).  Requires a
+        string classification loss (see ``ops.losses.class_weighted``);
+        each distinct weighting compiles its own step once and is cached.
         """
         c = self._require_compiled()
+        train_step = c["train_step"]
+        if class_weight is not None:
+            if c["loss_name"] is None:
+                raise ValueError("class_weight needs the model compiled "
+                                 "with a loss NAME (string), not a callable")
+            key_cw = tuple(sorted((int(k), float(v))
+                                  for k, v in class_weight.items()))
+            if key_cw not in c["weighted_steps"]:
+                wfn = loss_lib.class_weighted(c["loss_name"], class_weight)
+                c["weighted_steps"][key_cw] = step_lib.make_train_step(
+                    self.stack, wfn, c["optimizer"], **c["step_kwargs"])
+            train_step = c["weighted_steps"][key_cw]
         if validation_split and validation_data is None:
             if not 0.0 < validation_split < 1.0:
                 raise ValueError(
@@ -190,7 +215,7 @@ class Sequential:
             running: Dict[str, float] = {}
             count = 0
             for batch in prefetch_to_device(iter(dataset), sharding=sharding):
-                self.state, last_metrics = c["train_step"](self.state, batch)
+                self.state, last_metrics = train_step(self.state, batch)
                 count += 1
                 if count % sync_every == 0 or count == len(dataset):
                     for k, v in last_metrics.items():

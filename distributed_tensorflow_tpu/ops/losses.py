@@ -19,7 +19,7 @@ __all__ = ["mean_squared_error", "binary_cross_entropy",
            "smoothed_cross_entropy", "mean_absolute_error",
            "mean_absolute_percentage_error", "mean_squared_logarithmic_error",
            "hinge", "squared_hinge", "kullback_leibler_divergence", "poisson",
-           "cosine_proximity", "huber", "get"]
+           "cosine_proximity", "huber", "class_weighted", "get"]
 
 
 def mean_squared_error(preds, targets):
@@ -159,6 +159,53 @@ _REGISTRY = {
     "smoothed_cross_entropy": smoothed_cross_entropy(0.1),
     "huber": huber(1.0),
 }
+
+
+def class_weighted(base: str, class_weight):
+    """Weighted variant of a classification loss for ``fit(class_weight=)``
+    (Keras semantics: per-sample weights looked up from the label's class,
+    weighted-mean reduction so the loss scale is weight-invariant when all
+    weights are equal).
+
+    Supported bases: ``sparse_categorical_crossentropy`` (integer labels)
+    and ``binary_crossentropy`` (0/1 targets, elementwise).  Classes
+    absent from the dict weigh 1.0.
+    """
+    names = {"sparse_categorical_crossentropy", "binary_crossentropy"}
+    if base not in names:
+        raise ValueError(f"class_weight supports {sorted(names)}; "
+                         f"got loss {base!r}")
+    n = max(int(k) for k in class_weight) + 1
+    lut = [1.0] * n
+    for k, v in class_weight.items():
+        lut[int(k)] = float(v)
+    lut_arr = jnp.asarray(lut, jnp.float32)
+
+    def weight_of(labels):
+        """Class id -> weight; ids past the dict's range weigh 1.0 (NOT
+        the last entry — clipping would silently reuse the largest
+        specified class's weight, e.g. class_weight={1: 10} skewing
+        every class >= 2)."""
+        ids = labels.astype(jnp.int32)
+        return jnp.where(ids < n,
+                         jnp.take(lut_arr, jnp.clip(ids, 0, n - 1)), 1.0)
+
+    if base == "sparse_categorical_crossentropy":
+        def loss(logits, labels):
+            # the shared XE path's masked-mean reduction IS the weighted
+            # mean when handed float weights
+            return softmax_cross_entropy_with_integer_labels(
+                logits, labels, where=weight_of(labels))
+    else:
+        def loss(preds, targets, epsilon: float = 1e-7):
+            p = jnp.clip(preds.astype(jnp.float32), epsilon, 1.0 - epsilon)
+            t = targets.astype(jnp.float32)
+            bce = -(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))
+            w = weight_of(t)
+            return jnp.sum(bce * w) / jnp.maximum(jnp.sum(w), 1e-9)
+
+    loss.__name__ = f"class_weighted_{base}"
+    return loss
 
 
 def get(name_or_fn):
