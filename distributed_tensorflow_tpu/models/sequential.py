@@ -18,6 +18,7 @@ import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data.pipeline import Dataset, prefetch_to_device
@@ -78,19 +79,18 @@ class Sequential:
         for m in metrics:
             fn = metric_lib.get(m)
             metric_fns[getattr(fn, "__name__", str(m))] = fn
+        # ONE kwargs dict builds the default step AND any class-weighted
+        # sibling fit() compiles later — they can never drift apart.
+        step_kwargs = dict(metric_fns=metric_fns, seed=seed, mesh=mesh,
+                           params_spec=params_spec,
+                           grad_clip_norm=grad_clip_norm, policy=policy)
         self._compiled = dict(
             loss=loss_fn, optimizer=opt, metric_fns=metric_fns, mesh=mesh,
-            # raw loss name + step kwargs kept for fit(class_weight=...),
-            # which compiles a weighted sibling step on demand
             loss_name=loss if isinstance(loss, str) else None,
-            step_kwargs=dict(metric_fns=metric_fns, seed=seed, mesh=mesh,
-                             params_spec=params_spec,
-                             grad_clip_norm=grad_clip_norm, policy=policy),
+            step_kwargs=step_kwargs,
             weighted_steps={},
             train_step=step_lib.make_train_step(
-                self.stack, loss_fn, opt, metric_fns=metric_fns, seed=seed,
-                mesh=mesh, params_spec=params_spec,
-                grad_clip_norm=grad_clip_norm, policy=policy),
+                self.stack, loss_fn, opt, **step_kwargs),
             eval_step=step_lib.make_eval_step(
                 self.stack, loss_fn, metric_fns=metric_fns, mesh=mesh,
                 policy=policy),
@@ -344,6 +344,49 @@ class Sequential:
                 self.state.params, self.state.model_state,
                 x[lo:lo + batch_size])))
         return np.concatenate(outs, axis=0)
+
+    # -- flat weights access (Keras get_weights/set_weights analogue) ----
+    def _layer_leaves(self):
+        """(layer_key, leaves, treedef) per param-owning layer, in LAYER
+        order (dict-key sorting would put 'dense_10' before 'dense_2')."""
+        out = []
+        for key in self.stack.keys:
+            sub = self.state.params.get(key)
+            if sub is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(sub)
+                out.append((key, leaves, treedef))
+        return out
+
+    def get_weights(self) -> List[np.ndarray]:
+        """Parameters as a flat list of host arrays: layers in model
+        order, leaves in this framework's (sorted-key) order within each
+        layer — ``set_weights`` is the exact inverse."""
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        return [np.asarray(w) for _, leaves, _ in self._layer_leaves()
+                for w in leaves]
+
+    def set_weights(self, weights: Sequence[np.ndarray]) -> None:
+        """Inverse of ``get_weights``: same order, shapes must match."""
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        per_layer = self._layer_leaves()
+        total = sum(len(leaves) for _, leaves, _ in per_layer)
+        if len(weights) != total:
+            raise ValueError(f"expected {total} arrays, got {len(weights)}")
+        params = dict(self.state.params)
+        i = 0
+        for key, leaves, treedef in per_layer:
+            new = []
+            for cur in leaves:
+                w = np.asarray(weights[i])
+                i += 1
+                if w.shape != cur.shape:
+                    raise ValueError(f"shape mismatch at {key!r}: expected "
+                                     f"{cur.shape}, got {w.shape}")
+                new.append(jnp.asarray(w, cur.dtype))
+            params[key] = jax.tree_util.tree_unflatten(treedef, new)
+        self.state = self.state._replace(params=params)
 
     # -- full-model IO (Keras model.save / load_model / to_json parity) --
     def save(self, path: str) -> str:

@@ -40,13 +40,17 @@ def softmax_cross_entropy(logits, onehot_targets):
 
 
 def softmax_cross_entropy_with_integer_labels(logits, labels, where=None):
-    """XE with int labels; optional ``where`` mask (BERT MLM masked positions)."""
+    """XE with int labels; optional ``where`` weights/mask (BERT MLM
+    masked positions, class_weighted's per-sample weights).  The epsilon
+    floor only guards the all-masked case (0/eps = 0); fractional weight
+    sums below 1 divide exactly (a 1.0 floor would silently shrink
+    small-weight batches)."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     if where is None:
         return jnp.mean(nll)
     w = where.astype(jnp.float32)
-    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1e-9)
 
 
 def mean_absolute_error(preds, targets):
@@ -175,6 +179,11 @@ def class_weighted(base: str, class_weight):
     if base not in names:
         raise ValueError(f"class_weight supports {sorted(names)}; "
                          f"got loss {base!r}")
+    if not class_weight:
+        return get(base)            # Keras: empty dict is a no-op
+    if any(int(k) < 0 for k in class_weight):
+        raise ValueError(f"class_weight keys must be >= 0 class ids; "
+                         f"got {sorted(class_weight)}")
     n = max(int(k) for k in class_weight) + 1
     lut = [1.0] * n
     for k, v in class_weight.items():

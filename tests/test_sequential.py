@@ -520,3 +520,63 @@ def test_class_weight_out_of_range_classes_weigh_one():
     nll = -logp[np.arange(3), np.asarray(labels)]
     np.testing.assert_allclose(float(wl0(logits, labels)),
                                float((nll * w).sum() / w.sum()), rtol=1e-5)
+
+
+def test_get_set_weights_roundtrip():
+    (xt, yt), (xv, yv) = data.xor_data(200, val_size=16, seed=0)
+    a = xor_model()
+    a.fit(xt, yt, epochs=1, batch_size=50, verbose=0)
+    weights = a.get_weights()
+    assert all(isinstance(w, np.ndarray) for w in weights)
+    b = xor_model()
+    b.compile(loss="mse", optimizer="adam")
+    b.build((64,), seed=99)                # different init
+    b.set_weights(weights)
+    np.testing.assert_allclose(np.asarray(b.predict(xv)),
+                               np.asarray(a.predict(xv)), atol=1e-6)
+    import pytest
+    with pytest.raises(ValueError, match="expected"):
+        b.set_weights(weights[:-1])
+    with pytest.raises(ValueError, match="shape mismatch"):
+        b.set_weights([w.T for w in weights])
+
+
+def test_class_weight_edge_cases():
+    """Empty dict = unweighted no-op; negative class ids rejected; small
+    weight sums divide exactly (no 1.0 denominator floor)."""
+    import jax.numpy as jnp
+    import pytest
+    from distributed_tensorflow_tpu.ops import losses
+    base = losses.softmax_cross_entropy_with_integer_labels
+    assert losses.class_weighted("sparse_categorical_crossentropy", {}) \
+        is losses.get("sparse_categorical_crossentropy")
+    with pytest.raises(ValueError, match=">= 0"):
+        losses.class_weighted("sparse_categorical_crossentropy",
+                              {-1: 0.0, 1: 2.0})
+    # uniform small weights must equal the unweighted loss exactly
+    wl = losses.class_weighted("sparse_categorical_crossentropy",
+                               {0: 0.1, 1: 0.1})
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+    labels = jnp.asarray([0, 1, 0])
+    np.testing.assert_allclose(float(wl(logits, labels)),
+                               float(base(logits, labels)), rtol=1e-6)
+
+
+def test_get_weights_layer_order_beyond_ten_layers():
+    """11+ same-type layers: flat order is LAYER order, not lexicographic
+    dict order (where 'dense_10' would precede 'dense_2')."""
+    m = models.Sequential([ops.Dense(4) for _ in range(12)])
+    m.compile(loss="mse", optimizer="sgd")
+    m.build((4,))
+    ws = m.get_weights()
+    assert len(ws) == 24                   # kernel+bias per layer
+    # poison layer index 2's kernel (per-layer leaf order is sorted:
+    # [bias, kernel], so the kernel sits at slot 2*L + 1) and check it
+    # lands on 'dense_2', not 'dense_10'
+    ws = [w.copy() for w in ws]
+    ws[2 * 2 + 1] = np.full_like(ws[2 * 2 + 1], 7.0)
+    m.set_weights(ws)
+    assert float(np.asarray(
+        m.state.params["dense_2"]["kernel"]).max()) == 7.0
+    assert float(np.asarray(
+        m.state.params["dense_10"]["kernel"]).max()) < 7.0
