@@ -3,13 +3,33 @@
 Default metric (per BASELINE.md): MNIST-MLP training examples/sec/chip,
 measured on the framework's compiled data-parallel train step on whatever
 devices are available (the real TPU chip under the driver; the virtual CPU
-mesh in tests), plus a convergence gate (final eval accuracy must clear 0.9
-on the synthetic set or the result is reported as failed).
+mesh in tests), plus a convergence gate (final eval accuracy must clear the
+per-provenance threshold or the result is reported as failed).
 
 Other configs: ``python bench.py --config=cifar_cnn|resnet50|bert|gpt``
 measure those rows (same JSON shape; resnet50/bert are throughput+finite-loss
 benches, no convergence gate).  ``DTTPU_BENCH_SMOKE=1`` shrinks model/batch
 sizes so every config path smoke-runs on the CPU mesh.
+
+Supervisor layer (the default entry): the axon TPU tunnel can hang
+indefinitely during backend init, so the bench re-runs itself as a child
+subprocess — a hung attempt is killed and retried in a FRESH process (the
+hang is in first-touch backend init; a second attempt often wins tunnel
+flakes), and if the tunnel is down hard the final attempt measures on the
+virtual 8-device CPU mesh and labels the metric ``*_CPU_FALLBACK``.  The
+driver therefore always receives a nonzero, honestly-labeled number.
+Env knobs: ``DTTPU_BENCH_TPU_ATTEMPTS`` (default 2),
+``DTTPU_BENCH_INIT_TIMEOUT`` (total backend-init budget, split across
+attempts; default 240 s), ``DTTPU_BENCH_RUN_TIMEOUT`` (per-attempt wall
+clock; default 900 s), ``DTTPU_BENCH_NO_SUPERVISOR=1`` (run inline).
+
+Every JSON line also carries an ``mfu`` field when the chip's peak FLOP/s is
+known (model FLOPs utilisation = achieved FLOP/s ÷ peak): the per-step FLOP
+count comes from XLA's own cost analysis of the exact compiled executable
+(``lower().compile().cost_analysis()``), falling back to an analytic model.
+Image benches carry ``data: real|synthetic`` provenance (real files under
+``DTTPU_DATA_DIR`` vs the procedural stand-ins in data/datasets.py) and gate
+convergence on the provenance-appropriate threshold.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md:
 "published: {}"), so the baseline is a measured stand-in for its
@@ -45,6 +65,21 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+_SYNC = None
+
+
+def _sync_every_step() -> bool:
+    """XLA:CPU collective rendezvous can't take deep async dispatch queues
+    (a 40 s thread rendezvous deadlocks under many queued steps), so on the
+    CPU mesh every step is blocked individually; on TPU the queue stays
+    async and only the window-closing value fetch blocks."""
+    global _SYNC
+    if _SYNC is None:
+        import jax
+        _SYNC = SMOKE or jax.default_backend() == "cpu"
+    return _SYNC
+
+
 def _fetch(metrics) -> float:
     """Device->host fetch of the loss — the only reliable completion
     barrier.  Over the axon TPU tunnel ``jax.block_until_ready`` returns
@@ -54,6 +89,80 @@ def _fetch(metrics) -> float:
     every step ran."""
     import numpy as np
     return float(np.asarray(metrics["loss"]).ravel()[-1])
+
+
+# ---------------------------------------------------------------------------
+# MFU accounting
+
+# bf16 peak FLOP/s per chip by device_kind substring (public TPU specs).
+_PEAK_BF16 = [("v6e", 918e12), ("v6 lite", 918e12), ("v5p", 459e12),
+              ("v5e", 197e12), ("v5 lite", 197e12), ("v4", 275e12),
+              ("v3", 123e12), ("v2", 46e12)]
+
+
+def _peak_flops_per_chip():
+    """Per-chip peak bf16 FLOP/s, or None when unknown (CPU mesh).
+    ``DTTPU_PEAK_FLOPS`` overrides for parts not in the table."""
+    env = os.environ.get("DTTPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    import jax
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    if dev.platform == "cpu":
+        return None
+    for key, val in _PEAK_BF16:
+        if key in kind:
+            return val
+    return None
+
+
+def _flops_of(fn, *args):
+    """Total FLOPs of one call of a jitted ``fn`` on ``args``, from XLA's
+    cost analysis of the exact compiled executable.  Returns None when the
+    backend doesn't report flops.  Lowering is shape-only (nothing runs,
+    donated buffers are untouched)."""
+    try:
+        target = fn if hasattr(fn, "lower") else None
+        if target is None:
+            return None
+        cost = target.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float(cost.get("flops", 0.0) or 0.0)
+        return f if f > 0 else None
+    except Exception as e:  # pragma: no cover - backend-specific
+        log(f"cost_analysis unavailable ({e})")
+        return None
+
+
+def _attach_mfu(result: dict, rate_per_chip: float, flops_per_example,
+                analytic=None) -> dict:
+    """Add flops/example + mfu fields to a bench result.  ``rate_per_chip``
+    is examples/s/chip (or tokens/s/chip with flops per token)."""
+    f = flops_per_example or analytic
+    if not f:
+        return result
+    result["flops_per_example"] = round(float(f), 1)
+    result["flops_source"] = "xla" if flops_per_example else "analytic"
+    peak = _peak_flops_per_chip()
+    if peak:
+        result["mfu"] = round(rate_per_chip * f / peak, 4)
+    return result
+
+
+def _transformer_flops_per_token(params, num_layers: int, hidden: int,
+                                 seq: int) -> float:
+    """Analytic training FLOPs/token for a dense transformer: 6N for the
+    matmul path (fwd 2N + bwd 4N) + 12*L*h*s for attention logits/context
+    (fwd 4*L*h*s halves for QK^T and PV, x3 for training)."""
+    import jax
+    n = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    return 6.0 * n + 12.0 * num_layers * hidden * seq
+
+
+# ---------------------------------------------------------------------------
+# Measurement core
 
 
 def bench_framework():
@@ -68,7 +177,9 @@ def bench_framework():
     log(f"framework: {n_chips} x {jax.devices()[0].platform}, "
         f"mesh={dict(mesh.shape)}")
 
-    (xt, yt), (xv, yv) = data.mnist(flatten=True)
+    data_dir = os.environ.get("DTTPU_DATA_DIR")
+    prov = data.provenance("mnist", data_dir)
+    (xt, yt), (xv, yv) = data.mnist(data_dir, flatten=True)
     model = models.mnist_mlp()
     optimizer = optim.adam()
     step = train.make_train_step(model, "sparse_categorical_crossentropy",
@@ -84,15 +195,13 @@ def bench_framework():
     # backend="auto": the native C++ threaded gather loader when built.
     ds = data.Dataset([xt, yt], batch, seed=0, backend="auto")
 
-    # Convergence gate: a couple of epochs must clear 0.9 eval accuracy.
-    # (XLA:CPU collective rendezvous can't take deep async queues — sync
-    # each step in smoke mode; on TPU the queue stays async.)
+    # Convergence gate: a couple of epochs must clear the eval threshold.
     for b in ds.epochs(1 if SMOKE else 2):
         state, m_ = step(state, jax.device_put(b, bsh))
-        if SMOKE:
+        if _sync_every_step():
             jax.block_until_ready(m_["loss"])
     acc = float(eval_step(state, (xv[:8192], yv[:8192]))["accuracy"])
-    log(f"eval accuracy after 2 epochs: {acc:.4f}")
+    log(f"eval accuracy after 2 epochs ({prov} data): {acc:.4f}")
 
     # Throughput: the framework's multi-step path — STEPS_PER_CALL updates
     # scanned inside ONE compiled dispatch (train.make_multi_train_step), a
@@ -105,13 +214,15 @@ def bench_framework():
     ys = np.resize(yt, (k * batch,)).reshape(k, batch)
     msh = NamedSharding(mesh, P(None, "data"))
     bench_batch = (jax.device_put(xs, msh), jax.device_put(ys, msh))
+    f_total = _flops_of(multi, state, bench_batch)
+    flops_per_example = f_total / (k * batch) if f_total else None
     for _ in range(WARMUP_CALLS):
         state, m = multi(state, bench_batch)
     _fetch(m)
     t0 = time.perf_counter()
     for _ in range(CALLS):
         state, m = multi(state, bench_batch)
-        if SMOKE:
+        if _sync_every_step():
             jax.block_until_ready(m["loss"])
     _fetch(m)
     dt = time.perf_counter() - t0
@@ -131,14 +242,15 @@ def bench_framework():
     t0 = time.perf_counter()
     for _ in range(n_single):
         state, m = step(state, single_batch)
-        if SMOKE:
+        if _sync_every_step():
             jax.block_until_ready(m["loss"])
     _fetch(m)
     dts = time.perf_counter() - t0
     eps_single = n_single * batch / dts
     log(f"framework (single-step): {eps_single:,.0f} examples/s total "
         f"({dts / n_single * 1e3:.2f} ms/step)")
-    return eps / n_chips, acc, eps_single / n_chips
+    return (eps / n_chips, acc, eps_single / n_chips, prov,
+            flops_per_example)
 
 
 def bench_torch_baseline():
@@ -162,20 +274,20 @@ def bench_torch_baseline():
 def _time_steps(step, state, batch, warmup=3, steps=12):
     """Generic throughput timing for a compiled train step.  Returns
     (steps/sec, last loss, sec/step); per-chip normalization is the
-    caller's job.  SMOKE syncs every step (XLA:CPU collective rendezvous
-    can't take deep async queues)."""
+    caller's job.  On the CPU mesh every step is synced (see
+    ``_sync_every_step``)."""
     import jax
     if SMOKE:
         warmup, steps = min(warmup, 2), min(steps, 4)
     for _ in range(warmup):
         state, m = step(state, batch)
-        if SMOKE:
+        if _sync_every_step():
             jax.block_until_ready(m["loss"])
     _fetch(m)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = step(state, batch)
-        if SMOKE:
+        if _sync_every_step():
             jax.block_until_ready(m["loss"])
     loss = _fetch(m)
     dt = time.perf_counter() - t0
@@ -183,7 +295,22 @@ def _time_steps(step, state, batch, warmup=3, steps=12):
 
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Ran out of memory", "out of memory",
-                "hbm capacity")
+                "hbm capacity", "Allocation failure")
+
+
+def _is_oom(e: Exception) -> bool:
+    """OOM classification for the batch ladder.  Primary signal: a jaxlib
+    ``XlaRuntimeError`` whose status line is RESOURCE_EXHAUSTED (the
+    canonical ``{code}: {message}`` rendering); the marker substrings cover
+    runtimes that phrase allocation failure differently."""
+    try:
+        from jax.errors import JaxRuntimeError
+        if (isinstance(e, JaxRuntimeError)
+                and str(e).lstrip().startswith("RESOURCE_EXHAUSTED")):
+            return True
+    except ImportError:
+        pass
+    return any(k in str(e) for k in _OOM_MARKERS)
 
 
 def _run_batch_ladder(name, ladder, mesh, build, step, warmup, steps):
@@ -196,7 +323,7 @@ def _run_batch_ladder(name, ladder, mesh, build, step, warmup, steps):
     rungs' buffers are dropped before the next allocation so the retry
     doesn't OOM on the dead rung's memory.
 
-    Returns (steps/sec, loss, sec/step, global_batch).
+    Returns (steps/sec, loss, sec/step, global_batch, step_flops|None).
     """
     from distributed_tensorflow_tpu import parallel
     err = None
@@ -205,11 +332,12 @@ def _run_batch_ladder(name, ladder, mesh, build, step, warmup, steps):
             per_chip * parallel.data_shards(mesh), mesh)
         state, bench_batch = build(batch)
         try:
+            flops = _flops_of(step, state, bench_batch)
             rate, loss, ms = _time_steps(step, state, bench_batch,
                                          warmup=warmup, steps=steps)
-            return rate, loss, ms, batch
+            return rate, loss, ms, batch, flops
         except Exception as e:
-            if not any(k in str(e) for k in _OOM_MARKERS):
+            if not _is_oom(e):
                 raise
             err = e
             log(f"{name}: batch {per_chip}/chip OOM; retrying smaller")
@@ -248,7 +376,9 @@ def bench_cifar_cnn():
     n_chips = len(jax.devices())
     mesh = parallel.data_parallel_mesh()
     batch = parallel.round_batch_to_mesh(64 if SMOKE else 1024, mesh)
-    (xt, yt), (xv, yv) = data.cifar10()
+    data_dir = os.environ.get("DTTPU_DATA_DIR")
+    prov = data.provenance("cifar10", data_dir)
+    (xt, yt), (xv, yv) = data.cifar10(data_dir)
     model = models.cifar_cnn()
     optimizer = optim.adam()
     step = train.make_train_step(model, "sparse_categorical_crossentropy",
@@ -265,9 +395,12 @@ def bench_cifar_cnn():
         state, m = step(state, jax.device_put(b, bsh))
         if SMOKE:
             break
+        if _sync_every_step():
+            jax.block_until_ready(m["loss"])
     acc = float(eval_step(state, (xv[:2048], yv[:2048]))["accuracy"])
-    log(f"cifar_cnn eval accuracy: {acc:.4f}")
+    log(f"cifar_cnn eval accuracy ({prov} data): {acc:.4f}")
     bench_batch = jax.device_put(next(iter(ds)), bsh)
+    f_total = _flops_of(step, state, bench_batch)
     rate, loss, ms = _time_steps(step, state, bench_batch)
     eps = rate * batch / n_chips
     log(f"cifar_cnn: {eps:,.0f} examples/s/chip ({ms*1e3:.2f} ms/step)")
@@ -288,12 +421,14 @@ def bench_cifar_cnn():
         return m, lambda out: ce(out, y), torch.optim.Adam(m.parameters()), (x,), tb
 
     baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE["cifar_cnn"]
-    gate = 0.15 if SMOKE else 0.35
-    return dict(metric="cifar_cnn_train_examples_per_sec_per_chip"
-                       + ("" if acc > gate else "_NOT_CONVERGED"),
-                value=round(eps, 1), unit="examples/sec/chip",
-                vs_baseline=round(eps / baseline, 3),
-                eval_accuracy=round(acc, 4))
+    gate = 0.15 if SMOKE else (0.40 if prov == "real" else 0.35)
+    result = dict(metric="cifar_cnn_train_examples_per_sec_per_chip"
+                         + ("" if acc > gate else "_NOT_CONVERGED"),
+                  value=round(eps, 1), unit="examples/sec/chip",
+                  vs_baseline=round(eps / baseline, 3),
+                  eval_accuracy=round(acc, 4), data=prov)
+    return _attach_mfu(result, eps, f_total / batch if f_total else None,
+                       analytic=1.53e8)
 
 
 def bench_resnet50():
@@ -325,7 +460,7 @@ def bench_resnet50():
 
     # 256/chip measured +22% over 64/chip on v5e (probe 2026-07-30); the
     # ladder descends on smaller-HBM parts.
-    rate, loss, ms, batch = _run_batch_ladder(
+    rate, loss, ms, batch, f_total = _run_batch_ladder(
         "resnet50", [8] if SMOKE else [256, 128, 64], mesh, build, step,
         warmup=2, steps=4 if SMOKE else 10)
     eps = rate * batch / n_chips
@@ -349,11 +484,13 @@ def bench_resnet50():
 
     baseline = _torch_step_rate(torch_build) or FALLBACK_BASELINE["resnet50"]
     finite = np.isfinite(loss)
-    return dict(metric="resnet50_train_examples_per_sec_per_chip"
-                       + ("" if finite else "_NONFINITE_LOSS"),
-                value=round(eps, 2), unit="examples/sec/chip",
-                vs_baseline=round(eps / baseline, 3),
-                image_size=size)
+    result = dict(metric="resnet50_train_examples_per_sec_per_chip"
+                         + ("" if finite else "_NONFINITE_LOSS"),
+                  value=round(eps, 2), unit="examples/sec/chip",
+                  vs_baseline=round(eps / baseline, 3),
+                  image_size=size, batch=batch)
+    return _attach_mfu(result, eps, f_total / batch if f_total else None,
+                       analytic=12.3e9 * (size / 224) ** 2)
 
 
 def bench_bert():
@@ -394,28 +531,33 @@ def bench_bert():
 
     # 96/chip measured best on v5e (probe 2026-07-30: 109k tok/s/chip vs
     # 85k at 32/chip; 128/chip OOMs without remat at seq 128).
-    rate, loss, ms, batch = _run_batch_ladder(
+    rate, loss, ms, batch, f_total = _run_batch_ladder(
         "bert", [4] if SMOKE else [96, 48, 24], mesh, build, step,
         warmup=2, steps=4 if SMOKE else 10)
     tokens = rate * batch * seq / n_chips
     log(f"bert: {tokens:,.0f} tokens/s/chip ({ms*1e3:.1f} ms/step, "
         f"loss={loss:.3f})")
     finite = np.isfinite(loss)
-    return dict(metric="bert_mlm_train_tokens_per_sec_per_chip"
-                       + ("" if finite else "_NONFINITE_LOSS"),
-                value=round(tokens, 1), unit="tokens/sec/chip",
-                vs_baseline=1.0,  # no runnable reference-era BERT
-                # baseline exists; 1.0 = "unity ratio by definition"
-                seq_len=seq, batch=batch)
+    result = dict(metric="bert_mlm_train_tokens_per_sec_per_chip"
+                         + ("" if finite else "_NONFINITE_LOSS"),
+                  value=round(tokens, 1), unit="tokens/sec/chip",
+                  vs_baseline=1.0,  # no runnable reference-era BERT
+                  # baseline exists; 1.0 = "unity ratio by definition"
+                  seq_len=seq, batch=batch)
+    return _attach_mfu(
+        result, tokens, f_total / (batch * seq) if f_total else None,
+        analytic=_transformer_flops_per_token(params, config.num_layers,
+                                              config.hidden_size, seq))
 
 
 def bench_mnist_mlp():
-    value, acc, value_single = bench_framework()
+    value, acc, value_single, prov, flops = bench_framework()
     baseline = bench_torch_baseline()
     if baseline is None:
         baseline = FALLBACK_BASELINE["mnist_mlp"]
-    converged = acc > 0.9
-    return {
+    gate = 0.95 if prov == "real" else 0.9
+    converged = acc > gate
+    result = {
         "metric": "mnist_mlp_train_examples_per_sec_per_chip"
                   + ("" if converged else "_NOT_CONVERGED"),
         "value": round(value, 1),
@@ -424,7 +566,9 @@ def bench_mnist_mlp():
         "steps_per_call": STEPS_PER_CALL,
         "single_step_value": round(value_single, 1),
         "eval_accuracy": round(acc, 4),
+        "data": prov,
     }
+    return _attach_mfu(result, value, flops, analytic=6.1e5)
 
 
 def bench_gpt():
@@ -439,7 +583,7 @@ def bench_gpt():
 
     n_chips = len(jax.devices())
     mesh = parallel.data_parallel_mesh()
-    seq = 256
+    seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
     config = (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                         num_heads=2, intermediate_size=512,
                         max_position=seq, dtype=jnp.bfloat16,
@@ -465,18 +609,25 @@ def bench_gpt():
         bench_batch = jax.device_put({"input_ids": tokens}, bsh)
         return state, bench_batch
 
-    rate, loss, ms, batch = _run_batch_ladder(
-        "gpt", [4] if SMOKE else [48, 24, 12], mesh, build, step,
+    ladder = ([4] if SMOKE else
+              [max(1, 48 * 256 // seq), max(1, 24 * 256 // seq),
+               max(1, 12 * 256 // seq)])
+    rate, loss, ms, batch, f_total = _run_batch_ladder(
+        "gpt", ladder, mesh, build, step,
         warmup=2, steps=4 if SMOKE else 10)
     tokens_s = rate * batch * seq / n_chips
     log(f"gpt: {tokens_s:,.0f} tokens/s/chip ({ms*1e3:.1f} ms/step, "
         f"loss={loss:.3f})")
     finite = np.isfinite(loss)
-    return dict(metric="gpt_lm_train_tokens_per_sec_per_chip"
-                       + ("" if finite else "_NONFINITE_LOSS"),
-                value=round(tokens_s, 1), unit="tokens/sec/chip",
-                vs_baseline=1.0,  # no reference-era GPT baseline exists
-                seq_len=seq, batch=batch)
+    result = dict(metric="gpt_lm_train_tokens_per_sec_per_chip"
+                         + ("" if finite else "_NONFINITE_LOSS"),
+                  value=round(tokens_s, 1), unit="tokens/sec/chip",
+                  vs_baseline=1.0,  # no reference-era GPT baseline exists
+                  seq_len=seq, batch=batch)
+    return _attach_mfu(
+        result, tokens_s, f_total / (batch * seq) if f_total else None,
+        analytic=_transformer_flops_per_token(params, config.num_layers,
+                                              config.hidden_size, seq))
 
 
 CONFIGS = {
@@ -488,6 +639,85 @@ CONFIGS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Supervisor: retry backend bring-up in fresh subprocesses, CPU fallback.
+
+
+def _parse_last_json(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _result_ok(r) -> bool:
+    return (isinstance(r, dict) and float(r.get("value", 0) or 0) > 0
+            and "TIMEOUT" not in str(r.get("metric", "")))
+
+
+def _run_child(extra_argv, env, timeout):
+    """One bench attempt in a fresh interpreter.  Returns (parsed JSON or
+    None, reason string).  stderr passes through; stdout is captured so
+    exactly one JSON line ever reaches the real stdout."""
+    import subprocess
+    cmd = [sys.executable, os.path.abspath(__file__)] + sys.argv[1:] + extra_argv
+    try:
+        proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode("utf-8", "replace") if e.stdout else ""
+        return _parse_last_json(out), f"RUN_TIMEOUT after {timeout:.0f}s"
+    out = proc.stdout.decode("utf-8", "replace")
+    return _parse_last_json(out), f"rc={proc.returncode}"
+
+
+def supervise(config: str) -> int:
+    attempts = int(os.environ.get("DTTPU_BENCH_TPU_ATTEMPTS", "2"))
+    init_total = float(os.environ.get("DTTPU_BENCH_INIT_TIMEOUT", "240"))
+    run_timeout = float(os.environ.get("DTTPU_BENCH_RUN_TIMEOUT", "900"))
+    env = dict(os.environ, DTTPU_BENCH_CHILD="1")
+    # Split the init budget across attempts: the hang is in first-touch
+    # backend init, and a fresh process's second try often wins tunnel
+    # flakes that a single long wait never recovers from.
+    env["DTTPU_BENCH_INIT_TIMEOUT"] = str(max(60.0,
+                                              init_total / max(1, attempts)))
+    last = None
+    for i in range(attempts):
+        env["DTTPU_BENCH_ATTEMPT"] = str(i)
+        log(f"supervisor: attempt {i + 1}/{attempts} "
+            f"(init timeout {float(env['DTTPU_BENCH_INIT_TIMEOUT']):.0f}s)")
+        r, why = _run_child([], env, run_timeout)
+        if _result_ok(r):
+            print(json.dumps(r), flush=True)
+            return 0
+        last = r or last
+        log(f"supervisor: attempt {i + 1} failed ({why})")
+    log("supervisor: backend attempts exhausted; "
+        "measuring on the virtual CPU mesh (labeled _CPU_FALLBACK)")
+    cenv = dict(env, DTTPU_BENCH_ATTEMPT="-1")
+    cenv["XLA_FLAGS"] = (cenv.get("XLA_FLAGS", "")
+                         + " --xla_force_host_platform_device_count=8").strip()
+    if config != "mnist_mlp":
+        # Full-size conv/transformer configs are too slow for a bounded CPU
+        # run; the smoke-sized number is still nonzero and labeled.
+        cenv["DTTPU_BENCH_SMOKE"] = "1"
+    r, why = _run_child(["--device=cpu"], cenv, run_timeout)
+    if _result_ok(r):
+        r["metric"] = str(r["metric"]) + "_CPU_FALLBACK"
+        r["fallback"] = "cpu"
+        print(json.dumps(r), flush=True)
+        return 0
+    log(f"supervisor: CPU fallback failed too ({why})")
+    print(json.dumps(last or dict(metric=config + "_BENCH_FAILED", value=0.0,
+                                  unit="examples/sec/chip", vs_baseline=0.0)),
+          flush=True)
+    return 3
+
+
 def main():
     config = "mnist_mlp"
     device = os.environ.get("DTTPU_BENCH_DEVICE")
@@ -496,18 +726,33 @@ def main():
             device = arg.split("=", 1)[1]
             continue
         config = arg.split("=", 1)[1] if arg.startswith("--config=") else arg
+    if config not in CONFIGS:
+        log(f"unknown config {config!r}; choices: {sorted(CONFIGS)}")
+        sys.exit(2)
+
+    if (not os.environ.get("DTTPU_BENCH_CHILD")
+            and not os.environ.get("DTTPU_BENCH_NO_SUPERVISOR")):
+        sys.exit(supervise(config))
+
+    # Test hook: simulate a dead tunnel for supervisor tests.  Fails TPU
+    # attempts (attempt >= 0) below the threshold; the CPU fallback child
+    # runs with attempt=-1 and is never failed.
+    fail_below = int(os.environ.get("DTTPU_BENCH_TEST_FAIL_BELOW", "0"))
+    attempt = int(os.environ.get("DTTPU_BENCH_ATTEMPT", "-1"))
+    if fail_below and 0 <= attempt < fail_below:
+        log("test hook: simulated backend failure")
+        sys.exit(7)
+
     if device:
         # The axon sitecustomize force-selects the TPU platform at the
         # config level, so an env var alone cannot redirect to CPU.
         import jax
         jax.config.update("jax_platforms", device)
-    if config not in CONFIGS:
-        log(f"unknown config {config!r}; choices: {sorted(CONFIGS)}")
-        sys.exit(2)
 
     # The axon TPU tunnel can hang indefinitely (even jax.devices() blocks).
     # A hung bench leaves the driver with nothing; emit a failure JSON line
-    # instead if the backend doesn't come up within the timeout.
+    # instead if the backend doesn't come up within the timeout.  (The
+    # supervisor treats that line as a failed attempt and retries.)
     import threading
     ready = threading.Event()
     timeout_s = float(os.environ.get("DTTPU_BENCH_INIT_TIMEOUT", "240"))

@@ -1,7 +1,7 @@
 """Data subsystem: synthetic tasks, dataset loaders, device-prefetch pipeline."""
 
 from . import augment, datasets, pipeline, text, tfrecord, xor
-from .datasets import cifar10, mnist, synthetic_image_classes
+from .datasets import cifar10, mnist, provenance, synthetic_image_classes
 from .pipeline import Dataset, prefetch_to_device
 from .text import BPETokenizer, ByteTokenizer
 from .tfrecord import (RecordWriter, read_tfrecord,
@@ -11,6 +11,6 @@ from .xor import get_data as xor_data
 __all__ = ["augment", "datasets", "pipeline", "text", "tfrecord", "xor",
            "BPETokenizer", "ByteTokenizer",
            "RecordWriter", "read_tfrecord", "tfrecord_batches",
-           "write_tfrecord", "cifar10", "mnist",
+           "write_tfrecord", "cifar10", "mnist", "provenance",
            "synthetic_image_classes", "Dataset", "prefetch_to_device",
            "xor_data"]

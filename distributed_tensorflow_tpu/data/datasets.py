@@ -24,7 +24,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["mnist", "cifar10", "synthetic_image_classes"]
+__all__ = ["mnist", "cifar10", "synthetic_image_classes", "provenance"]
 
 Arrays = Tuple[Tuple[np.ndarray, np.ndarray], Tuple[np.ndarray, np.ndarray]]
 
@@ -112,23 +112,46 @@ def _find(data_dir: str, names) -> Optional[str]:
     return None
 
 
+_MNIST_IDX_NAMES = (["train-images-idx3-ubyte", "train-images.idx3-ubyte"],
+                    ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"],
+                    ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"],
+                    ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])
+
+
+def provenance(dataset: str, data_dir: Optional[str] = None) -> str:
+    """``"real"`` when the on-disk files ``mnist()``/``cifar10()`` would
+    load exist under ``data_dir``, else ``"synthetic"`` (the procedural
+    class-prototype stand-ins).  Benchmarks label their JSON output with
+    this so a throughput/accuracy number can never silently pass off the
+    synthetic task as the real dataset."""
+    if not data_dir:
+        return "synthetic"
+    if dataset == "mnist":
+        if _find(data_dir, ["mnist.npz"]):
+            return "real"
+        return ("real" if all(_find(data_dir, names)
+                              for names in _MNIST_IDX_NAMES) else "synthetic")
+    if dataset == "cifar10":
+        if (_find(data_dir, ["cifar10.npz"]) or
+                os.path.isdir(os.path.join(data_dir, "cifar-10-batches-py"))):
+            return "real"
+        return "synthetic"
+    raise ValueError(f"unknown dataset {dataset!r}; choices: mnist, cifar10")
+
+
 def mnist(data_dir: Optional[str] = None, flatten: bool = False,
           seed: int = 0) -> Arrays:
     """(x_train, y_train), (x_test, y_test); images float32 [0,1] 28x28x1."""
     loaded = None
     if data_dir:
         npz = _find(data_dir, ["mnist.npz"])
-        xi = _find(data_dir, ["train-images-idx3-ubyte",
-                              "train-images.idx3-ubyte"])
+        xi = _find(data_dir, _MNIST_IDX_NAMES[0])
         if npz:
             with np.load(npz) as z:
                 loaded = ((z["x_train"], z["y_train"]),
                           (z["x_test"], z["y_test"]))
         elif xi:
-            rest = [_find(data_dir, names) for names in (
-                ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"],
-                ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"],
-                ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])]
+            rest = [_find(data_dir, names) for names in _MNIST_IDX_NAMES[1:]]
             if all(rest):
                 yt_p, xe_p, ye_p = rest
                 loaded = ((_read_idx(xi), _read_idx(yt_p)),

@@ -39,6 +39,12 @@ class TensorBoard(Callback):
 
     def on_train_begin(self, model) -> None:
         self._writer = SummaryWriter(self.log_dir)
+        # graph topology event (reference example.py:195 add_graph parity);
+        # a model without an ordered layer list just skips it
+        try:
+            self._writer.add_graph(model)
+        except TypeError:
+            pass
 
     def on_epoch_end(self, model, epoch, logs) -> None:
         if self._writer and logs:
@@ -193,11 +199,20 @@ class CSVLogger(Callback):
         if self.append:
             # appending to a file with content: its header already exists —
             # never write a second one mid-file (Keras CSVLogger behavior)
-            if self._keys is None and os.path.exists(self.filename):
+            if self._keys is None and os.path.exists(self.filename) \
+                    and os.path.getsize(self.filename) > 0:
                 with open(self.filename) as f:
                     header = f.readline().strip()
                 if header.startswith("epoch,"):
                     self._keys = header.split(",")[1:]
+                else:
+                    # Appending rows under a foreign header would interleave
+                    # two incompatible tables in one file; refuse instead.
+                    raise ValueError(
+                        f"CSVLogger(append=True): {self.filename} has an "
+                        f"incompatible header {header!r} (expected it to "
+                        "start with 'epoch,'); pass append=False to "
+                        "overwrite or point at a fresh file")
         else:
             self._keys = None   # truncated file needs its header rewritten
         self._file = open(self.filename, "a" if self.append else "w")

@@ -427,6 +427,10 @@ class GPT:
         are masked out of attention and each row's position indices are
         shifted by its pad length, so learned and RoPE models both see the
         row's true positions — batch serving for unequal prompt lengths.
+        The left-padding contract is only VALIDATED on concrete masks:
+        under jit the check cannot run, and a right-padded mask silently
+        yields wrong positions/attention — callers tracing this must
+        guarantee left-padding themselves.
         """
         from ..ops import decoding as dec
         c = self.config
@@ -519,7 +523,8 @@ class GPT:
 
         ``prompt_valid``: LEFT-padded ragged prompts, same contract as
         ``generate`` — pad slots masked from attention, per-row position
-        shift through prefill and expansion.
+        shift through prefill and expansion.  As there, the left-padding
+        check only runs on concrete masks; under jit the caller owns it.
         """
         from ..ops import decoding as dec
 

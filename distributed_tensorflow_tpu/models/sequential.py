@@ -137,7 +137,7 @@ class Sequential:
             callbacks: Sequence[Callback] = (),
             shuffle: bool = True, seed: int = 0,
             verbose: int = 1, augment=None,
-            class_weight=None) -> History:
+            class_weight=None, sample_weight=None) -> History:
         """reference example2.py:197-200 parity (sync-DP underneath).
 
         ``augment``: per-batch transform from ``data.augment`` (host-side,
@@ -152,9 +152,33 @@ class Sequential:
         (Keras semantics; validation stays unweighted).  Requires a
         string classification loss (see ``ops.losses.class_weighted``);
         each distinct weighting compiles its own step once and is cached.
+
+        ``sample_weight``: per-sample float array [n] weighting the
+        TRAINING loss with Keras 2.0.8's exact normalization
+        (``sum(loss_i * w_i) / count_nonzero(w)`` — the
+        ``weighted_masked_objective`` rule the reference's ``model.fit``
+        applies, reference example2.py:200).  The weights ride the batch
+        tuple through ONE compiled weighted step (no recompile per call);
+        shuffling/sharding stay aligned with (x, y).  Assumes a loss whose
+        batch value is the mean of independent per-sample terms (true of
+        every registry loss).  Divergences from Keras 2.0.8, by design:
+        metrics stay unweighted, and combining with ``class_weight``
+        raises instead of silently preferring ``sample_weight``.
         """
         c = self._require_compiled()
         train_step = c["train_step"]
+        if sample_weight is not None:
+            if class_weight is not None:
+                raise ValueError(
+                    "pass either sample_weight or class_weight, not both "
+                    "(Keras 2.0.8 silently ignored class_weight here; "
+                    "refusing is safer)")
+            sample_weight = np.asarray(sample_weight, np.float32)
+            if sample_weight.shape != (int(np.shape(x)[0]),):
+                raise ValueError(
+                    f"sample_weight shape {sample_weight.shape} != "
+                    f"({int(np.shape(x)[0])},) — one float per sample")
+            train_step = self._sample_weighted_step(c)
         if class_weight is not None:
             if c["loss_name"] is None:
                 raise ValueError("class_weight needs the model compiled "
@@ -176,6 +200,8 @@ class Sequential:
             x, y = np.asarray(x), np.asarray(y)
             validation_data = (x[split:], y[split:])
             x, y = x[:split], y[:split]
+            if sample_weight is not None:   # held-out rows eval unweighted
+                sample_weight = sample_weight[:split]
         if self.state is None:
             self.build(tuple(np.shape(x)[1:]), seed=seed)
 
@@ -190,7 +216,10 @@ class Sequential:
                 log.info("batch_size %d -> %d (divisible by mesh data shards)",
                          batch_size, rounded)
                 batch_size = rounded
-        dataset = Dataset([np.asarray(x), np.asarray(y)], batch_size,
+        arrays = [np.asarray(x), np.asarray(y)]
+        if sample_weight is not None:
+            arrays.append(sample_weight)   # shuffles/shards with (x, y)
+        dataset = Dataset(arrays, batch_size,
                           shuffle=shuffle, seed=seed, transform=augment)
         sharding = None
         if c["mesh"] is not None:
@@ -233,6 +262,44 @@ class Sequential:
         for cb in callbacks:
             cb.on_train_end(self)
         return history
+
+    def _sample_weighted_step(self, c) -> Any:
+        """Compiled ``step(state, (x, y, w))`` applying Keras 2.0.8's
+        sample-weight rule; built once per compile and cached (the weights
+        are batch data, so every fit(sample_weight=...) reuses it)."""
+        if "sample_step" in c:
+            return c["sample_step"]
+        loss_value_fn = c["loss"]
+        metric_fns = c["metric_fns"]
+        stack = self.stack
+
+        def loss_fn(params, model_state, batch, rng, train):
+            xb, yb, wb = batch
+            preds, new_ms = stack.apply(params, model_state, xb,
+                                        train=train, rng=rng)
+            # per-sample losses: the scalar loss of each sample's own
+            # [1, ...] slice (exact for any mean-of-per-sample-terms loss)
+            per = jax.vmap(
+                lambda pi, yi: loss_value_fn(pi[None], yi[None]))(preds, yb)
+            w = wb.astype(per.dtype)
+            nonzero = jnp.sum((w != 0).astype(per.dtype))
+            loss = jnp.sum(per * w) / jnp.maximum(nonzero, 1.0)
+            metrics = {name: metric_lib.get(fn)(preds, yb)
+                       for name, fn in metric_fns.items()}
+            return loss, (metrics, new_ms)
+
+        kw = c["step_kwargs"]
+        mesh, state_sh, batch_sh = kw["mesh"], None, None
+        if mesh is not None:
+            from jax.sharding import PartitionSpec
+            state_sh, (bx, by) = step_lib._state_batch_shardings(
+                mesh, kw["params_spec"], PartitionSpec("data"))
+            batch_sh = (bx, by, by)
+        c["sample_step"] = step_lib.make_custom_train_step(
+            loss_fn, c["optimizer"], seed=kw["seed"], mesh=mesh,
+            state_shardings=state_sh, batch_shardings=batch_sh,
+            grad_clip_norm=kw["grad_clip_norm"], policy=kw["policy"])
+        return c["sample_step"]
 
     # -- single-batch steps (Keras train/test/predict_on_batch parity) ---
     def _mesh_batch(self, x, y, train: bool):

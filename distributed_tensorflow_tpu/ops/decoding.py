@@ -24,7 +24,13 @@ def ragged_prompt_masks(prompt_valid, prompt_shape: Tuple[int, int],
     """Validate a LEFT-padded ``prompt_valid`` mask and derive the decode
     quantities both ``generate`` and ``beam_search`` need:
     ``pad_len`` [b] (per-row pad count, for position shifting) and
-    ``kv_valid`` [b, max_len] (pad slots False, generated slots True)."""
+    ``kv_valid`` [b, max_len] (pad slots False, generated slots True).
+
+    The left-padded contract is validated on CONCRETE masks only — a
+    tracer can't be inspected, so under jit a right-padded mask silently
+    produces wrong positions and attention masks.  Callers who jit
+    ``generate``/``beam_search`` with ``prompt_valid`` must guarantee
+    left-padding themselves (e.g. validate before tracing)."""
     b, plen = prompt_shape
     if prompt_valid.shape != (b, plen):
         raise ValueError(f"prompt_valid shape {prompt_valid.shape} "

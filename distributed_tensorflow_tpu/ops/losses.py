@@ -210,7 +210,10 @@ def class_weighted(base: str, class_weight):
             p = jnp.clip(preds.astype(jnp.float32), epsilon, 1.0 - epsilon)
             t = targets.astype(jnp.float32)
             bce = -(t * jnp.log(p) + (1.0 - t) * jnp.log1p(-p))
-            w = weight_of(t)
+            # Soft/label-smoothed targets (e.g. 0.9) round to the nearest
+            # class for the weight lookup — a bare int cast would floor
+            # them all to class 0's weight.
+            w = weight_of(t > 0.5)
             return jnp.sum(bce * w) / jnp.maximum(jnp.sum(w), 1e-9)
 
     loss.__name__ = f"class_weighted_{base}"

@@ -181,6 +181,9 @@ def main() -> int:
     # summaries on the reference's fractional-epoch x-axis.
     last_step = FLAGS.epochs * total_batch
     writer = SummaryWriter(FLAGS.log_dir) if is_chief else None
+    if writer is not None:
+        # model topology -> TB graph tab (parity with ref :195 add_graph)
+        writer.add_graph(model)
     hooks = [train.StopAtStepHook(last_step=last_step),
              train.CheckpointHook(every_secs=60.0),
              train.PreemptionHook()]
@@ -198,19 +201,30 @@ def main() -> int:
         for epoch in range(start_epoch, FLAGS.epochs):
             if sess.should_stop():
                 break
-            avg_loss, last = 0.0, {}
+            # Epoch averages (parity with ref :216-217,226: the reference
+            # prints loss/accuracy averaged over the epoch's 600 batches).
+            # Sums accumulate ON DEVICE — one tiny add per step, a single
+            # host fetch per epoch — so the async dispatch queue never
+            # stalls on a per-step device->host sync.
+            loss_sum = acc_sum = None
+            n_batches = 0
             for batch in data.prefetch_to_device(iter(dataset),
                                                  sharding=batch_sharding):
                 if sess.should_stop():
                     break
-                last = sess.run_step(batch)
-            if last:
-                avg_loss = float(last["loss"])
+                m = sess.run_step(batch)
+                loss_sum = (m["loss"] if loss_sum is None
+                            else loss_sum + m["loss"])
+                acc_sum = (m["accuracy"] if acc_sum is None
+                           else acc_sum + m["accuracy"])
+                n_batches += 1
             # Per-print_rate validation (parity with ref :222-226).
             if epoch % print_rate == 0 or epoch == FLAGS.epochs - 1:
                 val = eval_step(sess.state, val_batch)
+                avg_loss = (float(loss_sum) / n_batches) if n_batches else 0.0
+                avg_acc = (float(acc_sum) / n_batches) if n_batches else 0.0
                 print(f"Epoch: {epoch:4d}  loss: {avg_loss:.6f}  "
-                      f"train acc: {float(last.get('accuracy', 0)):.4f}  "
+                      f"train acc: {avg_acc:.4f}  "
                       f"val acc: {float(val['accuracy']):.4f}", flush=True)
                 if writer is not None:
                     writer.add_scalars(

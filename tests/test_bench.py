@@ -1,0 +1,150 @@
+"""bench.py harness tests: supervisor retry/fallback, JSON contract,
+dataset provenance labeling, OOM classification, FLOP accounting.
+
+The reference has no benchmark harness at all (BASELINE.md: "published:
+{}"); bench.py is the driver-facing measurement artifact, so its failure
+handling is tested as first-class behavior — round 1 shipped a 0.0 because
+a tunnel hang had no retry path.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import bench
+from distributed_tensorflow_tpu import data
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _env(**extra):
+    env = dict(os.environ)
+    env.update({"DTTPU_BENCH_SMOKE": "1",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+               **{k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _run(args, env, timeout=600):
+    proc = subprocess.run([sys.executable, BENCH] + args, env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          timeout=timeout, cwd=REPO)
+    return proc
+
+
+class TestSupervisor:
+    def test_smoke_run_single_json_line(self):
+        """A working backend (user-requested CPU) succeeds on attempt 1;
+        stdout carries exactly one JSON line with the full field contract."""
+        proc = _run(["--device=cpu"], _env())
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1, lines
+        r = json.loads(lines[0])
+        assert r["value"] > 0
+        assert r["metric"].startswith("mnist_mlp_train_examples_per_sec")
+        assert "_CPU_FALLBACK" not in r["metric"]  # user asked for cpu
+        assert r["data"] == "synthetic"
+        assert r["unit"] == "examples/sec/chip"
+        assert r["vs_baseline"] > 0
+        # XLA:CPU reports flops, so the FLOP accounting fields must appear.
+        assert r.get("flops_per_example", 0) > 0
+
+    def test_dead_backend_falls_back_to_cpu_with_label(self):
+        """Both simulated-TPU attempts die -> supervisor measures on the
+        CPU mesh and labels the metric honestly."""
+        proc = _run([], _env(DTTPU_BENCH_TEST_FAIL_BELOW=5,
+                             DTTPU_BENCH_TPU_ATTEMPTS=2))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1
+        r = json.loads(lines[0])
+        assert r["metric"].endswith("_CPU_FALLBACK")
+        assert r["fallback"] == "cpu"
+        assert r["value"] > 0
+        err = proc.stderr.decode()
+        assert "attempt 1" in err and "attempt 2" in err
+
+    def test_retry_wins_on_second_attempt(self):
+        """Attempt 0 dies, attempt 1 succeeds -> no fallback label: the
+        fresh-subprocess retry is what recovers tunnel flakes."""
+        proc = _run(["--device=cpu"], _env(DTTPU_BENCH_TEST_FAIL_BELOW=1,
+                                           DTTPU_BENCH_TPU_ATTEMPTS=2))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        r = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+        assert "_CPU_FALLBACK" not in r["metric"]
+        assert r["value"] > 0
+
+
+class TestHelpers:
+    def test_parse_last_json(self):
+        text = "noise\n{\"a\": 1}\nnot json {broken\n"
+        assert bench._parse_last_json(text) == {"a": 1}
+        assert bench._parse_last_json("nothing here") is None
+
+    def test_result_ok(self):
+        assert bench._result_ok({"metric": "m", "value": 5.0})
+        assert not bench._result_ok({"metric": "m_BACKEND_INIT_TIMEOUT",
+                                     "value": 0.0})
+        assert not bench._result_ok({"metric": "m_RUN_TIMEOUT", "value": 1.0})
+        assert not bench._result_ok(None)
+        assert not bench._result_ok({"metric": "m", "value": 0})
+
+    def test_is_oom(self):
+        assert bench._is_oom(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 1 bytes"))
+        assert bench._is_oom(RuntimeError("Ran out of memory on device"))
+        assert not bench._is_oom(ValueError("shape mismatch"))
+
+    def test_transformer_flops_per_token(self):
+        params = {"w": np.zeros((1000,), np.float32)}
+        f = bench._transformer_flops_per_token(params, num_layers=2,
+                                               hidden=8, seq=16)
+        assert f == 6 * 1000 + 12 * 2 * 8 * 16
+
+    def test_attach_mfu_with_peak_override(self, monkeypatch):
+        monkeypatch.setenv("DTTPU_PEAK_FLOPS", "1e12")
+        r = bench._attach_mfu({"metric": "m"}, rate_per_chip=1e6,
+                              flops_per_example=1e5)
+        assert r["mfu"] == pytest.approx(0.1)
+        assert r["flops_source"] == "xla"
+
+    def test_attach_mfu_analytic_fallback(self, monkeypatch):
+        monkeypatch.setenv("DTTPU_PEAK_FLOPS", "1e12")
+        r = bench._attach_mfu({"metric": "m"}, 1e6, None, analytic=2e5)
+        assert r["mfu"] == pytest.approx(0.2)
+        assert r["flops_source"] == "analytic"
+
+
+class TestProvenance:
+    def test_no_dir_is_synthetic(self):
+        assert data.provenance("mnist", None) == "synthetic"
+        assert data.provenance("cifar10", "") == "synthetic"
+
+    def test_unknown_dataset_raises(self):
+        with pytest.raises(ValueError):
+            data.provenance("imagenet", "/tmp")
+
+    def test_real_mnist_npz(self, tmp_path):
+        x = np.zeros((8, 28, 28), np.uint8)
+        y = np.zeros((8,), np.uint8)
+        np.savez(tmp_path / "mnist.npz", x_train=x, y_train=y,
+                 x_test=x, y_test=y)
+        assert data.provenance("mnist", str(tmp_path)) == "real"
+        (xt, yt), (xe, ye) = data.mnist(str(tmp_path), flatten=True)
+        assert xt.shape == (8, 784) and yt.dtype == np.int32
+
+    def test_partial_idx_files_stay_synthetic(self, tmp_path):
+        (tmp_path / "train-images-idx3-ubyte").write_bytes(b"x")
+        assert data.provenance("mnist", str(tmp_path)) == "synthetic"
+
+    def test_real_cifar_npz(self, tmp_path):
+        x = np.zeros((4, 32, 32, 3), np.uint8)
+        y = np.zeros((4,), np.uint8)
+        np.savez(tmp_path / "cifar10.npz", x_train=x, y_train=y,
+                 x_test=x, y_test=y)
+        assert data.provenance("cifar10", str(tmp_path)) == "real"

@@ -379,6 +379,102 @@ def test_csv_logger_append_no_duplicate_header(tmp_path):
     assert sum(1 for l in lines if l.startswith("epoch,")) == 1
 
 
+def test_csv_logger_append_foreign_header_refused(tmp_path):
+    """append=True onto a file whose header isn't this logger's format
+    must refuse instead of interleaving two incompatible tables."""
+    import pytest
+    path = str(tmp_path / "log.csv")
+    with open(path, "w") as f:
+        f.write("step,lr,grad_norm\n0,0.1,2.3\n")
+    cb = models.CSVLogger(path, append=True)
+    with pytest.raises(ValueError, match="incompatible header"):
+        cb.on_train_begin(model=None)
+
+
+def test_class_weighted_binary_soft_targets():
+    """Label-smoothed binary targets (0.9/0.1) take the weight of the
+    NEAREST class — a bare int cast floored 0.9 to class 0's weight."""
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu.ops import losses
+    wl = losses.class_weighted("binary_crossentropy", {0: 1.0, 1: 100.0})
+    p = jnp.asarray([[0.8], [0.8]])
+    t = jnp.asarray([[0.9], [0.0]])   # soft positive + hard negative
+    weighted = float(wl(p, t))
+    unweighted = float(losses.get("binary_crossentropy")(p, t))
+    # The soft positive (low bce here) must carry class 1's 100x weight and
+    # dominate the mean; the broken int cast gave both rows weight 1.0,
+    # collapsing the weighted mean onto the unweighted one.
+    assert weighted < 0.9 * unweighted
+
+
+def test_sample_weight_keras_rule():
+    """fit(sample_weight=...) applies Keras 2.0.8's exact normalization:
+    sum(loss_i * w_i) / count_nonzero(w) (reference example2.py:200's fit
+    surface).  Checked numerically against the initial parameters."""
+    import numpy as np
+    from distributed_tensorflow_tpu import ops
+
+    rng = np.random.default_rng(0)
+    x = rng.random((6, 3)).astype(np.float32)
+    y = rng.random((6, 2)).astype(np.float32)
+    w = np.asarray([2.0, 1.0, 0.0, 1.0, 0.0, 3.0], np.float32)
+    model = models.Sequential([ops.Dense(4, activation="relu"),
+                               ops.Dense(2)])
+    model.compile(loss="mse", optimizer="sgd")
+    model.build((3,))
+    per = ((model.predict(x) - y) ** 2).mean(axis=1)
+    expected = float((per * w).sum() / 4)    # 4 nonzero weights
+    hist = model.fit(x, y, epochs=1, batch_size=6, shuffle=False,
+                     verbose=0, sample_weight=w)
+    assert abs(hist.history["loss"][0] - expected) < 1e-5
+
+
+def test_sample_weight_zero_excludes_samples():
+    """Zero-weighted samples must not influence training: poisoned labels
+    at weight 0 leave convergence on the real task intact."""
+    import numpy as np
+    (xt, yt), (xv, yv) = data.xor_data(600, val_size=64, seed=0)
+    # append 200 label-poisoned rows with weight 0
+    xp = xt[:200]
+    yp = 1.0 - yt[:200]
+    x = np.concatenate([xt, xp])
+    y = np.concatenate([yt, yp])
+    w = np.concatenate([np.ones(len(xt)), np.zeros(200)]).astype(np.float32)
+    model = xor_model()
+    model.fit(x, y, epochs=25, batch_size=50, verbose=0, sample_weight=w)
+    acc = model.evaluate(xv, yv, verbose=0)["bitwise_accuracy"]
+    assert acc > 0.9
+
+
+def test_sample_weight_validation():
+    import numpy as np
+    import pytest
+    (xt, yt), _ = data.xor_data(100, val_size=8, seed=0)
+    model = xor_model()
+    with pytest.raises(ValueError, match="not both"):
+        model.fit(xt, yt, epochs=1, verbose=0,
+                  sample_weight=np.ones(len(xt)), class_weight={0: 2.0})
+    with pytest.raises(ValueError, match="one float per sample"):
+        model.fit(xt, yt, epochs=1, verbose=0,
+                  sample_weight=np.ones(len(xt) - 1))
+
+
+def test_sample_weight_on_mesh():
+    """The weighted step's 3-tuple batch shards over the data axis."""
+    import numpy as np
+    from distributed_tensorflow_tpu import ops, parallel
+
+    mesh = parallel.data_parallel_mesh()
+    (xt, yt), _ = data.xor_data(400, val_size=8, seed=0)
+    w = np.ones(len(xt), np.float32)
+    model = models.Sequential([ops.Dense(16, activation="relu"),
+                               ops.Dense(32, activation="sigmoid")])
+    model.compile(loss="mse", optimizer="adam", mesh=mesh)
+    hist = model.fit(xt, yt, epochs=1, batch_size=64, verbose=0,
+                     sample_weight=w)
+    assert np.isfinite(hist.history["loss"][0])
+
+
 def test_validation_split():
     (xt, yt), _ = data.xor_data(300, val_size=8, seed=0)
     model = xor_model()

@@ -263,3 +263,57 @@ def test_audio_summary_roundtrip(tmp_path):
     # PCM data round-trips to ~the original samples
     pcm = np.frombuffer(wav[44:], dtype="<i2").astype(np.float64) / 32767.0
     np.testing.assert_allclose(pcm, tone, atol=1e-3)
+
+
+def test_graph_event_roundtrip(tmp_path):
+    """add_graph writes Event.graph_def (field 4) — the reference's
+    writer.add_graph(sess.graph) channel (reference example.py:195) — as a
+    GraphDef whose NodeDefs chain input -> layers in model order."""
+    import glob
+
+    from distributed_tensorflow_tpu import ops
+    from distributed_tensorflow_tpu.summary import EventFileWriter
+
+    model = ops.serial(ops.Dense(8, activation="relu"),
+                       ops.Dropout(0.3),
+                       ops.Dense(4))
+    with EventFileWriter(str(tmp_path)) as w:
+        w.add_graph(model)
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)
+    event = parse_event(records[1])
+    graph = parse_event(event[4][0])             # Event.graph_def
+    nodes = [parse_event(n) for n in graph[1]]   # GraphDef.node
+    names = [n[1][0].decode() for n in nodes]
+    ops_ = [n[2][0].decode() for n in nodes]
+    assert ops_[0] == "Placeholder"
+    assert ops_[1:] == ["Dense", "Dropout", "Dense"]
+    # the chain: every layer node's single input is the previous node
+    for prev, node in zip(names, nodes[1:]):
+        assert node[3] == [prev.encode()]
+    # duplicate layer names are disambiguated
+    assert len(set(names)) == len(names)
+    # versions field present (TB graph plugin requirement)
+    assert 4 in graph
+
+
+def test_graph_event_explicit_nodes(tmp_path):
+    """add_graph also takes explicit (name, op, inputs) tuples — the escape
+    hatch for non-Sequential topologies (BERT/GPT blocks)."""
+    import glob
+
+    from distributed_tensorflow_tpu.summary import EventFileWriter
+
+    nodes = [("tokens", "Placeholder", ()),
+             ("embed", "Embedding", ("tokens",)),
+             ("block0", "TransformerBlock", ("embed",)),
+             ("head", "Dense", ("block0",))]
+    with EventFileWriter(str(tmp_path)) as w:
+        w.add_graph(nodes)
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    event = parse_event(read_records(path)[1])
+    graph = parse_event(event[4][0])
+    parsed = [parse_event(n) for n in graph[1]]
+    assert [p[1][0] for p in parsed] == [b"tokens", b"embed", b"block0",
+                                         b"head"]
+    assert parsed[3][3] == [b"block0"]
