@@ -136,6 +136,17 @@ def _flops_of(fn, *args):
         return None
 
 
+def _per_example_flops(f_total, global_examples, mesh):
+    """XLA's ``cost_analysis`` reports the per-device SPMD program's FLOPs;
+    divide by the per-device (local) example count — global/ data shards —
+    not the global batch, or mfu understates by the shard count on
+    multi-device meshes (advisor round 2)."""
+    if not f_total:
+        return None
+    from distributed_tensorflow_tpu import parallel
+    return f_total * parallel.data_shards(mesh) / global_examples
+
+
 def _attach_mfu(result: dict, rate_per_chip: float, flops_per_example,
                 analytic=None) -> dict:
     """Add flops/example + mfu fields to a bench result.  ``rate_per_chip``
@@ -215,7 +226,7 @@ def bench_framework():
     msh = NamedSharding(mesh, P(None, "data"))
     bench_batch = (jax.device_put(xs, msh), jax.device_put(ys, msh))
     f_total = _flops_of(multi, state, bench_batch)
-    flops_per_example = f_total / (k * batch) if f_total else None
+    flops_per_example = _per_example_flops(f_total, k * batch, mesh)
     for _ in range(WARMUP_CALLS):
         state, m = multi(state, bench_batch)
     _fetch(m)
@@ -427,7 +438,7 @@ def bench_cifar_cnn():
                   value=round(eps, 1), unit="examples/sec/chip",
                   vs_baseline=round(eps / baseline, 3),
                   eval_accuracy=round(acc, 4), data=prov)
-    return _attach_mfu(result, eps, f_total / batch if f_total else None,
+    return _attach_mfu(result, eps, _per_example_flops(f_total, batch, mesh),
                        analytic=1.53e8)
 
 
@@ -443,8 +454,12 @@ def bench_resnet50():
     size = 64 if SMOKE else 224
     model = models.resnet50(num_classes=1000)
     optimizer = optim.momentum(0.1, beta=0.9)
+    # mixed_bfloat16: without the policy the f32 conv kernels promote the
+    # bf16 batch back to f32 and every conv runs off the bf16 MXU path —
+    # the master params stay f32 (grads/update in f32)
     step = train.make_train_step(model, "sparse_categorical_crossentropy",
-                                 optimizer, mesh=mesh)
+                                 optimizer, mesh=mesh,
+                                 policy="mixed_bfloat16")
     rng = np.random.default_rng(0)
     bsh = NamedSharding(mesh, P("data"))
 
@@ -459,9 +474,10 @@ def bench_resnet50():
                        jax.device_put(y, bsh))
 
     # 256/chip measured +22% over 64/chip on v5e (probe 2026-07-30); the
-    # ladder descends on smaller-HBM parts.
+    # bf16 policy halves activation memory so 512 leads the ladder, which
+    # descends on OOM for smaller-HBM parts.
     rate, loss, ms, batch, f_total = _run_batch_ladder(
-        "resnet50", [8] if SMOKE else [256, 128, 64], mesh, build, step,
+        "resnet50", [8] if SMOKE else [512, 256, 128, 64], mesh, build, step,
         warmup=2, steps=4 if SMOKE else 10)
     eps = rate * batch / n_chips
     log(f"resnet50: {eps:,.1f} examples/s/chip ({ms*1e3:.1f} ms/step, "
@@ -489,7 +505,7 @@ def bench_resnet50():
                   value=round(eps, 2), unit="examples/sec/chip",
                   vs_baseline=round(eps / baseline, 3),
                   image_size=size, batch=batch)
-    return _attach_mfu(result, eps, f_total / batch if f_total else None,
+    return _attach_mfu(result, eps, _per_example_flops(f_total, batch, mesh),
                        analytic=12.3e9 * (size / 224) ** 2)
 
 
@@ -545,7 +561,7 @@ def bench_bert():
                   # baseline exists; 1.0 = "unity ratio by definition"
                   seq_len=seq, batch=batch)
     return _attach_mfu(
-        result, tokens, f_total / (batch * seq) if f_total else None,
+        result, tokens, _per_example_flops(f_total, batch * seq, mesh),
         analytic=_transformer_flops_per_token(params, config.num_layers,
                                               config.hidden_size, seq))
 
@@ -625,7 +641,7 @@ def bench_gpt():
                   vs_baseline=1.0,  # no reference-era GPT baseline exists
                   seq_len=seq, batch=batch)
     return _attach_mfu(
-        result, tokens_s, f_total / (batch * seq) if f_total else None,
+        result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
         analytic=_transformer_flops_per_token(params, config.num_layers,
                                               config.hidden_size, seq))
 
@@ -709,6 +725,10 @@ def supervise(config: str) -> int:
     if _result_ok(r):
         r["metric"] = str(r["metric"]) + "_CPU_FALLBACK"
         r["fallback"] = "cpu"
+        if cenv.get("DTTPU_BENCH_SMOKE"):
+            # the number was measured on the shrunken smoke config — mark
+            # it so it can't be misread as the full-size model on CPU
+            r["config_size"] = "smoke"
         print(json.dumps(r), flush=True)
         return 0
     log(f"supervisor: CPU fallback failed too ({why})")

@@ -50,7 +50,11 @@ class BertConfig:
     dtype: Any = jnp.float32          # activation/compute dtype
     remat: bool = False               # checkpoint each encoder layer
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
-    use_flash: bool = False           # fused Pallas flash-attention kernel
+    # True / False / "auto": auto dispatches the fused Pallas kernel on TPU
+    # at seq >= the measured crossover (ops.attention.resolve_use_flash).
+    # Default stays False until the round-3 fused BACKWARD kernels pass
+    # hardware validation (docs/PERF.md) — flip to "auto" once measured.
+    use_flash: Any = False
 
     @property
     def head_dim(self) -> int:
@@ -169,7 +173,7 @@ class Bert:
             from ..parallel.ring import ring_attention
             attention_fn = lambda q, k, v, mask=None: ring_attention(
                 q, k, v, axis_name=c.seq_axis, kv_valid=valid)
-        elif c.use_flash:
+        elif attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
             from ..ops.pallas import flash_attention
             attention_fn = lambda q, k, v, mask=None: flash_attention(
                 q, k, v, kv_valid=valid)

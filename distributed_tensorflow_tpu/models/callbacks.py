@@ -40,11 +40,10 @@ class TensorBoard(Callback):
     def on_train_begin(self, model) -> None:
         self._writer = SummaryWriter(self.log_dir)
         # graph topology event (reference example.py:195 add_graph parity);
-        # a model without an ordered layer list just skips it
-        try:
+        # only a model without an ordered layer list skips it — real
+        # serialization errors must propagate
+        if getattr(model, "layers", None) is not None:
             self._writer.add_graph(model)
-        except TypeError:
-            pass
 
     def on_epoch_end(self, model, epoch, logs) -> None:
         if self._writer and logs:
@@ -185,7 +184,12 @@ class ReduceLROnPlateau(Callback):
 
 class CSVLogger(Callback):
     """Append per-epoch logs to a CSV file (Keras ``CSVLogger`` parity).
-    The column set is fixed by the first logged epoch."""
+    The column set is fixed by the first logged epoch.
+
+    With ``append=True``, a pre-existing file whose header does not start
+    with ``epoch,`` raises ``ValueError`` at ``on_train_begin`` — appending
+    rows under a foreign header would silently corrupt the log, so reusing
+    a log path across tools is an explicit error, not a degradation."""
 
     def __init__(self, filename: str, append: bool = False):
         self.filename = filename

@@ -51,7 +51,12 @@ class GPTConfig:
     dtype: Any = jnp.float32
     remat: bool = False
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
-    use_flash: bool = False
+    # True / False / "auto": auto dispatches the fused Pallas kernel on TPU
+    # at seq >= the measured crossover (ops.attention.resolve_use_flash).
+    # Default stays False until the round-3 fused BACKWARD kernels pass
+    # hardware validation (docs/PERF.md: interpret mode has hidden Mosaic
+    # tiling violations before) — flip to "auto" once measured on TPU.
+    use_flash: Any = False
     # "learned" absolute positions (GPT-2) or "rope" rotary embeddings
     # (relative; extrapolates past trained length, no position table)
     position_embedding: str = "learned"
@@ -67,6 +72,34 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
     moe_z_weight: float = 1e-3
+    # Pipeline parallelism (parallel.pipeline): split the L decoder blocks
+    # into ``pipeline_stages`` same-shape stages of L/S blocks over the
+    # ``pipe_axis`` mesh axis.  Embedding and LM head run pipe-REPLICATED
+    # (they are O(vocab*d) beside L blocks; dedicating stages to them would
+    # stretch the bubble instead).  0/1 = off.  Requires a mesh at
+    # construction (``GPT(config, mesh=...)``).
+    pipeline_stages: int = 0
+    pipe_axis: str = "pipe"
+    # microbatches per step; 0 -> pipeline_stages (the GPipe minimum for
+    # full utilization)
+    pipeline_microbatches: int = 0
+
+    def __post_init__(self):
+        if self.pipeline_stages > 1:
+            if self.num_layers % self.pipeline_stages:
+                raise ValueError(
+                    f"num_layers {self.num_layers} not divisible by "
+                    f"pipeline_stages {self.pipeline_stages}")
+            if self.moe_experts > 0:
+                raise ValueError(
+                    "pipeline_stages with MoE is unsupported: the router "
+                    "aux-loss scalar cannot cross the same-shape pipeline "
+                    "stage contract (parallel/pipeline.py)")
+            if self.seq_axis is not None:
+                raise ValueError(
+                    "pipeline_stages with seq_axis (ring attention) is "
+                    "unsupported: ring's shard_map cannot nest inside the "
+                    "pipe-manual region")
 
     @property
     def head_dim(self) -> int:
@@ -190,7 +223,7 @@ class GPT:
             from ..parallel.ring import ring_attention
             attention_fn = lambda q, k, v, mask=None: ring_attention(
                 q, k, v, axis_name=c.seq_axis, causal=True)
-        elif c.use_flash:
+        elif attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
             from ..ops.pallas import flash_attention
             attention_fn = lambda q, k, v, mask=None: flash_attention(
                 q, k, v, causal=True)
@@ -250,11 +283,6 @@ class GPT:
         r_emb, r_layers = jax.random.split(rng)
         x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
 
-        # Ring / flash paths mask internally (causal=True); the dense path
-        # gets an explicit causal mask.
-        mask = (None if (c.seq_axis is not None or c.use_flash)
-                else attn_lib.causal_mask(s))
-
         # the transform is bound via partial (not a call argument): it's a
         # callable, which jax.checkpoint can't accept as a traced arg
         from functools import partial
@@ -263,18 +291,76 @@ class GPT:
         if c.remat:
             layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
 
-        def body(carry, inputs):
-            layer_params, layer_key = inputs
-            new_x, aux = layer_fn(layer_params, carry, mask, layer_key,
-                                  train)
-            return new_x, aux
-
         layer_keys = jax.random.split(r_layers, c.num_layers)
-        x, aux_per_layer = lax.scan(body, x, (params["decoder"], layer_keys))
+        if c.pipeline_stages > 1:
+            # the stage_fn builds its own mask (shard_map bodies cannot
+            # capture traced values) — don't materialize one here
+            x = self._pipeline_blocks(params, x, layer_keys, train, layer_fn)
+            aux_total = jnp.zeros((), jnp.float32)   # MoE rejected at config
+        else:
+            # Ring / flash paths mask internally (causal=True); the dense
+            # path gets an explicit causal mask.
+            mask = (None if (c.seq_axis is not None
+                             or attn_lib.resolve_use_flash(c.use_flash, s))
+                    else attn_lib.causal_mask(s))
+
+            def body(carry, inputs):
+                layer_params, layer_key = inputs
+                new_x, aux = layer_fn(layer_params, carry, mask, layer_key,
+                                      train)
+                return new_x, aux
+
+            x, aux_per_layer = lax.scan(body, x,
+                                        (params["decoder"], layer_keys))
+            aux_total = jnp.sum(aux_per_layer)
         hidden = _layer_norm(params["ln_f"], x, c.layer_norm_eps)
         if return_aux:
-            return hidden, jnp.sum(aux_per_layer)
+            return hidden, aux_total
         return hidden
+
+    def _pipeline_blocks(self, params, x, layer_keys, train, layer_fn):
+        """Decoder blocks as a GPipe pipeline over ``config.pipe_axis``.
+
+        The scanned [L, ...] decoder stack reshapes to [S, L/S, ...] stage
+        params (a local view when the store shards the leading layer dim
+        ``P(pipe_axis)`` — ``partition_rules``); per-layer dropout keys ride
+        along inside the stage params so every block keeps its own key.
+        Note: under pp each layer key is reused for every microbatch of the
+        step, so dropout masks repeat across microbatches (still random
+        per layer/step); the non-pp path draws one mask over the full batch.
+        The causal mask is rebuilt from the microbatch shape inside the
+        stage (a closure-free constant — shard_map bodies cannot capture
+        traced values).
+        """
+        from ..parallel.pipeline import pipeline_apply
+        c = self.config
+        if self.mesh is None:
+            raise ValueError("pipeline_stages requires GPT(config, mesh=...)")
+        s_count = c.pipeline_stages
+        per = c.num_layers // s_count
+        stage_params = {
+            "layers": jax.tree.map(
+                lambda p: p.reshape(s_count, per, *p.shape[1:]),
+                params["decoder"]),
+            "keys": layer_keys.reshape(s_count, per, *layer_keys.shape[1:]),
+        }
+
+        def stage_fn(sp, acts):
+            mask = (None if attn_lib.resolve_use_flash(c.use_flash,
+                                                       acts.shape[1])
+                    else attn_lib.causal_mask(acts.shape[1]))
+
+            def body(carry, inputs):
+                lp, lk = inputs
+                new_x, _ = layer_fn(lp, carry, mask, lk, train)
+                return new_x, None
+
+            acts, _ = lax.scan(body, acts, (sp["layers"], sp["keys"]))
+            return acts
+
+        return pipeline_apply(
+            stage_fn, stage_params, x, self.mesh,
+            c.pipeline_microbatches or s_count, axis=c.pipe_axis)
 
     def logits(self, params, hidden):
         """Tied LM head -> [b, s, vocab] f32 logits."""
@@ -635,24 +721,33 @@ class GPT:
         the table is mesh-agnostic so it cannot decide this itself.
         """
         f = "fsdp" if fsdp else None
+        # With pipeline_stages the scanned leading LAYER dim shards over the
+        # pipe axis — each stage's devices hold exactly their L/S blocks;
+        # apply()'s [L,...]->[S,L/S,...] reshape is then a local view.
+        lead = (self.config.pipe_axis if self.config.pipeline_stages > 1
+                else None)
         kv_on_tensor = (shard_kv if shard_kv is not None
                         else self.config.kv_heads == self.config.num_heads)
-        kv_spec = (P(None, f, "tensor", None) if kv_on_tensor
-                   else P(None, f, None, None))
-        kv_bias = (P(None, "tensor", None) if kv_on_tensor
-                   else P(None, None, None))
+        kv_spec = (P(lead, f, "tensor", None) if kv_on_tensor
+                   else P(lead, f, None, None))
+        kv_bias = (P(lead, "tensor", None) if kv_on_tensor
+                   else P(lead, None, None))
         return PartitionRules([
             (r"embeddings/word$", P("tensor", f)),
             (r"embeddings/position$", P(None, None)),
-            (r"decoder/attention/query/kernel", P(None, f, "tensor", None)),
-            (r"decoder/attention/query/bias", P(None, "tensor", None)),
+            (r"decoder/attention/query/kernel", P(lead, f, "tensor", None)),
+            (r"decoder/attention/query/bias", P(lead, "tensor", None)),
             (r"decoder/attention/(key|value)/kernel", kv_spec),
             (r"decoder/attention/(key|value)/bias", kv_bias),
-            (r"decoder/attention/out/kernel", P(None, "tensor", None, f)),
-            (r"decoder/ffn/w_in/kernel", P(None, f, "tensor")),
-            (r"decoder/ffn/w_in/bias", P(None, "tensor")),
-            (r"decoder/ffn/w_out/kernel", P(None, "tensor", f)),
+            (r"decoder/attention/out/kernel", P(lead, "tensor", None, f)),
+            (r"decoder/ffn/w_in/kernel", P(lead, f, "tensor")),
+            (r"decoder/ffn/w_in/bias", P(lead, "tensor")),
+            (r"decoder/ffn/w_out/kernel", P(lead, "tensor", f)),
+            (r"decoder/ffn/w_out/bias", P(lead, None)),
+            (r"decoder/attention/out/bias", P(lead, None)),
+            (r"decoder/ln_[12]/(gamma|beta)", P(lead, None)),
             # MoE rows derive from the canonical ops.moe table (its patterns
             # are suffix-matching), with the scanned leading layer dim
-            # prepended to each spec — one source of truth.
+            # prepended to each spec — one source of truth.  (MoE cannot
+            # combine with pipeline — rejected at config — so lead=None.)
         ] + [(pat, P(None, *spec)) for pat, spec in moe_partition_rules()])

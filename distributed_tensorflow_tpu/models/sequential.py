@@ -60,6 +60,12 @@ class Sequential:
             self._stack = layer_lib.Stack(self._layers, name=self.name)
         return self._stack
 
+    @property
+    def layers(self) -> List[layer_lib.Layer]:
+        """Ordered layer list (Keras ``model.layers`` parity); consumed by
+        ``summary.model_graph_nodes`` for the TB graph event."""
+        return self._layers
+
     # -- compile ---------------------------------------------------------
     def compile(self, loss, optimizer="adam",
                 metrics: Sequence = (),

@@ -143,7 +143,7 @@ class ViT:
         """Pre-LN block: x + attn(LN(x)); x + ffn(LN(x))."""
         c = self.config
         r1, r2, r3 = jax.random.split(rng, 3)
-        if c.use_flash:
+        if attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
             from ..ops.pallas import flash_attention
             attention_fn = lambda q, k, v, mask=None: flash_attention(q, k, v)
         else:

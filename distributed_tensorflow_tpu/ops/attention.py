@@ -30,9 +30,36 @@ from .layers import Layer
 
 __all__ = ["dot_product_attention", "causal_mask", "padding_mask",
            "attention_core", "ffn_core", "rotary_embedding", "rope_tables",
-           "apply_rope", "MultiHeadAttention"]
+           "apply_rope", "MultiHeadAttention", "flash_wins",
+           "resolve_use_flash"]
 
 NEG_INF = -1e9  # finite -inf stand-in: keeps softmax well-defined in f32
+
+# Sequence length at/above which the fused Pallas flash kernel dispatches
+# under use_flash="auto".  At seq 512 plain XLA wins on v5e (103.9k vs
+# 85.7k tok/s, docs/PERF.md); the kernel's O(seq) memory advantage and
+# blockwise compute pay off as the logits matrix grows.  Override with
+# DTTPU_FLASH_MIN_SEQ; re-calibrate against hardware measurements.
+_FLASH_MIN_SEQ_DEFAULT = 1024
+
+
+def flash_wins(seq_len: int) -> bool:
+    """Auto-dispatch policy: fused flash attention only on a real TPU
+    backend and only at sequence lengths past the measured crossover."""
+    import os
+
+    import jax as _jax
+    min_seq = int(os.environ.get("DTTPU_FLASH_MIN_SEQ",
+                                 _FLASH_MIN_SEQ_DEFAULT))
+    return seq_len >= min_seq and _jax.default_backend() == "tpu"
+
+
+def resolve_use_flash(use_flash, seq_len: int) -> bool:
+    """Resolve a config's ``use_flash`` (True / False / "auto") for one
+    forward at ``seq_len`` — the single dispatch point for BERT/GPT."""
+    if use_flash == "auto":
+        return flash_wins(seq_len)
+    return bool(use_flash)
 
 
 def causal_mask(seq_len: int) -> jnp.ndarray:

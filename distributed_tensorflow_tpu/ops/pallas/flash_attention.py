@@ -1,4 +1,4 @@
-"""Fused flash-attention forward kernel (Pallas / Mosaic-TPU).
+"""Fused flash attention (Pallas / Mosaic-TPU): forward AND backward.
 
 Replaces the O(seq²)-memory ``ops.attention.dot_product_attention`` hot path
 with a blockwise online-softmax kernel: Q stays resident in VMEM per block
@@ -6,15 +6,25 @@ row while K/V blocks stream through, so the full logits matrix never
 materialises in HBM.  The MXU sees [block_q, head_dim] x [head_dim, block_k]
 matmuls with float32 accumulation; inputs may be bfloat16.
 
-Grid layout: ``(batch, heads, q_blocks, k_blocks)`` with the K dimension
+Forward grid: ``(batch, heads, q_blocks, k_blocks)`` with the K dimension
 minormost — Pallas executes the grid sequentially on a TPU core, so the
 float32 accumulator / running-max / running-sum scratch carried across the
-k iterations implements the streaming softmax without HBM round-trips.
+k iterations implements the streaming softmax without HBM round-trips.  The
+kernel also emits the row logsumexp (``lse``), which the backward consumes.
 
-The backward pass recomputes attention with the pure-XLA reference
-implementation under ``jax.vjp`` (flash forward + rematerialised backward);
-a fused Pallas backward is a later optimisation — the forward is where the
-memory ceiling was.
+Backward (the standard two-kernel flash split, residuals = (q,k,v,out,lse)
+— O(seq) extra memory, logits recomputed blockwise):
+  * ``dkv`` kernel, grid ``(b, h, k_blocks, q_blocks)`` (q minormost):
+    each k block accumulates dK/dV while the q blocks stream through;
+  * ``dq`` kernel, grid ``(b, h, q_blocks, k_blocks)`` (k minormost):
+    each q block accumulates dQ while the k blocks stream;
+  * the row term ``D = rowsum(dO * O)`` is a cheap elementwise reduce done
+    in plain XLA before both kernels.
+
+Off-TPU the kernels run in Pallas interpret mode so CPU tests execute the
+identical code; NOTE interpret mode has hidden Mosaic tiling violations
+before (docs/PERF.md) — hardware validation is required before claiming a
+measured win.
 
 Reference parity note: the reference repo has no attention at all (its model
 is an MLP, reference example.py:149-155); this kernel serves the BERT/GPT
@@ -38,7 +48,7 @@ __all__ = ["flash_attention", "make_flash_attention_fn"]
 NEG_INF = float("-inf")
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref,
                   acc_ref, m_ref, l_ref, *,
                   scale: float, causal: bool,
                   block_q: int, block_k: int):
@@ -46,7 +56,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref,
 
     Refs: q [1,1,bq,d], k/v [1,1,bk,d], valid [1,1,bk] float (1=real key;
     the singleton middle axis keeps the block's trailing-2 shape (1, bk)
-    equal-or-tiled against Mosaic's (8, 128) rule), o [1,1,bq,d]; scratch
+    equal-or-tiled against Mosaic's (8, 128) rule), o [1,1,bq,d],
+    lse [1,1,bq] f32 row logsumexp (backward residual); scratch
     acc [bq,d] f32, m/l [bq,1] f32.
     """
     # program_id must be read at kernel top level: the HLO interpreter used
@@ -108,6 +119,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref,
         l = l_ref[:, 0]
         out = acc_ref[:] / jnp.where(l > 0.0, l, 1.0)[:, None]
         o_ref[0, 0] = out.astype(o_ref.dtype)
+        # row logsumexp: the running max (shift) + log of the running sum;
+        # fully-masked rows (l == 0) get -inf so the backward's
+        # exp(s - lse) reproduces their zero probabilities
+        m = m_ref[:, 0]
+        shift = jnp.where(jnp.isfinite(m), m, 0.0)
+        lse_ref[0, 0, :] = jnp.where(l > 0.0, shift + jnp.log(
+            jnp.where(l > 0.0, l, 1.0)), NEG_INF)
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
@@ -121,7 +139,8 @@ def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
 
 def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
                    interpret):
-    """q,k,v: [b, h, s, d]; valid: [b, s_k] float32.  Returns [b, h, s, d]."""
+    """q,k,v: [b, h, s, d]; valid: [b, s_k] float32.
+    Returns (out [b, h, s, d], lse [b, h, s] f32)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq = min(block_q, sq)
@@ -135,10 +154,11 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
     sq_p, sk_p = q.shape[2], k.shape[2]
     grid = (b, h, sq_p // bq, sk_p // bk)
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d),
@@ -149,8 +169,10 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
                          lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
             pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, ik: (ib, 0, ik)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d),
-                               lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        out_specs=[pl.BlockSpec((1, 1, bq, d),
+                                lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+                   pl.BlockSpec((1, 1, bq),
+                                lambda ib, ih, iq, ik: (ib, ih, iq))],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -158,7 +180,201 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
         ],
         interpret=interpret,
     )(q, k, v, valid)
-    return out[:, :, :sq, :]
+    return out[:, :, :sq, :], lse[:, :, :sq]
+
+
+def _bwd_block_terms(q, k, v, do, lse, dvec, valid, qi, ki, scale, causal,
+                     block_q, block_k):
+    """Shared per-block backward math: returns (p, ds), both [bq, bk] f32.
+
+    ``p`` re-derives the forward probabilities from the saved row logsumexp
+    (exp(s - lse)); ``ds = p * (dp - D) * scale`` is the logits cotangent.
+    """
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = jnp.where(valid[None, :] > 0.5, s, NEG_INF)
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    # masked s = -inf -> p = 0; fully-masked rows have lse = -inf, guard the
+    # subtraction so exp sees -inf, not (-inf) - (-inf) = nan
+    p = jnp.exp(s - jnp.where(jnp.isfinite(lse), lse, 0.0)[:, None])
+    p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - dvec[:, None]) * scale
+    return p, ds
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, valid_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *,
+                      scale: float, causal: bool,
+                      block_q: int, block_k: int):
+    """dK/dV: grid (b, h, k_blocks, q_blocks), q minormost.  Each k block
+    holds f32 accumulators while every q block streams through."""
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        p, ds = _bwd_block_terms(
+            q, k, v, do, lse_ref[0, 0, :], d_ref[0, 0, :],
+            valid_ref[0, 0, :], qi, ki, scale, causal, block_q, block_k)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # p^T @ dO [bk, d]
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # ds^T @ Q [bk, d]
+
+    if causal:
+        # q blocks entirely above the diagonal contribute nothing to this
+        # k block
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, valid_ref,
+                     dq_ref, dq_acc, *,
+                     scale: float, causal: bool,
+                     block_q: int, block_k: int):
+    """dQ: grid (b, h, q_blocks, k_blocks), k minormost — the forward's
+    layout, accumulating dq while k blocks stream."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        _, ds = _bwd_block_terms(
+            q, k, v, do, lse_ref[0, 0, :], d_ref[0, 0, :],
+            valid_ref[0, 0, :], qi, ki, scale, causal, block_q, block_k)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # ds @ K [bq, d]
+
+    if causal:
+        @pl.when((qi + 1) * block_q > ki * block_k)
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
+                    block_q, block_k, interpret):
+    """Fused backward: (dq, dk, dv) with logits recomputed blockwise."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+
+    # D = rowsum(dO * O): cheap elementwise reduce, plain XLA
+    dvec = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    q_p = _pad_to(q, 2, bq)
+    do_p = _pad_to(do, 2, bq)                 # zero dO rows: no contribution
+    # pad lse with 0 (any finite value): padded q rows have dO = 0 and
+    # D = 0, so their p never reaches an accumulator
+    lse_p = _pad_to(lse, 2, bq)
+    d_p = _pad_to(dvec, 2, bq)
+    k_p = _pad_to(k, 2, bk)
+    v_p = _pad_to(v, 2, bk)
+    valid_p = _pad_to(valid, 1, bk)[:, None, :]   # [b, 1, sk_p]
+    sq_p, sk_p = q_p.shape[2], k_p.shape[2]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype)],
+        grid=(b, h, sk_p // bk, sq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda ib, ih, ik, iq: (ib, ih, iq, 0)),   # q
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, ik, iq: (ib, ih, ik, 0)),   # k
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, ik, iq: (ib, ih, ik, 0)),   # v
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda ib, ih, ik, iq: (ib, ih, iq, 0)),   # do
+            pl.BlockSpec((1, 1, bq),
+                         lambda ib, ih, ik, iq: (ib, ih, iq)),      # lse
+            pl.BlockSpec((1, 1, bq),
+                         lambda ib, ih, ik, iq: (ib, ih, iq)),      # D
+            pl.BlockSpec((1, 1, bk),
+                         lambda ib, ih, ik, iq: (ib, 0, ik)),       # valid
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, ik, iq: (ib, ih, ik, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q_p, k_p, v_p, do_p, lse_p, d_p, valid_p)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        grid=(b, h, sq_p // bq, sk_p // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),   # q
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),   # k
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),   # v
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),   # do
+            pl.BlockSpec((1, 1, bq),
+                         lambda ib, ih, iq, ik: (ib, ih, iq)),      # lse
+            pl.BlockSpec((1, 1, bq),
+                         lambda ib, ih, iq, ik: (ib, ih, iq)),      # D
+            pl.BlockSpec((1, 1, bk),
+                         lambda ib, ih, iq, ik: (ib, 0, ik)),       # valid
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q_p, k_p, v_p, do_p, lse_p, d_p, valid_p)
+
+    return dq[:, :, :sq, :], dk[:, :, :sk, :], dv[:, :, :sk, :]
 
 
 def _reference(q, k, v, valid, scale, causal):
@@ -180,22 +396,21 @@ def _reference(q, k, v, valid, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, valid, scale, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
-                          interpret)
+    out, _ = _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
+                            interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, valid, scale, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
-                         interpret)
-    return out, (q, k, v, valid)
+    out, lse = _flash_forward(q, k, v, valid, scale, causal, block_q,
+                              block_k, interpret)
+    return out, (q, k, v, valid, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v, valid = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference(q_, k_, v_, valid, scale, causal),
-        q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, valid, out, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, valid, out, lse, g, scale, causal,
+                                 block_q, block_k, interpret)
     return dq, dk, dv, jnp.zeros_like(valid)
 
 

@@ -151,9 +151,20 @@ class TrainSession:
         if not self.checkpoint_dir:
             return None
         if self.sharded:
+            sync_fn = None
+            if jax.process_count() > 1:
+                # barrier between every process's chunk writes and the
+                # chief's manifest — without it the manifest can miss
+                # another process's chunk index and the checkpoint is
+                # unreadable (restore: "chunks do not cover leaf")
+                from jax.experimental import multihost_utils
+                step_now = int(self.step)
+                sync_fn = lambda: multihost_utils.sync_global_devices(
+                    f"dttpu-sharded-ckpt-{step_now}")
             path = sharded_lib.save_sharded(self.checkpoint_dir, self.step,
                                             self.state,
-                                            max_to_keep=self.max_to_keep)
+                                            max_to_keep=self.max_to_keep,
+                                            sync_fn=sync_fn)
             self.last_saved_step = self.step
             log.info("saved sharded checkpoint %s", path)
             return path

@@ -1,0 +1,265 @@
+"""Multi-host hardening: cross-process checkpoint + preemption evidence.
+
+The reference's recovery story is MTS chief-led restore across real
+processes (reference example.py:189-192).  These tests prove the TPU-native
+equivalents with REAL subprocesses on the CPU backend:
+
+  * 2-process sharded save -> restore into a DIFFERENT topology (1 process,
+    different mesh width): reshard-on-restore proven cross-process, not just
+    single-process (train/sharded_checkpoint.py).
+  * SIGTERM delivered to ONE of 2 training processes mid-run: the
+    PreemptionHook's ``sync_fn`` agrees the stop cross-host, every process
+    writes its sharded chunks, the chief finalizes the manifest, both exit
+    cleanly — then a fresh single process auto-restores the session at the
+    preemption step.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch(script, pid, port, nproc=2, extra_env=None):
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               JAX_PLATFORMS="cpu",
+               COORDINATOR_ADDRESS=f"localhost:{port}",
+               NUM_PROCESSES=str(nproc), PROCESS_ID=str(pid))
+    env.update(extra_env or {})
+    return subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def _run_pair(script, timeout=240, extra_env=None, mid_run=None):
+    """Launch the script as 2 coordinated processes; retry stolen ports.
+
+    ``mid_run(procs)``: optional callback invoked after launch (e.g. to
+    signal a child).  Returns (procs, outs).
+    """
+    procs, outs = [], []
+    for _ in range(3):
+        port = _free_port()
+        procs = [_launch(script, 0, port, extra_env=extra_env),
+                 _launch(script, 1, port, extra_env=extra_env)]
+        outs = []
+        try:
+            if mid_run is not None:
+                mid_run(procs)
+            for p in procs:
+                try:
+                    outs.append(p.communicate(timeout=timeout)[0])
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    outs.append(p.communicate()[0] + "\n<TIMED OUT>")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        if all(p.returncode == 0 for p in procs):
+            break
+    return procs, outs
+
+
+def test_two_process_sharded_save_restores_into_one_process(tmp_path):
+    """Each of 2 processes writes only its own chunks (+ barrier before the
+    chief's manifest); the checkpoint then restores into THIS process on a
+    2-device mesh — saved 4-way, restored 2-way, values exact."""
+    ckpt_dir = tmp_path / "ckpt"
+    script = tmp_path / "saver.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_tensorflow_tpu import parallel
+        parallel.initialize()
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental import multihost_utils
+        from distributed_tensorflow_tpu.train import sharded_checkpoint as sc
+        assert jax.process_count() == 2
+        mesh = parallel.make_mesh({{"data": len(jax.devices())}})
+        w_global = np.arange(24, dtype=np.float32).reshape(8, 3)
+        w = jax.make_array_from_callback(
+            (8, 3), NamedSharding(mesh, P("data")),
+            lambda idx: w_global[idx])
+        b = jax.make_array_from_callback(
+            (3,), NamedSharding(mesh, P()),
+            lambda idx: np.asarray([9., 8., 7.], np.float32)[idx])
+        tree = {{"w": w, "b": b, "step": np.int64(7)}}
+        sc.save_sharded({str(ckpt_dir)!r}, 7, tree,
+                        sync_fn=lambda: multihost_utils.sync_global_devices(
+                            "save-barrier"))
+        print(f"SAVED proc={{jax.process_index()}}")
+    """))
+    procs, outs = _run_pair(script)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    assert "SAVED proc=0" in outs[0]
+    assert "SAVED proc=1" in outs[1]
+
+    # both processes' shard files + the chief manifest landed
+    final = str(ckpt_dir / "ckpt-0000000007")
+    names = sorted(os.listdir(final))
+    assert "shards-00000.npz" in names and "shards-00001.npz" in names
+    with open(os.path.join(final, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["process_count"] == 2
+    assert {c["pid"] for c in manifest["chunks"]} == {0, 1}
+
+    # restore HERE (1 process) onto a 2-device mesh: different topology
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import parallel
+    from distributed_tensorflow_tpu.train import sharded_checkpoint as sc
+    mesh = parallel.make_mesh({"data": 2}, jax.devices()[:2])
+    target = {
+        "w": jax.device_put(np.zeros((8, 3), np.float32),
+                            NamedSharding(mesh, P("data"))),
+        "b": jax.device_put(np.zeros((3,), np.float32),
+                            NamedSharding(mesh, P())),
+        "step": np.int64(0),
+    }
+    restored = sc.restore_sharded(target, final)
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]),
+        np.arange(24, dtype=np.float32).reshape(8, 3))
+    np.testing.assert_array_equal(np.asarray(restored["b"]), [9., 8., 7.])
+    assert int(restored["step"]) == 7
+    assert "data" in str(restored["w"].sharding.spec)
+
+
+def test_sigterm_one_process_saves_and_single_process_resumes(tmp_path):
+    """SIGTERM only the NON-chief mid-training: the preemption flag is
+    agreed cross-process (sync_fn allgather), both processes checkpoint
+    their chunks + stop cleanly, and a fresh SINGLE process auto-restores
+    the session at the preemption step."""
+    ckpt_dir = tmp_path / "ckpt"
+    marker = tmp_path / "step-reached-{pid}"
+    script = tmp_path / "trainer.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_tensorflow_tpu import parallel
+        parallel.initialize()
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        from distributed_tensorflow_tpu import ops, optim, train
+        from distributed_tensorflow_tpu.train.hooks import PreemptionHook
+
+        model = ops.serial(ops.Dense(8, activation="relu"), ops.Dense(2))
+        optimizer = optim.sgd(0.01)
+        mesh = parallel.make_mesh({{"data": len(jax.devices())}})
+        step_fn = train.make_train_step(model, "mse", optimizer, mesh=mesh)
+        state = train.init_train_state(model, optimizer,
+                                       jax.random.PRNGKey(0), (4,))
+        rng = np.random.default_rng(0)
+        x_h = rng.random((8, 4)).astype(np.float32)
+        y_h = rng.random((8, 2)).astype(np.float32)
+        # multi-process: batches must be GLOBAL jax.Arrays (same host data
+        # on every process, so a callback over the global index works)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        bsh = NamedSharding(mesh, P("data"))
+        x = jax.make_array_from_callback((8, 4), bsh, lambda i: x_h[i])
+        y = jax.make_array_from_callback((8, 2), bsh, lambda i: y_h[i])
+
+        def sync_flag(flag):
+            return bool(multihost_utils.process_allgather(
+                np.asarray([bool(flag)])).any())
+
+        hook = PreemptionHook(sync_fn=sync_flag)
+        sess = train.TrainSession(state, step_fn,
+                                  checkpoint_dir={str(ckpt_dir)!r},
+                                  sharded_checkpoint=True, hooks=[hook])
+        with sess:
+            while not sess.should_stop() and sess.step < 2000:
+                sess.run_step((x, y))
+                if sess.step == 5:
+                    open({str(marker)!r}.format(
+                        pid=jax.process_index()), "w").close()
+                time.sleep(0.02)
+        print(f"DONE proc={{jax.process_index()}} step={{sess.step}} "
+              f"preempted={{hook.triggered or sess.should_stop()}}")
+    """))
+
+    def send_sigterm(procs):
+        deadline = time.time() + 120
+        want = [str(marker).format(pid=p) for p in (0, 1)]
+        while time.time() < deadline:
+            if all(os.path.exists(w) for w in want):
+                break
+            if any(p.poll() is not None for p in procs):
+                return  # a child died early; let the asserts report it
+            time.sleep(0.1)
+        procs[1].send_signal(signal.SIGTERM)   # only the NON-chief
+
+    procs, outs = _run_pair(script, mid_run=send_sigterm)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    assert "DONE proc=0" in outs[0], outs[0]
+    assert "DONE proc=1" in outs[1], outs[1]
+
+    # the preemption checkpoint is complete: manifest + both shard files
+    from distributed_tensorflow_tpu.train import sharded_checkpoint as sc
+    ckpts = sc.all_sharded_checkpoints(str(ckpt_dir))
+    assert ckpts, os.listdir(str(ckpt_dir))
+    with open(os.path.join(ckpts[-1], "manifest.json")) as f:
+        manifest = json.load(f)
+    saved_step = manifest["step"]
+    assert saved_step >= 5
+    # the trainer's state is fully REPLICATED, so the chief owns every
+    # first replica and is the only chunk writer — that's the dedupe
+    # contract, not a gap (cross-process chunk ownership is proven by
+    # test_two_process_sharded_save_restores_into_one_process's sharded
+    # arrays); both shard FILES must still exist (possibly empty for pid 1)
+    assert manifest["chunks"] and {c["pid"] for c in manifest["chunks"]} <= {0, 1}
+    assert os.path.exists(os.path.join(ckpts[-1], "shards-00001.npz"))
+
+    # a fresh SINGLE process resumes the session from the preemption step
+    resume = tmp_path / "resume.py"
+    resume.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from distributed_tensorflow_tpu import ops, optim, parallel, train
+        model = ops.serial(ops.Dense(8, activation="relu"), ops.Dense(2))
+        optimizer = optim.sgd(0.01)
+        mesh = parallel.make_mesh({{"data": len(jax.devices())}})
+        step_fn = train.make_train_step(model, "mse", optimizer, mesh=mesh)
+        state = train.init_train_state(model, optimizer,
+                                       jax.random.PRNGKey(0), (4,))
+        sess = train.TrainSession(state, step_fn,
+                                  checkpoint_dir={str(ckpt_dir)!r},
+                                  sharded_checkpoint=True)
+        print(f"RESUMED step={{sess.step}}")
+    """))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2",
+               JAX_PLATFORMS="cpu")
+    for var in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+        env.pop(var, None)
+    out = subprocess.run([sys.executable, str(resume)], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert f"RESUMED step={saved_step}" in out.stdout, out.stdout

@@ -100,6 +100,102 @@ class TestFlashAttention:
         with pytest.raises(ValueError):
             fn(q, k, v, mask=causal_mask(16))
 
+    # -- fused Pallas backward (dq/dk/dv kernels) parity ------------------
+    def _grad_pair(self, q, k, v, flash_kwargs, ref_mask):
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, **flash_kwargs)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            out = dot_product_attention(q, k, v, mask=ref_mask)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        return g1, g2
+
+    def test_fused_backward_no_mask(self):
+        q, k, v = _qkv(jax.random.PRNGKey(10), b=2, s=64, h=2, d=16)
+        g1, g2 = self._grad_pair(q, k, v,
+                                 dict(block_q=32, block_k=32), None)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_fused_backward_causal_multiblock(self):
+        """Causal with several q/k blocks: exercises the diagonal-skip
+        guards of both backward kernels."""
+        q, k, v = _qkv(jax.random.PRNGKey(11), b=1, s=64, h=2, d=8)
+        g1, g2 = self._grad_pair(
+            q, k, v, dict(causal=True, block_q=16, block_k=16),
+            causal_mask(64))
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_fused_backward_padding_and_ragged(self):
+        """Padding mask + seq not a block multiple: padded q rows and
+        masked k columns must contribute exactly zero gradient."""
+        q, k, v = _qkv(jax.random.PRNGKey(12), b=2, s=50, h=2, d=8)
+        valid = jnp.ones((2, 50), jnp.int32).at[:, 40:].set(0)
+        g1, g2 = self._grad_pair(
+            q, k, v, dict(kv_valid=valid, block_q=16, block_k=16),
+            padding_mask(valid))
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+        # masked-out key positions get zero dk/dv
+        assert float(jnp.abs(g1[1][:, 40:]).max()) < 1e-6
+        assert float(jnp.abs(g1[2][:, 40:]).max()) < 1e-6
+
+    def test_fused_backward_bf16(self):
+        q, k, v = _qkv(jax.random.PRNGKey(13), b=1, s=32, h=2, d=8,
+                       dtype=jnp.bfloat16)
+        g1, g2 = self._grad_pair(
+            q, k, v, dict(causal=True, block_q=16, block_k=16),
+            causal_mask(32))
+        for a, b in zip(g1, g2):
+            assert a.dtype == b.dtype == jnp.bfloat16
+            np.testing.assert_allclose(a.astype(np.float32),
+                                       b.astype(np.float32),
+                                       atol=6e-2, rtol=6e-2)
+
+    def test_fused_backward_under_jit_value_and_grad(self):
+        q, k, v = _qkv(jax.random.PRNGKey(14), b=1, s=32, h=2, d=8)
+
+        @jax.jit
+        def vg(q, k, v):
+            return jax.value_and_grad(
+                lambda q: jnp.sum(flash_attention(q, k, v, causal=True,
+                                                  block_q=16,
+                                                  block_k=16) ** 2))(q)
+
+        val, grad = vg(q, k, v)
+        ref = jnp.sum(dot_product_attention(
+            q, k, v, mask=causal_mask(32)) ** 2)
+        np.testing.assert_allclose(float(val), float(ref), rtol=1e-5)
+        assert bool(jnp.isfinite(grad).all())
+
+
+class TestFlashAutoDispatch:
+    def test_resolve_use_flash(self, monkeypatch):
+        from distributed_tensorflow_tpu.ops import attention as attn_lib
+        assert attn_lib.resolve_use_flash(True, 8) is True
+        assert attn_lib.resolve_use_flash(False, 99999) is False
+        # pin the backend so the assertions hold on TPU-attached hosts too
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert attn_lib.resolve_use_flash("auto", 99999) is False
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert attn_lib.resolve_use_flash("auto", 2048) is True
+        assert attn_lib.resolve_use_flash("auto", 512) is False
+
+    def test_flash_min_seq_env(self, monkeypatch):
+        from distributed_tensorflow_tpu.ops import attention as attn_lib
+        monkeypatch.setenv("DTTPU_FLASH_MIN_SEQ", "64")
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        # still gated on the TPU backend even past the threshold
+        assert attn_lib.flash_wins(128) is False
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert attn_lib.flash_wins(128) is True
+        assert attn_lib.flash_wins(32) is False
+
 
 class TestFusedAdam:
     def _naive(self, p, g, m, v, t, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8,

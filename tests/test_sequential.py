@@ -37,8 +37,12 @@ def test_tensorboard_callback(tmp_path):
               callbacks=[models.TensorBoard(str(tmp_path))])
     files = glob.glob(str(tmp_path / "events.out.tfevents.*"))
     assert len(files) == 1
-    from tests.test_summary import read_records
-    assert len(read_records(files[0])) == 3  # version + 2 epochs
+    from tests.test_summary import parse_event, read_records
+    records = read_records(files[0])
+    assert len(records) == 4  # version + graph + 2 epochs
+    # exactly one graph event (Event.graph_def, field 4): the Sequential
+    # model.layers path (advisor round 2 — previously silently swallowed)
+    assert sum(1 for r in records if 4 in parse_event(r)) == 1
 
 
 def test_early_stopping():
@@ -431,17 +435,24 @@ def test_sample_weight_keras_rule():
 
 def test_sample_weight_zero_excludes_samples():
     """Zero-weighted samples must not influence training: poisoned labels
-    at weight 0 leave convergence on the real task intact."""
+    at weight 0 leave convergence on the real task intact.  Uses a config
+    that demonstrably learns 64-bit XOR (128-128 MLP, 4000 samples) so the
+    oracle actually discriminates — a smaller model fails even unweighted."""
     import numpy as np
-    (xt, yt), (xv, yv) = data.xor_data(600, val_size=64, seed=0)
-    # append 200 label-poisoned rows with weight 0
-    xp = xt[:200]
-    yp = 1.0 - yt[:200]
+    (xt, yt), (xv, yv) = data.xor_data(4000, val_size=128, seed=0)
+    # append 1000 label-poisoned rows with weight 0
+    xp = xt[:1000]
+    yp = 1.0 - yt[:1000]
     x = np.concatenate([xt, xp])
     y = np.concatenate([yt, yp])
-    w = np.concatenate([np.ones(len(xt)), np.zeros(200)]).astype(np.float32)
-    model = xor_model()
-    model.fit(x, y, epochs=25, batch_size=50, verbose=0, sample_weight=w)
+    w = np.concatenate([np.ones(len(xt)), np.zeros(1000)]).astype(np.float32)
+    model = models.Sequential()
+    model.add(ops.Dense(128, "relu"))
+    model.add(ops.Dense(128, "relu"))
+    model.add(ops.Dense(32, "sigmoid"))
+    model.compile(loss="mean_squared_error", optimizer="adam",
+                  metrics=["bitwise_accuracy"])
+    model.fit(x, y, epochs=30, batch_size=100, verbose=0, sample_weight=w)
     acc = model.evaluate(xv, yv, verbose=0)["bitwise_accuracy"]
     assert acc > 0.9
 

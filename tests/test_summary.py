@@ -297,6 +297,35 @@ def test_graph_event_roundtrip(tmp_path):
     assert 4 in graph
 
 
+def test_graph_event_sequential(tmp_path):
+    """The TensorBoard callback's primary consumer is Sequential: fit with
+    the callback must write a graph event reflecting model.layers (advisor
+    round 2: Sequential previously lacked .layers and the event was
+    silently swallowed)."""
+    import glob
+
+    import numpy as np
+
+    from distributed_tensorflow_tpu import models, ops
+
+    model = models.Sequential([ops.Dense(8, activation="relu"),
+                               ops.Dense(2)])
+    assert model.layers == model._layers   # Keras-parity property
+    model.compile(loss="mse", optimizer="sgd")
+    x = np.random.default_rng(0).random((16, 3)).astype(np.float32)
+    y = np.random.default_rng(1).random((16, 2)).astype(np.float32)
+    model.fit(x, y, epochs=1, batch_size=16, verbose=0,
+              callbacks=[models.TensorBoard(str(tmp_path))])
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    records = read_records(path)
+    graphs = [parse_event(r) for r in records if 4 in parse_event(r)]
+    assert len(graphs) == 1
+    graph = parse_event(graphs[0][4][0])
+    nodes = [parse_event(n) for n in graph[1]]
+    ops_ = [n[2][0].decode() for n in nodes]
+    assert ops_ == ["Placeholder", "Dense", "Dense"]
+
+
 def test_graph_event_explicit_nodes(tmp_path):
     """add_graph also takes explicit (name, op, inputs) tuples — the escape
     hatch for non-Sequential topologies (BERT/GPT blocks)."""
