@@ -265,6 +265,29 @@ class GPT:
         ffn_out, aux = self._ffn(p, x, rng=r_moe, train=train)
         return x + _dropout(ffn_out, c.dropout_rate, r_drop, train), aux
 
+    def _embed(self, emb, input_ids, r_emb, train):
+        """Word (+ learned position) embedding, dropout, compute-dtype
+        cast — ONE implementation for the plain forward and the 1F1B path
+        (the gradient parity between them depends on bit-identity here)."""
+        c = self.config
+        s = input_ids.shape[1]
+        x = jnp.take(emb["word"], input_ids, axis=0)
+        if c.position_embedding == "learned":
+            x = x + emb["position"][None, :s, :]
+        return _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
+
+    def _make_layer_fn(self, seq_len: int):
+        """Decoder block fn with the RoPE transform bound and optional
+        remat — shared by apply() and the 1F1B path.  The transform is
+        bound via partial (not a call argument): it's a callable, which
+        jax.checkpoint can't accept as a traced arg."""
+        from functools import partial
+        layer_fn = partial(self._block,
+                           qk_transform=self._rope_transform(seq_len))
+        if self.config.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
+        return layer_fn
+
     # -- full-sequence forward -------------------------------------------
     def apply(self, params, input_ids, *, train: bool = False, rng=None,
               return_aux: bool = False):
@@ -275,22 +298,10 @@ class GPT:
             if train:
                 raise ValueError("GPT.apply(train=True) requires rng")
             rng = jax.random.PRNGKey(0)
-        b, s = input_ids.shape
-        emb = params["embeddings"]
-        x = jnp.take(emb["word"], input_ids, axis=0)
-        if c.position_embedding == "learned":
-            x = x + emb["position"][None, :s, :]
+        s = input_ids.shape[1]
         r_emb, r_layers = jax.random.split(rng)
-        x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
-
-        # the transform is bound via partial (not a call argument): it's a
-        # callable, which jax.checkpoint can't accept as a traced arg
-        from functools import partial
-        layer_fn = partial(self._block,
-                           qk_transform=self._rope_transform(s))
-        if c.remat:
-            layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
-
+        x = self._embed(params["embeddings"], input_ids, r_emb, train)
+        layer_fn = self._make_layer_fn(s)
         layer_keys = jax.random.split(r_layers, c.num_layers)
         if c.pipeline_stages > 1:
             # the stage_fn builds its own mask (shard_map bodies cannot
@@ -318,8 +329,8 @@ class GPT:
             return hidden, aux_total
         return hidden
 
-    def _pipeline_blocks(self, params, x, layer_keys, train, layer_fn):
-        """Decoder blocks as a GPipe pipeline over ``config.pipe_axis``.
+    def _pipeline_stage_bits(self, params, layer_keys, train, layer_fn):
+        """(stage_params, stage_fn) for the pipelined decoder stack.
 
         The scanned [L, ...] decoder stack reshapes to [S, L/S, ...] stage
         params (a local view when the store shards the leading layer dim
@@ -332,7 +343,6 @@ class GPT:
         stage (a closure-free constant — shard_map bodies cannot capture
         traced values).
         """
-        from ..parallel.pipeline import pipeline_apply
         c = self.config
         if self.mesh is None:
             raise ValueError("pipeline_stages requires GPT(config, mesh=...)")
@@ -358,9 +368,18 @@ class GPT:
             acts, _ = lax.scan(body, acts, (sp["layers"], sp["keys"]))
             return acts
 
+        return stage_params, stage_fn
+
+    def _pipeline_blocks(self, params, x, layer_keys, train, layer_fn):
+        """Decoder blocks as a GPipe pipeline over ``config.pipe_axis``
+        (see ``_pipeline_stage_bits`` for the stage construction)."""
+        from ..parallel.pipeline import pipeline_apply
+        c = self.config
+        stage_params, stage_fn = self._pipeline_stage_bits(
+            params, layer_keys, train, layer_fn)
         return pipeline_apply(
             stage_fn, stage_params, x, self.mesh,
-            c.pipeline_microbatches or s_count, axis=c.pipe_axis)
+            c.pipeline_microbatches or c.pipeline_stages, axis=c.pipe_axis)
 
     def logits(self, params, hidden):
         """Tied LM head -> [b, s, vocab] f32 logits."""
@@ -396,6 +415,86 @@ class GPT:
             return loss + aux, (metrics, model_state)
 
         return loss_fn
+
+    def lm_1f1b_value_and_grad(self, params, batch, rng=None,
+                               train: bool = True):
+        """Full-model causal-LM training pass under the hand-scheduled
+        **1F1B** pipeline -> ``(loss, grads)`` with ``grads`` matching the
+        ``params`` tree (what ``jax.value_and_grad(lm_loss_fn)`` returns on
+        the GPipe path, at O(stages) activation memory instead of
+        O(microbatches)).
+
+        Composition: embeddings run pipe-replicated under an explicit
+        ``jax.vjp`` whose cotangent is the pipeline's ``dx``; the decoder
+        stages run ``parallel.pipeline.pipeline_value_and_grad``; final-LN
+        + tied LM head + softmax-CE are the pipeline's ``loss_fn`` with
+        ``aux_params`` (their grads come back pipe-replicated).  The tied
+        word embedding accumulates BOTH paths: embed-side lookup grads +
+        head-side logit grads.
+        """
+        c = self.config
+        if c.pipeline_stages <= 1:
+            raise ValueError("lm_1f1b_value_and_grad requires "
+                             "pipeline_stages > 1")
+        from ..parallel.pipeline import pipeline_value_and_grad
+        if rng is None:
+            if train:
+                raise ValueError("train=True requires rng")
+            rng = jax.random.PRNGKey(0)
+        ids = batch["input_ids"]
+        inputs, targets = ids[:, :-1], ids[:, 1:]
+        mask = batch.get("loss_mask")
+        r_emb, r_layers = jax.random.split(rng)
+
+        x_emb, vjp_embed = jax.vjp(
+            lambda emb: self._embed(emb, inputs, r_emb, train),
+            params["embeddings"])
+
+        layer_fn = self._make_layer_fn(inputs.shape[1])
+        layer_keys = jax.random.split(r_layers, c.num_layers)
+        stage_params, stage_fn = self._pipeline_stage_bits(
+            params, layer_keys, train, layer_fn)
+
+        aux = {"ln_f": params["ln_f"], "word": params["embeddings"]["word"]}
+
+        def head_loss(a, out_mb, y_mb):
+            h = _layer_norm(a["ln_f"], out_mb, c.layer_norm_eps)
+            logits = (h @ a["word"].T.astype(h.dtype)).astype(jnp.float32)
+            return loss_lib.softmax_cross_entropy_with_integer_labels(
+                logits, y_mb["t"], where=y_mb.get("m"))
+
+        n_micro = c.pipeline_microbatches or c.pipeline_stages
+        y = {"t": targets}
+        weights = None
+        if mask is not None:
+            # masked-mean loss: each microbatch's masked mean weighs in by
+            # its share of the global mask count (uniform weights would be
+            # wrong whenever microbatch mask counts differ)
+            y["m"] = mask
+            per_mb = jnp.maximum(
+                mask.reshape(n_micro, -1).sum(axis=1).astype(jnp.float32),
+                0.0)
+            weights = per_mb / jnp.maximum(per_mb.sum(), 1.0)
+
+        loss, stage_grads, aux_grads, dx = pipeline_value_and_grad(
+            stage_fn, head_loss, stage_params, x_emb, y, self.mesh,
+            n_micro, axis=c.pipe_axis, aux_params=aux, with_dx=True,
+            microbatch_weights=weights)
+
+        (emb_grads,) = vjp_embed(dx)
+        # tied embedding: head-side grads add to the lookup-side grads
+        emb_grads = dict(emb_grads)
+        emb_grads["word"] = (emb_grads["word"]
+                             + aux_grads["word"].astype(
+                                 emb_grads["word"].dtype))
+        grads = {
+            "embeddings": emb_grads,
+            "decoder": jax.tree.map(
+                lambda g, p: g.reshape(p.shape),
+                stage_grads["layers"], params["decoder"]),
+            "ln_f": aux_grads["ln_f"],
+        }
+        return loss, grads
 
     # -- KV-cache decode --------------------------------------------------
     def init_cache(self, batch_size: int, max_len: Optional[int] = None):

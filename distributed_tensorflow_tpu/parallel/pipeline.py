@@ -137,7 +137,10 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
                                               jnp.ndarray],
                             stacked_params, x: jnp.ndarray, y: jnp.ndarray,
                             mesh: Mesh, num_microbatches: int,
-                            axis: str = "pipe"):
+                            axis: str = "pipe",
+                            aux_params: Any = None,
+                            with_dx: bool = False,
+                            microbatch_weights: Any = None):
     """Hand-scheduled **1F1B** pipeline training pass -> ``(loss, grads)``.
 
     GPipe via ``jax.grad(pipeline_apply)`` runs all M forwards, then all M
@@ -162,6 +165,29 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
     The last stage seeds both its own cotangent and the loss value through
     ONE combined ``jax.vjp`` over ``(out, loss)``, so every stage runs an
     identical program — no per-device branching.
+
+    Full-model integration hooks (what lets a MODEL — embeddings before the
+    pipeline, a head inside the loss — train under 1F1B, not just the
+    stages):
+
+      * ``aux_params``: extra pytree differentiated THROUGH the loss —
+        ``loss_fn(aux_params, out_mb, y_mb)`` when given.  Returns their
+        grads (pipe-replicated psum; only the last stage's loss touches
+        them) appended to the result: the tied LM head / final-LN case.
+      * ``with_dx=True``: also return ``d(loss)/d(x)`` — stage 0's input
+        cotangents banked per microbatch — so the caller can chain
+        ``jax.vjp`` through whatever produced ``x`` (embeddings).
+
+    ``y`` may be any pytree whose leaves share the batch leading dim (e.g.
+    ``{"targets": ..., "mask": ...}``); ``loss_fn`` receives the matching
+    microbatch slice.  ``microbatch_weights``: optional [M] f32 summing to
+    1 — the per-microbatch contribution to the total loss/gradient.  A
+    MASKED-mean loss needs this: per-microbatch masked means averaged
+    uniformly are NOT the global masked mean when mask counts differ, so
+    pass each microbatch's normalizer share (mask-sum / total).  Default
+    uniform 1/M is exact for plain-mean losses.
+
+    Returns ``(loss, grads[, aux_grads][, dx])``.
     """
     n_stages = mesh.shape[axis]
     leading = {p.shape[0] for p in jax.tree.leaves(stacked_params)}
@@ -183,27 +209,47 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
     act_dtype = jnp.result_type(
         x.dtype, jax.eval_shape(stage_fn, one_stage, mb_in).dtype)
 
-    def inner(params, x, y):
+    has_aux = aux_params is not None
+
+    def inner(params, x, y, aux, weights):
         params = jax.tree.map(lambda p: p[0], params)
         idx = lax.axis_index(axis)
         is_first = idx == 0
         is_last = idx == n_stages - 1
         mbs = x.reshape(num_microbatches, mb, *x.shape[1:])
-        mbs_y = y.reshape(num_microbatches, mb, *y.shape[1:])
+        mbs_y = jax.tree.map(
+            lambda a: a.reshape(num_microbatches, a.shape[0]
+                                // num_microbatches, *a.shape[1:]), y)
 
         fwd_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
         bwd_perm = [(j, (j - 1) % n_stages) for j in range(n_stages)]
-        seed = jnp.float32(1.0 / num_microbatches)
 
-        def fwd_and_loss(p, xin, y_mb):
+        # Differentiate only floating leaves: integer leaves (e.g. stacked
+        # PRNG keys riding in the stage params) as vjp PRIMALS trip an
+        # unimplemented ShardMapTracer path — close over them instead
+        # (same-body closure, which shard_map allows).
+        p_leaves, p_tdef = jax.tree_util.tree_flatten(params)
+        p_isdiff = [jnp.issubdtype(l.dtype, jnp.floating) for l in p_leaves]
+        p_diff = [l for l, d in zip(p_leaves, p_isdiff) if d]
+
+        def rebuild(diff_leaves):
+            it = iter(diff_leaves)
+            return jax.tree_util.tree_unflatten(
+                p_tdef, [next(it) if d else l
+                         for l, d in zip(p_leaves, p_isdiff)])
+
+        def fwd_and_loss(dl, xin, a, y_mb):
             # cast as the forward sub-tick does: the vjp's `out` cotangent
             # must be act_dtype or mixed-precision stages (bf16 compute on
             # f32 carries) reject the incoming bwd_state
-            out = stage_fn(p, xin).astype(act_dtype)
-            return out, loss_fn(out, y_mb).astype(jnp.float32)
+            out = stage_fn(rebuild(dl), xin).astype(act_dtype)
+            loss = (loss_fn(a, out, y_mb) if has_aux
+                    else loss_fn(out, y_mb))
+            return out, loss.astype(jnp.float32)
 
         def tick(carry, t):
-            fwd_state, bwd_state, stash, gacc, loss_sum = carry
+            fwd_state, bwd_state, stash, gacc, ga_acc, dx_buf, loss_sum = \
+                carry
 
             # ---- F sub-tick: stage s forwards microbatch t - s ----------
             mf = t - idx
@@ -221,37 +267,82 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
             active_b = (mb_i >= 0) & (mb_i < num_microbatches)
             mb_c = jnp.clip(mb_i, 0, num_microbatches - 1)
             xin_b = stash[mb_c % n_slots]
-            y_mb = mbs_y[mb_c]
+            y_mb = jax.tree.map(lambda a: a[mb_c], mbs_y)
             (out_b, loss_b), vjp = jax.vjp(
-                lambda p, x_: fwd_and_loss(p, x_, y_mb), params, xin_b)
+                lambda dl, x_, a: fwd_and_loss(dl, x_, a, y_mb),
+                p_diff, xin_b, aux)
             del out_b
-            # last stage: seed d(loss); others: incoming cotangent on out
+            # last stage: seed this microbatch's share of d(loss); others:
+            # incoming cotangent on out
+            seed = weights[mb_c]
             g_out = jnp.where(is_last, jnp.zeros_like(bwd_state), bwd_state)
             g_loss = jnp.where(is_last, seed, jnp.float32(0.0))
-            gp, gx = vjp((g_out, g_loss))
-            gacc = jax.tree.map(
-                lambda a, g: a + jnp.where(active_b, g, 0.0).astype(a.dtype),
-                gacc, gp)
-            loss_sum = loss_sum + jnp.where(
-                is_last & active_b, loss_b, 0.0) / num_microbatches
+            gp, gx, ga = vjp((g_out, g_loss))
+
+            def acc(mask):
+                def f(a_, g):
+                    if g.dtype == jax.dtypes.float0:   # non-diff aux leaf
+                        return a_
+                    return a_ + jnp.where(mask, g, 0.0).astype(a_.dtype)
+                return f
+
+            gacc = jax.tree.map(acc(active_b), gacc, gp)
+            # aux (loss-side) grads are nonzero only where g_loss seeds —
+            # the last stage; accumulate there, psum-broadcast at the end
+            ga_acc = jax.tree.map(acc(is_last & active_b), ga_acc, ga)
+            if with_dx:
+                # stage 0's input cotangent IS d(loss)/d(x[microbatch]) —
+                # bank it (same slot trick as the forward output buffer)
+                dx_buf = dx_buf.at[mb_c].set(
+                    jnp.where(is_first & active_b, gx.astype(jnp.float32),
+                              dx_buf[mb_c]))
             bwd_state = lax.ppermute(gx.astype(act_dtype), axis, bwd_perm)
-            return (fwd_state, bwd_state, stash, gacc, loss_sum), None
+            loss_sum = loss_sum + jnp.where(
+                is_last & active_b, loss_b, 0.0) * seed
+            return (fwd_state, bwd_state, stash, gacc, ga_acc, dx_buf,
+                    loss_sum), None
 
         fwd0 = jnp.zeros((mb, *x.shape[1:]), act_dtype)
         stash0 = jnp.zeros((n_slots, mb, *x.shape[1:]), act_dtype)
-        gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                             params)
-        carry0 = (fwd0, fwd0, stash0, gacc0, jnp.float32(0.0))
-        (_, _, _, gacc, loss_sum), _ = lax.scan(
+        gacc0 = [jnp.zeros(p.shape, jnp.float32) for p in p_diff]
+        ga0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), aux)
+        dx0 = jnp.zeros((num_microbatches, mb, *x.shape[1:]), jnp.float32
+                        ) if with_dx else jnp.zeros((), jnp.float32)
+        carry0 = (fwd0, fwd0, stash0, gacc0, ga0, dx0, jnp.float32(0.0))
+        (_, _, _, gacc, ga_acc, dx_buf, loss_sum), _ = lax.scan(
             tick, carry0, jnp.arange(n_ticks))
         loss = lax.psum(jnp.where(is_last, loss_sum, 0.0), axis)
-        grads = jax.tree.map(lambda g, p: g.astype(p.dtype)[None],
-                             gacc, params)
-        return loss, grads
+        # grads in the full params structure; non-diff leaves get zeros
+        g_it = iter(gacc)
+        grads = jax.tree_util.tree_unflatten(
+            p_tdef,
+            [(next(g_it).astype(l.dtype) if d else jnp.zeros_like(l))[None]
+             for l, d in zip(p_leaves, p_isdiff)])
+        aux_grads = jax.tree.map(
+            lambda g, p: lax.psum(jnp.where(is_last, g, 0.0), axis
+                                  ).astype(p.dtype), ga_acc, aux)
+        dx = (lax.psum(jnp.where(is_first, dx_buf, 0.0), axis
+                       ).reshape(x.shape).astype(x.dtype)
+              if with_dx else dx_buf)
+        return loss, grads, aux_grads, dx
 
-    return jax.shard_map(
+    aux_in = aux_params if has_aux else ()
+    w_in = (jnp.full((num_microbatches,), 1.0 / num_microbatches,
+                     jnp.float32)
+            if microbatch_weights is None
+            else jnp.asarray(microbatch_weights, jnp.float32))
+    loss, grads, aux_grads, dx = jax.shard_map(
         inner, mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P(axis), stacked_params), P(), P()),
-        out_specs=(P(), jax.tree.map(lambda _: P(axis), stacked_params)),
+        in_specs=(jax.tree.map(lambda _: P(axis), stacked_params), P(),
+                  jax.tree.map(lambda _: P(), y),
+                  jax.tree.map(lambda _: P(), aux_in), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(axis), stacked_params),
+                   jax.tree.map(lambda _: P(), aux_in), P()),
         axis_names=frozenset({axis}),
-        check_vma=False)(stacked_params, x, y)
+        check_vma=False)(stacked_params, x, y, aux_in, w_in)
+    result = (loss, grads)
+    if has_aux:
+        result += (aux_grads,)
+    if with_dx:
+        result += (dx,)
+    return result

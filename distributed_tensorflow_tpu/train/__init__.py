@@ -8,7 +8,8 @@ from .hooks import (CheckpointHook, EvalHook, Hook, LoggingHook, NaNHook,
                     PreemptionHook, ProfilerHook, StepCounterHook,
                     StopAtStepHook, SummaryHook, WatchdogHook)
 from .session import TrainSession, TrainState
-from .step import (init_train_state, make_custom_train_step, make_eval_step,
+from .step import (init_train_state, make_1f1b_train_step,
+                   make_custom_train_step, make_eval_step,
                    make_multi_train_step, make_train_step,
                    shard_train_state)
 
@@ -20,4 +21,5 @@ __all__ = ["checkpoint", "hooks", "precision", "sharded_checkpoint",
            "NaNHook", "PreemptionHook", "ProfilerHook", "StepCounterHook",
            "StopAtStepHook", "SummaryHook", "WatchdogHook",
            "TrainSession", "TrainState", "init_train_state", "make_multi_train_step", "shard_train_state",
-           "make_custom_train_step", "make_eval_step", "make_train_step"]
+           "make_1f1b_train_step", "make_custom_train_step", "make_eval_step",
+           "make_train_step"]

@@ -31,7 +31,7 @@ from . import precision as prec_lib
 from .session import TrainState
 
 __all__ = ["make_train_step", "make_multi_train_step", "make_eval_step",
-           "init_train_state", "shard_train_state"]
+           "make_1f1b_train_step", "init_train_state", "shard_train_state"]
 
 
 def shard_train_state(state: "TrainState", mesh: Mesh, rules) -> "TrainState":
@@ -337,6 +337,39 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
         return jax.jit(step, donate_argnums=0)
     return jax.jit(step, donate_argnums=0,
                    in_shardings=(state_shardings, batch_shardings))
+
+
+def make_1f1b_train_step(model, optimizer: opt_lib.Optimizer,
+                         seed: int = 0,
+                         grad_clip_norm: Optional[float] = None,
+                         jit: bool = True) -> Callable:
+    """``step(state, batch) -> (new_state, metrics)`` whose gradients come
+    from the model's hand-scheduled **1F1B** pipeline pass — O(stages)
+    activation memory instead of the GPipe path's O(microbatches).
+
+    ``model`` must expose ``lm_1f1b_value_and_grad(params, batch, rng,
+    train)`` (``models.gpt.GPT`` with ``pipeline_stages > 1``); everything
+    else (fold-in dropout keys, clip, donated state) matches the plain
+    step builders.
+    """
+    base_key = jax.random.PRNGKey(seed)
+
+    def step(state: TrainState, batch):
+        rng = jax.random.fold_in(base_key, state.step)
+        loss_value, grads = model.lm_1f1b_value_and_grad(
+            state.params, batch, rng, True)
+        metrics = {"loss": loss_value}
+        if grad_clip_norm is not None:
+            grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)
+            metrics["grad_norm"] = gnorm
+        updates, new_opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+        new_params = opt_lib.apply_updates(state.params, updates)
+        return TrainState(step=state.step + 1, params=new_params,
+                          opt_state=new_opt_state,
+                          model_state=state.model_state), metrics
+
+    return jax.jit(step, donate_argnums=0) if jit else step
 
 
 def make_multi_train_step(model, loss, optimizer: opt_lib.Optimizer,
