@@ -381,10 +381,15 @@ class GPT:
             stage_fn, stage_params, x, self.mesh,
             c.pipeline_microbatches or c.pipeline_stages, axis=c.pipe_axis)
 
+    def _logits_from_word(self, word, hidden):
+        """Tied-head projection against an explicit word matrix — ONE
+        implementation for logits() and the 1F1B head loss (their
+        gradient parity depends on bit-identity)."""
+        return (hidden @ word.T.astype(hidden.dtype)).astype(jnp.float32)
+
     def logits(self, params, hidden):
         """Tied LM head -> [b, s, vocab] f32 logits."""
-        w = params["embeddings"]["word"].T.astype(hidden.dtype)
-        return (hidden @ w).astype(jnp.float32)
+        return self._logits_from_word(params["embeddings"]["word"], hidden)
 
     # -- training ---------------------------------------------------------
     def lm_loss_fn(self):
@@ -459,7 +464,7 @@ class GPT:
 
         def head_loss(a, out_mb, y_mb):
             h = _layer_norm(a["ln_f"], out_mb, c.layer_norm_eps)
-            logits = (h @ a["word"].T.astype(h.dtype)).astype(jnp.float32)
+            logits = self._logits_from_word(a["word"], h)
             return loss_lib.softmax_cross_entropy_with_integer_labels(
                 logits, y_mb["t"], where=y_mb.get("m"))
 
@@ -471,10 +476,11 @@ class GPT:
             # its share of the global mask count (uniform weights would be
             # wrong whenever microbatch mask counts differ)
             y["m"] = mask
-            per_mb = jnp.maximum(
-                mask.reshape(n_micro, -1).sum(axis=1).astype(jnp.float32),
-                0.0)
-            weights = per_mb / jnp.maximum(per_mb.sum(), 1.0)
+            per_mb = mask.reshape(n_micro, -1).sum(axis=1).astype(
+                jnp.float32)
+            # 1e-9 floor, same as ops.losses: a 1.0 floor would silently
+            # shrink fractional-weight batches relative to the GPipe path
+            weights = per_mb / jnp.maximum(per_mb.sum(), 1e-9)
 
         loss, stage_grads, aux_grads, dx = pipeline_value_and_grad(
             stage_fn, head_loss, stage_params, x_emb, y, self.mesh,

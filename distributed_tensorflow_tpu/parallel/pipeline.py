@@ -20,6 +20,14 @@ Design (TPU-first, not a port of any PS/NCCL scheme):
 Constraint of this formulation: every stage maps activations of one shape to
 activations of the SAME shape (transformer-block style).  Embed before the
 pipeline, project after — see tests/test_pipeline.py for the usage pattern.
+
+Known backend limitation (NOT a bug here): XLA:CPU miscompiles some of
+these scan+ppermute programs with **bfloat16** activations — a fatal
+"Invalid binary instruction opcode copy" check failure in the compiler
+(seen in the GPipe autodiff transpose and in a jitted pipelined forward on
+a pipe×data mesh; hand-scheduled 1F1B training compiles).  Use f32
+activations for pp work on the CPU test rig (examples/train_gpt.py does
+this automatically); TPU is the real target.
 """
 from __future__ import annotations
 
@@ -292,9 +300,10 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
             ga_acc = jax.tree.map(acc(is_last & active_b), ga_acc, ga)
             if with_dx:
                 # stage 0's input cotangent IS d(loss)/d(x[microbatch]) —
-                # bank it (same slot trick as the forward output buffer)
+                # bank it (same slot trick as the forward output buffer;
+                # act_dtype: each slot is written once, nothing accumulates)
                 dx_buf = dx_buf.at[mb_c].set(
-                    jnp.where(is_first & active_b, gx.astype(jnp.float32),
+                    jnp.where(is_first & active_b, gx.astype(act_dtype),
                               dx_buf[mb_c]))
             bwd_state = lax.ppermute(gx.astype(act_dtype), axis, bwd_perm)
             loss_sum = loss_sum + jnp.where(
@@ -306,7 +315,7 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
         stash0 = jnp.zeros((n_slots, mb, *x.shape[1:]), act_dtype)
         gacc0 = [jnp.zeros(p.shape, jnp.float32) for p in p_diff]
         ga0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), aux)
-        dx0 = jnp.zeros((num_microbatches, mb, *x.shape[1:]), jnp.float32
+        dx0 = jnp.zeros((num_microbatches, mb, *x.shape[1:]), act_dtype
                         ) if with_dx else jnp.zeros((), jnp.float32)
         carry0 = (fwd0, fwd0, stash0, gacc0, ga0, dx0, jnp.float32(0.0))
         (_, _, _, gacc, ga_acc, dx_buf, loss_sum), _ = lax.scan(
@@ -331,6 +340,11 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
                      jnp.float32)
             if microbatch_weights is None
             else jnp.asarray(microbatch_weights, jnp.float32))
+    if w_in.shape != (num_microbatches,):
+        raise ValueError(
+            f"microbatch_weights shape {w_in.shape} != "
+            f"({num_microbatches},) — clamp-indexing would silently "
+            "mis-scale the loss")
     loss, grads, aux_grads, dx = jax.shard_map(
         inner, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis), stacked_params), P(),

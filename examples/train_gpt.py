@@ -30,6 +30,14 @@ flags_lib.DEFINE_integer("batch_size", 32, "global batch size")
 flags_lib.DEFINE_integer("seq_len", 64, "sequence length")
 flags_lib.DEFINE_string("log_dir", "/tmp/dttpu_gpt", "checkpoints + events")
 flags_lib.DEFINE_integer("seed", 0, "data/init seed")
+flags_lib.DEFINE_integer("num_layers", 2, "decoder blocks")
+flags_lib.DEFINE_integer("pipeline_stages", 0,
+                         "split the decoder over a 'pipe' mesh axis "
+                         "(0 = off; must divide --num_layers AND the "
+                         "device count; replaces the fsdp axis)")
+flags_lib.DEFINE_string("pp_schedule", "gpipe",
+                        "pipeline schedule: gpipe (autodiff backward) | "
+                        "1f1b (hand-scheduled, O(stages) activation memory)")
 FLAGS = flags_lib.FLAGS
 
 
@@ -48,15 +56,37 @@ def main() -> int:
     from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
 
     n = len(jax.devices())
-    fsdp = 2 if n % 2 == 0 and n > 1 else 1
-    mesh = parallel.make_mesh({"data": n // fsdp, "fsdp": fsdp})
+    pp = FLAGS.pipeline_stages
+    if pp > 1:
+        if n % pp:
+            raise SystemExit(f"--pipeline_stages={pp} does not divide the "
+                             f"device count {n}")
+        if FLAGS.num_layers % pp:
+            raise SystemExit(f"--pipeline_stages={pp} does not divide "
+                             f"--num_layers={FLAGS.num_layers}")
+        fsdp = 1
+        mesh = parallel.make_mesh({"pipe": pp, "data": n // pp})
+    else:
+        fsdp = 2 if n % 2 == 0 and n > 1 else 1
+        mesh = parallel.make_mesh({"data": n // fsdp, "fsdp": fsdp})
     print(f"devices: {n} ({jax.devices()[0].platform}), "
           f"mesh={dict(mesh.shape)}", file=sys.stderr)
 
-    config = GPTConfig(vocab_size=256, num_layers=2, num_heads=4,
+    # XLA:CPU miscompiles scan+ppermute pipeline programs with bf16
+    # activations ("Invalid binary instruction opcode copy" check failure
+    # in both the GPipe transpose and the jitted pipelined forward) — on
+    # the CPU backend the pp path trains in f32.  TPU keeps bf16.
+    pp_cpu = pp > 1 and jax.devices()[0].platform == "cpu"
+    if pp_cpu:
+        print("pp on XLA:CPU: falling back to f32 activations (bf16 "
+              "pipeline programs trip an XLA:CPU compiler bug)",
+              file=sys.stderr)
+    config = GPTConfig(vocab_size=256, num_layers=FLAGS.num_layers,
+                       num_heads=4,
                        hidden_size=128, max_position=FLAGS.seq_len,
-                       dtype=jnp.bfloat16)
-    model = GPT(config)
+                       dtype=jnp.float32 if pp_cpu else jnp.bfloat16,
+                       pipeline_stages=pp if pp > 1 else 0)
+    model = GPT(config, mesh=mesh if pp > 1 else None)
     optimizer = optim.with_ema(optim.adamw(3e-3), decay=0.99)
 
     params = model.init(jax.random.PRNGKey(FLAGS.seed))
@@ -64,9 +94,19 @@ def main() -> int:
     state = train.shard_train_state(state, mesh,
                                     model.partition_rules(fsdp=fsdp > 1))
 
-    step = train.make_custom_train_step(model.lm_loss_fn(), optimizer,
-                                        grad_clip_norm=1.0,
-                                        policy="mixed_bfloat16")
+    if pp > 1 and FLAGS.pp_schedule == "1f1b":
+        # hand-scheduled 1F1B: full-model grads at O(stages) memory
+        step = train.make_1f1b_train_step(model, optimizer,
+                                          grad_clip_norm=1.0)
+    else:
+        # non-pp, or GPipe: apply() routes the decoder through the
+        # pipeline and autodiff transposes it into the backward schedule.
+        # The bf16 policy is skipped under pp: config.dtype already casts
+        # the compute path, and the param-cast composed with the pipeline
+        # shard_map trips an XLA:CPU check failure.
+        step = train.make_custom_train_step(
+            model.lm_loss_fn(), optimizer, grad_clip_norm=1.0,
+            policy=None if pp > 1 else "mixed_bfloat16")
 
     # order-1 (bigram) chain: strongly learnable, so short runs show a real
     # drop below the uniform baseline
@@ -74,6 +114,14 @@ def main() -> int:
                                             seed=FLAGS.seed, order=1),
                         FLAGS.seq_len)
     batch = parallel.round_batch_to_mesh(FLAGS.batch_size, mesh)
+    if pp > 1 and batch % pp:
+        # the pipeline also needs batch % microbatches == 0 (= stages
+        # here); round up to the lcm of the data-shard and stage counts
+        import math
+        quantum = math.lcm(parallel.data_shards(mesh), pp)
+        batch = -(-FLAGS.batch_size // quantum) * quantum
+        print(f"batch_size -> {batch} (divisible by {quantum}: data shards"
+              f" x pipeline stages)", file=sys.stderr)
     ds = data.Dataset([rows], batch, seed=FLAGS.seed)
     bsh = NamedSharding(mesh, P(("data", "fsdp")) if fsdp > 1 else P("data"))
 
@@ -104,9 +152,15 @@ def main() -> int:
     # Evaluate both live and EMA weights on held-out rows; generate a sample.
     eval_rows = rows[-64:]
     loss_fn = model.lm_loss_fn()
+
+    # jit the eval: the pipelined apply (shard_map manual over 'pipe' only)
+    # requires a jit context on a multi-axis mesh
+    @jax.jit
+    def _eval(params, rows_):
+        return loss_fn(params, (), {"input_ids": rows_}, None, False)
+
     def eval_loss(params):
-        loss, (metrics, _) = loss_fn(params, (), {
-            "input_ids": jnp.asarray(eval_rows)}, None, False)
+        loss, (metrics, _) = _eval(params, jnp.asarray(eval_rows))
         return float(loss), float(metrics["token_accuracy"])
     live = eval_loss(final.params)
     ema = eval_loss(optim.ema_params(final.opt_state))
