@@ -224,6 +224,8 @@ class GPT:
             attention_fn = lambda q, k, v, mask=None: ring_attention(
                 q, k, v, axis_name=c.seq_axis, causal=True)
         elif attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
+            # GQA configs work here too: attention_core broadcasts kv
+            # head groups before any swapped kernel (attention.py)
             from ..ops.pallas import flash_attention
             attention_fn = lambda q, k, v, mask=None: flash_attention(
                 q, k, v, causal=True)

@@ -430,6 +430,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     Off-TPU the kernel runs in Pallas interpret mode, so CPU tests cover the
     identical kernel code.
     """
+    if q.shape[2] != k.shape[2]:
+        # the grid blocks per (batch, head) assuming equal head counts —
+        # fewer kv heads (GQA/MQA) would index k/v out of range
+        raise ValueError(
+            f"flash_attention requires equal q/kv head counts; got "
+            f"{q.shape[2]} vs {k.shape[2]} (GQA/MQA) — use "
+            "dot_product_attention, whose grouped einsum handles it")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:

@@ -1,0 +1,148 @@
+"""Hardware validation + crossover measurement for the fused flash kernels.
+
+Run ON A REAL TPU (no --device flag).  Two phases:
+
+1. **Correctness**: forward and backward (dq/dk/dv) parity of the Pallas
+   kernels vs the pure-XLA reference, compiled by Mosaic (NOT interpret
+   mode — interpret has hidden tiling violations before, docs/PERF.md), at
+   shapes covering causal, padding masks, ragged seq, and bf16.
+2. **Crossover**: train-step-shaped timing (fwd+bwd, value-fetch closed) of
+   flash vs XLA dense attention at seq 512/1024/2048 — the numbers that
+   decide whether ``use_flash`` defaults flip to "auto"
+   (ops/attention.py DTTPU_FLASH_MIN_SEQ) or the kernel is demoted.
+
+Prints one JSON line per measurement; paste results into docs/PERF.md.
+"""
+import json
+import math
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    # --device=cpu: config-level override for a smoke run of the harness
+    # itself (the axon sitecustomize force-selects the TPU platform, so
+    # the env var alone loses); the real validation runs with no flag.
+    for arg in sys.argv[1:]:
+        if arg.startswith("--device="):
+            import jax
+            jax.config.update("jax_platforms", arg.split("=", 1)[1])
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.ops.attention import (
+        causal_mask, dot_product_attention, padding_mask)
+    from distributed_tensorflow_tpu.ops.pallas.flash_attention import (
+        flash_attention)
+
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    if dev.platform != "tpu":
+        print("NOT a TPU — this validation is meaningless off-hardware",
+              file=sys.stderr)
+        return 2
+
+    # ---- phase 1: compiled-kernel parity --------------------------------
+    def qkv(key, b, s, h, d, dtype):
+        ks = jax.random.split(key, 3)
+        return [jax.random.normal(k, (b, s, h, d), dtype) for k in ks]
+
+    failures = 0
+    cases = [
+        ("plain_f32", dict(b=2, s=256, h=4, d=64, dtype=jnp.float32),
+         dict(), None),
+        ("causal_f32", dict(b=2, s=256, h=4, d=64, dtype=jnp.float32),
+         dict(causal=True), "causal"),
+        ("ragged_causal", dict(b=2, s=200, h=4, d=64, dtype=jnp.float32),
+         dict(causal=True), "causal"),
+        ("padding_bf16", dict(b=2, s=256, h=4, d=64, dtype=jnp.bfloat16),
+         dict(), "padding"),
+        ("causal_bf16_long", dict(b=1, s=1024, h=8, d=64,
+                                  dtype=jnp.bfloat16),
+         dict(causal=True), "causal"),
+    ]
+    for name, shp, fkw, maskkind in cases:
+        q, k, v = qkv(jax.random.PRNGKey(0), shp["b"], shp["s"], shp["h"],
+                      shp["d"], shp["dtype"])
+        fkw = dict(fkw, interpret=False)      # force the compiled kernel
+        mask = None
+        if maskkind == "causal":
+            mask = causal_mask(shp["s"])
+        elif maskkind == "padding":
+            valid = jnp.ones((shp["b"], shp["s"]), jnp.int32
+                             ).at[:, shp["s"] * 3 // 4:].set(0)
+            fkw["kv_valid"] = valid
+            mask = padding_mask(valid)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, **fkw).astype(
+                jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, mask=mask).astype(
+                jnp.float32) ** 2)
+
+        try:
+            o1 = jax.jit(lambda q, k, v: flash_attention(q, k, v, **fkw)
+                         )(q, k, v)
+            o2 = dot_product_attention(q, k, v, mask=mask)
+            g1 = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+            g2 = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+            tol = 6e-2 if shp["dtype"] == jnp.bfloat16 else 2e-4
+            np.testing.assert_allclose(np.asarray(o1, np.float32),
+                                       np.asarray(o2, np.float32),
+                                       atol=tol, rtol=tol)
+            for a, b_ in zip(g1, g2):
+                np.testing.assert_allclose(np.asarray(a, np.float32),
+                                           np.asarray(b_, np.float32),
+                                           atol=tol, rtol=tol)
+            print(json.dumps({"check": name, "ok": True}), flush=True)
+        except Exception as e:  # noqa: BLE001 - report and continue
+            failures += 1
+            print(json.dumps({"check": name, "ok": False,
+                              "error": str(e)[:300]}), flush=True)
+    if failures:
+        print(f"{failures} parity failures — DO NOT enable use_flash",
+              file=sys.stderr)
+        return 1
+
+    # ---- phase 2: crossover timing --------------------------------------
+    b, h, d = 8, 12, 64
+    for seq in (512, 1024, 2048):
+        q, k, v = qkv(jax.random.PRNGKey(1), b, seq, h, d, jnp.bfloat16)
+
+        def step_of(attn_loss):
+            g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
+            g(q, k, v)[0].block_until_ready()   # compile
+            # value-fetch close (docs/PERF.md methodology)
+            t0 = time.perf_counter()
+            n = 20
+            for _ in range(n):
+                out = g(q, k, v)
+            float(jnp.sum(out[0].astype(jnp.float32)))
+            return (time.perf_counter() - t0) / n
+
+        t_flash = step_of(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, interpret=False).astype(jnp.float32)))
+        cmask = causal_mask(seq)
+        t_xla = step_of(lambda q, k, v: jnp.sum(dot_product_attention(
+            q, k, v, mask=cmask).astype(jnp.float32)))
+        tokens = b * seq
+        print(json.dumps({
+            "seq": seq,
+            "flash_fwdbwd_tokens_per_sec": round(tokens / t_flash, 1),
+            "xla_fwdbwd_tokens_per_sec": round(tokens / t_xla, 1),
+            "flash_speedup": round(t_xla / t_flash, 3),
+        }), flush=True)
+    print("crossover rule: flip use_flash defaults to 'auto' (and set "
+          "DTTPU_FLASH_MIN_SEQ to the first winning seq) only if "
+          "flash_speedup >= 1.3 at seq >= 1024; else demote in PERF.md",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
