@@ -137,33 +137,25 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
     return int(_CKPT_RE.match(os.path.basename(path)).group(1))
 
 
-class AsyncCheckpointer:
-    """Background checkpoint writes so the train loop never stalls on disk.
+class AsyncWriterBase:
+    """One-worker background writer with loud failure semantics — shared by
+    ``AsyncCheckpointer`` and ``sharded_checkpoint.AsyncShardedCheckpointer``
+    so the pending-futures / error-aggregation contract lives in ONE place.
 
-    The device→host copy happens on the CALLER's thread (it must complete
-    before donated buffers are reused by the next step; jax arrays are
-    immutable so the snapshot is consistent), then the npz serialization,
-    atomic rename, and pruning run on one worker thread.  Writes land in
-    submission order.  ``wait()`` blocks until everything pending is on
-    disk and re-raises the first failure; call it before reading the
-    checkpoint back or exiting the process.
+    Writes land in submission order.  ``wait()`` blocks until everything
+    pending is on disk and re-raises the first failure (logging any
+    additional ones); call it before reading checkpoints back or exiting.
     """
 
-    def __init__(self):
+    def __init__(self, thread_name_prefix: str = "ckpt-writer"):
         import concurrent.futures
         self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="ckpt-writer")
+            max_workers=1, thread_name_prefix=thread_name_prefix)
         self._pending: List[Any] = []
 
-    def save(self, ckpt_dir: str, step: int, tree: Any,
-             max_to_keep: int = 5):
-        """Snapshot to host now, write in the background; returns a future
-        resolving to the checkpoint path."""
+    def _submit(self, fn, *args):
         self._raise_failed()
-        host_tree = jax.tree.map(
-            lambda leaf: np.asarray(jax.device_get(leaf)), tree)
-        fut = self._executor.submit(save, ckpt_dir, step, host_tree,
-                                    max_to_keep)
+        fut = self._executor.submit(fn, *args)
         self._pending.append(fut)
         return fut
 
@@ -197,6 +189,24 @@ class AsyncCheckpointer:
     def close(self) -> None:
         self.wait()
         self._executor.shutdown(wait=True)
+
+
+class AsyncCheckpointer(AsyncWriterBase):
+    """Background checkpoint writes so the train loop never stalls on disk.
+
+    The device→host copy happens on the CALLER's thread (it must complete
+    before donated buffers are reused by the next step; jax arrays are
+    immutable so the snapshot is consistent), then the npz serialization,
+    atomic rename, and pruning run on one worker thread.
+    """
+
+    def save(self, ckpt_dir: str, step: int, tree: Any,
+             max_to_keep: int = 5):
+        """Snapshot to host now, write in the background; returns a future
+        resolving to the checkpoint path."""
+        host_tree = jax.tree.map(
+            lambda leaf: np.asarray(jax.device_get(leaf)), tree)
+        return self._submit(save, ckpt_dir, step, host_tree, max_to_keep)
 
 
 def restore(target: Any, ckpt_path: str) -> Any:

@@ -359,6 +359,9 @@ class PreemptionHook(Hook):
         if triggered and not session.should_stop():
             if self.save:
                 session.save()
+                # async mode queues the write — a preemption save must be
+                # DURABLE before the grace window closes
+                session.drain_checkpoints()
             session.request_stop()
 
     def close(self, session) -> None:

@@ -92,13 +92,15 @@ class TrainSession:
         # pjit-sharded states, train/sharded_checkpoint.py); restore
         # reassembles only locally-addressable slices.
         self.sharded = sharded_checkpoint
-        if sharded_checkpoint and async_checkpoint:
-            raise ValueError("sharded_checkpoint does not compose with "
-                             "async_checkpoint yet; pick one")
         # Async: disk writes happen on a background thread (the device->host
-        # snapshot still happens inline); drained on session exit.
-        self._async_ckpt = (ckpt_lib.AsyncCheckpointer()
-                            if async_checkpoint else None)
+        # snapshot still happens inline); drained on session exit.  The
+        # sharded variant needs no cross-process barrier (structural
+        # completeness), which is what makes it background-safe on a pod.
+        self._async_ckpt = None
+        if async_checkpoint:
+            self._async_ckpt = (sharded_lib.AsyncShardedCheckpointer()
+                                if sharded_checkpoint
+                                else ckpt_lib.AsyncCheckpointer())
 
         if restore and checkpoint_dir:
             if sharded_checkpoint:
@@ -151,12 +153,23 @@ class TrainSession:
         if not self.checkpoint_dir:
             return None
         if self.sharded:
+            if self._async_ckpt is not None:
+                # NO barrier on the background thread: its collectives
+                # would race the main thread's training collectives and
+                # can deadlock a pod — completeness is structural instead
+                self._async_ckpt.save(self.checkpoint_dir, self.step,
+                                      self.state,
+                                      max_to_keep=self.max_to_keep)
+                path = ckpt_lib.ckpt_path(self.checkpoint_dir, self.step)
+                self.last_saved_step = self.step
+                log.info("queued async sharded checkpoint %s", path)
+                return path
             sync_fn = None
             if jax.process_count() > 1:
-                # barrier between every process's chunk writes and the
-                # chief's manifest — without it the manifest can miss
-                # another process's chunk index and the checkpoint is
-                # unreadable (restore: "chunks do not cover leaf")
+                # sync path keeps the barrier so "save returned" means
+                # "checkpoint globally complete" — what a preemption save
+                # racing shutdown needs (completeness itself no longer
+                # depends on it)
                 from jax.experimental import multihost_utils
                 step_now = int(self.step)
                 sync_fn = lambda: multihost_utils.sync_global_devices(
@@ -180,6 +193,13 @@ class TrainSession:
         self.last_saved_step = self.step
         log.info("saved checkpoint %s", path)
         return path
+
+    def drain_checkpoints(self) -> None:
+        """Block until every queued async checkpoint write is on disk
+        (no-op without async) — what a preemption save needs: 'save
+        returned' must mean durable before the grace window closes."""
+        if self._async_ckpt is not None:
+            self._async_ckpt.wait()
 
     # -- context manager --------------------------------------------------
     def __enter__(self) -> "TrainSession":

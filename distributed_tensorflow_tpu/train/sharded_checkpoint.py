@@ -38,7 +38,9 @@ import numpy as np
 
 from . import checkpoint as _plain
 
-__all__ = ["save_sharded", "restore_sharded", "is_sharded_checkpoint"]
+__all__ = ["save_sharded", "restore_sharded", "is_sharded_checkpoint",
+           "is_complete_sharded_checkpoint", "all_sharded_checkpoints",
+           "AsyncShardedCheckpointer"]
 
 _SHARD_FILE = "shards-{pid:05d}.npz"
 
@@ -59,30 +61,16 @@ def _index_starts(index: Tuple[slice, ...], shape: Sequence[int]) -> Tuple[int, 
                  for s in index) or tuple([0] * len(shape))
 
 
-def save_sharded(ckpt_dir: str, step: int, tree: Any,
-                 max_to_keep: int = 5,
-                 process_index: Optional[int] = None,
-                 process_count: Optional[int] = None,
-                 sync_fn=None) -> str:
-    """Write this process's shards of ``tree``; chief finalizes the manifest.
-
-    Every process (not just the chief) must call this — each owns distinct
-    chunks.  ``sync_fn``, when given, is called as a barrier between the
-    shard writes and the chief's manifest write (on a pod, pass e.g. a
-    ``jax.experimental.multihost_utils.sync_global_devices`` wrapper); with
-    one process the default no-op is exact.  Returns the checkpoint dir.
-    """
-    pid = jax.process_index() if process_index is None else process_index
-    nproc = jax.process_count() if process_count is None else process_count
+def _snapshot_local(tree, pid: int) -> Tuple[Dict[str, np.ndarray],
+                                             List[Dict[str, Any]],
+                                             List[Dict[str, Any]]]:
+    """Device->host copy of this process's chunks (caller thread: donated
+    buffers may be reused the moment this returns).
+    Returns (chunk arrays, chunk index rows, leaf metadata)."""
     chief = pid == 0
-    final = _plain.ckpt_path(ckpt_dir, step)
-    os.makedirs(final, exist_ok=True)
-
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     paths = [jax.tree_util.keystr(p) for p, _ in flat]
-
     chunks: Dict[str, np.ndarray] = {}
-    # manifest rows: one per leaf; chunk list only filled by the owner rows
     leaves_meta: List[Dict[str, Any]] = []
     my_chunks: List[Dict[str, Any]] = []
     for i, (_, leaf) in enumerate(flat):
@@ -113,46 +101,122 @@ def save_sharded(ckpt_dir: str, step: int, tree: Any,
                                   "shape": list(data.shape), "pid": pid})
             leaves_meta.append({"path": paths[i], "shape": list(data.shape),
                                 "dtype": str(data.dtype), "kind": "host"})
+    return chunks, my_chunks, leaves_meta
 
+
+def _write_local(ckpt_dir: str, step: int, pid: int, nproc: int,
+                 chunks: Dict[str, np.ndarray],
+                 my_chunks: List[Dict[str, Any]],
+                 leaves_meta: List[Dict[str, Any]],
+                 max_to_keep: int) -> str:
+    """Disk IO half of a sharded save (runs on any thread, no collectives).
+
+    Completeness is structural, not barrier-ordered: a checkpoint counts as
+    complete only when the manifest AND every process's shard + chunk-index
+    files exist (``is_complete_sharded_checkpoint``), so the chief's
+    manifest can land before, after, or concurrently with other processes'
+    chunk files.
+    """
+    final = _plain.ckpt_path(ckpt_dir, step)
+    os.makedirs(final, exist_ok=True)
     shard_name = _SHARD_FILE.format(pid=pid)
     fd, tmp = tempfile.mkstemp(prefix=".shard-tmp-", dir=final)
     os.close(fd)
+    ctmp = os.path.join(final, f".chunks-tmp-{pid:05d}")
+    mtmp = os.path.join(final, ".manifest-tmp")
     try:
         with open(tmp, "wb") as f:
             np.savez(f, **chunks)
         os.replace(tmp, os.path.join(final, shard_name))
-        with open(os.path.join(final, f"chunks-{pid:05d}.json"), "w") as f:
+        with open(ctmp, "w") as f:
             json.dump(my_chunks, f)
-    except Exception:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+        # chunk-index rename is the per-process commit marker — after the
+        # npz, so a torn write can never look complete
+        os.replace(ctmp, os.path.join(final, f"chunks-{pid:05d}.json"))
 
+        if pid == 0:
+            manifest = {"step": int(step), "format": "sharded-v1",
+                        "process_count": nproc, "leaves": leaves_meta}
+            with open(mtmp, "w") as f:
+                json.dump(manifest, f, indent=1)
+            os.replace(mtmp, os.path.join(final, "manifest.json"))
+            with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
+                f.write(os.path.basename(final) + "\n")
+            if max_to_keep and max_to_keep > 0:
+                _prune(ckpt_dir, max_to_keep)
+    except Exception:
+        for t in (tmp, ctmp, mtmp):
+            if os.path.exists(t):
+                os.unlink(t)
+        raise
+    return final
+
+
+def _prune(ckpt_dir: str, max_to_keep: int) -> None:
+    """Delete old checkpoints, INCLUDING incomplete dirs older than the
+    oldest retained complete one (a save torn by a crashed process would
+    otherwise leak full-size shard files forever).  In-progress saves are
+    never touched: their step is >= every completed step."""
+    kept = all_sharded_checkpoints(ckpt_dir)[-max_to_keep:]
+    if not kept:
+        return
+    cutoff = int(_plain._CKPT_RE.match(os.path.basename(kept[0])).group(1))
+    for name in os.listdir(ckpt_dir):
+        m = _plain._CKPT_RE.match(name)
+        if m and int(m.group(1)) < cutoff:
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+
+
+def save_sharded(ckpt_dir: str, step: int, tree: Any,
+                 max_to_keep: int = 5,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 sync_fn=None) -> str:
+    """Write this process's shards of ``tree``.
+
+    Every process (not just the chief) must call this — each owns distinct
+    chunks.  No cross-process barrier is required: completeness is judged
+    structurally (manifest + every process's files present,
+    ``is_complete_sharded_checkpoint``).  ``sync_fn``, when given, is still
+    called after the local write — useful when the caller wants "save
+    returned" to mean "checkpoint globally complete" (e.g. a preemption
+    save racing shutdown).  Returns the checkpoint dir.
+    """
+    pid = jax.process_index() if process_index is None else process_index
+    nproc = jax.process_count() if process_count is None else process_count
+    chunks, my_chunks, leaves_meta = _snapshot_local(tree, pid)
+    final = _write_local(ckpt_dir, step, pid, nproc, chunks, my_chunks,
+                         leaves_meta, max_to_keep)
     if sync_fn is not None:
         sync_fn()
-
-    if chief:
-        # Collect every process's chunk index into the manifest.  On shared
-        # storage all chunks-*.json files are visible after the barrier.
-        all_chunks: List[Dict[str, Any]] = []
-        for p in range(nproc):
-            cpath = os.path.join(final, f"chunks-{p:05d}.json")
-            if os.path.exists(cpath):
-                with open(cpath) as f:
-                    all_chunks.extend(json.load(f))
-        manifest = {"step": int(step), "format": "sharded-v1",
-                    "process_count": nproc, "leaves": leaves_meta,
-                    "chunks": all_chunks}
-        mtmp = os.path.join(final, ".manifest-tmp")
-        with open(mtmp, "w") as f:
-            json.dump(manifest, f, indent=1)
-        os.replace(mtmp, os.path.join(final, "manifest.json"))
-        with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
-            f.write(os.path.basename(final) + "\n")
-        if max_to_keep and max_to_keep > 0:
-            for old in all_sharded_checkpoints(ckpt_dir)[:-max_to_keep]:
-                shutil.rmtree(old, ignore_errors=True)
     return final
+
+
+class AsyncShardedCheckpointer(_plain.AsyncWriterBase):
+    """Background sharded writes: the device->host chunk snapshot happens
+    on the CALLER's thread (donation safety), file IO on one worker thread.
+
+    Safe in multi-process training precisely because the sharded format
+    needs NO cross-process collective at save time (structural
+    completeness) — a barrier on a background thread would race the main
+    thread's training collectives and deadlock a pod.  ``wait()``/``close``
+    semantics are the shared ``checkpoint.AsyncWriterBase`` contract.
+    """
+
+    def __init__(self):
+        super().__init__(thread_name_prefix="sharded-ckpt-writer")
+
+    def save(self, ckpt_dir: str, step: int, tree: Any,
+             max_to_keep: int = 5,
+             process_index: Optional[int] = None,
+             process_count: Optional[int] = None):
+        pid = (jax.process_index() if process_index is None
+               else process_index)
+        nproc = (jax.process_count() if process_count is None
+                 else process_count)
+        chunks, my_chunks, leaves_meta = _snapshot_local(tree, pid)
+        return self._submit(_write_local, ckpt_dir, step, pid, nproc,
+                            chunks, my_chunks, leaves_meta, max_to_keep)
 
 
 def is_sharded_checkpoint(ckpt_path: str) -> bool:
@@ -163,15 +227,35 @@ def is_sharded_checkpoint(ckpt_path: str) -> bool:
         return json.load(f).get("format") == "sharded-v1"
 
 
+def is_complete_sharded_checkpoint(ckpt_path: str) -> bool:
+    """Structural completeness: manifest + EVERY process's shard and
+    chunk-index files present (replaces the old barrier-ordered
+    manifest-last contract, enabling barrier-free/async saves)."""
+    mpath = os.path.join(ckpt_path, "manifest.json")
+    if not os.path.exists(mpath):
+        return False
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != "sharded-v1":
+        return False
+    if "chunks" in manifest:
+        return True   # legacy format: manifest itself was the last write
+    nproc = int(manifest.get("process_count", 1))
+    return all(
+        os.path.exists(os.path.join(ckpt_path, _SHARD_FILE.format(pid=p)))
+        and os.path.exists(os.path.join(ckpt_path, f"chunks-{p:05d}.json"))
+        for p in range(nproc))
+
+
 def all_sharded_checkpoints(ckpt_dir: str) -> List[str]:
-    """Complete (manifest-finalized) sharded checkpoints, oldest → newest."""
+    """COMPLETE sharded checkpoints, oldest → newest."""
     if not os.path.isdir(ckpt_dir):
         return []
     found = []
     for name in os.listdir(ckpt_dir):
         m = _plain._CKPT_RE.match(name)
         path = os.path.join(ckpt_dir, name)
-        if m and is_sharded_checkpoint(path):
+        if m and is_complete_sharded_checkpoint(path):
             found.append((int(m.group(1)), path))
     return [p for _, p in sorted(found)]
 
@@ -186,9 +270,19 @@ class _ChunkReader:
         # stored uint-encoded; see checkpoint._storage_view)
         self._saved_dtypes = {i: m["dtype"]
                               for i, m in enumerate(manifest["leaves"])}
+        # chunk index: embedded in legacy manifests; current format reads
+        # each process's chunks-*.json (written without any barrier)
+        if "chunks" in manifest:
+            chunk_rows = manifest["chunks"]
+        else:
+            chunk_rows = []
+            for p in range(int(manifest.get("process_count", 1))):
+                cpath = os.path.join(ckpt_path, f"chunks-{p:05d}.json")
+                with open(cpath) as f:
+                    chunk_rows.extend(json.load(f))
         # leaf index -> [(start, shape, pid)]
         self._by_leaf: Dict[int, List[Tuple[Tuple[int, ...], Tuple[int, ...], int]]] = {}
-        for c in manifest["chunks"]:
+        for c in chunk_rows:
             self._by_leaf.setdefault(int(c["leaf"]), []).append(
                 (tuple(c["start"]), tuple(c["shape"]), int(c["pid"])))
 
@@ -246,6 +340,11 @@ def restore_sharded(target: Any, ckpt_path: str,
         manifest = json.load(f)
     if manifest.get("format") != "sharded-v1":
         raise ValueError(f"{ckpt_path} is not a sharded-v1 checkpoint")
+    if not is_complete_sharded_checkpoint(ckpt_path):
+        raise ValueError(
+            f"{ckpt_path} is structurally INCOMPLETE (a process's shard/"
+            "chunk files never landed — crashed or still-pending async "
+            "save); pick a complete one via all_sharded_checkpoints()")
     flat, treedef = jax.tree_util.tree_flatten_with_path(target)
     metas = manifest["leaves"]
     if len(flat) != len(metas):

@@ -121,7 +121,11 @@ def test_two_process_sharded_save_restores_into_one_process(tmp_path):
     with open(os.path.join(final, "manifest.json")) as f:
         manifest = json.load(f)
     assert manifest["process_count"] == 2
-    assert {c["pid"] for c in manifest["chunks"]} == {0, 1}
+    chunk_rows = []
+    for p in (0, 1):
+        with open(os.path.join(final, f"chunks-{p:05d}.json")) as f:
+            chunk_rows.extend(json.load(f))
+    assert {c["pid"] for c in chunk_rows} == {0, 1}
 
     # restore HERE (1 process) onto a 2-device mesh: different topology
     import jax
@@ -230,8 +234,10 @@ def test_sigterm_one_process_saves_and_single_process_resumes(tmp_path):
     # first replica and is the only chunk writer — that's the dedupe
     # contract, not a gap (cross-process chunk ownership is proven by
     # test_two_process_sharded_save_restores_into_one_process's sharded
-    # arrays); both shard FILES must still exist (possibly empty for pid 1)
-    assert manifest["chunks"] and {c["pid"] for c in manifest["chunks"]} <= {0, 1}
+    # arrays); both processes' files must still exist (pid 1's possibly
+    # empty) for the checkpoint to count complete
+    from distributed_tensorflow_tpu.train import sharded_checkpoint as _sck
+    assert _sck.is_complete_sharded_checkpoint(ckpts[-1])
     assert os.path.exists(os.path.join(ckpts[-1], "shards-00001.npz"))
 
     # a fresh SINGLE process resumes the session from the preemption step

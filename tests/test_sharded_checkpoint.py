@@ -87,7 +87,9 @@ def test_replicated_leaves_written_once(tmp_path):
     path = save_sharded(str(tmp_path), 3, state)
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
-    kernel_chunks = [c for c in manifest["chunks"] if c["leaf"] ==
+    with open(os.path.join(path, "chunks-00000.json")) as f:
+        chunk_rows = json.load(f)
+    kernel_chunks = [c for c in chunk_rows if c["leaf"] ==
                      [m["path"] for m in manifest["leaves"]].index(
                          "['params']['kernel']")]
     assert len(kernel_chunks) == 1  # not 8 copies
@@ -106,18 +108,117 @@ def test_missing_chunk_detected(tmp_path):
     mesh = make_mesh({"data": 8})
     state = make_state(mesh, P("data", None))
     path = save_sharded(str(tmp_path), 4, state)
-    # Corrupt the manifest chunk index: drop the bias chunk entries.
-    mpath = os.path.join(path, "manifest.json")
-    with open(mpath) as f:
+    # Corrupt the per-process chunk index: drop the bias chunk entries.
+    with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     bias_leaf = [m["path"] for m in manifest["leaves"]].index(
         "['params']['bias']")
-    manifest["chunks"] = [c for c in manifest["chunks"]
-                          if c["leaf"] != bias_leaf]
-    with open(mpath, "w") as f:
-        json.dump(manifest, f)
+    cpath = os.path.join(path, "chunks-00000.json")
+    with open(cpath) as f:
+        chunk_rows = json.load(f)
+    with open(cpath, "w") as f:
+        json.dump([c for c in chunk_rows if c["leaf"] != bias_leaf], f)
     with pytest.raises(ValueError, match="cover"):
         restore_sharded(zeros_like_on(mesh, P("data", None)), path)
+
+
+def test_structural_completeness_gates_listing(tmp_path):
+    """A checkpoint is complete only when EVERY process's shard + chunk
+    files exist alongside the manifest — the barrier-free contract that
+    makes async sharded saves safe."""
+    mesh = make_mesh({"data": 8})
+    state = make_state(mesh, P("data", None))
+    # simulate process 0 of 2: pid 1's files haven't landed yet
+    path = save_sharded(str(tmp_path), 9, state, process_index=0,
+                        process_count=2)
+    assert sck.is_sharded_checkpoint(path)          # format recognized
+    assert not sck.is_complete_sharded_checkpoint(path)
+    assert sck.all_sharded_checkpoints(str(tmp_path)) == []
+    # pid 1 lands (same tree here; ownership dedupe is separately tested)
+    save_sharded(str(tmp_path), 9, state, process_index=1, process_count=2)
+    assert sck.is_complete_sharded_checkpoint(path)
+    assert sck.all_sharded_checkpoints(str(tmp_path)) == [path]
+
+
+def test_restore_incomplete_raises_clearly(tmp_path):
+    """restore_sharded on a structurally-incomplete checkpoint must raise
+    a diagnosable error, not FileNotFoundError on an internal filename."""
+    mesh = make_mesh({"data": 8})
+    state = make_state(mesh, P("data", None))
+    path = save_sharded(str(tmp_path), 13, state, process_index=0,
+                        process_count=2)    # pid 1 never lands
+    with pytest.raises(ValueError, match="INCOMPLETE"):
+        restore_sharded(zeros_like_on(mesh, P("data", None)), path)
+
+
+def test_prune_removes_old_incomplete_dirs(tmp_path):
+    """Incomplete checkpoint dirs older than the retained window are
+    garbage-collected (a crashed process's torn save must not leak shard
+    files forever); newer ones — possibly still in flight — survive."""
+    mesh = make_mesh({"data": 8})
+    state = make_state(mesh, P("data", None))
+    # torn save at step 1 (pid 1 of 2 never lands)
+    torn_old = save_sharded(str(tmp_path), 1, state, process_index=0,
+                            process_count=2, max_to_keep=2)
+    for s in (2, 3, 4):
+        save_sharded(str(tmp_path), s, state, max_to_keep=2)
+    # in-flight save newer than every complete one
+    torn_new = save_sharded(str(tmp_path), 5, state, process_index=0,
+                            process_count=2, max_to_keep=2)
+    kept = sck.all_sharded_checkpoints(str(tmp_path))
+    assert [os.path.basename(p) for p in kept] == ["ckpt-0000000003",
+                                                   "ckpt-0000000004"]
+    assert not os.path.exists(torn_old)      # GC'd with step 2
+    assert os.path.exists(torn_new)          # never touched
+
+
+def test_legacy_embedded_chunk_manifest_restores(tmp_path):
+    """Pre-round-3 checkpoints embedded the chunk index in the manifest
+    ("chunks" key, barrier-ordered manifest-last) — they must keep
+    restoring and count as complete."""
+    mesh = make_mesh({"data": 8})
+    state = make_state(mesh, P("data", None))
+    path = save_sharded(str(tmp_path), 11, state)
+    mpath = os.path.join(path, "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    with open(os.path.join(path, "chunks-00000.json")) as f:
+        manifest["chunks"] = json.load(f)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    os.unlink(os.path.join(path, "chunks-00000.json"))   # legacy layout
+    assert sck.is_complete_sharded_checkpoint(path)
+    out = restore_sharded(zeros_like_on(mesh, P("data", None)), path)
+    np.testing.assert_array_equal(np.asarray(out["params"]["kernel"]),
+                                  np.asarray(state["params"]["kernel"]))
+
+
+def test_async_sharded_session_roundtrip(tmp_path):
+    """sharded_checkpoint=True + async_checkpoint=True: background chunk
+    writes drain on session exit and the next session auto-restores."""
+    from distributed_tensorflow_tpu import ops, optim, train
+    model = ops.serial(ops.Dense(8, activation="relu"), ops.Dense(2))
+    opt = optim.sgd(0.01)
+    mesh = make_mesh({"data": 8})
+    step = train.make_train_step(model, "mse", opt, mesh=mesh)
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (4,))
+    rng = np.random.default_rng(0)
+    x = rng.random((16, 4)).astype(np.float32)
+    y = rng.random((16, 2)).astype(np.float32)
+    d = str(tmp_path)
+    with train.TrainSession(state, step, checkpoint_dir=d,
+                            sharded_checkpoint=True,
+                            async_checkpoint=True,
+                            hooks=[train.CheckpointHook(every_steps=2)]
+                            ) as sess:
+        for _ in range(5):
+            sess.run_step((x, y))
+    ckpts = sck.all_sharded_checkpoints(d)
+    assert ckpts, os.listdir(d)
+    state2 = train.init_train_state(model, opt, jax.random.PRNGKey(1), (4,))
+    with train.TrainSession(state2, step, checkpoint_dir=d,
+                            sharded_checkpoint=True) as s2:
+        assert s2.step == 5
 
 
 def test_structure_and_shape_mismatch(tmp_path):
