@@ -55,10 +55,25 @@ class BertConfig:
     # Default stays False until the round-3 fused BACKWARD kernels pass
     # hardware validation (docs/PERF.md) — flip to "auto" once measured.
     use_flash: Any = False
+    # FFN / MLM-transform activation: "gelu_approx" (tanh, the GPT-2/zoo
+    # default) or "gelu" (exact erf — what HF BERT checkpoints were
+    # trained with; models/convert.py sets this)
+    hidden_act: str = "gelu_approx"
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def act_fn(self):
+        if self.hidden_act == "gelu_approx":
+            return jax.nn.gelu
+        if self.hidden_act == "gelu":
+            import functools
+            return functools.partial(jax.nn.gelu, approximate=False)
+        if self.hidden_act == "relu":
+            return jax.nn.relu
+        raise ValueError(f"unsupported hidden_act {self.hidden_act!r}")
 
 
 def bert_base(**kw) -> "Bert":
@@ -190,7 +205,7 @@ class Bert:
         x = _layer_norm(p["attention"]["ln"],
                         x + _dropout(attn_out, c.dropout_rate, r2, train),
                         c.layer_norm_eps)
-        ffn_out = attn_lib.ffn_core(p["ffn"], x)
+        ffn_out = attn_lib.ffn_core(p["ffn"], x, activation=c.act_fn)
         return _layer_norm(p["ffn"]["ln"],
                            x + _dropout(ffn_out, c.dropout_rate, r3, train),
                            c.layer_norm_eps)
@@ -240,8 +255,8 @@ class Bert:
         c = self.config
         p = params["mlm"]
         dtype = sequence_output.dtype
-        h = jax.nn.gelu(sequence_output @ p["transform"]["kernel"].astype(dtype)
-                        + p["transform"]["bias"].astype(dtype))
+        h = c.act_fn(sequence_output @ p["transform"]["kernel"].astype(dtype)
+                     + p["transform"]["bias"].astype(dtype))
         h = _layer_norm(p["ln"], h, c.layer_norm_eps)
         logits = h @ params["embeddings"]["word"].T.astype(dtype)
         return logits.astype(jnp.float32) + p["output_bias"]

@@ -1,0 +1,282 @@
+"""Checkpoint interop: Hugging Face transformers -> this framework.
+
+The reference has no pretrained-weight story (its model is a from-scratch
+MLP, reference example.py:149-155); this module is the usability bridge the
+TPU model zoo needs — load a GPT-2 checkpoint trained elsewhere and run it
+under this framework's pjit/pipeline/KV-cache machinery.
+
+Design: converters take an ALREADY-CONSTRUCTED ``transformers`` model (or
+its ``state_dict``), not a hub name — no network access is assumed or
+performed here; fetch/cache is the caller's concern.  The mapping is exact:
+GPT-2 is pre-LN with tanh-approximate GELU ("gelu_new") and a tied LM head,
+which is precisely this repo's ``GPT`` architecture
+(``models/gpt.py``), so converted logits match the torch forward to float
+tolerance (tests/test_convert.py).
+
+HF GPT-2 layout facts the mapping relies on:
+  * ``Conv1D`` stores weights **[in, out]** (unlike ``nn.Linear``), so
+    kernels land in our [in, ...out] layout with NO transpose;
+  * ``attn.c_attn`` fuses q|k|v on the output dim ([d, 3d]);
+  * per-head reshape is ``[d] -> [heads, head_dim]`` in both frameworks;
+  * ``lm_head.weight`` is the wte matrix (tied) — our ``GPT.logits``
+    reuses ``embeddings/word`` the same way.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bert import Bert, BertConfig
+from .gpt import GPT, GPTConfig
+
+__all__ = ["gpt2_config_from_hf", "gpt2_params_from_hf", "gpt2_from_hf",
+           "bert_config_from_hf", "bert_params_from_hf", "bert_from_hf"]
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        # .float() first: torch's .numpy() rejects BFloat16 (common for
+        # torch_dtype=bfloat16 checkpoints), and every weight becomes
+        # f32 on our side anyway
+        return t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _ln_of(sd, prefix):
+    """HF LayerNorm {weight, bias} -> this repo's {gamma, beta}."""
+    return {"gamma": jnp.asarray(_np(sd[f"{prefix}.weight"]), jnp.float32),
+            "beta": jnp.asarray(_np(sd[f"{prefix}.bias"]), jnp.float32)}
+
+
+def _stack_layers(layers):
+    """Per-layer trees -> one tree with the scanned leading layer axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def gpt2_config_from_hf(hf_config) -> GPTConfig:
+    """Map a ``transformers.GPT2Config`` onto ``GPTConfig``."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(
+            f"GPT-2 activation {act!r} unsupported: this zoo's FFN is "
+            "tanh-approximate GELU (gelu_new), the GPT-2 default")
+    if not getattr(hf_config, "scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False is unsupported")
+    if getattr(hf_config, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError("scale_attn_by_inverse_layer_idx is unsupported: "
+                         "this attention never divides logits by the "
+                         "layer index")
+    if getattr(hf_config, "reorder_and_upcast_attn", False):
+        raise ValueError("reorder_and_upcast_attn is unsupported")
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        intermediate_size=(hf_config.n_inner or 4 * hf_config.n_embd),
+        max_position=hf_config.n_positions,
+        dropout_rate=float(hf_config.resid_pdrop),
+        layer_norm_eps=float(hf_config.layer_norm_epsilon),
+        position_embedding="learned",
+    )
+
+
+def gpt2_params_from_hf(state_dict: Dict[str, Any],
+                        config: GPTConfig) -> Dict[str, Any]:
+    """Convert a GPT-2 ``state_dict`` (GPT2Model or GPT2LMHeadModel) into
+    this framework's stacked-decoder param tree."""
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+    d, h = config.hidden_size, config.num_heads
+    hd = config.head_dim
+    L = config.num_layers
+
+    def ln(prefix):
+        return _ln_of(sd, prefix)
+
+    def layer(i):
+        cattn_w = _np(sd[f"h.{i}.attn.c_attn.weight"])   # [d, 3d], in-out
+        cattn_b = _np(sd[f"h.{i}.attn.c_attn.bias"])     # [3d]
+        qw, kw, vw = np.split(cattn_w, 3, axis=1)
+        qb, kb, vb = np.split(cattn_b, 3, axis=0)
+
+        def qkv(w, b):
+            return {"kernel": jnp.asarray(w.reshape(d, h, hd), jnp.float32),
+                    "bias": jnp.asarray(b.reshape(h, hd), jnp.float32)}
+
+        return {
+            "ln_1": ln(f"h.{i}.ln_1"),
+            "attention": {
+                "query": qkv(qw, qb),
+                "key": qkv(kw, kb),
+                "value": qkv(vw, vb),
+                "out": {"kernel": jnp.asarray(
+                            _np(sd[f"h.{i}.attn.c_proj.weight"]
+                                ).reshape(h, hd, d), jnp.float32),
+                        "bias": jnp.asarray(
+                            _np(sd[f"h.{i}.attn.c_proj.bias"]),
+                            jnp.float32)},
+            },
+            "ln_2": ln(f"h.{i}.ln_2"),
+            "ffn": {
+                "w_in": {"kernel": jnp.asarray(
+                             _np(sd[f"h.{i}.mlp.c_fc.weight"]), jnp.float32),
+                         "bias": jnp.asarray(
+                             _np(sd[f"h.{i}.mlp.c_fc.bias"]), jnp.float32)},
+                "w_out": {"kernel": jnp.asarray(
+                              _np(sd[f"h.{i}.mlp.c_proj.weight"]),
+                              jnp.float32),
+                          "bias": jnp.asarray(
+                              _np(sd[f"h.{i}.mlp.c_proj.bias"]),
+                              jnp.float32)},
+            },
+        }
+
+    decoder = _stack_layers([layer(i) for i in range(L)])
+    return {
+        "embeddings": {
+            "word": jnp.asarray(_np(sd["wte.weight"]), jnp.float32),
+            "position": jnp.asarray(_np(sd["wpe.weight"]), jnp.float32),
+        },
+        "decoder": decoder,
+        "ln_f": ln("ln_f"),
+    }
+
+
+def bert_config_from_hf(hf_config) -> BertConfig:
+    """Map a ``transformers.BertConfig`` onto ``BertConfig``.  HF BERT
+    checkpoints use the EXACT (erf) GELU — ``hidden_act="gelu"`` threads
+    that through the FFN and MLM transform."""
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "relu"):
+        raise ValueError(f"BERT hidden_act {act!r} unsupported")
+    pos = getattr(hf_config, "position_embedding_type", "absolute")
+    if pos != "absolute":
+        raise ValueError(
+            f"position_embedding_type {pos!r} unsupported: this Bert "
+            "implements absolute positions only — a relative-position "
+            "checkpoint would convert silently wrong")
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        dropout_rate=float(hf_config.hidden_dropout_prob),
+        layer_norm_eps=float(hf_config.layer_norm_eps),
+        hidden_act=act,
+    )
+
+
+def bert_params_from_hf(state_dict: Dict[str, Any],
+                        config: BertConfig) -> Dict[str, Any]:
+    """Convert a BertModel / BertForMaskedLM ``state_dict``.
+
+    HF BERT uses ``nn.Linear`` ([out, in] weights — transposed into this
+    repo's [in, out] kernels, unlike GPT-2's Conv1D).  The pooler and the
+    MLM head (transform + LayerNorm + tied decoder + output bias) convert
+    when present; missing heads fall back to fresh zeros-free init shapes
+    being ABSENT from the tree (Bert.init adds them — slice what you need).
+    """
+    sd = {k.removeprefix("bert."): v for k, v in state_dict.items()}
+    d, h = config.hidden_size, config.num_heads
+    hd = config.head_dim
+    L = config.num_layers
+
+    def ln(prefix):
+        return _ln_of(sd, prefix)
+
+    def linear_t(prefix):
+        """nn.Linear [out, in] -> kernel [in, out]."""
+        return (_np(sd[f"{prefix}.weight"]).T,
+                _np(sd[f"{prefix}.bias"]))
+
+    def layer(i):
+        base = f"encoder.layer.{i}"
+
+        def qkv(name):
+            w, b = linear_t(f"{base}.attention.self.{name}")
+            return {"kernel": jnp.asarray(w.reshape(d, h, hd), jnp.float32),
+                    "bias": jnp.asarray(b.reshape(h, hd), jnp.float32)}
+
+        ow, ob = linear_t(f"{base}.attention.output.dense")
+        iw, ib = linear_t(f"{base}.intermediate.dense")
+        fw, fb = linear_t(f"{base}.output.dense")
+        return {
+            "attention": {
+                "query": qkv("query"),
+                "key": qkv("key"),
+                "value": qkv("value"),
+                "out": {"kernel": jnp.asarray(ow.reshape(h, hd, d),
+                                              jnp.float32),
+                        "bias": jnp.asarray(ob, jnp.float32)},
+                "ln": ln(f"{base}.attention.output.LayerNorm"),
+            },
+            "ffn": {
+                "w_in": {"kernel": jnp.asarray(iw, jnp.float32),
+                         "bias": jnp.asarray(ib, jnp.float32)},
+                "w_out": {"kernel": jnp.asarray(fw, jnp.float32),
+                          "bias": jnp.asarray(fb, jnp.float32)},
+                "ln": ln(f"{base}.output.LayerNorm"),
+            },
+        }
+
+    params: Dict[str, Any] = {
+        "embeddings": {
+            "word": jnp.asarray(
+                _np(sd["embeddings.word_embeddings.weight"]), jnp.float32),
+            "position": jnp.asarray(
+                _np(sd["embeddings.position_embeddings.weight"]),
+                jnp.float32),
+            "type": jnp.asarray(
+                _np(sd["embeddings.token_type_embeddings.weight"]),
+                jnp.float32),
+            "ln": ln("embeddings.LayerNorm"),
+        },
+        "encoder": _stack_layers([layer(i) for i in range(L)]),
+    }
+    if "pooler.dense.weight" in sd:
+        pw, pb = linear_t("pooler.dense")
+        params["pooler"] = {"kernel": jnp.asarray(pw, jnp.float32),
+                            "bias": jnp.asarray(pb, jnp.float32)}
+    if "cls.predictions.transform.dense.weight" in state_dict:
+        tw, tb = (_np(state_dict["cls.predictions.transform.dense.weight"]).T,
+                  _np(state_dict["cls.predictions.transform.dense.bias"]))
+        params["mlm"] = {
+            "transform": {"kernel": jnp.asarray(tw, jnp.float32),
+                          "bias": jnp.asarray(tb, jnp.float32)},
+            "ln": {"gamma": jnp.asarray(_np(state_dict[
+                       "cls.predictions.transform.LayerNorm.weight"]),
+                       jnp.float32),
+                   "beta": jnp.asarray(_np(state_dict[
+                       "cls.predictions.transform.LayerNorm.bias"]),
+                       jnp.float32)},
+            "output_bias": jnp.asarray(
+                _np(state_dict["cls.predictions.bias"]), jnp.float32),
+        }
+    return params
+
+
+def bert_from_hf(hf_model, mesh=None) -> Tuple[Bert, Dict[str, Any]]:
+    """(Bert, params) from a ``transformers`` BertModel / BertForMaskedLM
+    instance — sequence outputs, pooled head, and MLM logits match the
+    torch forward (tests/test_convert.py)."""
+    config = bert_config_from_hf(hf_model.config)
+    model = Bert(config, mesh=mesh)
+    params = bert_params_from_hf(hf_model.state_dict(), config)
+    return model, params
+
+
+def gpt2_from_hf(hf_model, mesh=None) -> Tuple[GPT, Dict[str, Any]]:
+    """(GPT, params) from a ``transformers`` GPT2Model / GPT2LMHeadModel
+    instance.  The returned model runs everything the zoo offers —
+    jit/pjit forward, ``lm_loss_fn`` fine-tuning, KV-cache ``generate`` /
+    ``beam_search`` — with logits matching the torch forward."""
+    config = gpt2_config_from_hf(hf_model.config)
+    model = GPT(config, mesh=mesh)
+    params = gpt2_params_from_hf(hf_model.state_dict(), config)
+    return model, params
