@@ -66,14 +66,8 @@ class BertConfig:
 
     @property
     def act_fn(self):
-        if self.hidden_act == "gelu_approx":
-            return jax.nn.gelu
-        if self.hidden_act == "gelu":
-            import functools
-            return functools.partial(jax.nn.gelu, approximate=False)
-        if self.hidden_act == "relu":
-            return jax.nn.relu
-        raise ValueError(f"unsupported hidden_act {self.hidden_act!r}")
+        from ..ops.attention import resolve_activation
+        return resolve_activation(self.hidden_act)
 
 
 def bert_base(**kw) -> "Bert":

@@ -31,9 +31,11 @@ import numpy as np
 
 from .bert import Bert, BertConfig
 from .gpt import GPT, GPTConfig
+from .vit import ViT, ViTConfig
 
 __all__ = ["gpt2_config_from_hf", "gpt2_params_from_hf", "gpt2_from_hf",
-           "bert_config_from_hf", "bert_params_from_hf", "bert_from_hf"]
+           "bert_config_from_hf", "bert_params_from_hf", "bert_from_hf",
+           "vit_config_from_hf", "vit_params_from_hf", "vit_from_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -268,6 +270,121 @@ def bert_from_hf(hf_model, mesh=None) -> Tuple[Bert, Dict[str, Any]]:
     config = bert_config_from_hf(hf_model.config)
     model = Bert(config, mesh=mesh)
     params = bert_params_from_hf(hf_model.state_dict(), config)
+    return model, params
+
+
+def vit_config_from_hf(hf_config, num_classes: int) -> ViTConfig:
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_approx"):
+        raise ValueError(f"ViT hidden_act {act!r} unsupported")
+    if not getattr(hf_config, "qkv_bias", True):
+        raise ValueError("qkv_bias=False is unsupported: this zoo's "
+                         "attention projections always carry biases")
+    return ViTConfig(
+        image_size=hf_config.image_size,
+        patch_size=hf_config.patch_size,
+        channels=hf_config.num_channels,
+        num_classes=num_classes,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        dropout_rate=float(hf_config.hidden_dropout_prob),
+        layer_norm_eps=float(hf_config.layer_norm_eps),
+        hidden_act=act,
+    )
+
+
+def vit_params_from_hf(state_dict: Dict[str, Any],
+                       config: ViTConfig) -> Dict[str, Any]:
+    """Convert a ViTModel / ViTForImageClassification ``state_dict``.
+
+    The patch projection is a torch conv ([out, in, kh, kw]) transposed to
+    the HWIO layout of ``lax.conv_general_dilated``; encoder layers are
+    BERT-style ``nn.Linear`` transposes with pre-LN naming
+    (``layernorm_before``/``after``).  A ``classifier`` head maps onto the
+    classification head when present; otherwise the head is zero-init and
+    ``apply(return_features=True)`` is the parity surface.
+    """
+    sd = {k.removeprefix("vit."): v for k, v in state_dict.items()}
+    d, h = config.hidden_size, config.num_heads
+    hd = config.head_dim
+    L = config.num_layers
+
+    def ln(prefix):
+        return _ln_of(sd, prefix)
+
+    def linear_t(prefix):
+        return (_np(sd[f"{prefix}.weight"]).T, _np(sd[f"{prefix}.bias"]))
+
+    def layer(i):
+        base = f"encoder.layer.{i}"
+
+        def qkv(name):
+            w, b = linear_t(f"{base}.attention.attention.{name}")
+            return {"kernel": jnp.asarray(w.reshape(d, h, hd), jnp.float32),
+                    "bias": jnp.asarray(b.reshape(h, hd), jnp.float32)}
+
+        ow, ob = linear_t(f"{base}.attention.output.dense")
+        iw, ib = linear_t(f"{base}.intermediate.dense")
+        fw, fb = linear_t(f"{base}.output.dense")
+        return {
+            "attention": {
+                "query": qkv("query"), "key": qkv("key"),
+                "value": qkv("value"),
+                "out": {"kernel": jnp.asarray(ow.reshape(h, hd, d),
+                                              jnp.float32),
+                        "bias": jnp.asarray(ob, jnp.float32)},
+                "ln": ln(f"{base}.layernorm_before"),
+            },
+            "ffn": {
+                "w_in": {"kernel": jnp.asarray(iw, jnp.float32),
+                         "bias": jnp.asarray(ib, jnp.float32)},
+                "w_out": {"kernel": jnp.asarray(fw, jnp.float32),
+                          "bias": jnp.asarray(fb, jnp.float32)},
+                "ln": ln(f"{base}.layernorm_after"),
+            },
+        }
+
+    proj = _np(sd["embeddings.patch_embeddings.projection.weight"])
+    params: Dict[str, Any] = {
+        "patch_embed": {
+            # torch conv [out, in, kh, kw] -> HWIO [kh, kw, in, out]
+            "kernel": jnp.asarray(proj.transpose(2, 3, 1, 0), jnp.float32),
+            "bias": jnp.asarray(
+                _np(sd["embeddings.patch_embeddings.projection.bias"]),
+                jnp.float32),
+        },
+        "cls_token": jnp.asarray(_np(sd["embeddings.cls_token"]),
+                                 jnp.float32),
+        "pos_embed": jnp.asarray(_np(sd["embeddings.position_embeddings"]),
+                                 jnp.float32),
+        "encoder": _stack_layers([layer(i) for i in range(L)]),
+        "final_ln": ln("layernorm"),
+    }
+    if "classifier.weight" in state_dict:
+        cw, cb = (_np(state_dict["classifier.weight"]).T,
+                  _np(state_dict["classifier.bias"]))
+        params["head"] = {"kernel": jnp.asarray(cw, jnp.float32),
+                          "bias": jnp.asarray(cb, jnp.float32)}
+    else:
+        params["head"] = {
+            "kernel": jnp.zeros((d, config.num_classes), jnp.float32),
+            "bias": jnp.zeros((config.num_classes,), jnp.float32)}
+    return params
+
+
+def vit_from_hf(hf_model, mesh=None) -> Tuple[ViT, Dict[str, Any]]:
+    """(ViT, params) from a ``transformers`` ViTModel /
+    ViTForImageClassification instance.  Features (and, with a classifier,
+    logits) match the torch forward; images are NHWC here vs torch NCHW."""
+    del mesh  # ViT carries no mesh state; kept for signature symmetry
+    n_classes = getattr(getattr(hf_model, "config", None), "num_labels", 0)
+    if not hasattr(hf_model, "classifier"):
+        n_classes = max(int(n_classes or 0), 1)
+    config = vit_config_from_hf(hf_model.config, num_classes=n_classes)
+    model = ViT(config)
+    params = vit_params_from_hf(hf_model.state_dict(), config)
     return model, params
 
 

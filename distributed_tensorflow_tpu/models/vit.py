@@ -46,12 +46,20 @@ class ViTConfig:
     dropout_rate: float = 0.0
     layer_norm_eps: float = 1e-6
     dtype: Any = jnp.float32
+    # FFN activation: "gelu_approx" (tanh, zoo default) or "gelu" (exact
+    # erf — HF ViT checkpoints; models/convert.py sets this)
+    hidden_act: str = "gelu_approx"
     remat: bool = False
     use_flash: bool = False
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def act_fn(self):
+        from ..ops.attention import resolve_activation
+        return resolve_activation(self.hidden_act)
 
     @property
     def n_patches(self) -> int:
@@ -154,11 +162,15 @@ class ViT:
                                     train=train, attention_fn=attention_fn)
         x = x + _dropout(y, c.dropout_rate, r2, train)
         y = _layer_norm(p["ffn"]["ln"], x, c.layer_norm_eps)
-        y = attn_lib.ffn_core(p["ffn"], y)
+        y = attn_lib.ffn_core(p["ffn"], y, activation=c.act_fn)
         return x + _dropout(y, c.dropout_rate, r3, train)
 
-    def apply(self, params, images, *, train: bool = False, rng=None):
-        """NHWC images -> [batch, num_classes] f32 logits."""
+    def apply(self, params, images, *, train: bool = False, rng=None,
+              return_features: bool = False):
+        """NHWC images -> [batch, num_classes] f32 logits; with
+        ``return_features`` the post-final-LN token sequence
+        [batch, 1 + n_patches, hidden] instead (feature extraction /
+        HF-parity surface)."""
         c = self.config
         if rng is None:
             if train and c.dropout_rate > 0.0:
@@ -190,6 +202,8 @@ class ViT:
         layer_keys = jax.random.split(r_layers, c.num_layers)
         x, _ = jax.lax.scan(body, x, (params["encoder"], layer_keys))
         x = _layer_norm(params["final_ln"], x, c.layer_norm_eps)
+        if return_features:
+            return x
         cls_out = x[:, 0, :]
         logits = (cls_out @ params["head"]["kernel"].astype(cls_out.dtype)
                   + params["head"]["bias"].astype(cls_out.dtype))

@@ -54,6 +54,24 @@ def flash_wins(seq_len: int) -> bool:
     return seq_len >= min_seq and _jax.default_backend() == "tpu"
 
 
+def resolve_activation(name: str):
+    """Config ``hidden_act`` string -> activation fn — ONE mapping for
+    every model config (BertConfig/ViTConfig) so numerics fixes and new
+    activations land in exactly one place (same principle as ffn_core)."""
+    import functools
+    table = {
+        "gelu_approx": jax.nn.gelu,                        # tanh, zoo default
+        "gelu_new": jax.nn.gelu,                           # HF alias (tanh)
+        "gelu_pytorch_tanh": jax.nn.gelu,                  # HF alias (tanh)
+        "gelu": functools.partial(jax.nn.gelu, approximate=False),  # erf
+        "relu": jax.nn.relu,
+    }
+    if name not in table:
+        raise ValueError(f"unsupported hidden_act {name!r}; "
+                         f"one of {sorted(table)}")
+    return table[name]
+
+
 def resolve_use_flash(use_flash, seq_len: int) -> bool:
     """Resolve a config's ``use_flash`` (True / False / "auto") for one
     forward at ``seq_len`` — the single dispatch point for BERT/GPT."""

@@ -66,6 +66,29 @@ def test_gpt2_converted_finetunes():
     assert losses[-1] < losses[0], losses
 
 
+def test_gpt2_converted_shards_and_trains_on_mesh():
+    """Interop composes with parallelism: converted HF weights shard over
+    a tensor x data mesh via partition_rules and train under pjit."""
+    from distributed_tensorflow_tpu import optim, parallel, train
+    from distributed_tensorflow_tpu.models.convert import gpt2_from_hf
+    from distributed_tensorflow_tpu.parallel.sharding import shard_pytree
+    mesh = parallel.make_mesh({"data": 4, "tensor": 2})
+    hf = _tiny_hf(seed=4)
+    model, params = gpt2_from_hf(hf, mesh=mesh)
+    params = shard_pytree(params, mesh, model.partition_rules())
+    assert "tensor" in str(
+        params["decoder"]["ffn"]["w_in"]["kernel"].sharding.spec)
+    opt = optim.adam(1e-3)
+    step = train.make_custom_train_step(model.lm_loss_fn(), opt)
+    state = train.TrainState.create(params, opt.init(params))
+    ids = np.random.default_rng(2).integers(0, 96, (8, 17)).astype(np.int32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    batch = {"input_ids": jax.device_put(
+        ids, NamedSharding(mesh, P("data")))}
+    state, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_gpt2_unsupported_configs_refused():
     from distributed_tensorflow_tpu.models.convert import gpt2_config_from_hf
     cfg = transformers.GPT2Config(activation_function="relu")
@@ -105,6 +128,43 @@ def test_bert_sequence_and_pooled_match_torch():
     pooled = np.asarray(model.pooled(params, seq))
     np.testing.assert_allclose(pooled, out.pooler_output.numpy(),
                                atol=2e-4, rtol=2e-4)
+
+
+def _tiny_hf_vit(seed=0, classify=False):
+    torch.manual_seed(seed)
+    cfg = transformers.ViTConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, image_size=16, patch_size=8,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        num_labels=5)
+    cls = (transformers.ViTForImageClassification if classify
+           else transformers.ViTModel)
+    return cls(cfg).eval()
+
+
+def test_vit_features_match_torch():
+    from distributed_tensorflow_tpu.models.convert import vit_from_hf
+    hf = _tiny_hf_vit()
+    model, params = vit_from_hf(hf)
+    imgs = np.random.default_rng(0).random((2, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(imgs.transpose(0, 3, 1, 2))
+                  ).last_hidden_state.numpy()
+    got = np.asarray(model.apply(params, imgs, return_features=True))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_vit_classifier_logits_match_torch():
+    from distributed_tensorflow_tpu.models.convert import vit_from_hf
+    hf = _tiny_hf_vit(seed=5, classify=True)
+    model, params = vit_from_hf(hf)
+    assert model.config.num_classes == 5
+    imgs = np.random.default_rng(1).random((2, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(imgs.transpose(0, 3, 1, 2))
+                  ).logits.numpy()
+    got = np.asarray(model.apply(params, imgs))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
 
 
 def test_bert_mlm_logits_match_torch():
