@@ -3,13 +3,13 @@
 from . import augment, datasets, pipeline, text, tfrecord, xor
 from .datasets import cifar10, mnist, provenance, synthetic_image_classes
 from .pipeline import Dataset, prefetch_to_device
-from .text import BPETokenizer, ByteTokenizer
+from .text import BPETokenizer, ByteTokenizer, GPT2BPETokenizer
 from .tfrecord import (RecordWriter, read_tfrecord,
                        tfrecord_batches, write_tfrecord)
 from .xor import get_data as xor_data
 
 __all__ = ["augment", "datasets", "pipeline", "text", "tfrecord", "xor",
-           "BPETokenizer", "ByteTokenizer",
+           "BPETokenizer", "ByteTokenizer", "GPT2BPETokenizer",
            "RecordWriter", "read_tfrecord", "tfrecord_batches",
            "write_tfrecord", "cifar10", "mnist", "provenance",
            "synthetic_image_classes", "Dataset", "prefetch_to_device",

@@ -21,7 +21,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["ByteTokenizer", "BPETokenizer"]
+__all__ = ["ByteTokenizer", "BPETokenizer", "GPT2BPETokenizer"]
 
 
 def _special_id(specials: Dict[str, int], name: str) -> int:
@@ -221,3 +221,109 @@ class BPETokenizer:
         with open(path) as f:
             d = json.load(f)
         return cls([tuple(m) for m in d["merges"]], d["specials"])
+
+
+# -- GPT-2 byte-level BPE (checkpoint interop) ----------------------------
+
+def _gpt2_bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte<->unicode table: printable bytes map to
+    themselves, the rest to 256+n — so every byte sequence becomes a
+    string the merge rules can operate on."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("\xa1"), ord("\xac") + 1))
+          + list(range(ord("\xae"), ord("\xff") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+class GPT2BPETokenizer:
+    """GPT-2's exact byte-level BPE, loaded from a checkpoint's
+    ``vocab.json`` + ``merges.txt`` — token ids match the checkpoint, so
+    this pairs with ``models.convert.gpt2_from_hf`` for end-to-end reuse
+    of GPT-2 weights (encode here, decode there, same ids as the HF
+    tokenizer).
+
+    The in-repo ``BPETokenizer`` remains the TRAINABLE tokenizer (its own
+    id scheme); this class only replays an existing vocabulary.
+    """
+
+    _PRETOKEN = (r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+"
+                 r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
+
+    def __init__(self, vocab: Dict[str, int],
+                 merges: List[Tuple[str, str]]):
+        import regex
+        self.vocab = dict(vocab)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self._ranks = {tuple(m): r for r, m in enumerate(merges)}
+        self._b2u = _gpt2_bytes_to_unicode()
+        self._u2b = {u: b for b, u in self._b2u.items()}
+        self._pat = regex.compile(self._PRETOKEN)
+        self._cache: Dict[str, List[str]] = {}
+
+    @classmethod
+    def load(cls, vocab_file: str, merges_file: str) -> "GPT2BPETokenizer":
+        with open(vocab_file, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges: List[Tuple[str, str]] = []
+        with open(merges_file, encoding="utf-8") as f:
+            for n, line in enumerate(f):
+                line = line.rstrip("\n")
+                # only the FIRST line may be the '#version' header — real
+                # GPT-2 merge rules can legitimately start with '#'
+                # ('# #', '## #'), so a blanket comment-skip would
+                # silently drop them and break id parity
+                if not line.strip():
+                    continue
+                if n == 0 and line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    def _bpe(self, word: str) -> List[str]:
+        if word in self._cache:
+            return self._cache[word]
+        symbols = list(word)
+        while len(symbols) > 1:
+            pairs = [(self._ranks.get((a, b), float("inf")), i)
+                     for i, (a, b) in enumerate(zip(symbols, symbols[1:]))]
+            rank, i = min(pairs)
+            if rank == float("inf"):
+                break
+            # merge EVERY occurrence of this pair left-to-right (the
+            # reference algorithm's behavior)
+            pair = (symbols[i], symbols[i + 1])
+            out = []
+            j = 0
+            while j < len(symbols):
+                if (j < len(symbols) - 1
+                        and (symbols[j], symbols[j + 1]) == pair):
+                    out.append(symbols[j] + symbols[j + 1])
+                    j += 2
+                else:
+                    out.append(symbols[j])
+                    j += 1
+            symbols = out
+        self._cache[word] = symbols
+        return symbols
+
+    def encode(self, text: str) -> np.ndarray:
+        ids: List[int] = []
+        for tok in self._pat.findall(text):
+            word = "".join(self._b2u[b] for b in tok.encode("utf-8"))
+            ids.extend(self.vocab[p] for p in self._bpe(word))
+        return np.asarray(ids, np.int32)
+
+    def decode(self, ids) -> str:
+        text = "".join(self.inv_vocab[int(i)]
+                       for i in np.asarray(ids).ravel()
+                       if int(i) in self.inv_vocab)
+        data = bytes(self._u2b[c] for c in text if c in self._u2b)
+        return data.decode("utf-8", errors="replace")

@@ -89,6 +89,49 @@ def test_gpt2_converted_shards_and_trains_on_mesh():
     assert np.isfinite(float(m["loss"]))
 
 
+def test_gpt2_bpe_tokenizer_matches_transformers(tmp_path):
+    """GPT2BPETokenizer replays a checkpoint's vocab.json + merges.txt with
+    the EXACT ids transformers.GPT2Tokenizer produces — the other half of
+    GPT-2 checkpoint reuse (weights convert via gpt2_from_hf, text
+    round-trips through the same id space)."""
+    import json
+
+    from distributed_tensorflow_tpu.data import GPT2BPETokenizer
+    from distributed_tensorflow_tpu.data.text import _gpt2_bytes_to_unicode
+
+    # synthetic checkpoint files: the full byte alphabet + a few merges
+    b2u = _gpt2_bytes_to_unicode()
+    alphabet = [b2u[b] for b in sorted(b2u)]
+    vocab = {u: i for i, u in enumerate(alphabet)}
+    # ('#', '#') pins the loader bug class: real GPT-2 merges.txt contains
+    # rules starting with '#', only the first '#version' line is a header
+    merges = [("t", "h"), ("th", "e"), ("Ġ", "the"), ("e", "s"),
+              ("i", "n"), ("Ġthe", "s"), ("1", "2"), ("#", "#")]
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    vf, mf = tmp_path / "vocab.json", tmp_path / "merges.txt"
+    vf.write_text(json.dumps(vocab), encoding="utf-8")
+    mf.write_text("#version: 0.2\n" +
+                  "\n".join(f"{a} {b}" for a, b in merges) + "\n",
+                  encoding="utf-8")
+
+    ours = GPT2BPETokenizer.load(str(vf), str(mf))
+    hf = transformers.GPT2Tokenizer(str(vf), str(mf))
+    texts = [
+        "the thesis in the theses",
+        "  leading spaces, punctuation! and 123 numbers",
+        "unicode: café — 中文 \U0001f600",
+        "line\nbreaks\n\n and trailing ",
+        "it's the'd they'll we've I'm",
+        "## markdown header and #include <stdio.h>",
+    ]
+    for text in texts:
+        want = hf.encode(text)
+        got = ours.encode(text).tolist()
+        assert got == want, (text, got, want)
+        assert ours.decode(got) == text
+
+
 def test_gpt2_unsupported_configs_refused():
     from distributed_tensorflow_tpu.models.convert import gpt2_config_from_hf
     cfg = transformers.GPT2Config(activation_function="relu")
