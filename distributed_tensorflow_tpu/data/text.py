@@ -1,16 +1,19 @@
-"""Text tokenization: byte-level base + trainable BPE.
+"""Text tokenization: byte-level base + trainable BPE + GPT-2 replay.
 
-The LM-framework complement to the synthetic corpora in ``datasets``: a
-dependency-free tokenizer pair (no downloads, no external vocab files).
+The LM-framework complement to the synthetic corpora in ``datasets``:
 
   * ``ByteTokenizer`` — the trivial reversible base: one id per byte, plus
-    reserved special ids appended AFTER the byte range.
+    reserved special ids appended AFTER the byte range.  Dependency-free.
   * ``BPETokenizer`` — classic byte-pair encoding trained on raw text
     (Sennrich et al., 2016): repeatedly merge the most frequent adjacent
     pair; encode applies merges in training order (rank order), which is
-    the same greedy scheme GPT-2's tokenizer uses.
+    the same greedy scheme GPT-2's tokenizer uses.  Dependency-free.
+  * ``GPT2BPETokenizer`` — replays an EXISTING GPT-2 checkpoint's
+    ``vocab.json``/``merges.txt`` with exact transformers ids (checkpoint
+    interop; needs the third-party ``regex`` package — the ``interop``
+    extra in pyproject).
 
-Both produce int32 numpy arrays ready for ``datasets.lm_sequences`` /
+All produce int32 numpy arrays ready for ``datasets.lm_sequences`` /
 the GPT/seq2seq batch dicts.
 """
 from __future__ import annotations
@@ -257,7 +260,8 @@ class GPT2BPETokenizer:
                  r"| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+")
 
     def __init__(self, vocab: Dict[str, int],
-                 merges: List[Tuple[str, str]]):
+                 merges: List[Tuple[str, str]],
+                 special_tokens: Sequence[str] = ("<|endoftext|>",)):
         import regex
         self.vocab = dict(vocab)
         self.inv_vocab = {i: t for t, i in self.vocab.items()}
@@ -266,6 +270,17 @@ class GPT2BPETokenizer:
         self._u2b = {u: b for b, u in self._b2u.items()}
         self._pat = regex.compile(self._PRETOKEN)
         self._cache: Dict[str, List[str]] = {}
+        # added tokens present in the vocab bypass BPE (transformers
+        # splits on them first — '<|endoftext|>' must stay ONE id, not a
+        # run of byte-level pieces); longest-first so overlapping markers
+        # resolve like transformers' added-token trie
+        self.special_tokens = sorted(
+            (t for t in special_tokens if t in self.vocab),
+            key=len, reverse=True)
+        self._special_pat = (
+            regex.compile("|".join(regex.escape(t)
+                                   for t in self.special_tokens))
+            if self.special_tokens else None)
 
     @classmethod
     def load(cls, vocab_file: str, merges_file: str) -> "GPT2BPETokenizer":
@@ -274,12 +289,13 @@ class GPT2BPETokenizer:
         merges: List[Tuple[str, str]] = []
         with open(merges_file, encoding="utf-8") as f:
             for n, line in enumerate(f):
-                line = line.rstrip("\n")
+                line = line.rstrip()   # full rstrip: CRLF files must not
+                # leave \r on the second symbol (that disables every rule)
                 # only the FIRST line may be the '#version' header — real
                 # GPT-2 merge rules can legitimately start with '#'
                 # ('# #', '## #'), so a blanket comment-skip would
                 # silently drop them and break id parity
-                if not line.strip():
+                if not line:
                     continue
                 if n == 0 and line.startswith("#version"):
                     continue
@@ -290,35 +306,36 @@ class GPT2BPETokenizer:
     def _bpe(self, word: str) -> List[str]:
         if word in self._cache:
             return self._cache[word]
-        symbols = list(word)
+        symbols: List[str] = list(word)
         while len(symbols) > 1:
             pairs = [(self._ranks.get((a, b), float("inf")), i)
                      for i, (a, b) in enumerate(zip(symbols, symbols[1:]))]
             rank, i = min(pairs)
             if rank == float("inf"):
                 break
-            # merge EVERY occurrence of this pair left-to-right (the
-            # reference algorithm's behavior)
+            # merge EVERY non-overlapping occurrence left-to-right — the
+            # same step train/encode share via _apply_merge
             pair = (symbols[i], symbols[i + 1])
-            out = []
-            j = 0
-            while j < len(symbols):
-                if (j < len(symbols) - 1
-                        and (symbols[j], symbols[j + 1]) == pair):
-                    out.append(symbols[j] + symbols[j + 1])
-                    j += 2
-                else:
-                    out.append(symbols[j])
-                    j += 1
-            symbols = out
+            symbols = _apply_merge(symbols, pair, pair[0] + pair[1])
         self._cache[word] = symbols
         return symbols
 
-    def encode(self, text: str) -> np.ndarray:
-        ids: List[int] = []
+    def _encode_plain(self, text: str, ids: List[int]) -> None:
         for tok in self._pat.findall(text):
             word = "".join(self._b2u[b] for b in tok.encode("utf-8"))
             ids.extend(self.vocab[p] for p in self._bpe(word))
+
+    def encode(self, text: str) -> np.ndarray:
+        ids: List[int] = []
+        if self._special_pat is None:
+            self._encode_plain(text, ids)
+        else:
+            pos = 0
+            for m in self._special_pat.finditer(text):
+                self._encode_plain(text[pos:m.start()], ids)
+                ids.append(self.vocab[m.group()])
+                pos = m.end()
+            self._encode_plain(text[pos:], ids)
         return np.asarray(ids, np.int32)
 
     def decode(self, ids) -> str:

@@ -109,6 +109,7 @@ def test_gpt2_bpe_tokenizer_matches_transformers(tmp_path):
               ("i", "n"), ("Ġthe", "s"), ("1", "2"), ("#", "#")]
     for a, b in merges:
         vocab[a + b] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)   # added token: must stay ONE id
     vf, mf = tmp_path / "vocab.json", tmp_path / "merges.txt"
     vf.write_text(json.dumps(vocab), encoding="utf-8")
     mf.write_text("#version: 0.2\n" +
@@ -124,6 +125,7 @@ def test_gpt2_bpe_tokenizer_matches_transformers(tmp_path):
         "line\nbreaks\n\n and trailing ",
         "it's the'd they'll we've I'm",
         "## markdown header and #include <stdio.h>",
+        "doc one<|endoftext|>doc two<|endoftext|>",
     ]
     for text in texts:
         want = hf.encode(text)

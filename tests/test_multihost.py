@@ -149,6 +149,56 @@ def test_two_process_sharded_save_restores_into_one_process(tmp_path):
     assert "data" in str(restored["w"].sharding.spec)
 
 
+def test_two_process_async_sharded_save_completes_without_barrier(tmp_path):
+    """Each of 2 processes queues its chunk write on a background thread
+    (AsyncShardedCheckpointer) with NO cross-process barrier anywhere;
+    after both drain, the checkpoint is structurally complete and restores
+    into this process."""
+    ckpt_dir = tmp_path / "ckpt"
+    script = tmp_path / "async_saver.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys, time
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_tensorflow_tpu import parallel
+        parallel.initialize()
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from distributed_tensorflow_tpu.train import sharded_checkpoint as sc
+        mesh = parallel.make_mesh({{"data": len(jax.devices())}})
+        w_global = np.arange(24, dtype=np.float32).reshape(8, 3)
+        w = jax.make_array_from_callback(
+            (8, 3), NamedSharding(mesh, P("data")), lambda i: w_global[i])
+        tree = {{"w": w, "step": np.int64(3)}}
+        if jax.process_index() == 1:
+            time.sleep(1.0)   # stagger BEFORE the save: the chief's
+                              # manifest lands first, completeness must
+                              # still wait for pid 1's files
+        ck = sc.AsyncShardedCheckpointer()
+        ck.save({str(ckpt_dir)!r}, 3, tree)
+        ck.close()
+        print(f"ASYNC-SAVED proc={{jax.process_index()}}")
+    """))
+    procs, outs = _run_pair(script)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+    from distributed_tensorflow_tpu.train import sharded_checkpoint as sc
+    ckpts = sc.all_sharded_checkpoints(str(ckpt_dir))
+    assert len(ckpts) == 1
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import parallel
+    mesh = parallel.make_mesh({"data": 2}, jax.devices()[:2])
+    target = {"w": jax.device_put(np.zeros((8, 3), np.float32),
+                                  NamedSharding(mesh, P("data"))),
+              "step": np.int64(0)}
+    restored = sc.restore_sharded(target, ckpts[-1])
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]),
+        np.arange(24, dtype=np.float32).reshape(8, 3))
+
+
 def test_sigterm_one_process_saves_and_single_process_resumes(tmp_path):
     """SIGTERM only the NON-chief mid-training: the preemption flag is
     agreed cross-process (sync_fn allgather), both processes checkpoint
