@@ -37,11 +37,12 @@ def _special_id(specials: Dict[str, int], name: str) -> int:
                        f"configured: {sorted(specials)}") from None
 
 
-def _apply_merge(seq: List[int], pair: Tuple[int, int],
-                 new_id: int) -> List[int]:
+def _apply_merge(seq, pair, new_id):
     """Replace every non-overlapping occurrence of ``pair`` with
-    ``new_id`` (left-to-right) — the single merge step shared by train
-    and encode so their segmentation can never diverge."""
+    ``new_id`` (left-to-right) — the single merge step shared by
+    BPETokenizer train/encode AND GPT2BPETokenizer so segmentation can
+    never diverge.  Symbols may be ints (trainable BPE) or strings
+    (GPT-2 replay); only equality is used."""
     out: List[int] = []
     i = 0
     n = len(seq)
@@ -283,7 +284,12 @@ class GPT2BPETokenizer:
             if self.special_tokens else None)
 
     @classmethod
-    def load(cls, vocab_file: str, merges_file: str) -> "GPT2BPETokenizer":
+    def load(cls, vocab_file: str, merges_file: str,
+             special_tokens: Sequence[str] = ("<|endoftext|>",)
+             ) -> "GPT2BPETokenizer":
+        """``special_tokens``: added tokens that must bypass BPE — pass a
+        fine-tuned checkpoint's extra markers (pad/chat tokens) here or
+        they would byte-split into multiple ids."""
         with open(vocab_file, encoding="utf-8") as f:
             vocab = json.load(f)
         merges: List[Tuple[str, str]] = []
@@ -301,7 +307,7 @@ class GPT2BPETokenizer:
                     continue
                 a, _, b = line.partition(" ")
                 merges.append((a, b))
-        return cls(vocab, merges)
+        return cls(vocab, merges, special_tokens=special_tokens)
 
     def _bpe(self, word: str) -> List[str]:
         if word in self._cache:

@@ -180,10 +180,29 @@ def test_two_process_async_sharded_save_completes_without_barrier(tmp_path):
         ck.close()
         print(f"ASYNC-SAVED proc={{jax.process_index()}}")
     """))
-    procs, outs = _run_pair(script)
+    from distributed_tensorflow_tpu.train import sharded_checkpoint as sc
+    observed_incomplete = []
+
+    def watch_window(procs):
+        # observe the manifest-first window WHILE pid 1 still sleeps: the
+        # chief's manifest alone must NOT make the checkpoint listable
+        deadline = time.time() + 120
+        manifest = ckpt_dir / "ckpt-0000000003" / "manifest.json"
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                return
+            if os.path.exists(manifest):
+                observed_incomplete.append(
+                    sc.all_sharded_checkpoints(str(ckpt_dir)) == [])
+                return
+            time.sleep(0.02)
+
+    procs, outs = _run_pair(script, mid_run=watch_window)
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out
-    from distributed_tensorflow_tpu.train import sharded_checkpoint as sc
+    # the window was seen, and completeness correctly held back then
+    # (first observation: port-steal retries may re-enter with leftovers)
+    assert observed_incomplete and observed_incomplete[0] is True
     ckpts = sc.all_sharded_checkpoints(str(ckpt_dir))
     assert len(ckpts) == 1
     import jax
