@@ -57,8 +57,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref,
     Refs: q [1,1,bq,d], k/v [1,1,bk,d], valid [1,1,bk] float (1=real key;
     the singleton middle axis keeps the block's trailing-2 shape (1, bk)
     equal-or-tiled against Mosaic's (8, 128) rule), o [1,1,bq,d],
-    lse [1,1,bq] f32 row logsumexp (backward residual); scratch
-    acc [bq,d] f32, m/l [bq,1] f32.
+    lse [1,1,bq,1] f32 row logsumexp (backward residual; the trailing
+    singleton makes the block's trailing-2 shape (bq, 1) — bq tiles by 8,
+    1 equals the array dim — the same Mosaic rule the valid mask needed);
+    scratch acc [bq,d] f32, m/l [bq,1] f32.
     """
     # program_id must be read at kernel top level: the HLO interpreter used
     # off-TPU cannot lower it from inside a pl.when body.
@@ -124,8 +126,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, valid_ref, o_ref, lse_ref,
         # exp(s - lse) reproduces their zero probabilities
         m = m_ref[:, 0]
         shift = jnp.where(jnp.isfinite(m), m, 0.0)
-        lse_ref[0, 0, :] = jnp.where(l > 0.0, shift + jnp.log(
+        lse = jnp.where(l > 0.0, shift + jnp.log(
             jnp.where(l > 0.0, l, 1.0)), NEG_INF)
+        lse_ref[0, 0] = lse[:, None]          # 2-D store: [bq, 1]
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
@@ -158,7 +161,7 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk),
         out_shape=[jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32)],
+                   jax.ShapeDtypeStruct((b, h, sq_p, 1), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d),
@@ -171,8 +174,8 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
         ],
         out_specs=[pl.BlockSpec((1, 1, bq, d),
                                 lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
-                   pl.BlockSpec((1, 1, bq),
-                                lambda ib, ih, iq, ik: (ib, ih, iq))],
+                   pl.BlockSpec((1, 1, bq, 1),
+                                lambda ib, ih, iq, ik: (ib, ih, iq, 0))],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -180,7 +183,7 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
         ],
         interpret=interpret,
     )(q, k, v, valid)
-    return out[:, :, :sq, :], lse[:, :, :sq]
+    return out[:, :, :sq, :], lse[:, :, :sq, 0]
 
 
 def _bwd_block_terms(q, k, v, do, lse, dvec, valid, qi, ki, scale, causal,
@@ -230,7 +233,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, valid_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         p, ds = _bwd_block_terms(
-            q, k, v, do, lse_ref[0, 0, :], d_ref[0, 0, :],
+            q, k, v, do, lse_ref[0, 0, :, 0], d_ref[0, 0, :, 0],
             valid_ref[0, 0, :], qi, ki, scale, causal, block_q, block_k)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -274,7 +277,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, valid_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         _, ds = _bwd_block_terms(
-            q, k, v, do, lse_ref[0, 0, :], d_ref[0, 0, :],
+            q, k, v, do, lse_ref[0, 0, :, 0], d_ref[0, 0, :, 0],
             valid_ref[0, 0, :], qi, ki, scale, causal, block_q, block_k)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -306,9 +309,11 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
     q_p = _pad_to(q, 2, bq)
     do_p = _pad_to(do, 2, bq)                 # zero dO rows: no contribution
     # pad lse with 0 (any finite value): padded q rows have dO = 0 and
-    # D = 0, so their p never reaches an accumulator
-    lse_p = _pad_to(lse, 2, bq)
-    d_p = _pad_to(dvec, 2, bq)
+    # D = 0, so their p never reaches an accumulator.  Both ride with a
+    # trailing singleton axis so their blocks' trailing-2 shape (bq, 1)
+    # satisfies Mosaic's (8, 128) tiling rule (see _flash_kernel docstring).
+    lse_p = _pad_to(lse, 2, bq)[..., None]    # [b, h, sq_p, 1]
+    d_p = _pad_to(dvec, 2, bq)[..., None]     # [b, h, sq_p, 1]
     k_p = _pad_to(k, 2, bk)
     v_p = _pad_to(v, 2, bk)
     valid_p = _pad_to(valid, 1, bk)[:, None, :]   # [b, 1, sk_p]
@@ -329,10 +334,10 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
                          lambda ib, ih, ik, iq: (ib, ih, ik, 0)),   # v
             pl.BlockSpec((1, 1, bq, d),
                          lambda ib, ih, ik, iq: (ib, ih, iq, 0)),   # do
-            pl.BlockSpec((1, 1, bq),
-                         lambda ib, ih, ik, iq: (ib, ih, iq)),      # lse
-            pl.BlockSpec((1, 1, bq),
-                         lambda ib, ih, ik, iq: (ib, ih, iq)),      # D
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda ib, ih, ik, iq: (ib, ih, iq, 0)),   # lse
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda ib, ih, ik, iq: (ib, ih, iq, 0)),   # D
             pl.BlockSpec((1, 1, bk),
                          lambda ib, ih, ik, iq: (ib, 0, ik)),       # valid
         ],
@@ -361,10 +366,10 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
                          lambda ib, ih, iq, ik: (ib, ih, ik, 0)),   # v
             pl.BlockSpec((1, 1, bq, d),
                          lambda ib, ih, iq, ik: (ib, ih, iq, 0)),   # do
-            pl.BlockSpec((1, 1, bq),
-                         lambda ib, ih, iq, ik: (ib, ih, iq)),      # lse
-            pl.BlockSpec((1, 1, bq),
-                         lambda ib, ih, iq, ik: (ib, ih, iq)),      # D
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),   # lse
+            pl.BlockSpec((1, 1, bq, 1),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),   # D
             pl.BlockSpec((1, 1, bk),
                          lambda ib, ih, iq, ik: (ib, 0, ik)),       # valid
         ],

@@ -1,0 +1,36 @@
+"""Shared timing harness for the flash-attention hardware scripts.
+
+Both ``validate_flash_tpu.py`` (crossover gate) and
+``sweep_flash_blocks.py`` (block tuner) feed the same docs/PERF.md table,
+so they must measure identically — one helper, imported by both.
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def require_tpu() -> bool:
+    """Print the backend; True iff it is a real TPU (numbers off-hardware
+    are meaningless for kernel decisions — the caller should exit)."""
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    if dev.platform != "tpu":
+        print("NOT a TPU — refusing to measure; kernel decisions need "
+              "hardware numbers", file=sys.stderr)
+        return False
+    return True
+
+
+def time_fwd_bwd(attn_loss, q, k, v, n: int = 20) -> float:
+    """Seconds per fwd+bwd step of ``attn_loss(q, k, v)``, value-fetch
+    closed (docs/PERF.md methodology: block_until_ready can return before
+    the tunneled execution finishes; fetching the last value cannot)."""
+    g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
+    g(q, k, v)[0].block_until_ready()   # compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = g(q, k, v)
+    float(jnp.sum(out[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / n

@@ -1,0 +1,64 @@
+"""Block-size sweep for the flash kernels on real TPU.
+
+The 128x128 default gives (b*h*q_blocks*k_blocks) tiny sequential grid
+steps; measured per-step overhead ~33us dominates (step time was constant
+~50ms across seq 512->2048).  Bigger blocks amortize it — this sweep finds
+the winning (block_q, block_k) per sequence length against the XLA dense
+path, fwd+bwd, timed by the same harness as the validate gate
+(``flash_timing``).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+from flash_timing import require_tpu, time_fwd_bwd
+
+from distributed_tensorflow_tpu.ops.attention import (
+    causal_mask, dot_product_attention)
+from distributed_tensorflow_tpu.ops.pallas.flash_attention import (
+    flash_attention)
+
+
+def main():
+    if not require_tpu():
+        return 2
+    b, h, d = 8, 12, 64
+    for seq in (1024, 2048, 4096):
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q, k, v = [jax.random.normal(kk, (b, seq, h, d), jnp.bfloat16)
+                   for kk in ks]
+        tokens = b * seq
+        cmask = causal_mask(seq)
+        t_xla = time_fwd_bwd(
+            lambda q, k, v: jnp.sum(dot_product_attention(
+                q, k, v, mask=cmask).astype(jnp.float32)), q, k, v, n=10)
+        print(json.dumps({"seq": seq, "xla_tokens_per_sec":
+                          round(tokens / t_xla, 1)}), flush=True)
+        for bq, bk in [(128, 128), (256, 256), (512, 512),
+                       (512, 1024), (1024, 1024), (2048, 1024)]:
+            if bq > seq or bk > seq:
+                continue
+            try:
+                t = time_fwd_bwd(
+                    lambda q, k, v, bq=bq, bk=bk: jnp.sum(
+                        flash_attention(q, k, v, causal=True, block_q=bq,
+                                        block_k=bk, interpret=False
+                                        ).astype(jnp.float32)),
+                    q, k, v, n=10)
+                print(json.dumps({
+                    "seq": seq, "block_q": bq, "block_k": bk,
+                    "flash_tokens_per_sec": round(tokens / t, 1),
+                    "speedup_vs_xla": round(t_xla / t, 3)}), flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(json.dumps({"seq": seq, "block_q": bq, "block_k": bk,
+                                  "error": str(e)[:160]}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,10 +2,16 @@
 
 Run ON A REAL TPU (no --device flag).  Two phases:
 
-1. **Correctness**: forward and backward (dq/dk/dv) parity of the Pallas
-   kernels vs the pure-XLA reference, compiled by Mosaic (NOT interpret
-   mode — interpret has hidden tiling violations before, docs/PERF.md), at
-   shapes covering causal, padding masks, ragged seq, and bf16.
+1. **Correctness**: forward and backward (dq/dk/dv) accuracy of the Pallas
+   kernels, compiled by Mosaic (NOT interpret mode — interpret has hidden
+   tiling violations before, docs/PERF.md), at shapes covering causal,
+   padding masks, ragged seq, and bf16.  Both the kernel and the pure-XLA
+   path run TPU default-precision matmuls (bf16 passes on the MXU), so a
+   fixed flash-vs-XLA tolerance measures rounding-order noise, not bugs
+   (measured 2026-07-31: both sit ~1e-2 from float64 at f32, in different
+   directions).  The gate is therefore self-calibrating: each tensor's
+   max-abs error vs a float64 HOST ground truth must be no worse than
+   2x the XLA path's own error (or inside the strict tolerance floor).
 2. **Crossover**: train-step-shaped timing (fwd+bwd, value-fetch closed) of
    flash vs XLA dense attention at seq 512/1024/2048 — the numbers that
    decide whether ``use_flash`` defaults flip to "auto"
@@ -17,7 +23,6 @@ import json
 import math
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -39,17 +44,38 @@ def main():
     from distributed_tensorflow_tpu.ops.pallas.flash_attention import (
         flash_attention)
 
-    dev = jax.devices()[0]
-    print(f"backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
-    if dev.platform != "tpu":
-        print("NOT a TPU — this validation is meaningless off-hardware",
-              file=sys.stderr)
+    from flash_timing import require_tpu, time_fwd_bwd
+    if not require_tpu():
         return 2
 
     # ---- phase 1: compiled-kernel parity --------------------------------
     def qkv(key, b, s, h, d, dtype):
         ks = jax.random.split(key, 3)
         return [jax.random.normal(k, (b, s, h, d), dtype) for k in ks]
+
+    def gt_fwd_bwd(q, k, v, causal, valid):
+        """float64 host ground truth for out and grads of sum(out**2)."""
+        q, k, v = (np.asarray(t, np.float64) for t in (q, k, v))
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        if valid is not None:
+            logits = np.where(np.asarray(valid)[:, None, None, :] > 0.5,
+                              logits, -np.inf)
+        if causal:
+            sq, sk = logits.shape[-2:]
+            cm = np.arange(sq)[:, None] >= np.arange(sk)[None, :]
+            logits = np.where(cm[None, None], logits, -np.inf)
+        m = logits.max(-1, keepdims=True)
+        p = np.exp(logits - m)
+        p /= p.sum(-1, keepdims=True)
+        out = np.einsum("bhqk,bkhd->bqhd", p, v)
+        do = 2.0 * out
+        dp = np.einsum("bqhd,bkhd->bhqk", do, v)
+        dv = np.einsum("bhqk,bqhd->bkhd", p, do)
+        ds = p * (dp - (dp * p).sum(-1, keepdims=True)) * scale
+        dq = np.einsum("bhqk,bkhd->bqhd", ds, k)
+        dk = np.einsum("bhqk,bqhd->bkhd", ds, q)
+        return out, (dq, dk, dv)
 
     failures = 0
     cases = [
@@ -92,15 +118,33 @@ def main():
             o2 = dot_product_attention(q, k, v, mask=mask)
             g1 = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
             g2 = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
-            tol = 6e-2 if shp["dtype"] == jnp.bfloat16 else 2e-4
-            np.testing.assert_allclose(np.asarray(o1, np.float32),
-                                       np.asarray(o2, np.float32),
-                                       atol=tol, rtol=tol)
-            for a, b_ in zip(g1, g2):
-                np.testing.assert_allclose(np.asarray(a, np.float32),
-                                           np.asarray(b_, np.float32),
-                                           atol=tol, rtol=tol)
-            print(json.dumps({"check": name, "ok": True}), flush=True)
+            valid_np = fkw.get("kv_valid")
+            gt_out, gt_grads = gt_fwd_bwd(q, k, v, maskkind == "causal",
+                                          valid_np)
+            floor = 6e-2 if shp["dtype"] == jnp.bfloat16 else 2e-4
+            errs, ok = {}, True
+            for tname, flash_t, xla_t, gt_t in [
+                    ("out", o1, o2, gt_out),
+                    ("dq", g1[0], g2[0], gt_grads[0]),
+                    ("dk", g1[1], g2[1], gt_grads[1]),
+                    ("dv", g1[2], g2[2], gt_grads[2])]:
+                ef = float(np.abs(np.asarray(flash_t, np.float64)
+                                  - gt_t).max())
+                ex = float(np.abs(np.asarray(xla_t, np.float64)
+                                  - gt_t).max())
+                errs[tname] = {"flash_vs_f64": round(ef, 6),
+                               "xla_vs_f64": round(ex, 6)}
+                # 2.0x: same order of magnitude as the incumbent's own
+                # rounding error is noise (measured spread 0.5-1.55x across
+                # tensors); real kernel bugs show up orders of magnitude
+                # out (the interpret-hidden tiling bug gave O(1) diffs).
+                # Inverted form so a NaN error FAILS (NaN > x is False).
+                if not ef <= max(2.0 * ex, floor):
+                    ok = False
+            if not ok:
+                failures += 1
+            print(json.dumps({"check": name, "ok": ok, "err": errs}),
+                  flush=True)
         except Exception as e:  # noqa: BLE001 - report and continue
             failures += 1
             print(json.dumps({"check": name, "ok": False,
@@ -114,23 +158,14 @@ def main():
     b, h, d = 8, 12, 64
     for seq in (512, 1024, 2048):
         q, k, v = qkv(jax.random.PRNGKey(1), b, seq, h, d, jnp.bfloat16)
-
-        def step_of(attn_loss):
-            g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
-            g(q, k, v)[0].block_until_ready()   # compile
-            # value-fetch close (docs/PERF.md methodology)
-            t0 = time.perf_counter()
-            n = 20
-            for _ in range(n):
-                out = g(q, k, v)
-            float(jnp.sum(out[0].astype(jnp.float32)))
-            return (time.perf_counter() - t0) / n
-
-        t_flash = step_of(lambda q, k, v: jnp.sum(flash_attention(
-            q, k, v, causal=True, interpret=False).astype(jnp.float32)))
+        t_flash = time_fwd_bwd(
+            lambda q, k, v: jnp.sum(flash_attention(
+                q, k, v, causal=True, interpret=False).astype(jnp.float32)),
+            q, k, v)
         cmask = causal_mask(seq)
-        t_xla = step_of(lambda q, k, v: jnp.sum(dot_product_attention(
-            q, k, v, mask=cmask).astype(jnp.float32)))
+        t_xla = time_fwd_bwd(
+            lambda q, k, v: jnp.sum(dot_product_attention(
+                q, k, v, mask=cmask).astype(jnp.float32)), q, k, v)
         tokens = b * seq
         print(json.dumps({
             "seq": seq,
