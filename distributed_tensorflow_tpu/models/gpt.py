@@ -224,11 +224,10 @@ class GPT:
             attention_fn = lambda q, k, v, mask=None: ring_attention(
                 q, k, v, axis_name=c.seq_axis, causal=True)
         elif attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
-            # GQA configs work here too: attention_core broadcasts kv
-            # head groups before any swapped kernel (attention.py)
-            from ..ops.pallas import flash_attention
-            attention_fn = lambda q, k, v, mask=None: flash_attention(
-                q, k, v, causal=True)
+            # GQA configs run natively: the kernel maps kv blocks by
+            # q_head // group, so no broadcast materialises
+            from ..ops.pallas.flash_attention import make_flash_attention_fn
+            attention_fn = make_flash_attention_fn(causal=True)
         else:
             attention_fn = attn_lib.dot_product_attention
         return attn_lib.attention_core(

@@ -199,10 +199,13 @@ def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
         # positional rotation (RoPE) — applied post-projection, pre-kernel
         q, k = qk_transform(q, k)
     if (k.shape[2] != q.shape[2]
-            and attention_fn is not dot_product_attention):
-        # grouped-query attention with a swapped kernel (flash/ring) that
-        # expects equal head counts: broadcast kv head groups here.  The
-        # default dense kernel handles grouping natively (no repeat).
+            and attention_fn is not dot_product_attention
+            and not getattr(attention_fn, "supports_gqa", False)):
+        # grouped-query attention with a swapped kernel that expects equal
+        # head counts: broadcast kv head groups here.  The default dense
+        # kernel handles grouping natively (grouped einsum), and kernels
+        # marked ``supports_gqa`` (the flash kernels, which map kv blocks
+        # by q_head // group) take the raw shapes — no repeat either way.
         if q.shape[2] % k.shape[2]:
             raise ValueError(f"query heads {q.shape[2]} not a multiple of "
                              f"kv heads {k.shape[2]}")

@@ -142,10 +142,13 @@ def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
 
 def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
                    interpret):
-    """q,k,v: [b, h, s, d]; valid: [b, s_k] float32.
+    """q: [b, h, s, d]; k, v: [b, hk, s, d] with h % hk == 0 (GQA/MQA:
+    each kv head serves h//hk query heads, selected by block-index
+    mapping — the broadcast never materialises); valid: [b, s_k] float32.
     Returns (out [b, h, s, d], lse [b, h, s] f32)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    group = h // k.shape[1]
     bq = min(block_q, sq)
     bk = min(block_k, sk)
 
@@ -167,9 +170,9 @@ def _flash_forward(q, k, v, valid, scale, causal, block_q, block_k,
             pl.BlockSpec((1, 1, bq, d),
                          lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
             pl.BlockSpec((1, 1, bk, d),
-                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+                         lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)),
             pl.BlockSpec((1, 1, bk, d),
-                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+                         lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)),
             pl.BlockSpec((1, 1, bk), lambda ib, ih, iq, ik: (ib, 0, ik)),
         ],
         out_specs=[pl.BlockSpec((1, 1, bq, d),
@@ -297,9 +300,18 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, valid_ref,
 
 def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
                     block_q, block_k, interpret):
-    """Fused backward: (dq, dk, dv) with logits recomputed blockwise."""
+    """Fused backward: (dq, dk, dv) with logits recomputed blockwise.
+
+    GQA: k/v may have hk < h heads.  The kernels consume them through the
+    same ``ih // group`` index mapping as the forward and emit PER-Q-HEAD
+    dk/dv ([b, h, sk, d]); the group reduction to [b, hk, sk, d] is one
+    cheap XLA sum afterwards (costs group x transient dk/dv memory — still
+    O(seq), the kernels' point).
+    """
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    hk = k.shape[1]
+    group = h // hk
     bq = min(block_q, sq)
     bk = min(block_k, sk)
 
@@ -329,9 +341,9 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
             pl.BlockSpec((1, 1, bq, d),
                          lambda ib, ih, ik, iq: (ib, ih, iq, 0)),   # q
             pl.BlockSpec((1, 1, bk, d),
-                         lambda ib, ih, ik, iq: (ib, ih, ik, 0)),   # k
+                         lambda ib, ih, ik, iq: (ib, ih // group, ik, 0)),
             pl.BlockSpec((1, 1, bk, d),
-                         lambda ib, ih, ik, iq: (ib, ih, ik, 0)),   # v
+                         lambda ib, ih, ik, iq: (ib, ih // group, ik, 0)),
             pl.BlockSpec((1, 1, bq, d),
                          lambda ib, ih, ik, iq: (ib, ih, iq, 0)),   # do
             pl.BlockSpec((1, 1, bq, 1),
@@ -361,9 +373,9 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
             pl.BlockSpec((1, 1, bq, d),
                          lambda ib, ih, iq, ik: (ib, ih, iq, 0)),   # q
             pl.BlockSpec((1, 1, bk, d),
-                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),   # k
+                         lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)),
             pl.BlockSpec((1, 1, bk, d),
-                         lambda ib, ih, iq, ik: (ib, ih, ik, 0)),   # v
+                         lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)),
             pl.BlockSpec((1, 1, bq, d),
                          lambda ib, ih, iq, ik: (ib, ih, iq, 0)),   # do
             pl.BlockSpec((1, 1, bq, 1),
@@ -379,6 +391,12 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
         interpret=interpret,
     )(q_p, k_p, v_p, do_p, lse_p, d_p, valid_p)
 
+    if group > 1:
+        sk_pad = dk.shape[2]
+        dk = dk.astype(jnp.float32).reshape(
+            b, hk, group, sk_pad, d).sum(2).astype(k.dtype)
+        dv = dv.astype(jnp.float32).reshape(
+            b, hk, group, sk_pad, d).sum(2).astype(v.dtype)
     return dq[:, :, :sq, :], dk[:, :, :sk, :], dv[:, :, :sk, :]
 
 
@@ -428,20 +446,20 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
-    """Fused attention.  q,k,v: [batch, seq, heads, head_dim] (the
-    framework-wide head layout, see ops.attention); kv_valid: optional
-    [batch, seq_k] mask, 1 = real key.  Returns [batch, seq, heads, head_dim].
+    """Fused attention.  q: [batch, seq, heads, head_dim] (the
+    framework-wide head layout, see ops.attention); k, v:
+    [batch, seq_k, kv_heads, head_dim] where heads % kv_heads == 0 —
+    GQA/MQA kv heads are shared across their query group by block-index
+    mapping, never materialised; kv_valid: optional [batch, seq_k] mask,
+    1 = real key.  Returns [batch, seq, heads, head_dim].
 
     Off-TPU the kernel runs in Pallas interpret mode, so CPU tests cover the
     identical kernel code.
     """
-    if q.shape[2] != k.shape[2]:
-        # the grid blocks per (batch, head) assuming equal head counts —
-        # fewer kv heads (GQA/MQA) would index k/v out of range
+    if q.shape[2] % k.shape[2] != 0:
         raise ValueError(
-            f"flash_attention requires equal q/kv head counts; got "
-            f"{q.shape[2]} vs {k.shape[2]} (GQA/MQA) — use "
-            "dot_product_attention, whose grouped einsum handles it")
+            f"flash_attention requires the q head count to be a multiple "
+            f"of the kv head count; got {q.shape[2]} vs {k.shape[2]}")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret is None:
@@ -479,4 +497,5 @@ def make_flash_attention_fn(causal: bool = False, block_q: int = 128,
             kv_valid = (mask[:, 0, 0, :] >= 0.0)
         return flash_attention(q, k, v, kv_valid=kv_valid, causal=causal,
                                scale=scale, block_q=block_q, block_k=block_k)
+    fn.supports_gqa = True   # attention_core: skip the kv-head broadcast
     return fn

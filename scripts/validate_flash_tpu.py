@@ -56,6 +56,10 @@ def main():
     def gt_fwd_bwd(q, k, v, causal, valid):
         """float64 host ground truth for out and grads of sum(out**2)."""
         q, k, v = (np.asarray(t, np.float64) for t in (q, k, v))
+        group = q.shape[2] // k.shape[2]
+        if group > 1:                 # GQA: q head ih uses kv head ih//group
+            k = np.repeat(k, group, axis=2)
+            v = np.repeat(v, group, axis=2)
         scale = 1.0 / math.sqrt(q.shape[-1])
         logits = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
         if valid is not None:
@@ -75,6 +79,10 @@ def main():
         ds = p * (dp - (dp * p).sum(-1, keepdims=True)) * scale
         dq = np.einsum("bhqk,bkhd->bqhd", ds, k)
         dk = np.einsum("bhqk,bqhd->bkhd", ds, q)
+        if group > 1:                 # reduce per-q-head dk/dv to kv heads
+            b_, s_, h_, d_ = dk.shape
+            dk = dk.reshape(b_, s_, h_ // group, group, d_).sum(3)
+            dv = dv.reshape(b_, s_, h_ // group, group, d_).sum(3)
         return out, (dq, dk, dv)
 
     failures = 0
@@ -90,10 +98,16 @@ def main():
         ("causal_bf16_long", dict(b=1, s=1024, h=8, d=64,
                                   dtype=jnp.bfloat16),
          dict(causal=True), "causal"),
+        ("gqa_causal_bf16", dict(b=2, s=512, h=8, d=64, kv_heads=2,
+                                 dtype=jnp.bfloat16),
+         dict(causal=True), "causal"),
     ]
     for name, shp, fkw, maskkind in cases:
         q, k, v = qkv(jax.random.PRNGKey(0), shp["b"], shp["s"], shp["h"],
                       shp["d"], shp["dtype"])
+        if "kv_heads" in shp:                 # GQA: fewer kv heads
+            _, k, v = qkv(jax.random.PRNGKey(7), shp["b"], shp["s"],
+                          shp["kv_heads"], shp["d"], shp["dtype"])
         fkw = dict(fkw, interpret=False)      # force the compiled kernel
         mask = None
         if maskkind == "causal":

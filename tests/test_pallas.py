@@ -174,18 +174,59 @@ class TestFlashAttention:
         assert bool(jnp.isfinite(grad).all())
 
 
-class TestFlashGQAGuard:
-    def test_kernel_rejects_gqa_shapes(self):
+class TestFlashGQA:
+    """GQA/MQA run natively in the kernels: kv blocks are selected by
+    q_head // group in the BlockSpec index maps (forward + both backward
+    kernels), and per-q-head dk/dv reduce over the group afterwards."""
+
+    def test_kernel_rejects_nondivisible_heads(self):
         q, _, _ = _qkv(jax.random.PRNGKey(20), s=16, h=4)
-        _, k, v = _qkv(jax.random.PRNGKey(21), s=16, h=2)
-        with pytest.raises(ValueError, match="equal q/kv head counts"):
+        _, k, v = _qkv(jax.random.PRNGKey(21), s=16, h=3)
+        with pytest.raises(ValueError, match="multiple of the kv head"):
             flash_attention(q, k, v)
 
+    @pytest.mark.parametrize("kv_heads", [1, 2])   # MQA and GQA
+    def test_gqa_forward_matches_grouped_dense(self, kv_heads):
+        q, _, _ = _qkv(jax.random.PRNGKey(20), b=2, s=48, h=4, d=8)
+        _, k, v = _qkv(jax.random.PRNGKey(21), b=2, s=48, h=kv_heads, d=8)
+        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        want = dot_product_attention(q, k, v, mask=causal_mask(48))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    def test_gqa_backward_matches_grouped_dense(self):
+        """dk/dv accumulate over the whole query group (per-q-head kernel
+        outputs reduced in XLA) — grads must match the grouped einsum's."""
+        q, _, _ = _qkv(jax.random.PRNGKey(22), b=2, s=48, h=4, d=8)
+        _, k, v = _qkv(jax.random.PRNGKey(23), b=2, s=48, h=2, d=8)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=True, block_q=16,
+                                  block_k=16)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            out = dot_product_attention(q, k, v, mask=causal_mask(48))
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        assert g1[1].shape == k.shape and g1[2].shape == v.shape
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_gqa_padding_mask(self):
+        q, _, _ = _qkv(jax.random.PRNGKey(24), b=2, s=40, h=4, d=8)
+        _, k, v = _qkv(jax.random.PRNGKey(25), b=2, s=40, h=2, d=8)
+        valid = jnp.ones((2, 40), jnp.int32).at[:, 30:].set(0)
+        got = flash_attention(q, k, v, kv_valid=valid, block_q=16,
+                              block_k=16)
+        want = dot_product_attention(q, k, v, mask=padding_mask(valid))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
     def test_gpt_gqa_flash_matches_dense(self):
-        """GQA + use_flash=True end-to-end: attention_core broadcasts the
-        kv head groups before the fused kernel, and the flash path's
-        internal causal masking matches the dense grouped-einsum path —
-        same hidden states, not just same shape."""
+        """GQA + use_flash=True end-to-end through attention_core (which
+        must NOT broadcast kv heads for a supports_gqa kernel): same
+        hidden states as the dense grouped-einsum path."""
         import numpy as np
         from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
         base = dict(vocab_size=32, hidden_size=32, num_layers=2,
