@@ -1,10 +1,11 @@
 """Model zoo + high-level Sequential/compile/fit API."""
 
-from . import bert, callbacks, gpt, resnet, saving, seq2seq, vit, zoo
+from . import bert, callbacks, gpt, llama, resnet, saving, seq2seq, vit, zoo
 from .saving import load_model, save_model
 from .vit import ViT, ViTConfig, vit_base, vit_tiny
 from .bert import Bert, BertConfig, bert_base, bert_tiny
 from .gpt import GPT, GPTConfig, gpt_small, gpt_tiny
+from .llama import llama_config, llama_tiny, llama2_7b, llama3_8b
 from .seq2seq import Seq2Seq, Seq2SeqConfig, seq2seq_tiny
 from .callbacks import (Callback, CSVLogger, EarlyStopping, History,
                         LambdaCallback, LearningRateScheduler,
@@ -14,11 +15,13 @@ from .resnet import ResNet, resnet18, resnet50, resnet_cifar
 from .sequential import Sequential
 from .zoo import cifar_cnn, mnist_mlp, xor_mlp
 
-__all__ = ["bert", "callbacks", "gpt", "resnet", "saving", "seq2seq", "vit",
+__all__ = ["bert", "callbacks", "gpt", "llama", "resnet", "saving",
+           "seq2seq", "vit",
            "zoo", "load_model", "save_model",
            "ViT", "ViTConfig", "vit_base", "vit_tiny",
            "Bert", "BertConfig",
            "GPT", "GPTConfig", "gpt_small", "gpt_tiny",
+           "llama_config", "llama_tiny", "llama2_7b", "llama3_8b",
            "bert_base", "bert_tiny", "Seq2Seq", "Seq2SeqConfig", "seq2seq_tiny",
            "Callback", "CSVLogger", "EarlyStopping", "History",
            "LambdaCallback", "LearningRateScheduler", "ModelCheckpoint",

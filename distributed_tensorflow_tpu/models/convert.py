@@ -35,7 +35,8 @@ from .vit import ViT, ViTConfig
 
 __all__ = ["gpt2_config_from_hf", "gpt2_params_from_hf", "gpt2_from_hf",
            "bert_config_from_hf", "bert_params_from_hf", "bert_from_hf",
-           "vit_config_from_hf", "vit_params_from_hf", "vit_from_hf"]
+           "vit_config_from_hf", "vit_params_from_hf", "vit_from_hf",
+           "llama_config_from_hf", "llama_params_from_hf", "llama_from_hf"]
 
 
 def _np(t) -> np.ndarray:
@@ -396,4 +397,116 @@ def gpt2_from_hf(hf_model, mesh=None) -> Tuple[GPT, Dict[str, Any]]:
     config = gpt2_config_from_hf(hf_model.config)
     model = GPT(config, mesh=mesh)
     params = gpt2_params_from_hf(hf_model.state_dict(), config)
+    return model, params
+
+
+def llama_config_from_hf(hf_config) -> GPTConfig:
+    """Map a ``transformers.LlamaConfig`` onto the Llama recipe of
+    ``GPTConfig`` (models/llama.py)."""
+    from .llama import llama_config
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise ValueError(f"Llama hidden_act {act!r} unsupported: the "
+                         "swiglu FFN gate is silu")
+    if getattr(hf_config, "attention_bias", False) or \
+            getattr(hf_config, "mlp_bias", False):
+        raise ValueError("attention_bias/mlp_bias checkpoints are "
+                         "unsupported: the Llama recipe is bias-free")
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise ValueError(f"rope_scaling {scaling!r} unsupported: plain "
+                         "rotate-half RoPE only")
+    head_dim = getattr(hf_config, "head_dim", None)
+    derived = hf_config.hidden_size // hf_config.num_attention_heads
+    if head_dim is not None and head_dim != derived:
+        # reject here with the field named, not later with a bare reshape
+        # error inside llama_params_from_hf
+        raise ValueError(
+            f"explicit head_dim {head_dim} != hidden_size//num_heads "
+            f"{derived} unsupported: GPTConfig derives head_dim")
+    return llama_config(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        intermediate_size=hf_config.intermediate_size,
+        max_position=hf_config.max_position_embeddings,
+        layer_norm_eps=hf_config.rms_norm_eps,
+        rope_base=getattr(hf_config, "rope_theta", 10000.0),
+        tied_head=bool(getattr(hf_config, "tie_word_embeddings", False)),
+    )
+
+
+def llama_params_from_hf(state_dict: Dict[str, Any],
+                         config: GPTConfig) -> Dict[str, Any]:
+    """Convert a Llama ``state_dict`` (LlamaModel or LlamaForCausalLM,
+    HF-format weights) into the stacked-decoder param tree.
+
+    Layout facts: ``nn.Linear`` weights are [out, in] (transpose to land
+    in our [in, ...out] kernels); q/k/v out dims are head-major, matching
+    our [d, heads, head_dim] reshape; HF-format checkpoints already use
+    the rotate-half RoPE convention of ``ops.attention.apply_rope``."""
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    d, h = config.hidden_size, config.num_heads
+    hd, kv = config.head_dim, config.kv_heads
+    L = config.num_layers
+
+    def rms(prefix):
+        return {"gamma": jnp.asarray(_np(sd[f"{prefix}.weight"]),
+                                     jnp.float32)}
+
+    def lin_t(prefix, shape):
+        return {"kernel": jnp.asarray(
+            _np(sd[f"{prefix}.weight"]).T.reshape(shape), jnp.float32)}
+
+    def layer(i):
+        p = f"layers.{i}"
+        return {
+            "ln_1": rms(f"{p}.input_layernorm"),
+            "attention": {
+                "query": lin_t(f"{p}.self_attn.q_proj", (d, h, hd)),
+                "key": lin_t(f"{p}.self_attn.k_proj", (d, kv, hd)),
+                "value": lin_t(f"{p}.self_attn.v_proj", (d, kv, hd)),
+                # out kernel is [h, hd, d]: o_proj.weight [d, h*hd] -> .T
+                # is [h*hd, d], reshaped head-major
+                "out": {"kernel": jnp.asarray(
+                    _np(sd[f"{p}.self_attn.o_proj.weight"]).T.reshape(
+                        h, hd, d), jnp.float32)},
+            },
+            "ln_2": rms(f"{p}.post_attention_layernorm"),
+            "ffn": {
+                "w_in": lin_t(f"{p}.mlp.up_proj", (d, -1)),
+                "w_gate": lin_t(f"{p}.mlp.gate_proj", (d, -1)),
+                "w_out": lin_t(f"{p}.mlp.down_proj", (-1, d)),
+            },
+        }
+
+    params = {
+        "embeddings": {
+            "word": jnp.asarray(_np(sd["embed_tokens.weight"]),
+                                jnp.float32),
+        },
+        "decoder": _stack_layers([layer(i) for i in range(L)]),
+        "ln_f": rms("norm"),
+    }
+    if not config.tied_head:
+        # LlamaModel state_dicts lack the head; LlamaForCausalLM has it
+        # (tie_word_embeddings checkpoints alias it to embed_tokens)
+        head = state_dict.get("lm_head.weight")
+        if head is None:
+            raise ValueError(
+                "state_dict has no lm_head.weight (a bare LlamaModel?) — "
+                "convert from LlamaForCausalLM, or set tied_head=True")
+        params["lm_head"] = jnp.asarray(_np(head), jnp.float32)
+    return params
+
+
+def llama_from_hf(hf_model, mesh=None) -> Tuple[GPT, Dict[str, Any]]:
+    """(GPT, params) from a ``transformers`` LlamaModel / LlamaForCausalLM
+    instance — the zoo's full decoder surface (pjit/TP, KV-cache
+    generate/beam_search, GQA cache) with logits matching torch."""
+    config = llama_config_from_hf(hf_model.config)
+    model = GPT(config, mesh=mesh)
+    params = llama_params_from_hf(hf_model.state_dict(), config)
     return model, params

@@ -60,6 +60,20 @@ class GPTConfig:
     # "learned" absolute positions (GPT-2) or "rope" rotary embeddings
     # (relative; extrapolates past trained length, no position table)
     position_embedding: str = "learned"
+    # RoPE frequency base (10000 = Su et al. / Llama-2; Llama-3 ships
+    # 500000 for its 8k context)
+    rope_base: float = 10000.0
+    # Block normalization: "layernorm" (GPT-2) or "rmsnorm" (Llama — gamma
+    # only, no centering/beta)
+    norm: str = "layernorm"
+    # FFN body: "gelu" (w_in -> gelu -> w_out) or "swiglu" (Llama:
+    # w_out(silu(w_gate(x)) * w_in(x)) — w_in is HF's up_proj)
+    ffn_activation: str = "gelu"
+    # False (Llama): no bias params anywhere in attention/FFN projections
+    use_bias: bool = True
+    # False (Llama): separate lm_head matrix instead of the tied
+    # word-embedding transpose
+    tied_head: bool = True
     # Grouped-query attention: number of key/value heads (None = num_heads
     # i.e. plain MHA; 1 = MQA).  Shrinks the KV cache num_heads/num_kv_heads
     # fold — the serving-memory lever for long-context decode.
@@ -85,6 +99,16 @@ class GPTConfig:
     pipeline_microbatches: int = 0
 
     def __post_init__(self):
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"norm must be 'layernorm' or 'rmsnorm'; "
+                             f"got {self.norm!r}")
+        if self.ffn_activation not in ("gelu", "swiglu"):
+            raise ValueError(f"ffn_activation must be 'gelu' or 'swiglu'; "
+                             f"got {self.ffn_activation!r}")
+        if self.ffn_activation == "swiglu" and self.moe_experts > 0:
+            raise ValueError("moe_experts with ffn_activation='swiglu' is "
+                             "unsupported: ops.moe's expert bank is the "
+                             "two-matrix gelu FFN")
         if self.pipeline_stages > 1:
             if self.num_layers % self.pipeline_stages:
                 raise ValueError(
@@ -143,8 +167,14 @@ class GPT:
         ke = jax.random.split(k_emb, 2)
 
         def ln():
-            return {"gamma": jnp.ones((c.hidden_size,), jnp.float32),
-                    "beta": jnp.zeros((c.hidden_size,), jnp.float32)}
+            p = {"gamma": jnp.ones((c.hidden_size,), jnp.float32)}
+            if c.norm == "layernorm":
+                p["beta"] = jnp.zeros((c.hidden_size,), jnp.float32)
+            return p
+
+        def maybe_bias(shape):
+            return {"bias": jnp.zeros(shape, jnp.float32)} if c.use_bias \
+                else {}
 
         h, hd, d, i = c.num_heads, c.head_dim, c.hidden_size, \
             c.intermediate_size
@@ -154,18 +184,18 @@ class GPT:
                              f"num_heads {h}; got {kv}")
 
         def one_layer(k):
-            ks = jax.random.split(k, 6)
+            ks = jax.random.split(k, 7)
             layer = {
                 "ln_1": ln(),
                 "attention": {
                     "query": {"kernel": trunc(ks[0], (d, h, hd)),
-                              "bias": jnp.zeros((h, hd), jnp.float32)},
+                              **maybe_bias((h, hd))},
                     "key": {"kernel": trunc(ks[1], (d, kv, hd)),
-                            "bias": jnp.zeros((kv, hd), jnp.float32)},
+                            **maybe_bias((kv, hd))},
                     "value": {"kernel": trunc(ks[2], (d, kv, hd)),
-                              "bias": jnp.zeros((kv, hd), jnp.float32)},
+                              **maybe_bias((kv, hd))},
                     "out": {"kernel": trunc(ks[3], (h, hd, d)),
-                            "bias": jnp.zeros((d,), jnp.float32)},
+                            **maybe_bias((d,))},
                 },
                 "ln_2": ln(),
             }
@@ -174,10 +204,14 @@ class GPT:
             else:
                 layer["ffn"] = {
                     "w_in": {"kernel": trunc(ks[4], (d, i)),
-                             "bias": jnp.zeros((i,), jnp.float32)},
+                             **maybe_bias((i,))},
                     "w_out": {"kernel": trunc(ks[5], (i, d)),
-                              "bias": jnp.zeros((d,), jnp.float32)},
+                              **maybe_bias((d,))},
                 }
+                if c.ffn_activation == "swiglu":
+                    layer["ffn"]["w_gate"] = {
+                        "kernel": trunc(ks[6], (d, i)),
+                        **maybe_bias((i,))}
             return layer
 
         embeddings = {"word": trunc(ke[0], (c.vocab_size, c.hidden_size))}
@@ -187,14 +221,33 @@ class GPT:
         elif c.position_embedding != "rope":
             raise ValueError("position_embedding must be 'learned' or "
                              f"'rope'; got {c.position_embedding!r}")
-        return {
+        params = {
             "embeddings": embeddings,
             "decoder": jax.vmap(one_layer)(
                 jax.random.split(k_layers, c.num_layers)),
             "ln_f": ln(),
         }
+        if not c.tied_head:
+            # HF lm_head layout [vocab, d] so logits() shares the tied
+            # `hidden @ W.T` projection
+            params["lm_head"] = trunc(jax.random.split(ke[1])[0],
+                                      (c.vocab_size, c.hidden_size))
+        return params
 
     # -- blocks -----------------------------------------------------------
+    def _norm(self, p, x):
+        """Config-dispatched block norm: LayerNorm (GPT-2) or RMSNorm
+        (Llama: f32 rms, gamma scale, no centering — matches HF
+        LlamaRMSNorm numerics)."""
+        c = self.config
+        if c.norm == "rmsnorm":
+            xf = x.astype(jnp.float32)
+            y = xf * jax.lax.rsqrt(
+                jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+                + c.layer_norm_eps)
+            return (y * p["gamma"]).astype(x.dtype)
+        return _layer_norm(p, x, c.layer_norm_eps)
+
     def _rope_transform(self, local_seq_len: int):
         """qk_transform for this forward, or None.  Built ONCE per forward
         (apply hoists it out of the layer scan — cos/sin tables are
@@ -209,7 +262,8 @@ class GPT:
             # traced inside an existing shard_map over seq_axis
             positions = (jax.lax.axis_index(c.seq_axis) * local_seq_len
                          + positions)
-        cos, sin = attn_lib.rope_tables(positions, c.head_dim)
+        cos, sin = attn_lib.rope_tables(positions, c.head_dim,
+                                        base=c.rope_base)
         return lambda q, k: (attn_lib.apply_rope(q, cos, sin),
                              attn_lib.apply_rope(k, cos, sin))
 
@@ -246,7 +300,7 @@ class GPT:
         tokens (use a generous ``moe_capacity_factor`` at eval).
         """
         c = self.config
-        h = _layer_norm(p["ln_2"], x, c.layer_norm_eps)
+        h = self._norm(p["ln_2"], x)
         if "moe" in p:
             y, m = apply_moe(p["moe"], h, k=c.moe_top_k,
                              capacity_factor=c.moe_capacity_factor,
@@ -254,13 +308,16 @@ class GPT:
             aux = (c.moe_aux_weight * m["aux_loss"]
                    + c.moe_z_weight * m["router_z_loss"])
             return y, aux
+        if c.ffn_activation == "swiglu":
+            return (attn_lib.ffn_swiglu_core(p["ffn"], h),
+                    jnp.zeros((), jnp.float32))
         return attn_lib.ffn_core(p["ffn"], h), jnp.zeros((), jnp.float32)
 
     def _block(self, p, x, mask, rng, train, qk_transform=None):
         c = self.config
         r_attn, r_res, r_moe, r_drop = jax.random.split(rng, 4)
         attn_out = self._attention(
-            p["attention"], _layer_norm(p["ln_1"], x, c.layer_norm_eps),
+            p["attention"], self._norm(p["ln_1"], x),
             mask, r_attn, train, qk_transform=qk_transform)
         x = x + _dropout(attn_out, c.dropout_rate, r_res, train)
         ffn_out, aux = self._ffn(p, x, rng=r_moe, train=train)
@@ -325,7 +382,7 @@ class GPT:
             x, aux_per_layer = lax.scan(body, x,
                                         (params["decoder"], layer_keys))
             aux_total = jnp.sum(aux_per_layer)
-        hidden = _layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        hidden = self._norm(params["ln_f"], x)
         if return_aux:
             return hidden, aux_total
         return hidden
@@ -389,8 +446,12 @@ class GPT:
         return (hidden @ word.T.astype(hidden.dtype)).astype(jnp.float32)
 
     def logits(self, params, hidden):
-        """Tied LM head -> [b, s, vocab] f32 logits."""
-        return self._logits_from_word(params["embeddings"]["word"], hidden)
+        """LM head -> [b, s, vocab] f32 logits: the tied word-embedding
+        transpose, or the separate ``lm_head`` matrix (same [vocab, d]
+        layout) for ``tied_head=False`` configs."""
+        word = (params["embeddings"]["word"] if self.config.tied_head
+                else params["lm_head"])
+        return self._logits_from_word(word, hidden)
 
     # -- training ---------------------------------------------------------
     def lm_loss_fn(self):
@@ -461,10 +522,12 @@ class GPT:
         stage_params, stage_fn = self._pipeline_stage_bits(
             params, layer_keys, train, layer_fn)
 
-        aux = {"ln_f": params["ln_f"], "word": params["embeddings"]["word"]}
+        aux = {"ln_f": params["ln_f"],
+               "word": (params["embeddings"]["word"] if c.tied_head
+                        else params["lm_head"])}
 
         def head_loss(a, out_mb, y_mb):
-            h = _layer_norm(a["ln_f"], out_mb, c.layer_norm_eps)
+            h = self._norm(a["ln_f"], out_mb)
             logits = self._logits_from_word(a["word"], h)
             return loss_lib.softmax_cross_entropy_with_integer_labels(
                 logits, y_mb["t"], where=y_mb.get("m"))
@@ -489,11 +552,7 @@ class GPT:
             microbatch_weights=weights)
 
         (emb_grads,) = vjp_embed(dx)
-        # tied embedding: head-side grads add to the lookup-side grads
         emb_grads = dict(emb_grads)
-        emb_grads["word"] = (emb_grads["word"]
-                             + aux_grads["word"].astype(
-                                 emb_grads["word"].dtype))
         grads = {
             "embeddings": emb_grads,
             "decoder": jax.tree.map(
@@ -501,6 +560,13 @@ class GPT:
                 stage_grads["layers"], params["decoder"]),
             "ln_f": aux_grads["ln_f"],
         }
+        if c.tied_head:
+            # tied embedding: head-side grads add to the lookup-side grads
+            emb_grads["word"] = (emb_grads["word"]
+                                 + aux_grads["word"].astype(
+                                     emb_grads["word"].dtype))
+        else:
+            grads["lm_head"] = aux_grads["word"]
         return loss, grads
 
     # -- KV-cache decode --------------------------------------------------
@@ -553,41 +619,42 @@ class GPT:
             x = carry
             p, k_cache, v_cache = inputs
 
-            h = _layer_norm(p["ln_1"], x, c.layer_norm_eps)
+            h = self._norm(p["ln_1"], x)
             a = p["attention"]
             dtype = h.dtype
-            q = (jnp.einsum("bsd,dhk->bshk", h,
-                            a["query"]["kernel"].astype(dtype))
-                 + a["query"]["bias"].astype(dtype))
-            k = (jnp.einsum("bsd,dhk->bshk", h,
-                            a["key"]["kernel"].astype(dtype))
-                 + a["key"]["bias"].astype(dtype))
-            v = (jnp.einsum("bsd,dhk->bshk", h,
-                            a["value"]["kernel"].astype(dtype))
-                 + a["value"]["bias"].astype(dtype))
+
+            def proj(pp):
+                y = jnp.einsum("bsd,dhk->bshk", h,
+                               pp["kernel"].astype(dtype))
+                if "bias" in pp:
+                    y = y + pp["bias"].astype(dtype)
+                return y
+
+            q, k, v = proj(a["query"]), proj(a["key"]), proj(a["value"])
             if c.position_embedding == "rope":
                 # rotate q and THIS k at its own position; cached keys were
                 # rotated when written, matching the full-sequence path
                 pos1 = (positions[:, None] if positions is not None
                         else jnp.full((1,), pos))
-                q = attn_lib.rotary_embedding(q, pos1)
-                k = attn_lib.rotary_embedding(k, pos1)
+                q = attn_lib.rotary_embedding(q, pos1, base=c.rope_base)
+                k = attn_lib.rotary_embedding(k, pos1, base=c.rope_base)
             k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
             v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
             # GQA handled natively by the dense kernel (grouped einsum
             # against the unrepeated cache — no full-head materialization)
             attn = attn_lib.dot_product_attention(q, k_cache, v_cache,
                                                   mask=kv_mask)
-            attn_out = (jnp.einsum("bshk,hkd->bsd", attn,
-                                   a["out"]["kernel"].astype(dtype))
-                        + a["out"]["bias"].astype(dtype))
+            attn_out = jnp.einsum("bshk,hkd->bsd", attn,
+                                  a["out"]["kernel"].astype(dtype))
+            if "bias" in a["out"]:
+                attn_out = attn_out + a["out"]["bias"].astype(dtype)
             x = x + attn_out
             ffn_out, _ = self._ffn(p, x)   # aux unused at decode
             return x + ffn_out, (k_cache, v_cache)
 
         x, (new_k, new_v) = lax.scan(
             body, x, (params["decoder"], cache["k"], cache["v"]))
-        x = _layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        x = self._norm(params["ln_f"], x)
         logits = self.logits(params, x)[:, 0, :]
         return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
 
@@ -840,14 +907,15 @@ class GPT:
                    else P(lead, None, None))
         return PartitionRules([
             (r"embeddings/word$", P("tensor", f)),
+            (r"lm_head$", P("tensor", f)),      # untied head: same split
             (r"embeddings/position$", P(None, None)),
             (r"decoder/attention/query/kernel", P(lead, f, "tensor", None)),
             (r"decoder/attention/query/bias", P(lead, "tensor", None)),
             (r"decoder/attention/(key|value)/kernel", kv_spec),
             (r"decoder/attention/(key|value)/bias", kv_bias),
             (r"decoder/attention/out/kernel", P(lead, "tensor", None, f)),
-            (r"decoder/ffn/w_in/kernel", P(lead, f, "tensor")),
-            (r"decoder/ffn/w_in/bias", P(lead, "tensor")),
+            (r"decoder/ffn/w_(in|gate)/kernel", P(lead, f, "tensor")),
+            (r"decoder/ffn/w_(in|gate)/bias", P(lead, "tensor")),
             (r"decoder/ffn/w_out/kernel", P(lead, "tensor", f)),
             (r"decoder/ffn/w_out/bias", P(lead, None)),
             (r"decoder/attention/out/bias", P(lead, None)),

@@ -1,0 +1,75 @@
+"""Llama model family — GPT decoder configs with the Llama block recipe.
+
+The Llama architecture (Touvron et al., 2023) is this repo's ``GPT``
+decoder with four config switches, so the whole zoo surface — pjit/TP
+sharding, KV-cache ``generate``/``beam_search``, GQA, ring attention,
+pipeline stages, 1F1B — comes along for free:
+
+  * ``norm="rmsnorm"``            (no centering, gamma only)
+  * ``ffn_activation="swiglu"``   (gate/up/down, silu gate)
+  * ``position_embedding="rope"`` (rotate-half convention, = HF)
+  * ``use_bias=False, tied_head=False``
+
+Reference parity note: the reference repo (TF-1.4 parameter-server
+example scripts) has no transformer at all; this family serves the
+driver's model-zoo breadth the same way BERT/ViT do.  HF checkpoint
+interop lives in ``models/convert.py`` (``llama_from_hf``).
+"""
+from __future__ import annotations
+
+from .gpt import GPT, GPTConfig
+
+__all__ = ["llama_config", "llama", "llama_tiny", "llama2_7b", "llama3_8b"]
+
+
+def llama_config(**kw) -> GPTConfig:
+    """A ``GPTConfig`` with the Llama block recipe; any field can still be
+    overridden (e.g. ``pipeline_stages``, ``seq_axis``, ``use_flash``)."""
+    kw.setdefault("norm", "rmsnorm")
+    kw.setdefault("ffn_activation", "swiglu")
+    kw.setdefault("position_embedding", "rope")
+    kw.setdefault("use_bias", False)
+    kw.setdefault("tied_head", False)
+    kw.setdefault("dropout_rate", 0.0)
+    kw.setdefault("layer_norm_eps", 1e-5)
+    return GPTConfig(**kw)
+
+
+def llama(mesh=None, **kw) -> GPT:
+    return GPT(llama_config(**kw), mesh=mesh)
+
+
+def llama_tiny(mesh=None, **kw) -> GPT:
+    """Test-sized Llama (GQA 4q/2kv) — the family's smoke config."""
+    kw.setdefault("vocab_size", 512)
+    kw.setdefault("hidden_size", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("intermediate_size", 256)
+    kw.setdefault("max_position", 128)
+    return llama(mesh=mesh, **kw)
+
+
+def llama2_7b(mesh=None, **kw) -> GPT:
+    """Llama-2-7B dimensions (MHA, 4k context, rope base 10000)."""
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("hidden_size", 4096)
+    kw.setdefault("num_layers", 32)
+    kw.setdefault("num_heads", 32)
+    kw.setdefault("intermediate_size", 11008)
+    kw.setdefault("max_position", 4096)
+    return llama(mesh=mesh, **kw)
+
+
+def llama3_8b(mesh=None, **kw) -> GPT:
+    """Llama-3-8B dimensions (GQA 32q/8kv, 8k context, rope base 500k)."""
+    kw.setdefault("vocab_size", 128256)
+    kw.setdefault("hidden_size", 4096)
+    kw.setdefault("num_layers", 32)
+    kw.setdefault("num_heads", 32)
+    kw.setdefault("num_kv_heads", 8)
+    kw.setdefault("intermediate_size", 14336)
+    kw.setdefault("max_position", 8192)
+    kw.setdefault("rope_base", 500000.0)
+    return llama(mesh=mesh, **kw)

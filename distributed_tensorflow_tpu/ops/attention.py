@@ -29,9 +29,9 @@ from . import initializers as init_lib
 from .layers import Layer
 
 __all__ = ["dot_product_attention", "causal_mask", "padding_mask",
-           "attention_core", "ffn_core", "rotary_embedding", "rope_tables",
-           "apply_rope", "MultiHeadAttention", "flash_wins",
-           "resolve_use_flash"]
+           "attention_core", "ffn_core", "ffn_swiglu_core",
+           "rotary_embedding", "rope_tables", "apply_rope",
+           "MultiHeadAttention", "flash_wins", "resolve_use_flash"]
 
 NEG_INF = -1e9  # finite -inf stand-in: keeps softmax well-defined in f32
 
@@ -188,8 +188,10 @@ def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
     dtype = x.dtype
 
     def project(p, src):
-        return (jnp.einsum("bsd,dhk->bshk", src, p["kernel"].astype(dtype))
-                + p["bias"].astype(dtype))
+        y = jnp.einsum("bsd,dhk->bshk", src, p["kernel"].astype(dtype))
+        if "bias" in p:           # no-bias configs (Llama) omit the key
+            y = y + p["bias"].astype(dtype)
+        return y
 
     memory = x if kv is None else kv.astype(dtype)
     q = project(params["query"], x)
@@ -221,7 +223,9 @@ def attention_core(params, x, *, mask=None, dropout_rate: float = 0.0,
         ctx = jnp.where(drop, ctx / keep, jnp.zeros_like(ctx))
     out = jnp.einsum("bshk,hkd->bsd", ctx,
                      params["out"]["kernel"].astype(dtype))
-    return out + params["out"]["bias"].astype(dtype)
+    if "bias" in params["out"]:
+        out = out + params["out"]["bias"].astype(dtype)
+    return out
 
 
 def ffn_core(params, x, activation=jax.nn.gelu) -> jnp.ndarray:
@@ -233,12 +237,28 @@ def ffn_core(params, x, activation=jax.nn.gelu) -> jnp.ndarray:
     BERT/GPT/seq2seq so dtype/numerics fixes land in exactly one place.
     """
     dtype = x.dtype
-    h = activation(
-        jnp.einsum("bsd,di->bsi", x, params["w_in"]["kernel"].astype(dtype))
-        + params["w_in"]["bias"].astype(dtype))
-    return (jnp.einsum("bsi,id->bsd", h,
-                       params["w_out"]["kernel"].astype(dtype))
-            + params["w_out"]["bias"].astype(dtype))
+    h = activation(_affine(params["w_in"], x, dtype))
+    return _affine(params["w_out"], h, dtype)
+
+
+def _affine(p, x, dtype):
+    """x @ kernel (+ bias when present — no-bias configs like Llama simply
+    omit the key)."""
+    y = jnp.einsum("...d,di->...i", x, p["kernel"].astype(dtype))
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
+def ffn_swiglu_core(params, x, activation=jax.nn.silu) -> jnp.ndarray:
+    """Gated-linear FFN body (Llama / PaLM):
+    ``w_out(silu(w_gate(x)) * w_in(x))`` — ``w_in`` is HF's up_proj,
+    ``w_gate`` gate_proj, ``w_out`` down_proj.  Same param-dict shape
+    conventions and dtype rules as ``ffn_core``."""
+    dtype = x.dtype
+    h = activation(_affine(params["w_gate"], x, dtype)) \
+        * _affine(params["w_in"], x, dtype)
+    return _affine(params["w_out"], h, dtype)
 
 
 class MultiHeadAttention(Layer):
