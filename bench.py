@@ -6,7 +6,7 @@ devices are available (the real TPU chip under the driver; the virtual CPU
 mesh in tests), plus a convergence gate (final eval accuracy must clear the
 per-provenance threshold or the result is reported as failed).
 
-Other configs: ``python bench.py --config=cifar_cnn|resnet50|bert|gpt``
+Other configs: ``python bench.py --config=cifar_cnn|resnet50|bert|gpt|llama``
 measure those rows (same JSON shape; resnet50/bert are throughput+finite-loss
 benches, no convergence gate).  ``DTTPU_BENCH_SMOKE=1`` shrinks model/batch
 sizes so every config path smoke-runs on the CPU mesh.
@@ -646,12 +646,76 @@ def bench_gpt():
                                               config.hidden_size, seq))
 
 
+
+def bench_llama():
+    """Llama-recipe causal-LM training throughput (tokens/s/chip): the
+    same harness as bench_gpt on the rmsnorm/swiglu/rope/GQA decoder
+    (models/llama.py) — the modern-LM row of the matrix."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_tensorflow_tpu import optim, train, parallel
+    from distributed_tensorflow_tpu.models.gpt import GPT
+    from distributed_tensorflow_tpu.models.llama import llama_config
+
+    n_chips = len(jax.devices())
+    mesh = parallel.data_parallel_mesh()
+    seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
+    # ~160M-param body (GPT-2-small-ish dims + GQA 12q/4kv) so the row is
+    # comparable to the gpt row while fitting the v5e ladder comfortably
+    config = (llama_config(vocab_size=512, hidden_size=128, num_layers=2,
+                           num_heads=4, num_kv_heads=2,
+                           intermediate_size=384, max_position=seq,
+                           dtype=jnp.bfloat16) if SMOKE
+              else llama_config(vocab_size=32000, hidden_size=768,
+                                num_layers=12, num_heads=12,
+                                num_kv_heads=4, intermediate_size=2048,
+                                max_position=seq, dtype=jnp.bfloat16))
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    optimizer = optim.adamw(1e-4)
+    step = train.make_custom_train_step(model.lm_loss_fn(), optimizer,
+                                        grad_clip_norm=1.0)
+    rng = np.random.default_rng(0)
+    bsh = NamedSharding(mesh, P("data"))
+
+    def build(batch):
+        state = train.TrainState.create(params, optimizer.init(params))
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+        tokens = rng.integers(0, config.vocab_size,
+                              (batch, seq + 1)).astype(np.int32)
+        bench_batch = jax.device_put({"input_ids": tokens}, bsh)
+        return state, bench_batch
+
+    ladder = ([4] if SMOKE else
+              [max(1, 48 * 256 // seq), max(1, 24 * 256 // seq),
+               max(1, 12 * 256 // seq)])
+    rate, loss, ms, batch, f_total = _run_batch_ladder(
+        "llama", ladder, mesh, build, step,
+        warmup=2, steps=4 if SMOKE else 10)
+    tokens_s = rate * batch * seq / n_chips
+    log(f"llama: {tokens_s:,.0f} tokens/s/chip ({ms*1e3:.1f} ms/step, "
+        f"loss={loss:.3f})")
+    finite = np.isfinite(loss)
+    result = dict(metric="llama_lm_train_tokens_per_sec_per_chip"
+                         + ("" if finite else "_NONFINITE_LOSS"),
+                  value=round(tokens_s, 1), unit="tokens/sec/chip",
+                  vs_baseline=1.0,  # no reference-era Llama baseline exists
+                  seq_len=seq, batch=batch)
+    return _attach_mfu(
+        result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
+        analytic=_transformer_flops_per_token(params, config.num_layers,
+                                              config.hidden_size, seq))
+
+
 CONFIGS = {
     "mnist_mlp": bench_mnist_mlp,
     "cifar_cnn": bench_cifar_cnn,
     "resnet50": bench_resnet50,
     "bert": bench_bert,
     "gpt": bench_gpt,
+    "llama": bench_llama,
 }
 
 

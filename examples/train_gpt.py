@@ -38,6 +38,10 @@ flags_lib.DEFINE_integer("pipeline_stages", 0,
 flags_lib.DEFINE_string("pp_schedule", "gpipe",
                         "pipeline schedule: gpipe (autodiff backward) | "
                         "1f1b (hand-scheduled, O(stages) activation memory)")
+flags_lib.DEFINE_string("family", "gpt2",
+                        "decoder recipe: gpt2 (layernorm/gelu/learned "
+                        "positions) | llama (rmsnorm/swiglu/rope/GQA, "
+                        "models/llama.py)")
 FLAGS = flags_lib.FLAGS
 
 
@@ -81,11 +85,17 @@ def main() -> int:
         print("pp on XLA:CPU: falling back to f32 activations (bf16 "
               "pipeline programs trip an XLA:CPU compiler bug)",
               file=sys.stderr)
-    config = GPTConfig(vocab_size=256, num_layers=FLAGS.num_layers,
-                       num_heads=4,
-                       hidden_size=128, max_position=FLAGS.seq_len,
-                       dtype=jnp.float32 if pp_cpu else jnp.bfloat16,
-                       pipeline_stages=pp if pp > 1 else 0)
+    dims = dict(vocab_size=256, num_layers=FLAGS.num_layers, num_heads=4,
+                hidden_size=128, max_position=FLAGS.seq_len,
+                dtype=jnp.float32 if pp_cpu else jnp.bfloat16,
+                pipeline_stages=pp if pp > 1 else 0)
+    if FLAGS.family == "llama":
+        from distributed_tensorflow_tpu.models.llama import llama_config
+        config = llama_config(num_kv_heads=2, **dims)
+    elif FLAGS.family == "gpt2":
+        config = GPTConfig(**dims)
+    else:
+        raise SystemExit(f"--family={FLAGS.family!r}: gpt2|llama")
     model = GPT(config, mesh=mesh if pp > 1 else None)
     optimizer = optim.with_ema(optim.adamw(3e-3), decay=0.99)
 
