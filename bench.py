@@ -6,7 +6,7 @@ devices are available (the real TPU chip under the driver; the virtual CPU
 mesh in tests), plus a convergence gate (final eval accuracy must clear the
 per-provenance threshold or the result is reported as failed).
 
-Other configs: ``python bench.py --config=cifar_cnn|resnet50|bert|gpt|llama``
+Other configs: ``python bench.py --config=cifar_cnn|resnet50|bert|gpt|llama|gpt_decode``
 measure those rows (same JSON shape; resnet50/bert are throughput+finite-loss
 benches, no convergence gate).  ``DTTPU_BENCH_SMOKE=1`` shrinks model/batch
 sizes so every config path smoke-runs on the CPU mesh.
@@ -709,6 +709,54 @@ def bench_llama():
                                               config.hidden_size, seq))
 
 
+
+def bench_gpt_decode():
+    """Serving-side decode throughput (tokens/s/chip): greedy KV-cache
+    generation on the GPT-2-small decoder, bf16.  The timed window covers
+    decode_step dispatches only (prompt prefill excluded) and closes with
+    a value fetch of the emitted tokens (docs/PERF.md methodology)."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+
+    n_chips = len(jax.devices())
+    seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
+    config = (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=2, intermediate_size=512,
+                        max_position=seq, dtype=jnp.bfloat16,
+                        dropout_rate=0.0) if SMOKE
+              else GPTConfig(vocab_size=50257, hidden_size=768,
+                             num_layers=12, num_heads=12,
+                             intermediate_size=3072, max_position=seq,
+                             dtype=jnp.bfloat16, dropout_rate=0.0))
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = 4 if SMOKE else 64
+    prompt_len = 8
+    new_tokens = 16 if SMOKE else seq - prompt_len
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, config.vocab_size,
+                          (batch, prompt_len)).astype(np.int32)
+
+    gen = jax.jit(lambda p, ids: model.generate(
+        p, ids, max_new_tokens=new_tokens, temperature=0.0, max_len=seq))
+    np.asarray(gen(params, prompt))              # compile + prefill warmup
+    t0 = _time.perf_counter()
+    out = gen(params, prompt)
+    np.asarray(out)                              # value fetch closes window
+    dt = _time.perf_counter() - t0
+    tokens_s = batch * new_tokens / dt / n_chips
+    log(f"gpt_decode: {tokens_s:,.0f} tokens/s/chip "
+        f"({dt * 1e3 / new_tokens:.2f} ms/token at batch {batch})")
+    return dict(metric="gpt_decode_tokens_per_sec_per_chip",
+                value=round(tokens_s, 1), unit="tokens/sec/chip",
+                vs_baseline=1.0,  # no reference-era decode baseline exists
+                batch=batch, new_tokens=new_tokens, seq_len=seq)
+
+
 CONFIGS = {
     "mnist_mlp": bench_mnist_mlp,
     "cifar_cnn": bench_cifar_cnn,
@@ -716,6 +764,7 @@ CONFIGS = {
     "bert": bench_bert,
     "gpt": bench_gpt,
     "llama": bench_llama,
+    "gpt_decode": bench_gpt_decode,
 }
 
 
