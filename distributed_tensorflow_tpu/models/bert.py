@@ -52,9 +52,9 @@ class BertConfig:
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
     # True / False / "auto": auto dispatches the fused Pallas kernel on TPU
     # at seq >= the measured crossover (ops.attention.resolve_use_flash).
-    # Default stays False until the round-3 fused BACKWARD kernels pass
-    # hardware validation (docs/PERF.md) — flip to "auto" once measured.
-    use_flash: Any = False
+    # Hardware-validated + measured 2026-07-31 (docs/PERF.md): ties XLA at
+    # seq <= 1024, wins 1.3-1.7x at 2048, ~3x at 4096 — "auto" is safe.
+    use_flash: Any = "auto"
     # FFN / MLM-transform activation: "gelu_approx" (tanh, the GPT-2/zoo
     # default) or "gelu" (exact erf — what HF BERT checkpoints were
     # trained with; models/convert.py sets this)

@@ -50,7 +50,10 @@ class ViTConfig:
     # erf — HF ViT checkpoints; models/convert.py sets this)
     hidden_act: str = "gelu_approx"
     remat: bool = False
-    use_flash: bool = False
+    # True / False / "auto" (ops.attention.resolve_use_flash); ViT seq is
+    # (image/patch)^2+1 — 197 for 224/16 — so "auto" stays on XLA until
+    # high-resolution inputs push past the measured seq-2048 crossover.
+    use_flash: Any = "auto"
 
     @property
     def head_dim(self) -> int:

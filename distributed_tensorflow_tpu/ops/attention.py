@@ -36,11 +36,13 @@ __all__ = ["dot_product_attention", "causal_mask", "padding_mask",
 NEG_INF = -1e9  # finite -inf stand-in: keeps softmax well-defined in f32
 
 # Sequence length at/above which the fused Pallas flash kernel dispatches
-# under use_flash="auto".  At seq 512 plain XLA wins on v5e (103.9k vs
-# 85.7k tok/s, docs/PERF.md); the kernel's O(seq) memory advantage and
-# blockwise compute pay off as the logits matrix grows.  Override with
-# DTTPU_FLASH_MIN_SEQ; re-calibrate against hardware measurements.
-_FLASH_MIN_SEQ_DEFAULT = 1024
+# under use_flash="auto".  Measured on v5e with (512, 1024) blocks and
+# RTT-amortised scan timing (docs/PERF.md, 2026-07-31): flash ties XLA at
+# seq <= 1024 (0.95x), wins 1.3-1.7x at 2048 and ~3x at 4096 — XLA's
+# materialised s^2 logits hit memory pressure exactly where the kernel's
+# O(seq) streaming pays off.  Override with DTTPU_FLASH_MIN_SEQ;
+# re-calibrate with scripts/validate_flash_tpu.py on new hardware.
+_FLASH_MIN_SEQ_DEFAULT = 2048
 
 
 def flash_wins(seq_len: int) -> bool:

@@ -26,6 +26,12 @@ identical code; NOTE interpret mode has hidden Mosaic tiling violations
 before (docs/PERF.md) — hardware validation is required before claiming a
 measured win.
 
+Default blocks (512, 1024), clamped to seq, come from the 2026-07-31
+hardware sweep (scripts/sweep_flash_blocks.py): the 128x128 blocks the
+kernel started with spend ~33us of per-grid-step overhead on thousands of
+tiny sequential steps, losing to XLA everywhere; 4x-fatter blocks win
+1.6x at seq 2048 and ~3x at 4096 (docs/PERF.md has the full table).
+
 Reference parity note: the reference repo has no attention at all (its model
 is an MLP, reference example.py:149-155); this kernel serves the BERT/GPT
 model families the driver's baseline configs require.
@@ -444,7 +450,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     kv_valid: Optional[jnp.ndarray] = None,
                     causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 1024,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
     """Fused attention.  q: [batch, seq, heads, head_dim] (the
     framework-wide head layout, see ops.attention); k, v:
@@ -478,8 +484,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return jnp.swapaxes(out, 1, 2)
 
 
-def make_flash_attention_fn(causal: bool = False, block_q: int = 128,
-                            block_k: int = 128):
+def make_flash_attention_fn(causal: bool = False, block_q: int = 512,
+                            block_k: int = 1024):
     """Adapter matching the ``attention_fn(q, k, v, mask=...)`` slot of
     ``ops.attention.attention_core``.
 

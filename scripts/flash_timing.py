@@ -26,11 +26,29 @@ def require_tpu() -> bool:
 def time_fwd_bwd(attn_loss, q, k, v, n: int = 20) -> float:
     """Seconds per fwd+bwd step of ``attn_loss(q, k, v)``, value-fetch
     closed (docs/PERF.md methodology: block_until_ready can return before
-    the tunneled execution finishes; fetching the last value cannot)."""
-    g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
-    g(q, k, v)[0].block_until_ready()   # compile
+    the tunneled execution finishes; fetching the last value cannot).
+
+    The n steps run inside ONE compiled ``lax.scan`` dispatch, chained by a
+    tiny grad feedback so no step can be folded away: over the tunnel each
+    dispatch is an HTTP round trip whose latency tracks host load, and a
+    per-step dispatch loop was measured to swing the same config 10x
+    between runs (docs/PERF.md).  One dispatch amortises the RTT n ways,
+    so the window measures the chip, not the tunnel."""
+    g = jax.grad(attn_loss, argnums=(0, 1, 2))
+
+    def step(carry, _):
+        q, k, v = carry
+        dq, dk, dv = g(q, k, v)
+        eps = jnp.asarray(1e-6, q.dtype)
+        return ((q + eps * dq, k + eps * dk, v + eps * dv),
+                jnp.sum(dq.astype(jnp.float32)))
+
+    @jax.jit
+    def run(q, k, v):
+        (_, _, _), ys = jax.lax.scan(step, (q, k, v), None, length=n)
+        return ys[-1]
+
+    float(run(q, k, v))                 # compile + first execute
     t0 = time.perf_counter()
-    for _ in range(n):
-        out = g(q, k, v)
-    float(jnp.sum(out[0].astype(jnp.float32)))
+    float(run(q, k, v))                 # fetch closes the window
     return (time.perf_counter() - t0) / n
