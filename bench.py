@@ -594,14 +594,19 @@ def _gpt_bench_config(seq):
     import jax.numpy as jnp
     from distributed_tensorflow_tpu.models.gpt import GPTConfig
 
+    # remat=True: the layer-scan otherwise saves every activation for
+    # backward and OOMs a 16G chip at batch 48/seq 256; rematerialising
+    # measured FASTER at equal batch too (scripts/tune_gpt_batch.py,
+    # 2026-07-31: 120k tok/s at remat batch 48 vs 101-108k no-remat 24)
     return (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                       num_heads=2, intermediate_size=512,
                       max_position=seq, dtype=jnp.bfloat16,
-                      dropout_rate=0.0) if SMOKE
+                      dropout_rate=0.0, remat=True) if SMOKE
             else GPTConfig(vocab_size=50257, hidden_size=768,
                            num_layers=12, num_heads=12,
                            intermediate_size=3072, max_position=seq,
-                           dtype=jnp.bfloat16, dropout_rate=0.0))
+                           dtype=jnp.bfloat16, dropout_rate=0.0,
+                           remat=True))
 
 
 def bench_gpt():
@@ -674,14 +679,17 @@ def bench_llama():
     seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
     # ~160M-param body (GPT-2-small-ish dims + GQA 12q/4kv) so the row is
     # comparable to the gpt row while fitting the v5e ladder comfortably
+    # remat=True for the same reason as _gpt_bench_config: bigger ladder
+    # rungs fit and the rematerialised step measured faster at equal batch
     config = (llama_config(vocab_size=512, hidden_size=128, num_layers=2,
                            num_heads=4, num_kv_heads=2,
                            intermediate_size=384, max_position=seq,
-                           dtype=jnp.bfloat16) if SMOKE
+                           dtype=jnp.bfloat16, remat=True) if SMOKE
               else llama_config(vocab_size=32000, hidden_size=768,
                                 num_layers=12, num_heads=12,
                                 num_kv_heads=4, intermediate_size=2048,
-                                max_position=seq, dtype=jnp.bfloat16))
+                                max_position=seq, dtype=jnp.bfloat16,
+                                remat=True))
     model = GPT(config)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(1e-4)
