@@ -148,3 +148,19 @@ class TestProvenance:
         np.savez(tmp_path / "cifar10.npz", x_train=x, y_train=y,
                  x_test=x, y_test=y)
         assert data.provenance("cifar10", str(tmp_path)) == "real"
+
+
+class TestGptLong:
+    def test_gpt_long_renames_metric_and_respects_seq_override(self):
+        """gpt_long is the gpt row pinned at seq 2048 (the flash-dispatch
+        operating point); an explicit DTTPU_BENCH_SEQ still wins so the
+        smoke test doesn't pay a 2048-seq CPU run."""
+        proc = _run(["--config=gpt_long", "--device=cpu"],
+                    _env(DTTPU_BENCH_SEQ=128))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1
+        r = json.loads(lines[0])
+        assert r["metric"].startswith("gpt_long_lm_train_tokens_per_sec")
+        assert r["seq_len"] == 128
+        assert r["value"] > 0
