@@ -769,6 +769,57 @@ def bench_gpt_decode():
                 batch=batch, new_tokens=new_tokens, seq_len=seq)
 
 
+def bench_gpt_decode_int8():
+    """Weight-only int8 decode (ops.quant): the int8 tree is the jitted
+    ``generate``'s argument and ``dequantize_tree`` runs INSIDE the jit,
+    so weights stay int8 in HBM (4x smaller reads — decode is
+    bandwidth-bound) and the scale multiply fuses into the matmul
+    prologue.  Reports the int8 rate plus the fp rate measured in the
+    same run and the greedy-token agreement between the two paths — the
+    honesty signal that rounding didn't change the decoded text."""
+    import jax
+    import numpy as np
+    from distributed_tensorflow_tpu.models.gpt import GPT
+    from distributed_tensorflow_tpu.ops import quant
+
+    seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
+    config = _gpt_bench_config(seq)
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    qparams = quant.quantize_tree(params)
+    batch = 4 if SMOKE else 64
+    prompt_len = 8
+    new_tokens = 16 if SMOKE else seq - prompt_len
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, config.vocab_size,
+                          (batch, prompt_len)).astype(np.int32)
+
+    gen_fp = jax.jit(lambda p, ids: model.generate(
+        p, ids, max_new_tokens=new_tokens, temperature=0.0, max_len=seq))
+    gen_q = jax.jit(lambda qp, ids: model.generate(
+        quant.dequantize_tree(qp), ids, max_new_tokens=new_tokens,
+        temperature=0.0, max_len=seq))
+
+    def timed(fn, args):
+        np.asarray(fn(*args))                    # compile + warmup
+        t0 = time.perf_counter()
+        out = fn(*args)
+        toks = np.asarray(out)                   # value fetch closes window
+        return batch * new_tokens / (time.perf_counter() - t0), toks
+
+    fp_rate, fp_toks = timed(gen_fp, (params, prompt))
+    q_rate, q_toks = timed(gen_q, (qparams, prompt))
+    match = float(np.mean(fp_toks[:, prompt_len:] == q_toks[:, prompt_len:]))
+    log(f"gpt_decode_int8: {q_rate:,.0f} tokens/s/chip vs fp "
+        f"{fp_rate:,.0f} ({q_rate / fp_rate:.2f}x), greedy match "
+        f"{match:.3f}")
+    return dict(metric="gpt_decode_int8_tokens_per_sec_per_chip",
+                value=round(q_rate, 1), unit="tokens/sec/chip",
+                vs_baseline=round(q_rate / fp_rate, 3),  # fp path, same run
+                fp_value=round(fp_rate, 1), greedy_token_match=round(match, 4),
+                batch=batch, new_tokens=new_tokens, seq_len=seq)
+
+
 def bench_gpt_long():
     """The gpt row at seq 2048 — the long-context operating point where
     ``use_flash="auto"`` actually dispatches the fused Pallas kernel on
@@ -790,6 +841,7 @@ CONFIGS = {
     "gpt_long": bench_gpt_long,
     "llama": bench_llama,
     "gpt_decode": bench_gpt_decode,
+    "gpt_decode_int8": bench_gpt_decode_int8,
 }
 
 

@@ -164,3 +164,17 @@ class TestGptLong:
         assert r["metric"].startswith("gpt_long_lm_train_tokens_per_sec")
         assert r["seq_len"] == 128
         assert r["value"] > 0
+
+    def test_gpt_decode_int8_smoke(self):
+        """int8 decode measures both paths in one run and reports their
+        greedy-token agreement; on the smoke model the two paths must
+        agree on nearly every token or the quant path is broken."""
+        proc = _run(["--config=gpt_decode_int8", "--device=cpu"],
+                    _env(DTTPU_BENCH_SEQ=64))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1
+        r = json.loads(lines[0])
+        assert r["metric"].startswith("gpt_decode_int8_tokens_per_sec")
+        assert r["value"] > 0 and r["fp_value"] > 0
+        assert r["greedy_token_match"] > 0.9
