@@ -130,13 +130,17 @@ class Dataset:
 
 
 def prefetch_to_device(iterator: Iterable, size: int = 2,
-                       sharding=None) -> Iterator:
+                       sharding=None, sharding_fn=None) -> Iterator:
     """Asynchronously stage upcoming batches onto device(s).
 
     A background thread uploads with ``jax.device_put`` (laid out per
     ``sharding`` when given, so multi-chip batches land already sharded over
     the mesh's data axis) while the current step computes — replacing the
     reference's per-step synchronous ``feed_dict`` upload.
+
+    ``sharding_fn``: optional ``item -> sharding`` override for streams
+    whose items need different layouts (Sequential's steps_per_execution
+    mixes [K, batch, ...] groups with plain-batch epoch tails).
     """
     queue: collections.deque = collections.deque()
     sem = threading.Semaphore(size)
@@ -144,13 +148,14 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
     err: list = []
 
     def put(item):
-        if sharding is not None and jax.process_count() > 1:
+        sh = sharding_fn(item) if sharding_fn is not None else sharding
+        if sh is not None and jax.process_count() > 1:
             # Multi-host: each process holds only its local shard; assemble
             # the global array from per-process data.
             return jax.tree.map(
-                lambda a: jax.make_array_from_process_local_data(sharding, a),
+                lambda a: jax.make_array_from_process_local_data(sh, a),
                 item)
-        return jax.device_put(item, sharding)
+        return jax.device_put(item, sh)
 
     def producer():
         try:

@@ -428,7 +428,10 @@ def with_lr_scale(optimizer: Optimizer) -> Optimizer:
 
     def init(params):
         inner = optimizer.init(params)
-        return OptState(inner.count,
+        # A fresh zero, not inner.count itself: the same concrete array in
+        # two pytree slots breaks buffer donation at the first dispatch
+        # (`donate(a), donate(a)`) — values equal, buffers must not be.
+        return OptState(jnp.zeros_like(inner.count),
                         {"scale": jnp.ones((), jnp.float32), "inner": inner})
 
     def update(grads, state: OptState, params=None):
