@@ -26,6 +26,9 @@ flags_lib.DEFINE_string("log_dir",
                         "TensorBoard directory; '{}' gets a timestamp")
 flags_lib.DEFINE_integer("epochs", 10, "Training epochs")
 flags_lib.DEFINE_integer("batch_size", 256, "Global batch size")
+flags_lib.DEFINE_integer("steps_per_execution", 1,
+                         "Optimizer updates per compiled dispatch (K>1 "
+                         "amortizes host->device latency for small models)")
 flags_lib.DEFINE_integer("seed", 0, "PRNG seed")
 
 
@@ -51,7 +54,8 @@ def main() -> int:
 
     model = models.Sequential(models.cifar_cnn().layers, name="cifar_cnn")
     model.compile(loss="sparse_categorical_crossentropy", optimizer="adam",
-                  metrics=["accuracy"], mesh=mesh, seed=FLAGS.seed)
+                  metrics=["accuracy"], mesh=mesh, seed=FLAGS.seed,
+                  steps_per_execution=FLAGS.steps_per_execution)
 
     tensorboard = models.TensorBoard(log_dir=FLAGS.log_dir.format(time()))
     # Standard CIFAR recipe: pad-reflect crop + horizontal flip, host-side,
