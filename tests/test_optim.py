@@ -332,3 +332,36 @@ def test_polynomial_decay_schedule():
     # cycle=True restarts the horizon instead of clamping
     c = schedules.polynomial_decay(1.0, 100, end_value=0.1, cycle=True)
     assert float(c(jnp.asarray(150))) > 0.1
+
+
+def test_no_aliased_buffers_in_fresh_state():
+    """Every optimizer (and the lr-scale/EMA wrapper compositions) must
+    initialize a TrainState whose leaves all own distinct buffers: one
+    buffer appearing in two pytree slots breaks donation at the first
+    dispatch ("Attempt to donate the same buffer twice") — the bug
+    with_lr_scale had when it mirrored inner.count."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu import optim, train
+    from distributed_tensorflow_tpu.optim import optimizers as opt_mod
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    builders = {name: (lambda n=name: optim.get(n))
+                for name in sorted(opt_mod._REGISTRY)}
+    builders["lr_scale(adam)"] = lambda: opt_mod.with_lr_scale(optim.adam())
+    builders["ema(adam)"] = lambda: optim.with_ema(optim.adam())
+    builders["lr_scale(ema(adam))"] = (
+        lambda: opt_mod.with_lr_scale(optim.with_ema(optim.adam())))
+    for label, build in builders.items():
+        opt = build()
+        state = train.TrainState.create(params, opt.init(params))
+        seen = {}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state):
+            try:
+                ptr = leaf.unsafe_buffer_pointer()
+            except Exception:
+                continue
+            assert ptr not in seen, (
+                f"{label}: {jax.tree_util.keystr(path)} shares a buffer "
+                f"with {seen[ptr]}")
+            seen[ptr] = jax.tree_util.keystr(path)
