@@ -175,6 +175,12 @@ class Sequential:
         ``(x, y)`` before shuffling (Keras semantics) when no explicit
         ``validation_data`` is given.
 
+        Epoch ``logs``/History values are the LATEST compiled-step metrics
+        (pulled at sync points), not Keras's running epoch mean: averaging
+        on the host would force a device sync per batch and stall the
+        async dispatch queue.  With converged-ish training the two agree;
+        exact per-epoch means are available via ``evaluate()``.
+
         ``class_weight``: {class_id: weight} applied to the TRAINING loss
         (Keras semantics; validation stays unweighted).  Requires a
         string classification loss (see ``ops.losses.class_weighted``);
