@@ -506,10 +506,11 @@ class Sequential:
         outs = []
         x = np.asarray(x)
         for lo in range(0, x.shape[0], batch_size):
-            outs.append(np.asarray(apply_fn(
-                self.state.params, self.state.model_state,
-                x[lo:lo + batch_size])))
-        return np.concatenate(outs, axis=0)
+            # device arrays, un-pulled: dispatch the whole stream async,
+            # convert once at the end (one sync, not one per batch)
+            outs.append(apply_fn(self.state.params, self.state.model_state,
+                                 x[lo:lo + batch_size]))
+        return np.concatenate([np.asarray(o) for o in outs], axis=0)
 
     # -- flat weights access (Keras get_weights/set_weights analogue) ----
     def _layer_leaves(self):
