@@ -15,8 +15,8 @@ Supervisor layer (the default entry): the axon TPU tunnel can hang
 indefinitely during backend init, so the bench re-runs itself as a child
 subprocess — a hung attempt is killed and retried in a FRESH process (the
 hang is in first-touch backend init; a second attempt often wins tunnel
-flakes), and if the tunnel is down hard the final attempt measures on the
-virtual 8-device CPU mesh and labels the metric ``*_CPU_FALLBACK``.  The
+flakes), and if the tunnel is down hard the final attempt measures on
+single-device XLA:CPU and labels the metric ``*_CPU_FALLBACK``.  The
 driver therefore always receives a nonzero, honestly-labeled number.
 Env knobs: ``DTTPU_BENCH_TPU_ATTEMPTS`` (default 2),
 ``DTTPU_BENCH_INIT_TIMEOUT`` (total backend-init budget, split across
@@ -911,6 +911,13 @@ def supervise(config: str) -> int:
     # the dryrun and the mesh test suite; the fallback's one job is an
     # honest per-device liveness number.
     cenv = dict(env, DTTPU_BENCH_ATTEMPT="-1")
+    # The flag may also arrive FROM the environment (the test suite and CI
+    # export it process-wide) — force it to 1 rather than merely not adding
+    # it, or the child silently runs the 8-way mesh anyway.
+    flags = [f for f in cenv.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count=1")
+    cenv["XLA_FLAGS"] = " ".join(flags)
     if config != "mnist_mlp":
         # Full-size conv/transformer configs are too slow for a bounded CPU
         # run; the smoke-sized number is still nonzero and labeled.
