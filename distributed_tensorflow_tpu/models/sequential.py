@@ -71,7 +71,8 @@ class Sequential:
                 metrics: Sequence = (),
                 mesh=None, params_spec=None, seed: int = 0,
                 grad_clip_norm: Optional[float] = None,
-                policy=None, steps_per_execution: int = 1) -> None:
+                policy=None, steps_per_execution: int = 1,
+                grad_accum_steps: int = 1) -> None:
         """reference example2.py:165 parity: strings or callables/objects.
 
         ``policy``: mixed-precision spec (e.g. ``"mixed_bfloat16"``) applied
@@ -88,6 +89,13 @@ class Sequential:
         shorter than K fall back to the single-step path.  fit() with
         ``sample_weight``/``class_weight`` ignores it (those compile
         dedicated single-step programs) — a one-line log says so.
+
+        ``grad_accum_steps``: split each batch into that many microbatches
+        inside the step (train/step.py gradient accumulation): ONE
+        optimizer update from the averaged gradients, peak activation
+        memory down ~accum-fold — the HBM lever when the target batch
+        doesn't fit.  Requires ``fit(batch_size=...)`` divisible by it;
+        composes with ``steps_per_execution``.
         """
         loss_fn = loss_lib.get(loss)
         # with_lr_scale: LearningRateScheduler / ReduceLROnPlateau mutate a
@@ -99,9 +107,13 @@ class Sequential:
             metric_fns[getattr(fn, "__name__", str(m))] = fn
         # ONE kwargs dict builds the default step AND any class-weighted
         # sibling fit() compiles later — they can never drift apart.
+        if grad_accum_steps < 1:
+            raise ValueError(
+                f"grad_accum_steps must be >= 1; got {grad_accum_steps}")
         step_kwargs = dict(metric_fns=metric_fns, seed=seed, mesh=mesh,
                            params_spec=params_spec,
-                           grad_clip_norm=grad_clip_norm, policy=policy)
+                           grad_clip_norm=grad_clip_norm, policy=policy,
+                           accum_steps=int(grad_accum_steps))
         if steps_per_execution < 1:
             raise ValueError(
                 f"steps_per_execution must be >= 1; got {steps_per_execution}")
@@ -130,7 +142,8 @@ class Sequential:
         self._compile_config = dict(
             loss=loss, optimizer=optimizer, metrics=list(metrics),
             seed=seed, grad_clip_norm=grad_clip_norm, policy=policy,
-            steps_per_execution=int(steps_per_execution)
+            steps_per_execution=int(steps_per_execution),
+            grad_accum_steps=int(grad_accum_steps)
         ) if serializable else None
         # Recompile keeps the weights but resets the optimizer state for
         # the new optimizer (Keras recompile semantics) — also what lets
@@ -200,6 +213,20 @@ class Sequential:
         """
         c = self._require_compiled()
         train_step = c["train_step"]
+        accum = c["step_kwargs"].get("accum_steps", 1)
+        if accum > 1:
+            if sample_weight is not None or class_weight is not None:
+                # per-microbatch weighted means averaged equally are NOT the
+                # full-batch weighted mean when the weight mass differs per
+                # microbatch — refuse rather than silently bias gradients
+                raise ValueError(
+                    "grad_accum_steps > 1 composes only with the unweighted "
+                    "loss path; drop sample_weight/class_weight or recompile "
+                    "with grad_accum_steps=1")
+            if batch_size % accum:
+                raise ValueError(
+                    f"batch_size {batch_size} is not divisible by "
+                    f"grad_accum_steps {accum}")
         if sample_weight is not None:
             if class_weight is not None:
                 raise ValueError(

@@ -380,6 +380,7 @@ def make_multi_train_step(model, loss, optimizer: opt_lib.Optimizer,
                           params_spec: Any = None,
                           batch_spec: P = P("data"),
                           grad_clip_norm: Optional[float] = None,
+                          accum_steps: int = 1,
                           policy: Any = None,
                           loss_scale: bool = False) -> Callable:
     """``step(state, (xs, ys)) -> (state, metrics)`` running
@@ -396,7 +397,8 @@ def make_multi_train_step(model, loss, optimizer: opt_lib.Optimizer,
     """
     inner = make_train_step(model, loss, optimizer, metric_fns=metric_fns,
                             seed=seed, jit=False,
-                            grad_clip_norm=grad_clip_norm, policy=policy,
+                            grad_clip_norm=grad_clip_norm,
+                            accum_steps=accum_steps, policy=policy,
                             loss_scale=loss_scale)
 
     def multi(state: TrainState, batch):

@@ -755,3 +755,56 @@ class TestStepsPerExecution:
                       steps_per_execution=3)
         hist = model.fit(xt, yt, epochs=2, batch_size=56, verbose=0)
         assert np.isfinite(hist.history["loss"][-1])
+
+
+class TestGradAccum:
+    """compile(grad_accum_steps=A): microbatched gradients, one update."""
+
+    def _fit(self, accum, spe=1):
+        import jax
+        (xt, yt), _ = data.xor_data(600, val_size=64, seed=0)
+        model = models.Sequential([ops.Dense(64, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="adam",
+                      grad_accum_steps=accum, steps_per_execution=spe)
+        hist = model.fit(xt, yt, epochs=2, batch_size=50, verbose=0,
+                         shuffle=True, seed=3)
+        return jax.device_get(model.state.params), hist
+
+    def test_accum_matches_full_batch(self):
+        """Mean-loss microbatch averaging reproduces the full-batch
+        gradient; weights must match the accum=1 run to float tolerance."""
+        import jax
+        p1, _ = self._fit(1)
+        p2, _ = self._fit(2)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(a, b, atol=5e-5)
+
+    def test_accum_composes_with_steps_per_execution(self):
+        import jax
+        p1, _ = self._fit(1)
+        p, h = self._fit(2, spe=4)
+        assert np.isfinite(h.history["loss"][-1])
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p)):
+            np.testing.assert_allclose(a, b, atol=5e-5)
+
+    def test_weighted_fit_refused(self):
+        import pytest
+        (xt, yt), _ = data.xor_data(100, val_size=8, seed=0)
+        model = models.Sequential([ops.Dense(8, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="sgd",
+                      grad_accum_steps=2)
+        with pytest.raises(ValueError, match="unweighted"):
+            model.fit(xt, yt, epochs=1, batch_size=50, verbose=0,
+                      sample_weight=np.ones(len(xt), np.float32))
+
+    def test_indivisible_batch_refused(self):
+        import pytest
+        (xt, yt), _ = data.xor_data(100, val_size=8, seed=0)
+        model = models.Sequential([ops.Dense(8, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="sgd",
+                      grad_accum_steps=3)
+        with pytest.raises(ValueError, match="divisible"):
+            model.fit(xt, yt, epochs=1, batch_size=50, verbose=0)
