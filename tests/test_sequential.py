@@ -808,3 +808,23 @@ class TestGradAccum:
                       grad_accum_steps=3)
         with pytest.raises(ValueError, match="divisible"):
             model.fit(xt, yt, epochs=1, batch_size=50, verbose=0)
+
+    def test_compile_config_roundtrips_through_save(self, tmp_path):
+        """steps_per_execution/grad_accum_steps survive model.save ->
+        load_model (compile_config is re-applied verbatim)."""
+        (xt, yt), _ = data.xor_data(200, val_size=8, seed=0)
+        model = models.Sequential([ops.Dense(16, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="adam",
+                      steps_per_execution=4, grad_accum_steps=2)
+        model.fit(xt, yt, epochs=1, batch_size=50, verbose=0)
+        path = str(tmp_path / "m")
+        model.save(path)
+        loaded = models.load_model(path)
+        cc = loaded._compile_config
+        assert cc["steps_per_execution"] == 4
+        assert cc["grad_accum_steps"] == 2
+        assert loaded._compiled["steps_per_execution"] == 4
+        assert loaded._compiled["multi_train_step"] is not None
+        hist = loaded.fit(xt, yt, epochs=1, batch_size=50, verbose=0)
+        assert np.isfinite(hist.history["loss"][0])
