@@ -615,8 +615,12 @@ class GPT:
                                           )[:, None, None, :]
 
         def body(carry, inputs):
-            x = carry
-            p, k_cache, v_cache = inputs
+            # The caches ride the CARRY, not the scanned xs/ys: as ys each
+            # layer would write its FULL [b, max_len, h, d] cache back out
+            # every token (~600 MB/step at the bench shapes) when only one
+            # row changes; as carry the updates are in-place row writes.
+            x, k_all, v_all = carry
+            p, i = inputs
 
             h = self._norm(p["ln_1"], x)
             a = p["attention"]
@@ -637,8 +641,15 @@ class GPT:
                         else jnp.full((1,), pos))
                 q = attn_lib.rotary_embedding(q, pos1, base=c.rope_base)
                 k = attn_lib.rotary_embedding(k, pos1, base=c.rope_base)
-            k_cache = lax.dynamic_update_slice_in_dim(k_cache, k, pos, axis=1)
-            v_cache = lax.dynamic_update_slice_in_dim(v_cache, v, pos, axis=1)
+            zero = jnp.zeros((), jnp.int32)
+            # write ONLY the new row [1, b, 1, h, d] into the 5-D carry,
+            # then slice this layer's cache out for the attention read
+            k_all = lax.dynamic_update_slice(k_all, k[None].astype(
+                k_all.dtype), (i, zero, pos, zero, zero))
+            v_all = lax.dynamic_update_slice(v_all, v[None].astype(
+                v_all.dtype), (i, zero, pos, zero, zero))
+            k_cache = lax.dynamic_index_in_dim(k_all, i, keepdims=False)
+            v_cache = lax.dynamic_index_in_dim(v_all, i, keepdims=False)
             # GQA handled natively by the dense kernel (grouped einsum
             # against the unrepeated cache — no full-head materialization)
             attn = attn_lib.dot_product_attention(q, k_cache, v_cache,
@@ -649,10 +660,11 @@ class GPT:
                 attn_out = attn_out + a["out"]["bias"].astype(dtype)
             x = x + attn_out
             ffn_out, _ = self._ffn(p, x)   # aux unused at decode
-            return x + ffn_out, (k_cache, v_cache)
+            return (x + ffn_out, k_all, v_all), None
 
-        x, (new_k, new_v) = lax.scan(
-            body, x, (params["decoder"], cache["k"], cache["v"]))
+        (x, new_k, new_v), _ = lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["decoder"], jnp.arange(c.num_layers)))
         x = self._norm(params["ln_f"], x)
         logits = self.logits(params, x)[:, 0, :]
         return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
