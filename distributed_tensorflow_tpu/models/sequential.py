@@ -188,11 +188,13 @@ class Sequential:
         ``(x, y)`` before shuffling (Keras semantics) when no explicit
         ``validation_data`` is given.
 
-        Epoch ``logs``/History values are the LATEST compiled-step metrics
-        (pulled at sync points), not Keras's running epoch mean: averaging
-        on the host would force a device sync per batch and stall the
-        async dispatch queue.  With converged-ish training the two agree;
-        exact per-epoch means are available via ``evaluate()``.
+        Epoch ``logs``/History values are the SAMPLED running mean of
+        compiled-step metrics — every dispatch pulled at a sync point
+        contributes (all K of a multi-step group).  Pulling every batch
+        would stall the async dispatch queue, so on TPU the mean samples
+        every ~50th dispatch; on the CPU mesh (sync_every=1) it is exactly
+        Keras's epoch mean of batch metrics.  Exact full-data means are
+        available via ``evaluate()``.
 
         ``class_weight``: {class_id: weight} applied to the TRAINING loss
         (Keras semantics; validation stays unweighted).  Requires a
@@ -339,7 +341,8 @@ class Sequential:
             sync_every = (1 if jax.devices()[0].platform == "cpu"
                           and c["mesh"] is not None else 50)
             last_metrics: Dict[str, Any] = {}
-            running: Dict[str, float] = {}
+            sums: Dict[str, float] = {}
+            counts: Dict[str, int] = {}
             count = 0
             dispatches = 0
             for batch in prefetch_to_device(batch_stream(),
@@ -353,11 +356,17 @@ class Sequential:
                     count += 1
                 dispatches += 1
                 if dispatches % sync_every == 0 or count == len(dataset):
+                    # Sampled running mean: only dispatches at sync points
+                    # are pulled (pulling every batch would stall the async
+                    # queue), and multi-step metrics arrive stacked [K] —
+                    # all K contribute.  With sync_every=1 (CPU mesh) this
+                    # IS the exact Keras epoch mean of batch metrics.
                     for k, v in last_metrics.items():
-                        v = np.asarray(v)
-                        # multi-step metrics come back stacked [K]
-                        running[k] = float(v[-1] if v.ndim else v)
-            logs = dict(running)
+                        v = np.asarray(v, np.float64)
+                        vals = v.reshape(-1)
+                        sums[k] = sums.get(k, 0.0) + float(vals.sum())
+                        counts[k] = counts.get(k, 0) + vals.size
+            logs = {k: sums[k] / counts[k] for k in sums}
             if validation_data is not None:
                 val = self.evaluate(validation_data[0], validation_data[1],
                                     batch_size=batch_size, verbose=0)
