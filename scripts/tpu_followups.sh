@@ -1,0 +1,19 @@
+#!/bin/sh
+# Queued hardware measurements for the next tunnel-up window (run from the
+# repo root; each step prints one JSON line or a short table to stdout).
+# Order: cheapest liveness first, then the rows whose PERF.md entries are
+# pending.  Safe to re-run; every step is read-only w.r.t. the repo.
+set -x
+timeout 60 python -c "import jax; print(jax.devices())" || exit 1
+
+# decode throughput after the cache-carry fix (pre-fix same-day: 7,017)
+timeout 900 python bench.py --config=gpt_decode
+
+# int8 decode row (fp rate + greedy agreement come from the same run)
+timeout 900 python bench.py --config=gpt_decode_int8
+
+# the flash-dispatch operating point (seq 2048)
+timeout 1200 python bench.py --config=gpt_long
+
+# BERT remat/batch operating point (decides whether bench_bert flips remat)
+timeout 900 python scripts/tune_bert_batch.py
