@@ -598,15 +598,19 @@ def _gpt_bench_config(seq):
     # backward and OOMs a 16G chip at batch 48/seq 256; rematerialising
     # measured FASTER at equal batch too (scripts/tune_gpt_batch.py,
     # 2026-07-31: 120k tok/s at remat batch 48 vs 101-108k no-remat 24)
+    moe = {}
+    experts = int(os.environ.get("DTTPU_BENCH_GPT_MOE", "0"))
+    if experts:
+        moe = dict(moe_experts=experts, moe_top_k=2)
     return (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                       num_heads=2, intermediate_size=512,
                       max_position=seq, dtype=jnp.bfloat16,
-                      dropout_rate=0.0, remat=True) if SMOKE
+                      dropout_rate=0.0, remat=True, **moe) if SMOKE
             else GPTConfig(vocab_size=50257, hidden_size=768,
                            num_layers=12, num_heads=12,
                            intermediate_size=3072, max_position=seq,
                            dtype=jnp.bfloat16, dropout_rate=0.0,
-                           remat=True))
+                           remat=True, **moe))
 
 
 def bench_gpt():
@@ -820,6 +824,18 @@ def bench_gpt_decode_int8():
                 batch=batch, new_tokens=new_tokens, seq_len=seq)
 
 
+def bench_gpt_moe():
+    """The gpt row with a mixture-of-experts FFN (ops.moe top-2/8 capacity
+    routing + aux load-balance loss) — the measured row for the MoE
+    subsystem.  Single-chip the experts are co-located (no all_to_all);
+    the routing/capacity compute is what this row prices."""
+    os.environ.setdefault("DTTPU_BENCH_GPT_MOE", "8")
+    result = bench_gpt()
+    result["metric"] = "gpt_moe" + result.pop("metric")[len("gpt"):]
+    result["moe_experts"] = int(os.environ["DTTPU_BENCH_GPT_MOE"])
+    return result
+
+
 def bench_gpt_long():
     """The gpt row at seq 2048 — the long-context operating point where
     ``use_flash="auto"`` actually dispatches the fused Pallas kernel on
@@ -839,6 +855,7 @@ CONFIGS = {
     "bert": bench_bert,
     "gpt": bench_gpt,
     "gpt_long": bench_gpt_long,
+    "gpt_moe": bench_gpt_moe,
     "llama": bench_llama,
     "gpt_decode": bench_gpt_decode,
     "gpt_decode_int8": bench_gpt_decode_int8,

@@ -178,3 +178,13 @@ class TestGptLong:
         assert r["metric"].startswith("gpt_decode_int8_tokens_per_sec")
         assert r["value"] > 0 and r["fp_value"] > 0
         assert r["greedy_token_match"] > 0.9
+
+    def test_gpt_moe_smoke(self):
+        proc = _run(["--config=gpt_moe", "--device=cpu"],
+                    _env(DTTPU_BENCH_SEQ=64))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        r = json.loads(lines[0])
+        assert r["metric"].startswith("gpt_moe_lm_train_tokens_per_sec")
+        assert r["moe_experts"] == 8
+        assert r["value"] > 0
