@@ -216,19 +216,15 @@ class Sequential:
         c = self._require_compiled()
         train_step = c["train_step"]
         accum = c["step_kwargs"].get("accum_steps", 1)
-        if accum > 1:
-            if sample_weight is not None or class_weight is not None:
-                # per-microbatch weighted means averaged equally are NOT the
-                # full-batch weighted mean when the weight mass differs per
-                # microbatch — refuse rather than silently bias gradients
-                raise ValueError(
-                    "grad_accum_steps > 1 composes only with the unweighted "
-                    "loss path; drop sample_weight/class_weight or recompile "
-                    "with grad_accum_steps=1")
-            if batch_size % accum:
-                raise ValueError(
-                    f"batch_size {batch_size} is not divisible by "
-                    f"grad_accum_steps {accum}")
+        if accum > 1 and (sample_weight is not None
+                          or class_weight is not None):
+            # per-microbatch weighted means averaged equally are NOT the
+            # full-batch weighted mean when the weight mass differs per
+            # microbatch — refuse rather than silently bias gradients
+            raise ValueError(
+                "grad_accum_steps > 1 composes only with the unweighted "
+                "loss path; drop sample_weight/class_weight or recompile "
+                "with grad_accum_steps=1")
         if sample_weight is not None:
             if class_weight is not None:
                 raise ValueError(
@@ -278,6 +274,12 @@ class Sequential:
                 log.info("batch_size %d -> %d (divisible by mesh data shards)",
                          batch_size, rounded)
                 batch_size = rounded
+        if accum > 1 and batch_size % accum:
+            # validated AFTER mesh rounding — the rounded size is what the
+            # step actually splits into microbatches
+            raise ValueError(
+                f"batch_size {batch_size} is not divisible by "
+                f"grad_accum_steps {accum}")
         arrays = [np.asarray(x), np.asarray(y)]
         if sample_weight is not None:
             arrays.append(sample_weight)   # shuffles/shards with (x, y)
@@ -305,16 +307,15 @@ class Sequential:
                                            PartitionSpec(None, "data"))
 
         def batch_stream():
-            """K-stacked groups + plain-batch tails (epoch end / ragged
-            last batch); runs on the prefetch producer thread."""
+            """K-stacked groups + plain-batch count-tails (an epoch whose
+            batch count isn't divisible by K ends with < K single batches);
+            runs on the prefetch producer thread.  All batches are the same
+            size — fit's Dataset drops the sample remainder."""
             if multi_step is None or spe <= 1:
                 yield from iter(dataset)
                 return
             buf = []
             for b in iter(dataset):
-                if buf and b[0].shape[0] != buf[0][0].shape[0]:
-                    yield from buf            # ragged last batch: flush
-                    buf = []                  # singles, then the odd one
                 buf.append(b)
                 if len(buf) == spe:
                     yield tuple(np.stack(z) for z in zip(*buf))

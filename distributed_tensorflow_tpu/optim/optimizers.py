@@ -439,7 +439,12 @@ def with_lr_scale(optimizer: Optimizer) -> Optimizer:
         updates, new_inner = optimizer.update(grads, state.inner["inner"],
                                               params)
         updates = jax.tree.map(lambda u: u * scale, updates)
-        return updates, OptState(new_inner.count,
+        # state.count + 1, NOT new_inner.count: mirroring the inner value
+        # puts one jaxpr output in two pytree slots, and when XLA aliases
+        # identical outputs to one buffer the NEXT dispatch donates it
+        # twice — the same class of failure init avoids with its fresh
+        # zero.  The add keeps the value equal but the buffer distinct.
+        return updates, OptState(state.count + 1,
                                  {"scale": scale, "inner": new_inner})
 
     return Optimizer(init, update)

@@ -365,3 +365,35 @@ def test_no_aliased_buffers_in_fresh_state():
                 f"{label}: {jax.tree_util.keystr(path)} shares a buffer "
                 f"with {seen[ptr]}")
             seen[ptr] = jax.tree_util.keystr(path)
+
+
+def test_no_aliased_buffers_after_update():
+    """The update path must not reintroduce the aliased count either:
+    returning inner.count in the wrapper slot puts one jaxpr value in two
+    output leaves, which a deduping backend can alias to one buffer."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_tensorflow_tpu import optim
+    from distributed_tensorflow_tpu.optim import optimizers as opt_mod
+
+    opt = opt_mod.with_lr_scale(optim.adam())
+    params = {"w": jnp.ones((4, 4))}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        grads = {"w": jnp.ones((4, 4))}
+        updates, new_state = opt.update(grads, state, params)
+        return opt_mod.apply_updates(params, updates), new_state
+
+    _, state = step(params, state)
+    assert int(state.count) == int(state.inner["inner"].count) == 1
+    seen = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state):
+        try:
+            ptr = leaf.unsafe_buffer_pointer()
+        except Exception:
+            continue
+        assert ptr not in seen, (
+            f"{jax.tree_util.keystr(path)} shares a buffer with {seen[ptr]}")
+        seen[ptr] = jax.tree_util.keystr(path)

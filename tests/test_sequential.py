@@ -719,10 +719,11 @@ class TestStepsPerExecution:
         np.testing.assert_allclose(flat1, flat4, rtol=0, atol=1e-6)
         assert set(h4.history) == set(h1.history)
 
-    def test_ragged_tail_falls_back_to_single(self):
-        """530 samples / 50 = 10 full + 1 ragged batch; K=4 leaves 2 full
-        + 1 ragged as singles — the run must complete and train."""
-        p, h = self._fit(4, n=530 + 64)
+    def test_count_tail_falls_back_to_single(self):
+        """550 train samples / 50 = 11 equal batches (fit's Dataset drops
+        sample remainders); K=4 groups 8 and leaves 3 as single-step
+        dispatches — the run must complete and train."""
+        p, h = self._fit(4, n=550 + 64)
         assert np.isfinite(h.history["loss"][-1])
 
     def test_weighted_fit_ignores_spe(self):
@@ -828,3 +829,18 @@ class TestGradAccum:
         assert loaded._compiled["multi_train_step"] is not None
         hist = loaded.fit(xt, yt, epochs=1, batch_size=50, verbose=0)
         assert np.isfinite(hist.history["loss"][0])
+
+    def test_mesh_rounded_batch_divisibility_checked(self):
+        """The accum divisibility check runs on the MESH-ROUNDED batch
+        size: 51 % 3 == 0 would pass naively, but rounding to the 8-way
+        mesh gives 56, which must be refused up front."""
+        import pytest
+        from distributed_tensorflow_tpu import parallel
+        (xt, yt), _ = data.xor_data(200, val_size=8, seed=0)
+        model = models.Sequential([ops.Dense(8, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="sgd",
+                      mesh=parallel.data_parallel_mesh(),
+                      grad_accum_steps=3)
+        with pytest.raises(ValueError, match="divisible"):
+            model.fit(xt, yt, epochs=1, batch_size=51, verbose=0)
