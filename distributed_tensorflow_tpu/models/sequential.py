@@ -381,6 +381,126 @@ class Sequential:
             cb.on_train_end(self)
         return history
 
+    def fit_stream(self, batches, steps_per_epoch: int, epochs: int = 1,
+                   callbacks: Sequence[Callback] = (),
+                   validation_data: Optional[Tuple] = None,
+                   verbose: int = 1) -> History:
+        """Train from streamed batches — the ``fit_generator``-shaped
+        entry for sources that don't fit in memory.
+
+        ``batches``: an iterator of ``(x, y)`` numpy batch tuples, or a
+        callable ``epoch -> iterator`` (pass ``data.tfrecord_batches``
+        with its ``epoch=`` argument for the per-epoch reshuffle
+        contract).  All batches must share one shape.  Each epoch draws
+        ``steps_per_epoch`` batches; a source that ends sooner ends the
+        epoch — and training — early.  ``compile(steps_per_execution=K)``
+        groups dispatches exactly as in ``fit``; sample/class weights are
+        not supported on this path.
+        """
+        c = self._require_compiled()
+        train_step = c["train_step"]
+        spe = c["steps_per_execution"]
+        multi_step = c["multi_train_step"]
+
+        def epoch_iter(epoch):
+            it = batches(epoch) if callable(batches) else batches
+            for _ in range(steps_per_epoch):
+                try:
+                    yield next(it)
+                except StopIteration:
+                    return
+
+        # Build from the first batch's feature shape if needed.
+        first_it = epoch_iter(0)
+        try:
+            first = next(first_it)
+        except StopIteration:
+            raise ValueError("batch stream is empty")
+        if self.state is None:
+            self.build(tuple(np.shape(first[0])[1:]))
+        base_ndim = np.asarray(first[0]).ndim
+        sharding = multi_sharding = None
+        if c["mesh"] is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sharding = NamedSharding(c["mesh"], PartitionSpec("data"))
+            multi_sharding = NamedSharding(c["mesh"],
+                                           PartitionSpec(None, "data"))
+
+        def grouped(it):
+            if multi_step is None or spe <= 1:
+                yield from it
+                return
+            buf = []
+            for b in it:
+                buf.append(b)
+                if len(buf) == spe:
+                    yield tuple(np.stack(z) for z in zip(*buf))
+                    buf = []
+            yield from buf
+
+        def batch_sharding(item):
+            if multi_sharding is not None and item[0].ndim > base_ndim:
+                return multi_sharding
+            return sharding
+
+        import itertools
+        history = History()
+        callbacks = list(callbacks) + [history]
+        self.stop_training = False
+        exhausted = False
+        for cb in callbacks:
+            cb.on_train_begin(self)
+        for epoch in range(epochs):
+            if self.stop_training or exhausted:
+                break
+            for cb in callbacks:
+                cb.on_epoch_begin(self, epoch)
+            it = (itertools.chain([first], first_it) if epoch == 0
+                  else epoch_iter(epoch))
+            sync_every = (1 if jax.devices()[0].platform == "cpu"
+                          and c["mesh"] is not None else 50)
+            sums: Dict[str, float] = {}
+            counts: Dict[str, int] = {}
+            last_metrics: Dict[str, Any] = {}
+            drawn = 0
+            dispatches = 0
+            pulled_at = 0
+
+            def pull():
+                for k, v in last_metrics.items():
+                    v = np.asarray(v, np.float64).reshape(-1)
+                    sums[k] = sums.get(k, 0.0) + float(v.sum())
+                    counts[k] = counts.get(k, 0) + v.size
+
+            for batch in prefetch_to_device(grouped(it), sharding=sharding,
+                                            sharding_fn=batch_sharding):
+                if batch[0].ndim > base_ndim:
+                    self.state, last_metrics = multi_step(self.state, batch)
+                    drawn += batch[0].shape[0]
+                else:
+                    self.state, last_metrics = train_step(self.state, batch)
+                    drawn += 1
+                dispatches += 1
+                if dispatches % sync_every == 0:
+                    pull()
+                    pulled_at = dispatches
+            if dispatches > pulled_at and last_metrics:
+                pull()
+            exhausted = drawn < steps_per_epoch
+            logs = {k: sums[k] / counts[k] for k in sums}
+            if validation_data is not None:
+                val = self.evaluate(validation_data[0], validation_data[1],
+                                    verbose=0)
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            if verbose:
+                parts = ", ".join(f"{k}={v:.4f}" for k, v in logs.items())
+                print(f"Epoch {epoch + 1}/{epochs}: {parts}", flush=True)
+            for cb in callbacks:
+                cb.on_epoch_end(self, epoch, logs)
+        for cb in callbacks:
+            cb.on_train_end(self)
+        return history
+
     def _sample_weighted_step(self, c) -> Any:
         """Compiled ``step(state, (x, y, w))`` applying Keras 2.0.8's
         sample-weight rule; built once per compile and cached (the weights

@@ -844,3 +844,63 @@ class TestGradAccum:
                       grad_accum_steps=3)
         with pytest.raises(ValueError, match="divisible"):
             model.fit(xt, yt, epochs=1, batch_size=51, verbose=0)
+
+
+class TestFitStream:
+    """fit_stream: the fit_generator-shaped entry over streamed batches
+    (data.tfrecord_batches -> Sequential)."""
+
+    def _records(self, tmp_path, n=400):
+        import io
+        (xt, yt), _ = data.xor_data(n, val_size=8, seed=0)
+        path = str(tmp_path / "xor.tfrecord")
+
+        def ser(i):
+            buf = io.BytesIO()
+            np.save(buf, xt[i]); np.save(buf, yt[i])
+            return buf.getvalue()
+
+        data.write_tfrecord(path, (ser(i) for i in range(len(xt))))
+
+        def parse(rec):
+            buf = io.BytesIO(rec)
+            return np.load(buf), np.load(buf)
+
+        return path, parse
+
+    def _model(self, spe=1):
+        model = models.Sequential([ops.Dense(32, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="adam",
+                      steps_per_execution=spe)
+        return model
+
+    def test_trains_from_tfrecords(self, tmp_path):
+        path, parse = self._records(tmp_path)
+        model = self._model()
+        hist = model.fit_stream(
+            lambda epoch: data.tfrecord_batches(path, parse, batch_size=50,
+                                                shuffle_buffer=128,
+                                                epoch=epoch),
+            steps_per_epoch=8, epochs=2, verbose=0)
+        assert len(hist.history["loss"]) == 2
+        assert np.isfinite(hist.history["loss"][-1])
+
+    def test_steps_per_execution_grouping(self, tmp_path):
+        path, parse = self._records(tmp_path)
+        model = self._model(spe=3)
+        hist = model.fit_stream(
+            lambda epoch: data.tfrecord_batches(path, parse, batch_size=50,
+                                                epoch=epoch),
+            steps_per_epoch=8, epochs=2, verbose=0)
+        assert len(hist.history["loss"]) == 2
+        assert np.isfinite(hist.history["loss"][-1])
+
+    def test_exhausted_stream_ends_training(self, tmp_path):
+        path, parse = self._records(tmp_path, n=110)  # 2 batches of 50
+        model = self._model()
+        hist = model.fit_stream(
+            data.tfrecord_batches(path, parse, batch_size=50),
+            steps_per_epoch=10, epochs=5, verbose=0)
+        # one short epoch, then the (now empty) iterator ends training
+        assert len(hist.history["loss"]) == 1
