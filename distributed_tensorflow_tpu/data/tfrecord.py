@@ -107,7 +107,8 @@ def read_tfrecord(path: str, verify: bool = True) -> Iterator[bytes]:
 def tfrecord_batches(paths, parse_fn, batch_size: int,
                      shuffle_buffer: int = 0, seed: int = 0,
                      epoch: int = 0, drop_remainder: bool = True,
-                     verify: bool = True):
+                     verify: bool = True,
+                     process_index: int = 0, process_count: int = 1):
     """Stream record files into training batches (the tf.data
     ``TFRecordDataset -> map -> shuffle -> batch`` pipeline shape, sized
     for host feeding + ``prefetch_to_device``).
@@ -121,16 +122,28 @@ def tfrecord_batches(paths, parse_fn, batch_size: int,
     seeded by ``(seed, epoch)``: pass the epoch number on each re-
     iteration for the per-epoch reshuffle contract ``pipeline.Dataset``
     keeps (a fixed (seed, epoch) pair replays the same order).
+
+    ``process_index/process_count``: multi-host sharding — each process
+    keeps every ``count``-th example (record-order stride BEFORE the
+    shuffle window, so hosts see disjoint streams), the streaming
+    analogue of ``pipeline.Dataset``'s per-process slice.
     """
     import numpy as np
 
     if isinstance(paths, (str, os.PathLike)):
         paths = [paths]
 
+    if not 0 <= process_index < process_count:
+        raise ValueError(f"process_index {process_index} not in "
+                         f"[0, {process_count})")
+
     def examples():
+        i = 0
         for p in paths:
             for rec in read_tfrecord(str(p), verify=verify):
-                yield parse_fn(rec)
+                if i % process_count == process_index:
+                    yield parse_fn(rec)
+                i += 1
 
     def shuffled():
         if shuffle_buffer <= 0:

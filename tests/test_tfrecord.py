@@ -120,3 +120,30 @@ def test_tfrecord_batches_multiple_files(tmp_path):
         [p1, p2], lambda r: {"x": np.frombuffer(r, np.int32)[0]},
         batch_size=4)])
     np.testing.assert_array_equal(out, np.arange(8))
+
+
+def test_process_sharded_batches_are_disjoint_and_complete(tmp_path):
+    """Multi-host streaming: per-process strides see disjoint examples
+    whose union is the full record set (pipeline.Dataset's per-process
+    slice, streaming form)."""
+    import numpy as np
+    from distributed_tensorflow_tpu import data
+
+    path = str(tmp_path / "r.tfrecord")
+    data.write_tfrecord(path, (bytes([i]) for i in range(21)))
+    parse = lambda rec: np.frombuffer(rec, np.uint8).astype(np.int32)
+    seen = []
+    for pi in range(2):
+        got = [int(v) for b in data.tfrecord_batches(
+                   path, parse, batch_size=4, drop_remainder=False,
+                   process_index=pi, process_count=2)
+               for v in np.ravel(b)]
+        seen.append(set(got))
+        assert len(got) == len(seen[-1])          # no duplicates
+    assert seen[0].isdisjoint(seen[1])
+    assert seen[0] | seen[1] == set(range(21))
+
+    import pytest
+    with pytest.raises(ValueError, match="process_index"):
+        next(iter(data.tfrecord_batches(path, parse, 4,
+                                        process_index=2, process_count=2)))
