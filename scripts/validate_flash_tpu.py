@@ -101,6 +101,11 @@ def main():
         ("gqa_causal_bf16", dict(b=2, s=512, h=8, d=64, kv_heads=2,
                                  dtype=jnp.bfloat16),
          dict(causal=True), "causal"),
+        # head_dim 128 = the Llama preset dimension; exercises the VMEM
+        # footprint of the (512, 1024) default blocks at the fatter head
+        ("causal_bf16_d128", dict(b=2, s=1024, h=4, d=128,
+                                  dtype=jnp.bfloat16),
+         dict(causal=True), "causal"),
     ]
     for name, shp, fkw, maskkind in cases:
         q, k, v = qkv(jax.random.PRNGKey(0), shp["b"], shp["s"], shp["h"],
