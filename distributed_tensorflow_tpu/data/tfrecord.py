@@ -123,10 +123,13 @@ def tfrecord_batches(paths, parse_fn, batch_size: int,
     iteration for the per-epoch reshuffle contract ``pipeline.Dataset``
     keeps (a fixed (seed, epoch) pair replays the same order).
 
-    ``process_index/process_count``: multi-host sharding — each process
-    keeps every ``count``-th example (record-order stride BEFORE the
-    shuffle window, so hosts see disjoint streams), the streaming
-    analogue of ``pipeline.Dataset``'s per-process slice.
+    ``process_index/process_count``: multi-host sharding — records are
+    consumed in windows of ``count`` and each process keeps its slot, so
+    hosts see disjoint streams of EXACTLY equal length (``n // count``;
+    the final partial window is dropped on every host).  Equal lengths
+    are load-bearing: one host drawing an extra batch would enter the
+    compiled collective step alone and hang the cross-host rendezvous —
+    the same guarantee ``pipeline.Dataset`` gets from ``n // count``.
     """
     import numpy as np
 
@@ -138,12 +141,13 @@ def tfrecord_batches(paths, parse_fn, batch_size: int,
                          f"[0, {process_count})")
 
     def examples():
-        i = 0
+        window: List = []
         for p in paths:
             for rec in read_tfrecord(str(p), verify=verify):
-                if i % process_count == process_index:
-                    yield parse_fn(rec)
-                i += 1
+                window.append(rec)
+                if len(window) == process_count:
+                    yield parse_fn(window[process_index])
+                    window.clear()
 
     def shuffled():
         if shuffle_buffer <= 0:

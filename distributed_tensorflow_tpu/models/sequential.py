@@ -35,6 +35,66 @@ log = logging.getLogger(__name__)
 __all__ = ["Sequential"]
 
 
+def _group_batches(it, spe: int, active: bool):
+    """K-stack consecutive same-shaped batches for the multi-step path;
+    a count-tail shorter than ``spe`` falls through as single batches.
+    Runs on the prefetch producer thread."""
+    if not active or spe <= 1:
+        yield from it
+        return
+    buf = []
+    for b in it:
+        buf.append(b)
+        if len(buf) == spe:
+            yield tuple(np.stack(z) for z in zip(*buf))
+            buf = []
+    yield from buf
+
+
+def _stream_shardings(mesh, base_ndim, want_multi: bool):
+    """(per-batch sharding, sharding_fn) for prefetch_to_device — the fn
+    routes [K, batch, ...] groups to P(None, 'data') and plain batches to
+    P('data')."""
+    if mesh is None:
+        return None, None
+    from jax.sharding import NamedSharding, PartitionSpec
+    sharding = NamedSharding(mesh, PartitionSpec("data"))
+    if not want_multi:
+        return sharding, None
+    multi = NamedSharding(mesh, PartitionSpec(None, "data"))
+
+    def fn(item):
+        return multi if item[0].ndim > base_ndim else sharding
+
+    return sharding, fn
+
+
+def _sync_every(mesh) -> int:
+    """Metric-pull cadence: XLA:CPU's collective rendezvous dies under a
+    deep async queue, so the CPU mesh syncs every dispatch; TPU pulls
+    rarely and keeps the queue async."""
+    return (1 if jax.devices()[0].platform == "cpu" and mesh is not None
+            else 50)
+
+
+class _MeanAccumulator:
+    """Sampled running mean of pulled step metrics — every pulled
+    dispatch contributes all its entries (the K of a multi-step group)."""
+
+    def __init__(self):
+        self.sums: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    def add(self, metrics: Dict[str, Any]) -> None:
+        for k, v in metrics.items():
+            v = np.asarray(v, np.float64).reshape(-1)
+            self.sums[k] = self.sums.get(k, 0.0) + float(v.sum())
+            self.counts[k] = self.counts.get(k, 0) + v.size
+
+    def means(self) -> Dict[str, float]:
+        return {k: self.sums[k] / self.counts[k] for k in self.sums}
+
+
 class Sequential:
     def __init__(self, layers: Sequence[layer_lib.Layer] = (),
                  name: str = "sequential"):
@@ -301,31 +361,8 @@ class Sequential:
                      "(sample_weight/class_weight use their own compiled "
                      "step)", spe)
         base_ndim = arrays[0].ndim   # group leaves carry one extra dim
-        multi_sharding = None
-        if multi_step is not None and c["mesh"] is not None:
-            multi_sharding = NamedSharding(c["mesh"],
-                                           PartitionSpec(None, "data"))
-
-        def batch_stream():
-            """K-stacked groups + plain-batch count-tails (an epoch whose
-            batch count isn't divisible by K ends with < K single batches);
-            runs on the prefetch producer thread.  All batches are the same
-            size — fit's Dataset drops the sample remainder."""
-            if multi_step is None or spe <= 1:
-                yield from iter(dataset)
-                return
-            buf = []
-            for b in iter(dataset):
-                buf.append(b)
-                if len(buf) == spe:
-                    yield tuple(np.stack(z) for z in zip(*buf))
-                    buf = []
-            yield from buf
-
-        def batch_sharding(item):
-            if multi_sharding is not None and item[0].ndim > base_ndim:
-                return multi_sharding
-            return sharding
+        _, batch_sharding = _stream_shardings(
+            c["mesh"], base_ndim, multi_step is not None)
 
         for cb in callbacks:
             cb.on_train_begin(self)
@@ -334,20 +371,18 @@ class Sequential:
                 break
             for cb in callbacks:
                 cb.on_epoch_begin(self, epoch)
-            # Keep metrics device-side between pulls.  XLA:CPU's collective
-            # rendezvous dies under a deep async queue of collective
-            # programs (threads from queued executions miss its 40s
-            # window), so the CPU mesh syncs every step; TPU pulls rarely
-            # and keeps the dispatch queue async.
-            sync_every = (1 if jax.devices()[0].platform == "cpu"
-                          and c["mesh"] is not None else 50)
+            # Sampled running mean: only dispatches at sync points are
+            # pulled (a float() per batch would stall the async dispatch
+            # queue); with sync_every=1 (CPU mesh) this IS the exact Keras
+            # epoch mean of batch metrics.
+            sync_every = _sync_every(c["mesh"])
+            acc = _MeanAccumulator()
             last_metrics: Dict[str, Any] = {}
-            sums: Dict[str, float] = {}
-            counts: Dict[str, int] = {}
             count = 0
             dispatches = 0
-            for batch in prefetch_to_device(batch_stream(),
-                                            sharding=sharding,
+            groups = _group_batches(iter(dataset), spe,
+                                    multi_step is not None)
+            for batch in prefetch_to_device(groups, sharding=sharding,
                                             sharding_fn=batch_sharding):
                 if batch[0].ndim > base_ndim:       # [K, batch, ...] group
                     self.state, last_metrics = multi_step(self.state, batch)
@@ -357,17 +392,8 @@ class Sequential:
                     count += 1
                 dispatches += 1
                 if dispatches % sync_every == 0 or count == len(dataset):
-                    # Sampled running mean: only dispatches at sync points
-                    # are pulled (pulling every batch would stall the async
-                    # queue), and multi-step metrics arrive stacked [K] —
-                    # all K contribute.  With sync_every=1 (CPU mesh) this
-                    # IS the exact Keras epoch mean of batch metrics.
-                    for k, v in last_metrics.items():
-                        v = np.asarray(v, np.float64)
-                        vals = v.reshape(-1)
-                        sums[k] = sums.get(k, 0.0) + float(vals.sum())
-                        counts[k] = counts.get(k, 0) + vals.size
-            logs = {k: sums[k] / counts[k] for k in sums}
+                    acc.add(last_metrics)
+            logs = acc.means()
             if validation_data is not None:
                 val = self.evaluate(validation_data[0], validation_data[1],
                                     batch_size=batch_size, verbose=0)
@@ -391,11 +417,14 @@ class Sequential:
         ``batches``: an iterator of ``(x, y)`` numpy batch tuples, or a
         callable ``epoch -> iterator`` (pass ``data.tfrecord_batches``
         with its ``epoch=`` argument for the per-epoch reshuffle
-        contract).  All batches must share one shape.  Each epoch draws
-        ``steps_per_epoch`` batches; a source that ends sooner ends the
-        epoch — and training — early.  ``compile(steps_per_execution=K)``
-        groups dispatches exactly as in ``fit``; sample/class weights are
-        not supported on this path.
+        contract).  All batches must share one shape, divisible by the
+        mesh's data shards and by ``grad_accum_steps`` (validated on the
+        first batch — the stream fixes the size, so nothing is rounded).
+        Each epoch draws ``steps_per_epoch`` batches; a source that ends
+        sooner ends the epoch — and training — early, with no ghost
+        epoch.  ``compile(steps_per_execution=K)`` groups dispatches
+        exactly as in ``fit``; sample/class weights are not supported on
+        this path.
         """
         c = self._require_compiled()
         train_step = c["train_step"]
@@ -410,38 +439,30 @@ class Sequential:
                 except StopIteration:
                     return
 
-        # Build from the first batch's feature shape if needed.
+        # Build + validate from the first batch: the stream fixes the
+        # batch size, so incompatibilities must fail HERE with the
+        # parameter's name, not at trace time inside the step.
         first_it = epoch_iter(0)
         try:
             first = next(first_it)
         except StopIteration:
             raise ValueError("batch stream is empty")
+        bs = int(np.shape(first[0])[0])
+        accum = c["step_kwargs"].get("accum_steps", 1)
+        if accum > 1 and bs % accum:
+            raise ValueError(f"streamed batch size {bs} is not divisible "
+                             f"by grad_accum_steps {accum}")
+        if c["mesh"] is not None:
+            shards = c["mesh"].shape.get("data", 1)
+            if bs % shards:
+                raise ValueError(f"streamed batch size {bs} is not "
+                                 f"divisible by the mesh's {shards} data "
+                                 f"shards")
         if self.state is None:
             self.build(tuple(np.shape(first[0])[1:]))
         base_ndim = np.asarray(first[0]).ndim
-        sharding = multi_sharding = None
-        if c["mesh"] is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            sharding = NamedSharding(c["mesh"], PartitionSpec("data"))
-            multi_sharding = NamedSharding(c["mesh"],
-                                           PartitionSpec(None, "data"))
-
-        def grouped(it):
-            if multi_step is None or spe <= 1:
-                yield from it
-                return
-            buf = []
-            for b in it:
-                buf.append(b)
-                if len(buf) == spe:
-                    yield tuple(np.stack(z) for z in zip(*buf))
-                    buf = []
-            yield from buf
-
-        def batch_sharding(item):
-            if multi_sharding is not None and item[0].ndim > base_ndim:
-                return multi_sharding
-            return sharding
+        sharding, batch_sharding = _stream_shardings(
+            c["mesh"], base_ndim, multi_step is not None)
 
         import itertools
         history = History()
@@ -453,27 +474,24 @@ class Sequential:
         for epoch in range(epochs):
             if self.stop_training or exhausted:
                 break
-            for cb in callbacks:
-                cb.on_epoch_begin(self, epoch)
             it = (itertools.chain([first], first_it) if epoch == 0
                   else epoch_iter(epoch))
-            sync_every = (1 if jax.devices()[0].platform == "cpu"
-                          and c["mesh"] is not None else 50)
-            sums: Dict[str, float] = {}
-            counts: Dict[str, int] = {}
+            sync_every = _sync_every(c["mesh"])
+            acc = _MeanAccumulator()
             last_metrics: Dict[str, Any] = {}
             drawn = 0
             dispatches = 0
             pulled_at = 0
-
-            def pull():
-                for k, v in last_metrics.items():
-                    v = np.asarray(v, np.float64).reshape(-1)
-                    sums[k] = sums.get(k, 0.0) + float(v.sum())
-                    counts[k] = counts.get(k, 0) + v.size
-
-            for batch in prefetch_to_device(grouped(it), sharding=sharding,
+            epoch_began = False
+            groups = _group_batches(it, spe, multi_step is not None)
+            for batch in prefetch_to_device(groups, sharding=sharding,
                                             sharding_fn=batch_sharding):
+                if not epoch_began:
+                    # after the first batch exists: an exactly-exhausted
+                    # stream must not produce a ghost zero-step epoch
+                    epoch_began = True
+                    for cb in callbacks:
+                        cb.on_epoch_begin(self, epoch)
                 if batch[0].ndim > base_ndim:
                     self.state, last_metrics = multi_step(self.state, batch)
                     drawn += batch[0].shape[0]
@@ -482,12 +500,14 @@ class Sequential:
                     drawn += 1
                 dispatches += 1
                 if dispatches % sync_every == 0:
-                    pull()
+                    acc.add(last_metrics)
                     pulled_at = dispatches
+            if not epoch_began:
+                break                              # stream already dry
             if dispatches > pulled_at and last_metrics:
-                pull()
+                acc.add(last_metrics)
             exhausted = drawn < steps_per_epoch
-            logs = {k: sums[k] / counts[k] for k in sums}
+            logs = acc.means()
             if validation_data is not None:
                 val = self.evaluate(validation_data[0], validation_data[1],
                                     verbose=0)

@@ -904,3 +904,28 @@ class TestFitStream:
             steps_per_epoch=10, epochs=5, verbose=0)
         # one short epoch, then the (now empty) iterator ends training
         assert len(hist.history["loss"]) == 1
+
+    def test_no_ghost_epoch_on_exact_boundary(self, tmp_path):
+        """A stream with exactly steps_per_epoch batches must log ONE
+        epoch — no zero-step epoch with misaligned val-only history."""
+        path, parse = self._records(tmp_path, n=110)  # yields 2 batches
+        (xv, yv) = data.xor_data(64, val_size=32, seed=1)[1]
+        model = self._model()
+        hist = model.fit_stream(
+            data.tfrecord_batches(path, parse, batch_size=50),
+            steps_per_epoch=2, epochs=5, verbose=0,
+            validation_data=(xv, yv))
+        assert len(hist.history["loss"]) == 1
+        assert len(hist.history["val_loss"]) == 1
+
+    def test_stream_batch_validations(self, tmp_path):
+        import pytest
+        path, parse = self._records(tmp_path)
+        model = models.Sequential([ops.Dense(8, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="sgd",
+                      grad_accum_steps=3)
+        with pytest.raises(ValueError, match="grad_accum_steps"):
+            model.fit_stream(
+                data.tfrecord_batches(path, parse, batch_size=50),
+                steps_per_epoch=2, verbose=0)

@@ -122,10 +122,11 @@ def test_tfrecord_batches_multiple_files(tmp_path):
     np.testing.assert_array_equal(out, np.arange(8))
 
 
-def test_process_sharded_batches_are_disjoint_and_complete(tmp_path):
-    """Multi-host streaming: per-process strides see disjoint examples
-    whose union is the full record set (pipeline.Dataset's per-process
-    slice, streaming form)."""
+def test_process_sharded_batches_are_disjoint_and_equal(tmp_path):
+    """Multi-host streaming: per-process window slots see disjoint
+    examples of EXACTLY equal count (n // count; the partial final window
+    drops everywhere) — unequal counts would strand one host inside the
+    collective step and hang the cross-host rendezvous."""
     import numpy as np
     from distributed_tensorflow_tpu import data
 
@@ -138,10 +139,11 @@ def test_process_sharded_batches_are_disjoint_and_complete(tmp_path):
                    path, parse, batch_size=4, drop_remainder=False,
                    process_index=pi, process_count=2)
                for v in np.ravel(b)]
-        seen.append(set(got))
-        assert len(got) == len(seen[-1])          # no duplicates
-    assert seen[0].isdisjoint(seen[1])
-    assert seen[0] | seen[1] == set(range(21))
+        seen.append(got)
+        assert len(got) == 10                     # 21 // 2, equal on both
+        assert len(got) == len(set(got))          # no duplicates
+    assert set(seen[0]).isdisjoint(seen[1])
+    assert set(seen[0]) | set(seen[1]) == set(range(20))  # 21st dropped
 
     import pytest
     with pytest.raises(ValueError, match="process_index"):
