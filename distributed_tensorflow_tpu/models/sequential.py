@@ -647,6 +647,49 @@ class Sequential:
             print(f"evaluate: {parts}", flush=True)
         return out
 
+    def evaluate_stream(self, batches, steps: Optional[int] = None,
+                        verbose: int = 1) -> Dict[str, float]:
+        """``evaluate`` over streamed ``(x, y)`` batches (an iterator, e.g.
+        ``data.tfrecord_batches``): batch-size-weighted metric means over
+        up to ``steps`` batches (all of them when ``steps`` is None).
+        Same async-queue pull discipline as ``evaluate``."""
+        c = self._require_compiled()
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
+        sharding, _ = _stream_shardings(c["mesh"], 0, want_multi=False)
+        sync_now = (c["mesh"] is not None
+                    and jax.devices()[0].platform == "cpu")
+        pending = []
+        totals: Dict[str, float] = {}
+        n = 0
+
+        def pull(bs, metrics):
+            nonlocal n
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v) * bs
+            n += bs
+
+        drawn = 0
+        for batch in batches:
+            if steps is not None and drawn >= steps:
+                break
+            drawn += 1
+            bs = batch[0].shape[0]
+            if sharding is not None and bs % sharding.mesh.shape["data"] == 0:
+                batch = jax.device_put(batch, sharding)
+            metrics = c["eval_step"](self.state, batch)
+            if sync_now:
+                pull(bs, metrics)
+            else:
+                pending.append((bs, metrics))
+        for bs, metrics in pending:
+            pull(bs, metrics)
+        out = {k: v / max(n, 1) for k, v in totals.items()}
+        if verbose:
+            parts = ", ".join(f"{k}={v:.4f}" for k, v in out.items())
+            print(f"evaluate: {parts}", flush=True)
+        return out
+
     # -- weights IO (Keras save_weights/load_weights parity) -------------
     def save_weights(self, ckpt_dir: str) -> str:
         """Write {params, model_state} (not optimizer state) as a

@@ -929,3 +929,19 @@ class TestFitStream:
             model.fit_stream(
                 data.tfrecord_batches(path, parse, batch_size=50),
                 steps_per_epoch=2, verbose=0)
+
+    def test_evaluate_stream(self, tmp_path):
+        """Streamed evaluation: weighted means over the drawn batches
+        match in-memory evaluate on the same examples."""
+        path, parse = self._records(tmp_path, n=208)  # 4 batches of 50
+        model = self._model()
+        (xt, yt), _ = data.xor_data(208, val_size=8, seed=0)
+        model.fit(xt, yt, epochs=1, batch_size=50, verbose=0)
+        streamed = model.evaluate_stream(
+            data.tfrecord_batches(path, parse, batch_size=50), verbose=0)
+        in_mem = model.evaluate(xt[:200], yt[:200], batch_size=50, verbose=0)
+        assert abs(streamed["loss"] - in_mem["loss"]) < 1e-5
+        limited = model.evaluate_stream(
+            data.tfrecord_batches(path, parse, batch_size=50), steps=1,
+            verbose=0)
+        assert set(limited) == set(in_mem)
