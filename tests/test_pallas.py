@@ -352,3 +352,48 @@ class TestFusedLayerNorm:
                       argnums=(0, 1, 2))(x, gamma, beta)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+class TestFlashShapeFuzz:
+    def test_random_shape_parity(self):
+        """Seeded fuzz over odd seq lengths / head counts / GQA ratios /
+        mask kinds: the padded-block kernel must match dense attention on
+        shapes that don't divide the (512, 1024) default blocks."""
+        import numpy as np
+        from distributed_tensorflow_tpu.ops import attention as attn_lib
+        from distributed_tensorflow_tpu.ops.pallas.flash_attention import (
+            flash_attention)
+
+        rng = np.random.default_rng(20260731)
+        for trial in range(6):
+            b = int(rng.integers(1, 3))
+            s = int(rng.integers(3, 97))
+            groups = int(rng.choice([1, 2, 4]))
+            kvh = int(rng.choice([1, 2]))
+            h = kvh * groups
+            d = int(rng.choice([8, 16]))
+            causal = bool(rng.integers(0, 2))
+            use_pad = bool(rng.integers(0, 2))
+            ks = jax.random.split(jax.random.PRNGKey(trial), 3)
+            q = jax.random.normal(ks[0], (b, s, h, d))
+            k = jax.random.normal(ks[1], (b, s, kvh, d))
+            v = jax.random.normal(ks[2], (b, s, kvh, d))
+            kv_valid = None
+            mask = attn_lib.causal_mask(s) if causal else None
+            if use_pad and not causal:
+                keep = max(1, s - int(rng.integers(0, s)))
+                kv_valid = jnp.asarray(
+                    np.arange(s)[None, :] < keep, jnp.int32
+                ).repeat(b, axis=0)
+                mask = attn_lib.padding_mask(kv_valid)
+            got = flash_attention(q, k, v, kv_valid=kv_valid, causal=causal)
+            if kvh != h:   # dense path wants broadcast kv heads
+                k2 = jnp.repeat(k, groups, axis=2)
+                v2 = jnp.repeat(v, groups, axis=2)
+            else:
+                k2, v2 = k, v
+            want = attn_lib.dot_product_attention(q, k2, v2, mask=mask)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-5,
+                err_msg=f"trial {trial}: b={b} s={s} h={h} kvh={kvh} "
+                        f"d={d} causal={causal} pad={use_pad}")
