@@ -945,3 +945,20 @@ class TestFitStream:
             data.tfrecord_batches(path, parse, batch_size=50), steps=1,
             verbose=0)
         assert set(limited) == set(in_mem)
+
+    def test_fit_stream_on_mesh(self, tmp_path):
+        """Streamed fit over the 8-device mesh with multi-step grouping:
+        sharded uploads for both group and tail dispatches."""
+        from distributed_tensorflow_tpu import parallel
+        path, parse = self._records(tmp_path, n=408)  # 8 batches of 48
+        model = models.Sequential([ops.Dense(16, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="adam",
+                      mesh=parallel.data_parallel_mesh(),
+                      steps_per_execution=3)
+        hist = model.fit_stream(
+            lambda epoch: data.tfrecord_batches(path, parse, batch_size=48,
+                                                epoch=epoch),
+            steps_per_epoch=8, epochs=2, verbose=0)
+        assert len(hist.history["loss"]) == 2
+        assert np.isfinite(hist.history["loss"][-1])
