@@ -604,86 +604,66 @@ class Sequential:
 
     def evaluate(self, x, y, batch_size: int = 32,
                  verbose: int = 1) -> Dict[str, float]:
-        c = self._require_compiled()
-        if self.state is None:
-            raise RuntimeError("model has no state; call fit or build first")
         dataset = Dataset([np.asarray(x), np.asarray(y)], batch_size,
                           shuffle=False, drop_remainder=False)
-        sharding = None
-        if c["mesh"] is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            sharding = NamedSharding(c["mesh"], PartitionSpec("data"))
-        # Dispatch every eval step first (device arrays, un-pulled), THEN
-        # pull: a float() per batch would sync the queue once per dispatch,
-        # which over a TPU tunnel costs more than the eval compute.  The
-        # exception is the CPU mesh, whose collective rendezvous dies
-        # under a deep async queue (same guard as fit's sync_every).
-        sync_now = (c["mesh"] is not None
-                    and jax.devices()[0].platform == "cpu")
-        pending = []
-        totals: Dict[str, float] = {}
-        n = 0
-
-        def pull(bs, metrics):
-            nonlocal n
-            for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * bs
-            n += bs
-
-        for batch in iter(dataset):
-            bs = batch[0].shape[0]
-            if sharding is not None and bs % sharding.mesh.shape["data"] == 0:
-                batch = jax.device_put(batch, sharding)
-            metrics = c["eval_step"](self.state, batch)
-            if sync_now:
-                pull(bs, metrics)
-            else:
-                pending.append((bs, metrics))
-        for bs, metrics in pending:
-            pull(bs, metrics)
-        out = {k: v / max(n, 1) for k, v in totals.items()}
-        if verbose:
-            parts = ", ".join(f"{k}={v:.4f}" for k, v in out.items())
-            print(f"evaluate: {parts}", flush=True)
-        return out
+        return self._evaluate_batches(iter(dataset), verbose)
 
     def evaluate_stream(self, batches, steps: Optional[int] = None,
                         verbose: int = 1) -> Dict[str, float]:
         """``evaluate`` over streamed ``(x, y)`` batches (an iterator, e.g.
         ``data.tfrecord_batches``): batch-size-weighted metric means over
-        up to ``steps`` batches (all of them when ``steps`` is None).
-        Same async-queue pull discipline as ``evaluate``."""
+        up to ``steps`` batches (all of them when ``steps`` is None; the
+        limit is an ``islice``, so no extra batch is drawn from a shared
+        iterator).  Same pull discipline and multi-host upload path as
+        ``evaluate``/``fit_stream``."""
+        import itertools
+        it = batches if steps is None else itertools.islice(batches, steps)
+        return self._evaluate_batches(it, verbose)
+
+    def _evaluate_batches(self, it, verbose: int) -> Dict[str, float]:
+        """ONE eval core: batch-size-weighted metric means over an
+        iterator of (x, y) batches.  Pulls are deferred (a float() per
+        batch would sync the async dispatch queue once per dispatch —
+        over a TPU tunnel that costs more than the eval compute) but
+        BOUNDED by the same ``_sync_every`` cadence the fit paths use, so
+        neither the dispatch queue nor the pending list grows with the
+        stream; on the CPU mesh the cadence is 1, which is also the
+        collective-rendezvous guard.  Uploads route through
+        ``prefetch_to_device`` — overlap plus the multi-host per-process
+        assembly — except batches not divisible by the mesh's data shards
+        (the ragged eval tail), which stay host-side as before."""
         c = self._require_compiled()
         if self.state is None:
             raise RuntimeError("model has no state; call fit or build first")
         sharding, _ = _stream_shardings(c["mesh"], 0, want_multi=False)
-        sync_now = (c["mesh"] is not None
-                    and jax.devices()[0].platform == "cpu")
+        shards = (sharding.mesh.shape["data"] if sharding is not None
+                  else 1)
+
+        def batch_sharding(item):
+            if sharding is not None and item[0].shape[0] % shards == 0:
+                return sharding
+            return None
+
+        sync_every = _sync_every(c["mesh"])
         pending = []
         totals: Dict[str, float] = {}
         n = 0
 
-        def pull(bs, metrics):
+        def pull_all():
             nonlocal n
-            for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v) * bs
-            n += bs
+            for bs, metrics in pending:
+                for k, v in metrics.items():
+                    totals[k] = totals.get(k, 0.0) + float(v) * bs
+                n += bs
+            pending.clear()
 
-        drawn = 0
-        for batch in batches:
-            if steps is not None and drawn >= steps:
-                break
-            drawn += 1
-            bs = batch[0].shape[0]
-            if sharding is not None and bs % sharding.mesh.shape["data"] == 0:
-                batch = jax.device_put(batch, sharding)
-            metrics = c["eval_step"](self.state, batch)
-            if sync_now:
-                pull(bs, metrics)
-            else:
-                pending.append((bs, metrics))
-        for bs, metrics in pending:
-            pull(bs, metrics)
+        for batch in prefetch_to_device(it, sharding=None,
+                                        sharding_fn=batch_sharding):
+            pending.append((batch[0].shape[0],
+                            c["eval_step"](self.state, batch)))
+            if len(pending) >= sync_every:
+                pull_all()
+        pull_all()
         out = {k: v / max(n, 1) for k, v in totals.items()}
         if verbose:
             parts = ", ".join(f"{k}={v:.4f}" for k, v in out.items())
