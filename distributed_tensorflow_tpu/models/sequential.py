@@ -604,6 +604,9 @@ class Sequential:
 
     def evaluate(self, x, y, batch_size: int = 32,
                  verbose: int = 1) -> Dict[str, float]:
+        self._require_compiled()
+        if self.state is None:
+            raise RuntimeError("model has no state; call fit or build first")
         dataset = Dataset([np.asarray(x), np.asarray(y)], batch_size,
                           shuffle=False, drop_remainder=False)
         return self._evaluate_batches(iter(dataset), verbose)
@@ -630,14 +633,33 @@ class Sequential:
         stream; on the CPU mesh the cadence is 1, which is also the
         collective-rendezvous guard.  Uploads route through
         ``prefetch_to_device`` — overlap plus the multi-host per-process
-        assembly — except batches not divisible by the mesh's data shards
-        (the ragged eval tail), which stay host-side as before."""
+        assembly.  A batch not divisible by the mesh's data shards (the
+        ragged eval tail) is uploaded unsharded on one host, but in a
+        MULTI-process run it cannot be assembled into a consistent global
+        array, so there it is DROPPED from the means with a warning
+        (drop_remainder semantics) rather than fed divergent into the
+        mesh computation."""
         c = self._require_compiled()
         if self.state is None:
             raise RuntimeError("model has no state; call fit or build first")
         sharding, _ = _stream_shardings(c["mesh"], 0, want_multi=False)
         shards = (sharding.mesh.shape["data"] if sharding is not None
                   else 1)
+        multi_process = jax.process_count() > 1
+
+        def keep(it):
+            for b in it:
+                if (sharding is not None and multi_process
+                        and b[0].shape[0] % shards):
+                    log.warning(
+                        "evaluate: dropping ragged batch of %d (not "
+                        "divisible by %d data shards; cannot assemble a "
+                        "consistent global array across processes)",
+                        b[0].shape[0], shards)
+                    continue
+                yield b
+
+        it = keep(it)
 
         def batch_sharding(item):
             if sharding is not None and item[0].shape[0] % shards == 0:
