@@ -13,6 +13,12 @@ def test_train_gpt_example_smoke(tmp_path):
          "--steps=8", "--batch_size=16", f"--log_dir={tmp_path}"],
         env=env, capture_output=True, text=True, timeout=900,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    assert proc.returncode in (0, 1), proc.stderr[-2000:]
+    # rc 1 is the script's defined "ran fine but didn't beat the uniform
+    # baseline" outcome (train_gpt.py prints the WARNING and returns 1) —
+    # possible at an 8-step budget.  Anything else nonzero is a crash.
+    ok = proc.returncode == 0 or (
+        proc.returncode == 1
+        and "did not beat the uniform baseline" in proc.stderr)
+    assert ok, f"rc={proc.returncode}\n{proc.stderr[-2000:]}"
     assert "eval loss:" in proc.stdout
     assert any(p.startswith("ckpt-") for p in os.listdir(tmp_path))
