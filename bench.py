@@ -596,7 +596,7 @@ def bench_mnist_mlp():
     return _attach_mfu(result, value, flops, analytic=6.1e5)
 
 
-def _gpt_bench_config(seq):
+def _gpt_bench_config(seq, experts=0):
     """The GPT bench model: GPT-2-small (or the SMOKE shrink), bf16.
     ONE constructor shared by the train and decode rows so their numbers
     stay measurements of the same model."""
@@ -607,10 +607,7 @@ def _gpt_bench_config(seq):
     # backward and OOMs a 16G chip at batch 48/seq 256; rematerialising
     # measured FASTER at equal batch too (scripts/tune_gpt_batch.py,
     # 2026-07-31: 120k tok/s at remat batch 48 vs 101-108k no-remat 24)
-    moe = {}
-    experts = int(os.environ.get("DTTPU_BENCH_GPT_MOE", "0"))
-    if experts:
-        moe = dict(moe_experts=experts, moe_top_k=2)
+    moe = dict(moe_experts=experts, moe_top_k=2) if experts else {}
     return (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                       num_heads=2, intermediate_size=512,
                       max_position=seq, dtype=jnp.bfloat16,
@@ -622,9 +619,13 @@ def _gpt_bench_config(seq):
                            remat=True, **moe))
 
 
-def bench_gpt():
+def bench_gpt(seq=None, experts=None):
     """Causal-LM training throughput (tokens/s/chip) on a GPT-2-small-
-    shaped decoder, bf16, adamw — the LM-family row next to BERT's MLM."""
+    shaped decoder, bf16, adamw — the LM-family row next to BERT's MLM.
+    ``seq``/``experts`` are defaults the env vars may still override; the
+    moe/long rows pass them explicitly rather than mutating os.environ
+    (which would leak into later rows in a same-process multi-config
+    run)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -634,8 +635,9 @@ def bench_gpt():
 
     n_chips = len(jax.devices())
     mesh = parallel.data_parallel_mesh()
-    seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
-    config = _gpt_bench_config(seq)
+    seq = int(os.environ.get("DTTPU_BENCH_SEQ", seq or 256))
+    experts = int(os.environ.get("DTTPU_BENCH_GPT_MOE", experts or 0))
+    config = _gpt_bench_config(seq, experts)
     model = GPT(config)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(1e-4)
@@ -838,10 +840,10 @@ def bench_gpt_moe():
     routing + aux load-balance loss) — the measured row for the MoE
     subsystem.  Single-chip the experts are co-located (no all_to_all);
     the routing/capacity compute is what this row prices."""
-    os.environ.setdefault("DTTPU_BENCH_GPT_MOE", "8")
-    result = bench_gpt()
+    experts = int(os.environ.get("DTTPU_BENCH_GPT_MOE", "8"))
+    result = bench_gpt(experts=experts)
     result["metric"] = "gpt_moe" + result.pop("metric")[len("gpt"):]
-    result["moe_experts"] = int(os.environ["DTTPU_BENCH_GPT_MOE"])
+    result["moe_experts"] = experts
     return result
 
 
@@ -851,8 +853,7 @@ def bench_gpt_long():
     TPU (crossover at DTTPU_FLASH_MIN_SEQ=2048, docs/PERF.md); seq 256
     keeps the default gpt row on the XLA path, so this row is the one
     that exercises flash attention end-to-end in a train step."""
-    os.environ.setdefault("DTTPU_BENCH_SEQ", "2048")
-    result = bench_gpt()
+    result = bench_gpt(seq=2048)
     result["metric"] = "gpt_long" + result.pop("metric")[len("gpt"):]
     return result
 

@@ -55,6 +55,10 @@ class BertConfig:
     # Hardware-validated + measured 2026-07-31 (docs/PERF.md): ties XLA at
     # seq <= 1024, wins 1.3-1.7x at 2048, ~3x at 4096 — "auto" is safe.
     use_flash: Any = "auto"
+    # True / False / "auto": LayerNorms via the fused Pallas kernel
+    # (ops.pallas.fused_layernorm, one HBM pass); auto = TPU only.
+    # Default False until the end-to-end win is measured on hardware.
+    fused_layernorm: Any = False
     # FFN / MLM-transform activation: "gelu_approx" (tanh, the GPT-2/zoo
     # default) or "gelu" (exact erf — what HF BERT checkpoints were
     # trained with; models/convert.py sets this)
@@ -84,12 +88,20 @@ def bert_tiny(**kw) -> "Bert":
     return Bert(BertConfig(**kw))
 
 
-def _layer_norm(params, x, eps):
+def _layer_norm(params, x, eps, fused=False):
+    if fused:
+        from ..ops.pallas import fused_layernorm
+        return fused_layernorm(x, params["gamma"], params["beta"], eps=eps)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
     y = (xf - mean) * jax.lax.rsqrt(var + eps)
     return (y * params["gamma"] + params["beta"]).astype(x.dtype)
+
+
+def _resolve_fused_ln(flag) -> bool:
+    from ..ops.pallas import resolve_fused_ln
+    return resolve_fused_ln(flag)
 
 
 def _dropout(x, rate, rng, train):
@@ -194,15 +206,16 @@ class Bert:
 
     def _encoder_layer(self, p, x, mask, valid, rng, train):
         c = self.config
+        fused = _resolve_fused_ln(c.fused_layernorm)
         r1, r2, r3 = jax.random.split(rng, 3)
         attn_out = self._attention(p["attention"], x, mask, valid, r1, train)
         x = _layer_norm(p["attention"]["ln"],
                         x + _dropout(attn_out, c.dropout_rate, r2, train),
-                        c.layer_norm_eps)
+                        c.layer_norm_eps, fused=fused)
         ffn_out = attn_lib.ffn_core(p["ffn"], x, activation=c.act_fn)
         return _layer_norm(p["ffn"]["ln"],
                            x + _dropout(ffn_out, c.dropout_rate, r3, train),
-                           c.layer_norm_eps)
+                           c.layer_norm_eps, fused=fused)
 
     def apply(self, params, input_ids, *, token_type_ids=None,
               attention_mask=None, train: bool = False, rng=None):
@@ -222,7 +235,8 @@ class Bert:
             x = x + jnp.take(emb["type"], token_type_ids, axis=0)
         else:
             x = x + emb["type"][0][None, None, :]
-        x = _layer_norm(emb["ln"], x, c.layer_norm_eps)
+        x = _layer_norm(emb["ln"], x, c.layer_norm_eps,
+                        fused=_resolve_fused_ln(c.fused_layernorm))
         r_emb, r_layers = jax.random.split(rng)
         x = _dropout(x, c.dropout_rate, r_emb, train).astype(c.dtype)
 
@@ -251,7 +265,8 @@ class Bert:
         dtype = sequence_output.dtype
         h = c.act_fn(sequence_output @ p["transform"]["kernel"].astype(dtype)
                      + p["transform"]["bias"].astype(dtype))
-        h = _layer_norm(p["ln"], h, c.layer_norm_eps)
+        h = _layer_norm(p["ln"], h, c.layer_norm_eps,
+                        fused=_resolve_fused_ln(c.fused_layernorm))
         logits = h @ params["embeddings"]["word"].T.astype(dtype)
         return logits.astype(jnp.float32) + p["output_bias"]
 

@@ -56,6 +56,11 @@ class GPTConfig:
     # Hardware-validated + measured 2026-07-31 (docs/PERF.md): ties XLA at
     # seq <= 1024, wins 1.3-1.7x at 2048, ~3x at 4096 — "auto" is safe.
     use_flash: Any = "auto"
+    # True / False / "auto": block LayerNorms via the fused Pallas kernel
+    # (ops.pallas.fused_layernorm); auto = TPU only; layernorm norm only
+    # (the rmsnorm path has no fused kernel).  Default False until the
+    # end-to-end win is measured on hardware.
+    fused_layernorm: Any = False
     # "learned" absolute positions (GPT-2) or "rope" rotary embeddings
     # (relative; extrapolates past trained length, no position table)
     position_embedding: str = "learned"
@@ -245,7 +250,9 @@ class GPT:
                 jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
                 + c.layer_norm_eps)
             return (y * p["gamma"]).astype(x.dtype)
-        return _layer_norm(p, x, c.layer_norm_eps)
+        from ..ops.pallas import resolve_fused_ln
+        return _layer_norm(p, x, c.layer_norm_eps,
+                           fused=resolve_fused_ln(c.fused_layernorm))
 
     def _rope_transform(self, local_seq_len: int):
         """qk_transform for this forward, or None.  Built ONCE per forward

@@ -44,6 +44,13 @@ def _group_batches(it, spe: int, active: bool):
         return
     buf = []
     for b in it:
+        # A ragged batch (e.g. a drop_remainder=False tail) can't be
+        # stacked with its neighbours; flush the buffer as single batches
+        # instead of letting np.stack raise an opaque ValueError from
+        # inside the producer thread.
+        if buf and any(x.shape != y.shape for x, y in zip(b, buf[0])):
+            yield from buf
+            buf = []
         buf.append(b)
         if len(buf) == spe:
             yield tuple(np.stack(z) for z in zip(*buf))
@@ -78,21 +85,35 @@ def _sync_every(mesh) -> int:
 
 
 class _MeanAccumulator:
-    """Sampled running mean of pulled step metrics — every pulled
-    dispatch contributes all its entries (the K of a multi-step group)."""
+    """Exact epoch mean of step metrics with no per-batch host pulls:
+    every dispatch's scalars (or the [K] vector of a multi-step group)
+    are summed into a DEVICE-resident running total — a couple of tiny
+    async dispatches per step — and the host pulls once, at epoch end.
+    Replaces the round-3 sampled mean (every ~50th dispatch on TPU),
+    which made History a sample rather than the Keras mean over all
+    batches.  ``block()`` is the queue-depth bound: called at the sync
+    cadence it waits for the running total (and therefore every chained
+    step before it) without transferring anything."""
 
     def __init__(self):
-        self.sums: Dict[str, float] = {}
+        self.sums: Dict[str, Any] = {}
         self.counts: Dict[str, int] = {}
 
     def add(self, metrics: Dict[str, Any]) -> None:
         for k, v in metrics.items():
-            v = np.asarray(v, np.float64).reshape(-1)
-            self.sums[k] = self.sums.get(k, 0.0) + float(v.sum())
-            self.counts[k] = self.counts.get(k, 0) + v.size
+            s = jnp.sum(jnp.asarray(v), dtype=jnp.float32)
+            prev = self.sums.get(k)
+            self.sums[k] = s if prev is None else prev + s
+            self.counts[k] = (self.counts.get(k, 0)
+                              + int(np.prod(np.shape(v)) or 1))
+
+    def block(self) -> None:
+        for v in self.sums.values():
+            jax.block_until_ready(v)
+            break
 
     def means(self) -> Dict[str, float]:
-        return {k: self.sums[k] / self.counts[k] for k in self.sums}
+        return {k: float(self.sums[k]) / self.counts[k] for k in self.sums}
 
 
 class Sequential:
@@ -371,14 +392,14 @@ class Sequential:
                 break
             for cb in callbacks:
                 cb.on_epoch_begin(self, epoch)
-            # Sampled running mean: only dispatches at sync points are
-            # pulled (a float() per batch would stall the async dispatch
-            # queue); with sync_every=1 (CPU mesh) this IS the exact Keras
-            # epoch mean of batch metrics.
+            # Exact epoch mean, accumulated on device: every dispatch
+            # contributes (a float() per batch would stall the async
+            # dispatch queue, so the host pulls once at epoch end); the
+            # sync cadence only BLOCKS — bounding queue depth, and on the
+            # CPU mesh guarding the collective rendezvous.
             sync_every = _sync_every(c["mesh"])
             acc = _MeanAccumulator()
             last_metrics: Dict[str, Any] = {}
-            count = 0
             dispatches = 0
             groups = _group_batches(iter(dataset), spe,
                                     multi_step is not None)
@@ -386,13 +407,12 @@ class Sequential:
                                             sharding_fn=batch_sharding):
                 if batch[0].ndim > base_ndim:       # [K, batch, ...] group
                     self.state, last_metrics = multi_step(self.state, batch)
-                    count += spe
                 else:
                     self.state, last_metrics = train_step(self.state, batch)
-                    count += 1
                 dispatches += 1
-                if dispatches % sync_every == 0 or count == len(dataset):
-                    acc.add(last_metrics)
+                acc.add(last_metrics)
+                if dispatches % sync_every == 0:
+                    acc.block()
             logs = acc.means()
             if validation_data is not None:
                 val = self.evaluate(validation_data[0], validation_data[1],
@@ -481,7 +501,6 @@ class Sequential:
             last_metrics: Dict[str, Any] = {}
             drawn = 0
             dispatches = 0
-            pulled_at = 0
             epoch_began = False
             groups = _group_batches(it, spe, multi_step is not None)
             for batch in prefetch_to_device(groups, sharding=sharding,
@@ -499,13 +518,11 @@ class Sequential:
                     self.state, last_metrics = train_step(self.state, batch)
                     drawn += 1
                 dispatches += 1
+                acc.add(last_metrics)
                 if dispatches % sync_every == 0:
-                    acc.add(last_metrics)
-                    pulled_at = dispatches
+                    acc.block()
             if not epoch_began:
                 break                              # stream already dry
-            if dispatches > pulled_at and last_metrics:
-                acc.add(last_metrics)
             exhausted = drawn < steps_per_epoch
             logs = acc.means()
             if validation_data is not None:
@@ -646,6 +663,7 @@ class Sequential:
         shards = (sharding.mesh.shape["data"] if sharding is not None
                   else 1)
         multi_process = jax.process_count() > 1
+        dropped = [0]
 
         def keep(it):
             for b in it:
@@ -656,6 +674,7 @@ class Sequential:
                         "divisible by %d data shards; cannot assemble a "
                         "consistent global array across processes)",
                         b[0].shape[0], shards)
+                    dropped[0] += b[0].shape[0]
                     continue
                 yield b
 
@@ -687,6 +706,12 @@ class Sequential:
                 pull_all()
         pull_all()
         out = {k: v / max(n, 1) for k, v in totals.items()}
+        if dropped[0]:
+            # Make the 1-process vs N-process divergence visible in the
+            # RESULT, not only in a log line: callers comparing eval
+            # numbers across topologies can see how many examples the
+            # N-process means exclude.
+            out["dropped_examples"] = float(dropped[0])
         if verbose:
             parts = ", ".join(f"{k}={v:.4f}" for k, v in out.items())
             print(f"evaluate: {parts}", flush=True)

@@ -23,7 +23,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fused_adam_update", "fused_layernorm"]
+__all__ = ["fused_adam_update", "fused_layernorm", "resolve_fused_ln"]
+
+
+def resolve_fused_ln(flag) -> bool:
+    """Model-config gate for ``fused_layernorm``: True/False pass through;
+    "auto" means the Pallas kernel on TPU only (off-TPU it would run in
+    slow interpret mode)."""
+    if flag == "auto":
+        import jax
+        return jax.default_backend() == "tpu"
+    return bool(flag)
 
 _LANES = 128
 _BLOCK_ROWS = 256        # 256 x 128 f32 = 128 KiB per stream, well under VMEM

@@ -1,0 +1,187 @@
+"""Single-run MFU ablation for the LM bench rows, on real TPU.
+
+Round-3 verdict: BERT 0.112 / Llama 0.140 / GPT 0.169 MFU got remat and
+batch tuning only — the repo's own fused kernels were never in a bench
+config, and nobody profiled where the step time actually goes.  This
+script measures every candidate lever in ONE tunnel window so the arms
+are comparable (docs/PERF.md methodology: donated-state step chain closed
+by a value fetch; compare only within one run):
+
+gpt arms:   base(remat,b48,s256) / fused_adam / fused_ln / both /
+            vocab_pad(50304: lm head + embed padded to a 128-multiple
+            lane width) / batch96 / batch192 / seq512_b24
+bert arms:  base(s128,b64) / seq256 / fused_adam / fused_ln / batch128
+
+Usage: python scripts/mfu_ablation.py [gpt|bert] [arm ...]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# DTTPU_ABLATION_SMOKE=1: shrink every arm to a 2-layer toy so the script's
+# wiring can be validated on CPU in seconds; numbers are meaningless there.
+SMOKE = bool(os.environ.get("DTTPU_ABLATION_SMOKE"))
+
+PEAK = {"v5e": 197e12, "v5 lite": 197e12, "v5p": 459e12,
+        "v6e": 918e12, "v4": 275e12}
+
+
+def peak_flops():
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for k, v in PEAK.items():
+        if k in kind:
+            return v
+    return None
+
+
+def time_step(step, state, batch, warmup=3, steps=10):
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    loss = float(metrics["loss"])  # value fetch closes the window
+    return (time.perf_counter() - t0) / steps, loss
+
+
+def run_gpt(arms):
+    from distributed_tensorflow_tpu import optim, parallel, train
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+
+    mesh = parallel.data_parallel_mesh()
+    bsh = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(0)
+    peak = peak_flops()
+
+    MATRIX = {
+        "base":       dict(),
+        "fused_adam": dict(fused_adam=True),
+        "fused_ln":   dict(fused_layernorm=True),
+        "both":       dict(fused_adam=True, fused_layernorm=True),
+        "vocab_pad":  dict(vocab=50304),
+        "batch96":    dict(batch=96),
+        "batch192":   dict(batch=192),
+        "seq512_b24": dict(seq=512, batch=24),
+    }
+    for arm in arms or MATRIX:
+        a = MATRIX[arm]
+        seq, batch = a.get("seq", 256), a.get("batch", 48)
+        vocab = a.get("vocab", 50257)
+        if SMOKE:
+            seq, batch = min(seq, 64), min(batch, 4)
+        config = GPTConfig(vocab_size=vocab, hidden_size=64 if SMOKE else 768,
+                           num_layers=2 if SMOKE else 12,
+                           num_heads=2 if SMOKE else 12,
+                           intermediate_size=128 if SMOKE else 3072,
+                           max_position=seq, dtype=jnp.bfloat16,
+                           dropout_rate=0.0, remat=True,
+                           fused_layernorm=a.get("fused_layernorm", False))
+        model = GPT(config)
+        optimizer = optim.adamw(1e-4, fused=a.get("fused_adam", False))
+        step = train.make_custom_train_step(model.lm_loss_fn(), optimizer,
+                                            grad_clip_norm=1.0)
+        try:
+            params = model.init(jax.random.PRNGKey(0))
+            n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+            state = train.TrainState.create(params, optimizer.init(params))
+            state = jax.device_put(state, NamedSharding(mesh, P()))
+            # targets stay < 50257 so vocab_pad's tail rows get no gradient
+            # traffic beyond the matmul itself — same work, aligned shapes
+            tokens = rng.integers(0, 50257, (batch, seq + 1)).astype(np.int32)
+            bb = jax.device_put({"input_ids": tokens}, bsh)
+            dt, loss = time_step(step, state, bb)
+            toks = batch * seq / dt
+            f_tok = 6.0 * n_params + 12.0 * 12 * 768 * seq
+            out = {"model": "gpt", "arm": arm, "batch": batch, "seq": seq,
+                   "tokens_per_sec": round(toks, 1),
+                   "ms_per_step": round(dt * 1e3, 2), "loss": round(loss, 3)}
+            if peak:
+                out["mfu"] = round(toks * f_tok / peak, 4)
+            print(json.dumps(out), flush=True)
+        except Exception as e:  # noqa: BLE001 - OOM arms are data
+            print(json.dumps({"model": "gpt", "arm": arm,
+                              "error": str(e)[:160]}), flush=True)
+
+
+def run_bert(arms):
+    from distributed_tensorflow_tpu import optim, parallel, train
+    from distributed_tensorflow_tpu.models.bert import Bert, BertConfig
+
+    mesh = parallel.data_parallel_mesh()
+    bsh = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(0)
+    peak = peak_flops()
+
+    MATRIX = {
+        "base":       dict(),
+        "seq256":     dict(seq=256, batch=32),
+        "fused_adam": dict(fused_adam=True),
+        "fused_ln":   dict(fused_layernorm=True),
+        "batch128":   dict(batch=128),
+    }
+    for arm in arms or MATRIX:
+        a = MATRIX[arm]
+        seq, batch = a.get("seq", 128), a.get("batch", 64)
+        if SMOKE:
+            seq, batch = min(seq, 64), min(batch, 4)
+        kw = (dict(vocab_size=512, hidden_size=64, num_layers=2,
+                   num_heads=2, intermediate_size=128) if SMOKE else {})
+        config = BertConfig(max_position=seq, dtype=jnp.bfloat16,
+                            dropout_rate=0.0, remat=True,
+                            fused_layernorm=a.get("fused_layernorm", False),
+                            **kw)
+        model = Bert(config)
+        optimizer = optim.adamw(1e-4, fused=a.get("fused_adam", False))
+        step = train.make_custom_train_step(model.mlm_loss_fn(), optimizer,
+                                            grad_clip_norm=1.0)
+        try:
+            params = model.init(jax.random.PRNGKey(0))
+            n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+            state = train.TrainState.create(params, optimizer.init(params))
+            state = jax.device_put(state, NamedSharding(mesh, P()))
+            ids = rng.integers(0, config.vocab_size,
+                               (batch, seq)).astype(np.int32)
+            batch_d = jax.device_put(
+                {"input_ids": ids,
+                 "labels": ids,
+                 "mlm_mask": (rng.random((batch, seq)) < 0.15
+                              ).astype(np.float32),
+                 "attention_mask": np.ones((batch, seq), np.int32)}, bsh)
+            dt, loss = time_step(step, state, batch_d)
+            toks = batch * seq / dt
+            f_tok = 6.0 * n_params + 12.0 * 12 * 768 * seq
+            out = {"model": "bert", "arm": arm, "batch": batch, "seq": seq,
+                   "tokens_per_sec": round(toks, 1),
+                   "ms_per_step": round(dt * 1e3, 2), "loss": round(loss, 3)}
+            if peak:
+                out["mfu"] = round(toks * f_tok / peak, 4)
+            print(json.dumps(out), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"model": "bert", "arm": arm,
+                              "error": str(e)[:160]}), flush=True)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({getattr(dev, 'device_kind', '?')})",
+          file=sys.stderr)
+    which = sys.argv[1] if len(sys.argv) > 1 else "gpt"
+    arms = sys.argv[2:]
+    if which in ("gpt", "all"):
+        run_gpt(arms if which == "gpt" else None)
+    if which in ("bert", "all"):
+        run_bert(arms if which == "bert" else None)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

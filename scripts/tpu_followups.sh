@@ -3,8 +3,15 @@
 # repo root; each step prints one JSON line or a short table to stdout).
 # Order: cheapest liveness first, then the rows whose PERF.md entries are
 # pending.  Safe to re-run; every step is read-only w.r.t. the repo.
+#
+# Round-4 queue (VERDICT r3 items 2-4): the flagship headline first so a
+# short window still lands a driver-comparable number, then the pending
+# r3 rows, then the MFU ablation arms, then the d128 flash validation.
 set -x
 timeout 60 python -c "import jax; print(jax.devices())" || exit 1
+
+# the driver's headline row on hardware (mnist_mlp, supervisor-wrapped)
+timeout 900 python bench.py
 
 # decode throughput after the cache-carry fix (pre-fix same-day: 7,017)
 timeout 900 python bench.py --config=gpt_decode
@@ -15,5 +22,16 @@ timeout 900 python bench.py --config=gpt_decode_int8
 # the flash-dispatch operating point (seq 2048)
 timeout 1200 python bench.py --config=gpt_long
 
+# MoE row: an actual number for the 85b4bf0 claim
+timeout 1200 python bench.py --config=gpt_moe
+
+# MFU ablation: fused adam / fused LN / vocab pad / batch+seq ladder,
+# one window so arms are comparable (gpt first, then bert incl. seq 256)
+timeout 1800 python scripts/mfu_ablation.py gpt
+timeout 1200 python scripts/mfu_ablation.py bert
+
 # BERT remat/batch operating point (decides whether bench_bert flips remat)
 timeout 900 python scripts/tune_bert_batch.py
+
+# flash d128 head-dim (the Llama preset) hardware validation + crossover
+timeout 1200 python scripts/validate_flash_tpu.py

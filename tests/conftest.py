@@ -23,3 +23,73 @@ if "xla_force_host_platform_device_count" not in _xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Speed tiers.  `pytest -m "not slow"` is the default development loop;
+# the full suite (including this list) is the CI/driver gate.  Entries are
+# nodeid prefixes (after "tests/"); whole files for the subprocess-heavy
+# tiers, individual tests elsewhere — from the measured round-4 full-run
+# durations (docs/ROUND4.md), threshold ~14 s/test on the 8-device mesh.
+_SLOW_FILES = {
+    "test_bench.py",         # supervisor/bench subprocess round-trips
+    "test_example_gpt.py",   # full example-script smoke (900 s budget)
+    "test_multihost.py",     # real 2-process jax.distributed bootstraps
+    "test_cluster.py",       # subprocess cluster bootstrap tests
+    "test_graft_entry.py",   # dryrun_multichip compile at n=1/2/8
+}
+_SLOW_TESTS = (
+    "test_pipeline.py::test_gpt_pipeline_loss_and_grads_match",
+    "test_pipeline.py::test_gpt_1f1b_full_model_grads_match_gpipe",
+    "test_pipeline.py::test_gpt_1f1b_loss_mask_matches_gpipe",
+    "test_pipeline.py::test_gpt_pipeline_training_trajectory_matches",
+    "test_pipeline.py::test_gpt_pipeline_forward_matches_sequential",
+    "test_pipeline.py::test_gpt_1f1b_train_step_converges",
+    "test_pipeline.py::test_1f1b_matches_gpipe_autodiff",
+    "test_pipeline.py::test_pipeline_backward_matches_sequential",
+    "test_pallas.py::TestFlashShapeFuzz",
+    "test_pallas.py::TestFlashGQA",
+    "test_pallas.py::TestFlashAttention::test_fused_backward",
+    "test_pallas.py::TestFlashAttention::test_gradients_match_reference",
+    "test_gpt.py::test_moe_gpt_trains_and_decodes",
+    "test_gpt.py::test_gqa_trains_cache_shrinks_and_decode_matches_forward",
+    "test_gpt.py::test_beam_search_ragged_prompts_match_solo",
+    "test_gpt.py::test_rope_gpt_trains_and_decode_matches_forward",
+    "test_gpt.py::test_kv_cache_decode_matches_full_forward",
+    "test_gpt.py::test_beam_search_ragged_plus_eos_compose",
+    "test_gpt.py::test_moe_gpt_expert_parallel_step",
+    "test_gpt.py::test_gpt_beam_search_improves_logprob_and_eos_freezes",
+    "test_gpt.py::test_ragged_prompt_left_padding_matches_solo_rows",
+    "test_gpt.py::test_bf16_forward_and_training",
+    "test_gpt.py::test_beam_search_eos_early_exit_pads_with_eos",
+    "test_sharding.py::test_fsdp_shards_params_and_optimizer_moments",
+    "test_seq2seq.py::test_beam_search_beats_or_matches_greedy",
+    "test_seq2seq.py::test_learns_copy_task",
+    "test_seq2seq.py::test_generate_eos_early_stop_and_padding",
+    "test_data.py::test_synthetic_datasets_shapes_and_learnability",
+    "test_ring.py::test_ring_gradients_flow",
+    "test_moe.py::test_single_expert_equals_dense_ffn",
+    "test_moe.py::test_moe_gradients_flow_through_router_and_experts",
+    "test_moe.py::test_tiny_capacity_drops_tokens_to_zero",
+    "test_session.py::test_masked_loss_accumulation_exact",
+    "test_convert.py::test_gpt2_logits_match_torch",
+    "test_resnet.py::test_resnet50_canonical_param_count",
+    "test_resnet.py::test_resnet_cifar_trains_and_updates_bn",
+    "test_vit.py::test_vit_tensor_parallel_step",
+    "test_vit.py::test_vit_trains",
+    "test_convergence.py::test_xor_learns_low_level",
+    "test_bert.py::test_bert_base_param_count",
+    "test_llama.py::TestLlamaRecipe::test_trains",
+    "test_quant.py::test_quantized_beam_search_with_ragged_prompts",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    slow = pytest.mark.slow
+    for item in items:
+        nodeid = item.nodeid.split("tests/")[-1]
+        if nodeid.split("::")[0] in _SLOW_FILES:
+            item.add_marker(slow)
+        elif any(nodeid.startswith(p) for p in _SLOW_TESTS):
+            item.add_marker(slow)

@@ -88,6 +88,22 @@ def test_remat_matches_no_remat():
         np.asarray(m2.apply(params, ids)), atol=1e-5)
 
 
+def test_fused_layernorm_matches_plain():
+    """fused_layernorm=True (Pallas kernel, interpret mode on CPU) must be
+    numerically interchangeable with the plain XLA LayerNorm end-to-end —
+    the wiring gate for enabling it in the bench configs."""
+    ids = jnp.ones((2, 16), jnp.int32)
+    m1 = bert_tiny(dropout_rate=0.0)
+    m2 = bert_tiny(dropout_rate=0.0, fused_layernorm=True)
+    params = m1.init(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        np.asarray(m1.apply(params, ids)),
+        np.asarray(m2.apply(params, ids)), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(m1.mlm_logits(params, m1.apply(params, ids))),
+        np.asarray(m2.mlm_logits(params, m2.apply(params, ids))), atol=1e-4)
+
+
 def test_tensor_parallel_sharding_and_step():
     mesh = make_mesh({"data": 4, "tensor": 2})
     model = bert_tiny()

@@ -758,6 +758,54 @@ class TestStepsPerExecution:
         assert np.isfinite(hist.history["loss"][-1])
 
 
+class TestGroupBatches:
+    """_group_batches: the K-stacker feeding the multi-step path must
+    tolerate ragged batches (drop_remainder=False tails) instead of
+    raising from np.stack on the producer thread."""
+
+    def test_ragged_tail_flushes_as_singles(self):
+        from distributed_tensorflow_tpu.models.sequential import \
+            _group_batches
+        full = [(np.zeros((4, 3)), np.zeros((4,))) for _ in range(5)]
+        ragged = (np.zeros((2, 3)), np.zeros((2,)))
+        out = list(_group_batches(iter(full + [ragged]), spe=2,
+                                  active=True))
+        # two stacked pairs, then the odd full batch flushed single when
+        # the ragged batch arrives, then the ragged batch itself
+        assert [o[0].shape for o in out] == [
+            (2, 4, 3), (2, 4, 3), (4, 3), (2, 3)]
+
+    def test_ragged_midstream_then_regroups(self):
+        from distributed_tensorflow_tpu.models.sequential import \
+            _group_batches
+        a = (np.zeros((4, 3)), np.zeros((4,)))
+        b = (np.zeros((2, 3)), np.zeros((2,)))
+        out = list(_group_batches(iter([a, b, a, a]), spe=2, active=True))
+        assert [o[0].shape for o in out] == [(4, 3), (2, 3), (2, 4, 3)]
+
+
+def test_evaluate_surfaces_dropped_examples(monkeypatch):
+    """In a (simulated) multi-process run, the ragged eval tail that
+    cannot be assembled into a global array is dropped — and the drop is
+    surfaced in the returned metrics, not only in a log line."""
+    import jax
+    from distributed_tensorflow_tpu import parallel
+    (xt, yt), _ = data.xor_data(100, val_size=4, seed=0)
+    model = models.Sequential([ops.Dense(8, "relu"),
+                               ops.Dense(32, "sigmoid")])
+    model.compile(loss="mean_squared_error", optimizer="sgd",
+                  mesh=parallel.data_parallel_mesh())
+    model.fit(xt, yt, epochs=1, batch_size=56, verbose=0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # 100 = 3*32 + 4: the 4-example tail is not divisible by 8 shards
+    out = model.evaluate(xt, yt, batch_size=32, verbose=0)
+    assert out["dropped_examples"] == 4.0
+    # single-process: tail kept, no field
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    out1 = model.evaluate(xt, yt, batch_size=32, verbose=0)
+    assert "dropped_examples" not in out1
+
+
 class TestGradAccum:
     """compile(grad_accum_steps=A): microbatched gradients, one update."""
 
