@@ -519,11 +519,17 @@ def bench_bert():
 
     n_chips = len(jax.devices())
     mesh = parallel.data_parallel_mesh()
-    seq = 128
+    seq = int(os.environ.get("DTTPU_BENCH_BERT_SEQ", "128"))
+    # DTTPU_BENCH_MLM_GATHER=1: head on masked positions only (cap 20% of
+    # seq) — A/B hook until the hardware ablation decides the default
+    gather = (seq // 5
+              if os.environ.get("DTTPU_BENCH_MLM_GATHER") == "1" else 0)
     config = (BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
                          num_heads=2, intermediate_size=512,
-                         max_position=seq, dtype=jnp.bfloat16) if SMOKE
-              else BertConfig(max_position=seq, dtype=jnp.bfloat16))
+                         max_position=seq, dtype=jnp.bfloat16,
+                         mlm_predictions_per_seq=gather) if SMOKE
+              else BertConfig(max_position=seq, dtype=jnp.bfloat16,
+                              mlm_predictions_per_seq=gather))
     model = Bert(config)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(1e-4)
@@ -560,10 +566,19 @@ def bench_bert():
                   vs_baseline=1.0,  # no runnable reference-era BERT
                   # baseline exists; 1.0 = "unity ratio by definition"
                   seq_len=seq, batch=batch)
+    if gather:
+        result["mlm_predictions_per_seq"] = gather
+    analytic = _transformer_flops_per_token(params, config.num_layers,
+                                            config.hidden_size, seq)
+    if gather:
+        # the gathered head skips transform d^2 + vocab projection d*V
+        # (6x each for training) on non-gathered tokens; the XLA-counted
+        # f_total already reflects this, the analytic fallback must too
+        d, v = config.hidden_size, config.vocab_size
+        analytic -= (1.0 - gather / seq) * 6.0 * (d * d + d * v)
     return _attach_mfu(
         result, tokens, _per_example_flops(f_total, batch * seq, mesh),
-        analytic=_transformer_flops_per_token(params, config.num_layers,
-                                              config.hidden_size, seq))
+        analytic=analytic)
 
 
 def bench_mnist_mlp():
@@ -608,15 +623,20 @@ def _gpt_bench_config(seq, experts=0):
     # measured FASTER at equal batch too (scripts/tune_gpt_batch.py,
     # 2026-07-31: 120k tok/s at remat batch 48 vs 101-108k no-remat 24)
     moe = dict(moe_experts=experts, moe_top_k=2) if experts else {}
+    # DTTPU_BENCH_LOSS_CHUNK > 0: chunked LM loss (the [tokens, vocab]
+    # logits never materialise) — A/B hook until the hardware ablation
+    # (scripts/mfu_ablation.py) decides the default
+    chunk = int(os.environ.get("DTTPU_BENCH_LOSS_CHUNK", "0"))
     return (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                       num_heads=2, intermediate_size=512,
                       max_position=seq, dtype=jnp.bfloat16,
-                      dropout_rate=0.0, remat=True, **moe) if SMOKE
+                      dropout_rate=0.0, remat=True,
+                      loss_seq_chunk=chunk, **moe) if SMOKE
             else GPTConfig(vocab_size=50257, hidden_size=768,
                            num_layers=12, num_heads=12,
                            intermediate_size=3072, max_position=seq,
                            dtype=jnp.bfloat16, dropout_rate=0.0,
-                           remat=True, **moe))
+                           remat=True, loss_seq_chunk=chunk, **moe))
 
 
 def bench_gpt(seq=None, experts=None):
@@ -670,6 +690,8 @@ def bench_gpt(seq=None, experts=None):
                   value=round(tokens_s, 1), unit="tokens/sec/chip",
                   vs_baseline=1.0,  # no reference-era GPT baseline exists
                   seq_len=seq, batch=batch)
+    if config.loss_seq_chunk:
+        result["loss_seq_chunk"] = config.loss_seq_chunk
     return _attach_mfu(
         result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
         analytic=_transformer_flops_per_token(params, config.num_layers,
@@ -696,15 +718,17 @@ def bench_llama():
     # comparable to the gpt row while fitting the v5e ladder comfortably
     # remat=True for the same reason as _gpt_bench_config: bigger ladder
     # rungs fit and the rematerialised step measured faster at equal batch
+    chunk = int(os.environ.get("DTTPU_BENCH_LOSS_CHUNK", "0"))
     config = (llama_config(vocab_size=512, hidden_size=128, num_layers=2,
                            num_heads=4, num_kv_heads=2,
                            intermediate_size=384, max_position=seq,
-                           dtype=jnp.bfloat16, remat=True) if SMOKE
+                           dtype=jnp.bfloat16, remat=True,
+                           loss_seq_chunk=chunk) if SMOKE
               else llama_config(vocab_size=32000, hidden_size=768,
                                 num_layers=12, num_heads=12,
                                 num_kv_heads=4, intermediate_size=2048,
                                 max_position=seq, dtype=jnp.bfloat16,
-                                remat=True))
+                                remat=True, loss_seq_chunk=chunk))
     model = GPT(config)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(1e-4)
@@ -736,6 +760,8 @@ def bench_llama():
                   value=round(tokens_s, 1), unit="tokens/sec/chip",
                   vs_baseline=1.0,  # no reference-era Llama baseline exists
                   seq_len=seq, batch=batch)
+    if config.loss_seq_chunk:
+        result["loss_seq_chunk"] = config.loss_seq_chunk
     return _attach_mfu(
         result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
         analytic=_transformer_flops_per_token(params, config.num_layers,

@@ -59,6 +59,14 @@ class BertConfig:
     # (ops.pallas.fused_layernorm, one HBM pass); auto = TPU only.
     # Default False until the end-to-end win is measured on hardware.
     fused_layernorm: Any = False
+    # >0: the original BERT ``max_predictions_per_seq`` design — gather at
+    # most N masked positions per sequence BEFORE the MLM head, so the
+    # transform/LN/vocab projection (2*d*V FLOPs/token, V=30522) runs on
+    # ~15% of tokens instead of all of them and the [b, s, V] logits are
+    # never built.  Exact vs the full path while every row has <= N masked
+    # positions; overflow drops extra positions from the loss (reported in
+    # the ``mlm_overflow`` metric).  0 = project every position.
+    mlm_predictions_per_seq: int = 0
     # FFN / MLM-transform activation: "gelu_approx" (tanh, the GPT-2/zoo
     # default) or "gelu" (exact erf — what HF BERT checkpoints were
     # trained with; models/convert.py sets this)
@@ -287,17 +295,32 @@ class Bert:
                              token_type_ids=batch.get("token_type_ids"),
                              attention_mask=batch.get("attention_mask"),
                              train=train, rng=rng)
-            logits = self.mlm_logits(params, seq)
             mask = batch["mlm_mask"]
+            labels = batch["labels"]
+            n_pred = self.config.mlm_predictions_per_seq
+            extra = {}
+            if n_pred:
+                # top_k on the 0/1 mask sorts the masked positions first;
+                # the gathered mask values double as the loss weights, so
+                # rows with fewer than n_pred masked positions pad with
+                # weight 0 and rows with more drop the overflow.
+                w, idx = jax.lax.top_k(mask.astype(jnp.float32), n_pred)
+                seq = jnp.take_along_axis(seq, idx[..., None], axis=1)
+                labels = jnp.take_along_axis(labels, idx, axis=1)
+                full = jnp.sum(mask.astype(jnp.float32))
+                mask = w
+                extra["mlm_overflow"] = full - jnp.sum(w)
+            logits = self.mlm_logits(params, seq)
             loss = loss_lib.softmax_cross_entropy_with_integer_labels(
-                logits, batch["labels"], where=mask)
-            acc_hits = (jnp.argmax(logits, -1) == batch["labels"]).astype(
+                logits, labels, where=mask)
+            acc_hits = (jnp.argmax(logits, -1) == labels).astype(
                 jnp.float32) * mask
             accuracy = jnp.sum(acc_hits) / jnp.maximum(jnp.sum(mask), 1.0)
             # loss_weight: the masked-mean normalizer, consumed by
             # train.step gradient accumulation for exact full-batch grads.
             return loss, ({"mlm_accuracy": accuracy,
-                           "loss_weight": jnp.sum(mask).astype(jnp.float32)},
+                           "loss_weight": jnp.sum(mask).astype(jnp.float32),
+                           **extra},
                           model_state)
 
         return loss_fn

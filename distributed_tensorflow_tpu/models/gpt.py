@@ -61,6 +61,12 @@ class GPTConfig:
     # (the rmsnorm path has no fused kernel).  Default False until the
     # end-to-end win is measured on hardware.
     fused_layernorm: Any = False
+    # >0: compute the LM loss ``loss_seq_chunk`` tokens at a time (head
+    # projection + log-softmax reduced per chunk under jax.checkpoint) so
+    # the [tokens, vocab] logits tensor is never fully materialised —
+    # GPT-2-small at bench shapes pays ~2.5 GB of f32 logits otherwise.
+    # 0 = off (single full-width projection).
+    loss_seq_chunk: int = 0
     # "learned" absolute positions (GPT-2) or "rope" rotary embeddings
     # (relative; extrapolates past trained length, no position table)
     position_embedding: str = "learned"
@@ -451,34 +457,93 @@ class GPT:
         gradient parity depends on bit-identity)."""
         return (hidden @ word.T.astype(hidden.dtype)).astype(jnp.float32)
 
-    def logits(self, params, hidden):
-        """LM head -> [b, s, vocab] f32 logits: the tied word-embedding
-        transpose, or the separate ``lm_head`` matrix (same [vocab, d]
-        layout) for ``tied_head=False`` configs."""
-        word = (params["embeddings"]["word"] if self.config.tied_head
+    def _head_word(self, params):
+        """The LM head's [vocab, d] matrix: the tied word embedding, or
+        the separate ``lm_head`` for ``tied_head=False`` configs.  One
+        resolver for logits(), the chunked loss, and the 1F1B head."""
+        return (params["embeddings"]["word"] if self.config.tied_head
                 else params["lm_head"])
-        return self._logits_from_word(word, hidden)
+
+    def logits(self, params, hidden):
+        """LM head -> [b, s, vocab] f32 logits."""
+        return self._logits_from_word(self._head_word(params), hidden)
 
     # -- training ---------------------------------------------------------
+    def _chunked_lm_stats(self, word, hidden, targets, mask, chunk):
+        """(nll_sum, hit_sum) over all tokens, computed ``chunk`` tokens at
+        a time so the full ``[tokens, vocab]`` logits tensor is never live:
+        each scan step projects one chunk against the head and reduces it,
+        with ``jax.checkpoint`` recomputing the chunk's logits in backward.
+        At GPT-2 bench shapes the unchunked f32 logits are ~2.5 GB of the
+        step's peak (batch 48 x seq 256 x vocab 50257) — this caps the
+        live slice at ``chunk x vocab`` and unlocks bigger batches."""
+        d = hidden.shape[-1]
+        h2 = hidden.reshape(-1, d)
+        y2 = targets.reshape(-1)
+        m2 = (jnp.ones(y2.shape, jnp.float32) if mask is None
+              else mask.reshape(-1).astype(jnp.float32))
+        t = h2.shape[0]
+        pad = (-t) % chunk
+        if pad:
+            h2 = jnp.concatenate(
+                [h2, jnp.zeros((pad, d), h2.dtype)])
+            y2 = jnp.concatenate([y2, jnp.zeros((pad,), y2.dtype)])
+            m2 = jnp.concatenate([m2, jnp.zeros((pad,), m2.dtype)])
+        n = h2.shape[0] // chunk
+
+        @jax.checkpoint
+        def stats(h_c, y_c, m_c):
+            logits = self._logits_from_word(word, h_c)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, y_c[:, None], axis=-1)[:, 0]
+            hits = (jnp.argmax(logits, -1) == y_c).astype(jnp.float32)
+            return jnp.sum(nll * m_c), jnp.sum(hits * m_c)
+
+        def body(carry, xs):
+            nll_c, hit_c = stats(*xs)
+            return (carry[0] + nll_c, carry[1] + hit_c), None
+
+        (nll_sum, hit_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (h2.reshape(n, chunk, d), y2.reshape(n, chunk),
+             m2.reshape(n, chunk)))
+        return nll_sum, hit_sum
+
     def lm_loss_fn(self):
         """Contract for ``train.make_custom_train_step``: batch dict with
         ``input_ids`` [b, s] and optional ``loss_mask`` [b, s-1]; next-token
         targets are the shifted inputs."""
 
         def loss_fn(params, model_state, batch, rng, train):
+            c = self.config
             ids = batch["input_ids"]
             hidden, aux = self.apply(params, ids[:, :-1], train=train,
                                      rng=rng, return_aux=True)
-            logits = self.logits(params, hidden)
             targets = ids[:, 1:]
             mask = batch.get("loss_mask")
-            loss = loss_lib.softmax_cross_entropy_with_integer_labels(
-                logits, targets, where=mask)
-            hits = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
-            if mask is not None:
-                acc = jnp.sum(hits * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            if c.loss_seq_chunk:
+                nll_sum, hit_sum = self._chunked_lm_stats(
+                    self._head_word(params), hidden, targets, mask,
+                    c.loss_seq_chunk)
+                if mask is None:
+                    count = jnp.asarray(targets.size, jnp.float32)
+                    loss = nll_sum / count
+                    acc = hit_sum / count
+                else:
+                    w = jnp.sum(mask.astype(jnp.float32))
+                    loss = nll_sum / jnp.maximum(w, 1e-9)
+                    acc = hit_sum / jnp.maximum(w, 1.0)
             else:
-                acc = jnp.mean(hits)
+                logits = self.logits(params, hidden)
+                loss = loss_lib.softmax_cross_entropy_with_integer_labels(
+                    logits, targets, where=mask)
+                hits = (jnp.argmax(logits, -1) == targets
+                        ).astype(jnp.float32)
+                if mask is not None:
+                    acc = (jnp.sum(hits * mask)
+                           / jnp.maximum(jnp.sum(mask), 1.0))
+                else:
+                    acc = jnp.mean(hits)
             metrics = {"token_accuracy": acc}
             if mask is not None:
                 # normalizer for exact gradient accumulation (train.step)
@@ -509,6 +574,13 @@ class GPT:
         if c.pipeline_stages <= 1:
             raise ValueError("lm_1f1b_value_and_grad requires "
                              "pipeline_stages > 1")
+        if c.loss_seq_chunk:
+            import warnings
+            warnings.warn(
+                "loss_seq_chunk is not applied on the 1F1B path: head_loss "
+                "builds full-width logits per microbatch (already 1/N of "
+                "the batch).  Use the GPipe path (the normal train step) "
+                "for chunked-loss memory savings.", stacklevel=2)
         from ..parallel.pipeline import pipeline_value_and_grad
         if rng is None:
             if train:
@@ -528,9 +600,7 @@ class GPT:
         stage_params, stage_fn = self._pipeline_stage_bits(
             params, layer_keys, train, layer_fn)
 
-        aux = {"ln_f": params["ln_f"],
-               "word": (params["embeddings"]["word"] if c.tied_head
-                        else params["lm_head"])}
+        aux = {"ln_f": params["ln_f"], "word": self._head_word(params)}
 
         def head_loss(a, out_mb, y_mb):
             h = self._norm(a["ln_f"], out_mb)

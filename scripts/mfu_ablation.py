@@ -71,6 +71,12 @@ def run_gpt(arms):
         "batch96":    dict(batch=96),
         "batch192":   dict(batch=192),
         "seq512_b24": dict(seq=512, batch=24),
+        # chunked LM loss: the [tokens, vocab] logits never materialise,
+        # so the batch ladder can climb past the logits memory wall
+        "loss_chunk":      dict(loss_chunk=512),
+        "loss_chunk_b96":  dict(loss_chunk=512, batch=96),
+        "loss_chunk_b192": dict(loss_chunk=512, batch=192),
+        "loss_chunk_b384": dict(loss_chunk=512, batch=384),
     }
     for arm in arms or MATRIX:
         a = MATRIX[arm]
@@ -84,7 +90,9 @@ def run_gpt(arms):
                            intermediate_size=128 if SMOKE else 3072,
                            max_position=seq, dtype=jnp.bfloat16,
                            dropout_rate=0.0, remat=True,
-                           fused_layernorm=a.get("fused_layernorm", False))
+                           fused_layernorm=a.get("fused_layernorm", False),
+                           loss_seq_chunk=min(a.get("loss_chunk", 0),
+                                              64 if SMOKE else 1 << 30))
         model = GPT(config)
         optimizer = optim.adamw(1e-4, fused=a.get("fused_adam", False))
         step = train.make_custom_train_step(model.lm_loss_fn(), optimizer,
@@ -127,6 +135,11 @@ def run_bert(arms):
         "fused_adam": dict(fused_adam=True),
         "fused_ln":   dict(fused_layernorm=True),
         "batch128":   dict(batch=128),
+        # original-BERT max_predictions_per_seq: MLM head on ~15% of
+        # tokens instead of all of them (cap = 20% of seq)
+        "mlm_gather":      dict(mlm_gather=True),
+        "mlm_gather_b128": dict(mlm_gather=True, batch=128),
+        "mlm_gather_b256": dict(mlm_gather=True, batch=256),
     }
     for arm in arms or MATRIX:
         a = MATRIX[arm]
@@ -138,6 +151,8 @@ def run_bert(arms):
         config = BertConfig(max_position=seq, dtype=jnp.bfloat16,
                             dropout_rate=0.0, remat=True,
                             fused_layernorm=a.get("fused_layernorm", False),
+                            mlm_predictions_per_seq=(
+                                seq // 5 if a.get("mlm_gather") else 0),
                             **kw)
         model = Bert(config)
         optimizer = optim.adamw(1e-4, fused=a.get("fused_adam", False))
@@ -159,6 +174,14 @@ def run_bert(arms):
             dt, loss = time_step(step, state, batch_d)
             toks = batch * seq / dt
             f_tok = 6.0 * n_params + 12.0 * 12 * 768 * seq
+            if config.mlm_predictions_per_seq:
+                # gather arms skip the MLM head (transform d^2 + vocab
+                # projection d*V, 6x each for training) on the non-gathered
+                # tokens — count only the FLOPs actually executed, or the
+                # MFU column overstates utilization by the saved fraction
+                d, v = config.hidden_size, config.vocab_size
+                frac = config.mlm_predictions_per_seq / seq
+                f_tok -= (1.0 - frac) * 6.0 * (d * d + d * v)
             out = {"model": "bert", "arm": arm, "batch": batch, "seq": seq,
                    "tokens_per_sec": round(toks, 1),
                    "ms_per_step": round(dt * 1e3, 2), "loss": round(loss, 3)}

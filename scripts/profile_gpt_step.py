@@ -67,9 +67,8 @@ def build(which):
     optimizer = optim.adamw(1e-4)
     step = train.make_custom_train_step(loss_fn, optimizer,
                                         grad_clip_norm=1.0)
-    from distributed_tensorflow_tpu import train as train_pkg
     params = model.init(jax.random.PRNGKey(0))
-    state = train_pkg.TrainState.create(params, optimizer.init(params))
+    state = train.TrainState.create(params, optimizer.init(params))
     state = jax.device_put(state, NamedSharding(mesh, P()))
     return step, state, batch
 

@@ -7,31 +7,47 @@
 # Round-4 queue (VERDICT r3 items 2-4): the flagship headline first so a
 # short window still lands a driver-comparable number, then the pending
 # r3 rows, then the MFU ablation arms, then the d128 flash validation.
+# The tunnel is re-probed before every step so a mid-queue outage aborts
+# in 45 s instead of burning each remaining step's full timeout.
 set -x
-timeout 60 python -c "import jax; print(jax.devices())" || exit 1
+
+probe() {
+  timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+step() {
+  probe || { echo "TUNNEL GONE — aborting queue" >&2; exit 1; }
+  "$@"
+}
+
+probe || exit 1
 
 # the driver's headline row on hardware (mnist_mlp, supervisor-wrapped)
-timeout 900 python bench.py
+step timeout 900 python bench.py
 
 # decode throughput after the cache-carry fix (pre-fix same-day: 7,017)
-timeout 900 python bench.py --config=gpt_decode
+step timeout 900 python bench.py --config=gpt_decode
 
 # int8 decode row (fp rate + greedy agreement come from the same run)
-timeout 900 python bench.py --config=gpt_decode_int8
+step timeout 900 python bench.py --config=gpt_decode_int8
 
 # the flash-dispatch operating point (seq 2048)
-timeout 1200 python bench.py --config=gpt_long
+step timeout 1200 python bench.py --config=gpt_long
 
 # MoE row: an actual number for the 85b4bf0 claim
-timeout 1200 python bench.py --config=gpt_moe
+step timeout 1200 python bench.py --config=gpt_moe
 
-# MFU ablation: fused adam / fused LN / vocab pad / batch+seq ladder,
-# one window so arms are comparable (gpt first, then bert incl. seq 256)
-timeout 1800 python scripts/mfu_ablation.py gpt
-timeout 1200 python scripts/mfu_ablation.py bert
+# MFU ablation: fused adam / fused LN / vocab pad / chunked loss /
+# mlm gather / batch+seq ladder, one window so arms are comparable
+step timeout 2400 python scripts/mfu_ablation.py gpt
+step timeout 1800 python scripts/mfu_ablation.py bert
+
+# one-step op profile (top time sinks for the MFU analysis)
+step timeout 900 python scripts/profile_gpt_step.py gpt /tmp/prof_gpt
+step timeout 900 python scripts/profile_gpt_step.py bert /tmp/prof_bert
 
 # BERT remat/batch operating point (decides whether bench_bert flips remat)
-timeout 900 python scripts/tune_bert_batch.py
+step timeout 900 python scripts/tune_bert_batch.py
 
 # flash d128 head-dim (the Llama preset) hardware validation + crossover
-timeout 1200 python scripts/validate_flash_tpu.py
+step timeout 1200 python scripts/validate_flash_tpu.py

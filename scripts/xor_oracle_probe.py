@@ -18,9 +18,10 @@ remaining gap.  CPU-friendly (tiny model); run on a quiet host.
 Usage: python scripts/xor_oracle_probe.py [--device=cpu]
 """
 import json
+import os
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

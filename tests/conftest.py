@@ -52,6 +52,7 @@ _SLOW_TESTS = (
     "test_pallas.py::TestFlashGQA",
     "test_pallas.py::TestFlashAttention::test_fused_backward",
     "test_pallas.py::TestFlashAttention::test_gradients_match_reference",
+    "test_gpt.py::TestChunkedLoss",
     "test_gpt.py::test_moe_gpt_trains_and_decodes",
     "test_gpt.py::test_gqa_trains_cache_shrinks_and_decode_matches_forward",
     "test_gpt.py::test_beam_search_ragged_prompts_match_solo",
@@ -80,6 +81,7 @@ _SLOW_TESTS = (
     "test_vit.py::test_vit_trains",
     "test_convergence.py::test_xor_learns_low_level",
     "test_bert.py::test_bert_base_param_count",
+    "test_bert.py::TestMlmGather",
     "test_llama.py::TestLlamaRecipe::test_trains",
     "test_quant.py::test_quantized_beam_search_with_ragged_prompts",
 )

@@ -184,3 +184,48 @@ def test_flash_attention_matches_dense():
     out_flash = flash.apply(params, ids, attention_mask=mask)
     np.testing.assert_allclose(np.asarray(out_dense), np.asarray(out_flash),
                                atol=2e-4, rtol=2e-4)
+
+
+class TestMlmGather:
+    """mlm_predictions_per_seq: gathering masked positions before the MLM
+    head must be exactly interchangeable with projecting every position
+    whenever each row has <= N masked tokens."""
+
+    def _run(self, n_pred, mask):
+        model = bert_tiny(dropout_rate=0.0,
+                          mlm_predictions_per_seq=n_pred)
+        params = model.init(jax.random.PRNGKey(0))
+        b, s = mask.shape
+        ids = np.asarray(
+            jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, 1000))
+        batch = {"input_ids": ids, "labels": ids,
+                 "mlm_mask": mask.astype(np.float32)}
+        loss_fn = model.mlm_loss_fn()
+
+        def scalar(p):
+            loss, (metrics, _) = loss_fn(p, {}, batch, None, False)
+            return loss, metrics
+
+        return scalar(params), jax.grad(lambda p: scalar(p)[0])(params)
+
+    def test_exact_parity_under_cap(self):
+        rng = np.random.default_rng(0)
+        mask = (rng.random((2, 32)) < 0.15).astype(np.float32)
+        assert mask.sum(1).max() <= 8
+        (l0, m0), g0 = self._run(0, mask)
+        (l1, m1), g1 = self._run(8, mask)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        np.testing.assert_allclose(float(m0["mlm_accuracy"]),
+                                   float(m1["mlm_accuracy"]), rtol=1e-6)
+        np.testing.assert_allclose(float(m0["loss_weight"]),
+                                   float(m1["loss_weight"]), rtol=0)
+        assert float(m1["mlm_overflow"]) == 0.0
+        f0 = np.concatenate([np.ravel(x) for x in jax.tree.leaves(g0)])
+        f1 = np.concatenate([np.ravel(x) for x in jax.tree.leaves(g1)])
+        np.testing.assert_allclose(f0, f1, atol=2e-5)
+
+    def test_overflow_drops_and_reports(self):
+        mask = np.ones((1, 16), np.float32)   # 16 masked, cap 4
+        (_, m1), _ = self._run(4, mask)
+        assert float(m1["mlm_overflow"]) == 12.0
+        assert float(m1["loss_weight"]) == 4.0
