@@ -566,16 +566,15 @@ def bench_bert():
                   vs_baseline=1.0,  # no runnable reference-era BERT
                   # baseline exists; 1.0 = "unity ratio by definition"
                   seq_len=seq, batch=batch)
+    # the gathered head skips work on non-gathered tokens; the XLA-counted
+    # f_total already reflects this, the analytic fallback must too
+    from distributed_tensorflow_tpu.models.bert import \
+        mlm_gather_flops_correction
+    analytic = (_transformer_flops_per_token(params, config.num_layers,
+                                             config.hidden_size, seq)
+                - mlm_gather_flops_correction(config, seq))
     if gather:
         result["mlm_predictions_per_seq"] = gather
-    analytic = _transformer_flops_per_token(params, config.num_layers,
-                                            config.hidden_size, seq)
-    if gather:
-        # the gathered head skips transform d^2 + vocab projection d*V
-        # (6x each for training) on non-gathered tokens; the XLA-counted
-        # f_total already reflects this, the analytic fallback must too
-        d, v = config.hidden_size, config.vocab_size
-        analytic -= (1.0 - gather / seq) * 6.0 * (d * d + d * v)
     return _attach_mfu(
         result, tokens, _per_example_flops(f_total, batch * seq, mesh),
         analytic=analytic)
@@ -959,8 +958,10 @@ def supervise(config: str, device: str | None = None) -> int:
     probe_timeout = float(os.environ.get("DTTPU_BENCH_PROBE_TIMEOUT", "45"))
     # Total wall-clock the supervisor may spend waiting for a dead tunnel
     # to come back (probe + sleep cycles) before giving up on the backend.
+    # Default keeps worst case (budget + CPU-fallback run) inside the
+    # ~25 min the driver demonstrably tolerated in r03's outage round.
     bringup_budget = float(os.environ.get("DTTPU_BENCH_BRINGUP_BUDGET",
-                                          "900"))
+                                          "600"))
     # Probing is pointless when the user pinned the device (no tunnel in
     # play) and must not run under the simulated-failure test hook (the
     # probe subprocess bypasses bench.py, so it would always pass).

@@ -112,6 +112,19 @@ def _resolve_fused_ln(flag) -> bool:
     return resolve_fused_ln(flag)
 
 
+def mlm_gather_flops_correction(config, seq: int) -> float:
+    """Training FLOPs/token the gathered MLM head SKIPS vs projecting
+    every position: transform d^2 + vocab projection d*V, 6x each (fwd
+    2x + bwd 4x), on the non-gathered fraction.  One accounting shared
+    by bench.py and scripts/mfu_ablation.py so their MFU columns stay
+    comparable.  0 when gathering is off."""
+    n = config.mlm_predictions_per_seq
+    if not n:
+        return 0.0
+    d, v = config.hidden_size, config.vocab_size
+    return (1.0 - n / seq) * 6.0 * (d * d + d * v)
+
+
 def _dropout(x, rate, rng, train):
     if not train or rate == 0.0:
         return x

@@ -483,6 +483,9 @@ class GPT:
         m2 = (jnp.ones(y2.shape, jnp.float32) if mask is None
               else mask.reshape(-1).astype(jnp.float32))
         t = h2.shape[0]
+        # an over-large chunk would PAD tokens up to it and allocate a
+        # bigger logits block than the unchunked path — clamp, don't cliff
+        chunk = min(chunk, t)
         pad = (-t) % chunk
         if pad:
             h2 = jnp.concatenate(

@@ -613,11 +613,13 @@ class LayerNorm(Layer):
     """Layer normalization over the trailing dim (transformer workhorse).
 
     ``fused=True`` runs the Pallas TPU kernel (``ops.pallas.fused_layernorm``,
-    one HBM pass; interpret mode off-TPU) — requires both scale and center.
+    one HBM pass; interpret mode off-TPU); ``fused="auto"`` uses the
+    kernel on TPU only (same switch as the BERT/GPT configs) — requires
+    both scale and center.
     """
 
     def __init__(self, epsilon: float = 1e-6, scale: bool = True,
-                 center: bool = True, fused: bool = False,
+                 center: bool = True, fused=False,
                  name: Optional[str] = None):
         super().__init__(name)
         self.epsilon = float(epsilon)
@@ -643,7 +645,8 @@ class LayerNorm(Layer):
         return params, {}
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        if self.fused:
+        from .pallas import resolve_fused_ln
+        if resolve_fused_ln(self.fused):
             from .pallas import fused_layernorm
             return fused_layernorm(x, params["gamma"], params["beta"],
                                    eps=self.epsilon), state

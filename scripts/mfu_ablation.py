@@ -173,15 +173,12 @@ def run_bert(arms):
                  "attention_mask": np.ones((batch, seq), np.int32)}, bsh)
             dt, loss = time_step(step, state, batch_d)
             toks = batch * seq / dt
-            f_tok = 6.0 * n_params + 12.0 * 12 * 768 * seq
-            if config.mlm_predictions_per_seq:
-                # gather arms skip the MLM head (transform d^2 + vocab
-                # projection d*V, 6x each for training) on the non-gathered
-                # tokens — count only the FLOPs actually executed, or the
-                # MFU column overstates utilization by the saved fraction
-                d, v = config.hidden_size, config.vocab_size
-                frac = config.mlm_predictions_per_seq / seq
-                f_tok -= (1.0 - frac) * 6.0 * (d * d + d * v)
+            # gather arms execute fewer head FLOPs — count only what ran
+            # (shared accounting with bench_bert)
+            from distributed_tensorflow_tpu.models.bert import \
+                mlm_gather_flops_correction
+            f_tok = (6.0 * n_params + 12.0 * 12 * 768 * seq
+                     - mlm_gather_flops_correction(config, seq))
             out = {"model": "bert", "arm": arm, "batch": batch, "seq": seq,
                    "tokens_per_sec": round(toks, 1),
                    "ms_per_step": round(dt * 1e3, 2), "loss": round(loss, 3)}

@@ -33,13 +33,16 @@ import pytest  # noqa: E402
 # tiers, individual tests elsewhere — from the measured round-4 full-run
 # durations (docs/ROUND4.md), threshold ~14 s/test on the 8-device mesh.
 _SLOW_FILES = {
-    "test_bench.py",         # supervisor/bench subprocess round-trips
     "test_example_gpt.py",   # full example-script smoke (900 s budget)
     "test_multihost.py",     # real 2-process jax.distributed bootstraps
     "test_cluster.py",       # subprocess cluster bootstrap tests
     "test_graft_entry.py",   # dryrun_multichip compile at n=1/2/8
 }
 _SLOW_TESTS = (
+    # subprocess round-trips; the in-process classes in the same file
+    # (TestSupervisorProbe, TestHelpers, TestProvenance) stay fast
+    "test_bench.py::TestSupervisor::",
+    "test_bench.py::TestGptLong",
     "test_pipeline.py::test_gpt_pipeline_loss_and_grads_match",
     "test_pipeline.py::test_gpt_1f1b_full_model_grads_match_gpipe",
     "test_pipeline.py::test_gpt_1f1b_loss_mask_matches_gpipe",

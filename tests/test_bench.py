@@ -80,6 +80,102 @@ class TestSupervisor:
         assert r["value"] > 0
 
 
+class TestSupervisorProbe:
+    """In-process tests of the probe-gated bring-up loop (the subprocess
+    tier covers the no-probe paths; these cover the budget bookkeeping)."""
+
+    def _supervise(self, monkeypatch, capsys, probe_results, child_results,
+                   budget="30", attempts="4"):
+        calls = {"probe": 0, "child": 0}
+
+        def fake_probe(timeout):
+            i = min(calls["probe"], len(probe_results) - 1)
+            calls["probe"] += 1
+            return probe_results[i]
+
+        def fake_child(extra_argv, env, timeout):
+            i = min(calls["child"], len(child_results) - 1)
+            calls["child"] += 1
+            return child_results[i]
+
+        monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+        monkeypatch.setattr(bench, "_run_child", fake_child)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        monkeypatch.setenv("DTTPU_BENCH_BRINGUP_BUDGET", budget)
+        monkeypatch.setenv("DTTPU_BENCH_TPU_ATTEMPTS", attempts)
+        monkeypatch.delenv("DTTPU_BENCH_TEST_FAIL_BELOW", raising=False)
+        monkeypatch.delenv("DTTPU_BENCH_PROBE", raising=False)
+        rc = bench.supervise("mnist_mlp")
+        out = capsys.readouterr().out.strip().splitlines()
+        return rc, json.loads(out[-1]), calls
+
+    def test_probe_pass_commits_attempt(self, monkeypatch, capsys):
+        ok = {"metric": "m", "value": 5.0, "vs_baseline": 1.2}
+        rc, r, calls = self._supervise(monkeypatch, capsys,
+                                       [True], [(ok, "rc=0")])
+        assert rc == 0 and r["value"] == 5.0
+        assert calls == {"probe": 1, "child": 1}
+
+    def test_probe_failures_retry_then_recover(self, monkeypatch, capsys):
+        ok = {"metric": "m", "value": 5.0, "vs_baseline": 1.2}
+        rc, r, calls = self._supervise(monkeypatch, capsys,
+                                       [False, False, True],
+                                       [(ok, "rc=0")])
+        assert rc == 0 and r["value"] == 5.0
+        assert calls["probe"] == 3 and calls["child"] == 1
+
+    def test_budget_exhausted_falls_back(self, monkeypatch, capsys):
+        """Probe never passes -> no full attempt is ever spent; the CPU
+        fallback child (which runs without probing) is the one report."""
+        fb = {"metric": "m", "value": 3.0, "vs_baseline": 1.0}
+        rc, r, calls = self._supervise(
+            monkeypatch, capsys, [False], [(fb, "rc=0")],
+            # time.sleep is stubbed, so only probe-time consumes budget;
+            # zero budget exhausts immediately
+            budget="0")
+        assert rc == 0
+        assert r["metric"].endswith("_CPU_FALLBACK")
+        assert calls["child"] == 1  # the fallback child only
+
+    def test_child_runtime_excluded_from_budget(self, monkeypatch, capsys):
+        """A slow failing attempt must not eat the probe budget: with a
+        tiny budget and a child that 'takes' long, the supervisor still
+        probes again for attempt 2."""
+        ok = {"metric": "m", "value": 5.0, "vs_baseline": 1.2}
+
+        t = [0.0]
+        monkeypatch.setattr(bench.time, "monotonic", lambda: t[0])
+
+        def slow_fail_child(extra_argv, env, timeout):
+            t[0] += 100.0   # simulated 100 s child vs 30 s budget
+            return None, "rc=7"
+
+        calls = {"probe": 0}
+
+        def fake_probe(timeout):
+            calls["probe"] += 1
+            return True
+
+        seq = [slow_fail_child,
+               lambda *a: ({"metric": "m", "value": 5.0,
+                            "vs_baseline": 1.2}, "rc=0")]
+
+        def child(extra_argv, env, timeout):
+            return seq.pop(0)(extra_argv, env, timeout)
+
+        monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+        monkeypatch.setattr(bench, "_run_child", child)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        monkeypatch.setenv("DTTPU_BENCH_BRINGUP_BUDGET", "30")
+        monkeypatch.setenv("DTTPU_BENCH_TPU_ATTEMPTS", "4")
+        monkeypatch.delenv("DTTPU_BENCH_TEST_FAIL_BELOW", raising=False)
+        rc = bench.supervise("mnist_mlp")
+        out = capsys.readouterr().out.strip().splitlines()
+        r = json.loads(out[-1])
+        assert rc == 0 and r["value"] == 5.0
+        assert calls["probe"] == 2  # probed again after the 100s child
+
+
 class TestHelpers:
     def test_parse_last_json(self):
         text = "noise\n{\"a\": 1}\nnot json {broken\n"
