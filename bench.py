@@ -623,19 +623,22 @@ def _gpt_bench_config(seq, experts=0):
     # 2026-07-31: 120k tok/s at remat batch 48 vs 101-108k no-remat 24)
     moe = dict(moe_experts=experts, moe_top_k=2) if experts else {}
     # DTTPU_BENCH_LOSS_CHUNK > 0: chunked LM loss (the [tokens, vocab]
-    # logits never materialise) — A/B hook until the hardware ablation
-    # (scripts/mfu_ablation.py) decides the default
+    # logits never materialise); DTTPU_BENCH_REMAT_POLICY: what the
+    # per-layer checkpoint saves — A/B hooks until the hardware ablation
+    # (scripts/mfu_ablation.py) decides the defaults
     chunk = int(os.environ.get("DTTPU_BENCH_LOSS_CHUNK", "0"))
+    rpol = os.environ.get("DTTPU_BENCH_REMAT_POLICY", "full")
     return (GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                       num_heads=2, intermediate_size=512,
                       max_position=seq, dtype=jnp.bfloat16,
-                      dropout_rate=0.0, remat=True,
+                      dropout_rate=0.0, remat=True, remat_policy=rpol,
                       loss_seq_chunk=chunk, **moe) if SMOKE
             else GPTConfig(vocab_size=50257, hidden_size=768,
                            num_layers=12, num_heads=12,
                            intermediate_size=3072, max_position=seq,
                            dtype=jnp.bfloat16, dropout_rate=0.0,
-                           remat=True, loss_seq_chunk=chunk, **moe))
+                           remat=True, remat_policy=rpol,
+                           loss_seq_chunk=chunk, **moe))
 
 
 def bench_gpt(seq=None, experts=None):
@@ -691,6 +694,8 @@ def bench_gpt(seq=None, experts=None):
                   seq_len=seq, batch=batch)
     if config.loss_seq_chunk:
         result["loss_seq_chunk"] = config.loss_seq_chunk
+    if config.remat_policy != "full":
+        result["remat_policy"] = config.remat_policy
     return _attach_mfu(
         result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
         analytic=_transformer_flops_per_token(params, config.num_layers,
@@ -718,16 +723,19 @@ def bench_llama():
     # remat=True for the same reason as _gpt_bench_config: bigger ladder
     # rungs fit and the rematerialised step measured faster at equal batch
     chunk = int(os.environ.get("DTTPU_BENCH_LOSS_CHUNK", "0"))
+    rpol = os.environ.get("DTTPU_BENCH_REMAT_POLICY", "full")
     config = (llama_config(vocab_size=512, hidden_size=128, num_layers=2,
                            num_heads=4, num_kv_heads=2,
                            intermediate_size=384, max_position=seq,
                            dtype=jnp.bfloat16, remat=True,
+                           remat_policy=rpol,
                            loss_seq_chunk=chunk) if SMOKE
               else llama_config(vocab_size=32000, hidden_size=768,
                                 num_layers=12, num_heads=12,
                                 num_kv_heads=4, intermediate_size=2048,
                                 max_position=seq, dtype=jnp.bfloat16,
-                                remat=True, loss_seq_chunk=chunk))
+                                remat=True, remat_policy=rpol,
+                                loss_seq_chunk=chunk))
     model = GPT(config)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(1e-4)
@@ -761,6 +769,8 @@ def bench_llama():
                   seq_len=seq, batch=batch)
     if config.loss_seq_chunk:
         result["loss_seq_chunk"] = config.loss_seq_chunk
+    if config.remat_policy != "full":
+        result["remat_policy"] = config.remat_policy
     return _attach_mfu(
         result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
         analytic=_transformer_flops_per_token(params, config.num_layers,

@@ -49,6 +49,10 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.float32          # activation/compute dtype
     remat: bool = False               # checkpoint each encoder layer
+    # with remat=True: "full" (save nothing), "dots" (save matmul
+    # outputs, recompute elementwise only), "dots_no_batch" — see
+    # GPTConfig.remat_policy
+    remat_policy: str = "full"
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
     # True / False / "auto": auto dispatches the fused Pallas kernel on TPU
     # at seq >= the measured crossover (ops.attention.resolve_use_flash).
@@ -267,7 +271,9 @@ class Bert:
 
         layer_fn = self._encoder_layer
         if c.remat:
-            layer_fn = jax.checkpoint(layer_fn, static_argnums=(5,))
+            from .gpt import _remat_policy
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(5,),
+                                      policy=_remat_policy(c.remat_policy))
 
         def body(carry, inputs):
             layer_params, layer_key = inputs

@@ -50,6 +50,13 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     dtype: Any = jnp.float32
     remat: bool = False
+    # With remat=True, what the per-layer checkpoint SAVES: "full"
+    # (nothing — recompute the whole block, max memory savings),
+    # "dots" (all matmul outputs — recompute only elementwise chains,
+    # much cheaper backward at higher memory), "dots_no_batch"
+    # (weight-only dots).  Measured on hardware via
+    # scripts/mfu_ablation.py before changing any default.
+    remat_policy: str = "full"
     seq_axis: Optional[str] = None    # mesh axis for ring attention (SP)
     # True / False / "auto": auto dispatches the fused Pallas kernel on TPU
     # at seq >= the measured crossover (ops.attention.resolve_use_flash).
@@ -149,6 +156,19 @@ class GPTConfig:
 
 def gpt_small(**kw) -> "GPT":
     return GPT(GPTConfig(**kw))
+
+
+def _remat_policy(name: str):
+    """Map the config string to a jax.checkpoint save policy (None =
+    save nothing, the classic full-block remat)."""
+    if name == "full":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    if name == "dots_no_batch":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"remat_policy must be 'full', 'dots', or "
+                     f"'dots_no_batch'; got {name!r}")
 
 
 def gpt_tiny(**kw) -> "GPT":
@@ -355,7 +375,9 @@ class GPT:
         layer_fn = partial(self._block,
                            qk_transform=self._rope_transform(seq_len))
         if self.config.remat:
-            layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
+            layer_fn = jax.checkpoint(
+                layer_fn, static_argnums=(4,),
+                policy=_remat_policy(self.config.remat_policy))
         return layer_fn
 
     # -- full-sequence forward -------------------------------------------

@@ -77,6 +77,12 @@ def run_gpt(arms):
         "loss_chunk_b96":  dict(loss_chunk=512, batch=96),
         "loss_chunk_b192": dict(loss_chunk=512, batch=192),
         "loss_chunk_b384": dict(loss_chunk=512, batch=384),
+        # remat policy: save matmul outputs instead of nothing — less
+        # backward recompute, more memory (OOM rungs are data)
+        "remat_dots":          dict(remat_policy="dots"),
+        "remat_dots_chunk":    dict(remat_policy="dots", loss_chunk=512),
+        "remat_dots_chunk_b96": dict(remat_policy="dots", loss_chunk=512,
+                                     batch=96),
     }
     for arm in arms or MATRIX:
         a = MATRIX[arm]
@@ -90,6 +96,7 @@ def run_gpt(arms):
                            intermediate_size=128 if SMOKE else 3072,
                            max_position=seq, dtype=jnp.bfloat16,
                            dropout_rate=0.0, remat=True,
+                           remat_policy=a.get("remat_policy", "full"),
                            fused_layernorm=a.get("fused_layernorm", False),
                            loss_seq_chunk=min(a.get("loss_chunk", 0),
                                               64 if SMOKE else 1 << 30))
@@ -140,6 +147,9 @@ def run_bert(arms):
         "mlm_gather":      dict(mlm_gather=True),
         "mlm_gather_b128": dict(mlm_gather=True, batch=128),
         "mlm_gather_b256": dict(mlm_gather=True, batch=256),
+        "remat_dots":        dict(remat_policy="dots"),
+        "remat_dots_gather": dict(remat_policy="dots", mlm_gather=True,
+                                  batch=128),
     }
     for arm in arms or MATRIX:
         a = MATRIX[arm]
@@ -150,6 +160,7 @@ def run_bert(arms):
                    num_heads=2, intermediate_size=128) if SMOKE else {})
         config = BertConfig(max_position=seq, dtype=jnp.bfloat16,
                             dropout_rate=0.0, remat=True,
+                            remat_policy=a.get("remat_policy", "full"),
                             fused_layernorm=a.get("fused_layernorm", False),
                             mlm_predictions_per_seq=(
                                 seq // 5 if a.get("mlm_gather") else 0),

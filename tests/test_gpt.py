@@ -658,9 +658,10 @@ class TestChunkedLoss:
         return scalar(params), jax.grad(lambda p: scalar(p)[0])(params)
 
     def test_loss_metrics_and_grads_match_unchunked(self):
-        # 32 tokens/row, chunk 8 divides; also chunk 7 exercises padding
+        # chunk 8 divides the 32 tokens; chunk 7 exercises padding;
+        # chunk 1000 > token count exercises the clamp (no pad-up)
         (l0, m0), g0 = self._losses(0)
-        for chunk in (8, 7):
+        for chunk in (8, 7, 1000):
             (l1, m1), g1 = self._losses(chunk)
             np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
             np.testing.assert_allclose(float(m0["token_accuracy"]),
@@ -695,3 +696,32 @@ class TestChunkedLoss:
             state, m = step(state, {"input_ids": ids})
             first = float(m["loss"]) if first is None else first
         assert float(m["loss"]) < first
+
+
+def test_remat_policies_match():
+    """remat policy choices change memory/recompute, never values: dots /
+    dots_no_batch / full all match the no-remat forward and gradients."""
+    ids = _ids(b=2, s=16)
+    base_model, params = _model_params()
+
+    def loss_of(model):
+        fn = model.lm_loss_fn()
+        return lambda p: fn(p, {}, {"input_ids": ids}, None, False)[0]
+
+    l0 = float(loss_of(base_model)(params))
+    g0 = jax.grad(loss_of(base_model))(params)
+    for policy in ("full", "dots", "dots_no_batch"):
+        m = gpt_tiny(dropout_rate=0.0, remat=True, remat_policy=policy)
+        l1 = float(loss_of(m)(params))
+        g1 = jax.grad(loss_of(m))(params)
+        np.testing.assert_allclose(l0, l1, rtol=1e-6)
+        f0 = np.concatenate([np.ravel(x) for x in jax.tree.leaves(g0)])
+        f1 = np.concatenate([np.ravel(x) for x in jax.tree.leaves(g1)])
+        np.testing.assert_allclose(f0, f1, atol=2e-5)
+
+
+def test_remat_policy_invalid_raises():
+    m = gpt_tiny(remat=True, remat_policy="bogus")
+    import pytest
+    with pytest.raises(ValueError, match="remat_policy"):
+        m.apply(m.init(jax.random.PRNGKey(0)), _ids())
