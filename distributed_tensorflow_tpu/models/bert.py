@@ -212,13 +212,36 @@ class Bert:
         c = self.config
 
         if c.seq_axis is not None and self.mesh is not None:
-            from ..parallel.ring import ring_attention_sharded
-            attention_fn = lambda q, k, v, mask=None: ring_attention_sharded(
-                q, k, v, self.mesh, seq_axis=c.seq_axis, kv_valid=valid)
+            # the flash crossover applies to the kernel's PER-CALL seq:
+            # inside the ring each call sees one shard, so gate on the
+            # local shard length, not the global sequence
+            local = x.shape[1] // self.mesh.shape[c.seq_axis]
+            if attn_lib.resolve_use_flash(c.use_flash, local):
+                # SP x flash: the ring schedule with the fused kernel per
+                # block pair (parallel.ring_flash) — both long-context
+                # levers stacked
+                from ..parallel.ring_flash import ring_flash_attention_sharded
+                attention_fn = lambda q, k, v, mask=None: \
+                    ring_flash_attention_sharded(
+                        q, k, v, self.mesh, seq_axis=c.seq_axis,
+                        kv_valid=valid)
+            else:
+                from ..parallel.ring import ring_attention_sharded
+                attention_fn = lambda q, k, v, mask=None: \
+                    ring_attention_sharded(
+                        q, k, v, self.mesh, seq_axis=c.seq_axis,
+                        kv_valid=valid)
         elif c.seq_axis is not None:
-            from ..parallel.ring import ring_attention
-            attention_fn = lambda q, k, v, mask=None: ring_attention(
-                q, k, v, axis_name=c.seq_axis, kv_valid=valid)
+            # traced inside a caller's shard_map: x is the local shard
+            if attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
+                from ..parallel.ring_flash import ring_flash_attention
+                attention_fn = lambda q, k, v, mask=None: \
+                    ring_flash_attention(q, k, v, axis_name=c.seq_axis,
+                                         kv_valid=valid)
+            else:
+                from ..parallel.ring import ring_attention
+                attention_fn = lambda q, k, v, mask=None: ring_attention(
+                    q, k, v, axis_name=c.seq_axis, kv_valid=valid)
         elif attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
             from ..ops.pallas import flash_attention
             attention_fn = lambda q, k, v, mask=None: flash_attention(

@@ -302,13 +302,38 @@ class GPT:
     def _attention(self, p, x, mask, rng, train, qk_transform=None):
         c = self.config
         if c.seq_axis is not None and self.mesh is not None:
-            from ..parallel.ring import ring_attention_sharded
-            attention_fn = lambda q, k, v, mask=None: ring_attention_sharded(
-                q, k, v, self.mesh, seq_axis=c.seq_axis, causal=True)
+            # flash-vs-XLA crossover applies to the kernel's PER-CALL
+            # sequence: inside the ring each call sees one shard, so the
+            # gate uses the local shard length, not the global seq
+            local = x.shape[1] // self.mesh.shape[c.seq_axis]
+            if attn_lib.resolve_use_flash(c.use_flash, local):
+                # SP x flash: ring schedule with the fused kernel per
+                # block pair (parallel.ring_flash)
+                from ..parallel.ring_flash import ring_flash_attention_sharded
+                attention_fn = lambda q, k, v, mask=None: \
+                    ring_flash_attention_sharded(
+                        q, k, v, self.mesh, seq_axis=c.seq_axis,
+                        causal=True)
+                attention_fn.supports_gqa = True
+            else:
+                from ..parallel.ring import ring_attention_sharded
+                attention_fn = lambda q, k, v, mask=None: \
+                    ring_attention_sharded(
+                        q, k, v, self.mesh, seq_axis=c.seq_axis,
+                        causal=True)
         elif c.seq_axis is not None:
-            from ..parallel.ring import ring_attention
-            attention_fn = lambda q, k, v, mask=None: ring_attention(
-                q, k, v, axis_name=c.seq_axis, causal=True)
+            # traced inside a caller's shard_map: x is already the local
+            # shard, so x.shape[1] IS the per-call sequence
+            if attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
+                from ..parallel.ring_flash import ring_flash_attention
+                attention_fn = lambda q, k, v, mask=None: \
+                    ring_flash_attention(q, k, v, axis_name=c.seq_axis,
+                                         causal=True)
+                attention_fn.supports_gqa = True
+            else:
+                from ..parallel.ring import ring_attention
+                attention_fn = lambda q, k, v, mask=None: ring_attention(
+                    q, k, v, axis_name=c.seq_axis, causal=True)
         elif attn_lib.resolve_use_flash(c.use_flash, x.shape[1]):
             # GQA configs run natively: the kernel maps kv blocks by
             # q_head // group, so no broadcast materialises

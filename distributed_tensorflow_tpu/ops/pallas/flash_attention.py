@@ -305,7 +305,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, valid_ref,
 
 
 def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
-                    block_q, block_k, interpret):
+                    block_q, block_k, interpret, dvec=None):
     """Fused backward: (dq, dk, dv) with logits recomputed blockwise.
 
     GQA: k/v may have hk < h heads.  The kernels consume them through the
@@ -313,6 +313,10 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
     dk/dv ([b, h, sk, d]); the group reduction to [b, hk, sk, d] is one
     cheap XLA sum afterwards (costs group x transient dk/dv memory — still
     O(seq), the kernels' point).
+
+    ``dvec``: optionally the precomputed D = rowsum(dO·O) [b, h, sq] —
+    ring-flash calls this once per K/V block with identical q/do/out, so
+    it hoists the reduce out of its loop.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -321,8 +325,10 @@ def _flash_backward(q, k, v, valid, out, lse, do, scale, causal,
     bq = min(block_q, sq)
     bk = min(block_k, sk)
 
-    # D = rowsum(dO * O): cheap elementwise reduce, plain XLA
-    dvec = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if dvec is None:
+        # D = rowsum(dO * O): cheap elementwise reduce, plain XLA
+        dvec = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                       axis=-1)
 
     q_p = _pad_to(q, 2, bq)
     do_p = _pad_to(do, 2, bq)                 # zero dO rows: no contribution

@@ -1,23 +1,26 @@
 """Parallelism layer: cluster bootstrap, meshes, shardings, collectives."""
 
-from . import cluster, data_parallel, mesh, pipeline, ring, sharding
+from . import (cluster, data_parallel, mesh, pipeline, ring,
+               ring_flash, sharding)
 from .data_parallel import make_psum_train_step
 from .cluster import ClusterConfig, cluster_from_env, initialize, is_chief
 from .pipeline import (pipeline_apply, pipeline_rules_spec,
                        pipeline_value_and_grad, stack_pipeline_params)
 from .ring import ring_attention, ring_attention_sharded
+from .ring_flash import ring_flash_attention, ring_flash_attention_sharded
 from .sharding import PartitionRules, shard_pytree
 from .mesh import (AXIS_ORDER, data_parallel_mesh, data_shards,
                    local_batch_size, make_mesh, named_sharding, replicated,
                    round_batch_to_mesh)
 
 __all__ = ["cluster", "data_parallel", "make_psum_train_step",
-           "mesh", "pipeline", "ring", "sharding",
+           "mesh", "pipeline", "ring", "ring_flash", "sharding",
            "pipeline_apply", "pipeline_rules_spec", "pipeline_value_and_grad",
            "stack_pipeline_params",
            "ClusterConfig",
            "cluster_from_env", "initialize", "is_chief", "ring_attention",
-           "ring_attention_sharded", "PartitionRules", "shard_pytree",
+           "ring_attention_sharded", "ring_flash_attention",
+           "ring_flash_attention_sharded", "PartitionRules", "shard_pytree",
            "AXIS_ORDER", "data_parallel_mesh", "data_shards",
            "local_batch_size", "make_mesh", "named_sharding", "replicated",
            "round_batch_to_mesh"]
