@@ -73,6 +73,11 @@ _SLOW_TESTS = (
     "test_seq2seq.py::test_generate_eos_early_stop_and_padding",
     "test_data.py::test_synthetic_datasets_shapes_and_learnability",
     "test_ring.py::test_ring_gradients_flow",
+    "test_ring_flash.py::test_gradients_match_dense",
+    "test_ring_flash.py::test_padding_plus_causal_gradients",
+    "test_ring_flash.py::test_bert_sp_flash_matches_dense",
+    "test_ring_flash.py::test_gpt_sp_flash_matches_dense",
+    "test_ring_flash.py::test_gpt_gqa_sp_flash_matches_dense",
     "test_moe.py::test_single_expert_equals_dense_ffn",
     "test_moe.py::test_moe_gradients_flow_through_router_and_experts",
     "test_moe.py::test_tiny_capacity_drops_tokens_to_zero",
