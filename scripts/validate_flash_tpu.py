@@ -173,6 +173,52 @@ def main():
               file=sys.stderr)
         return 1
 
+    # ---- phase 1b: ring-flash single-chip compile check -----------------
+    # A 1-device "ring" is numerically trivial but proves Mosaic compiles
+    # the kernels inside ring_flash's lax.switch/fori_loop/custom-vjp
+    # context on real hardware (interpret mode has hidden Mosaic-only
+    # failures before — docs/PERF.md).  Multi-device rings are covered on
+    # the CPU mesh; one chip cannot exercise the ppermute rotation.
+    try:
+        from jax.sharding import Mesh
+        from distributed_tensorflow_tpu.parallel.ring_flash import (
+            ring_flash_attention_sharded)
+        mesh1 = Mesh(np.array(jax.devices()[:1]), ("seq",))
+        q, k, v = qkv(jax.random.PRNGKey(2), 2, 512, 4, 64, jnp.bfloat16)
+
+        def rf_loss(q, k, v):
+            return jnp.sum(ring_flash_attention_sharded(
+                q, k, v, mesh1, "seq", causal=True).astype(jnp.float32) ** 2)
+
+        cm512 = causal_mask(512)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(dot_product_attention(
+                q, k, v, mask=cm512).astype(jnp.float32) ** 2)
+
+        o_rf = jax.jit(lambda q, k, v: ring_flash_attention_sharded(
+            q, k, v, mesh1, "seq", causal=True))(q, k, v)
+        g_rf = jax.jit(jax.grad(rf_loss, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+        o_ref = dot_product_attention(q, k, v, mask=cm512)
+        errs = {"out": float(np.abs(np.asarray(o_rf, np.float64)
+                                    - np.asarray(o_ref, np.float64)).max())}
+        for tname, a, b in zip(("dq", "dk", "dv"), g_rf, g_ref):
+            errs[tname] = float(np.abs(np.asarray(a, np.float64)
+                                       - np.asarray(b, np.float64)).max())
+        # inverted form: a NaN error FAILS (NaN < x is False)
+        ok = all(e < 6e-2 for e in errs.values())
+        print(json.dumps({"check": "ring_flash_1dev_compile", "ok": ok,
+                          "max_abs_vs_xla": {t: round(e, 6)
+                                             for t, e in errs.items()}}),
+              flush=True)
+        if not ok:
+            return 1
+    except Exception as e:  # noqa: BLE001 - report and fail
+        print(json.dumps({"check": "ring_flash_1dev_compile", "ok": False,
+                          "error": str(e)[:300]}), flush=True)
+        return 1
+
     # ---- phase 2: crossover timing --------------------------------------
     b, h, d = 8, 12, 64
     for seq in (512, 1024, 2048):
