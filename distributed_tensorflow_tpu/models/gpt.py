@@ -119,6 +119,9 @@ class GPTConfig:
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(f"norm must be 'layernorm' or 'rmsnorm'; "
                              f"got {self.norm!r}")
+        if self.loss_seq_chunk < 0:
+            raise ValueError(f"loss_seq_chunk must be >= 0; "
+                             f"got {self.loss_seq_chunk}")
         if self.ffn_activation not in ("gelu", "swiglu"):
             raise ValueError(f"ffn_activation must be 'gelu' or 'swiglu'; "
                              f"got {self.ffn_activation!r}")

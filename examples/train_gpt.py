@@ -42,6 +42,16 @@ flags_lib.DEFINE_string("family", "gpt2",
                         "decoder recipe: gpt2 (layernorm/gelu/learned "
                         "positions) | llama (rmsnorm/swiglu/rope/GQA, "
                         "models/llama.py)")
+flags_lib.DEFINE_integer("loss_seq_chunk", 0,
+                         "chunked LM loss: compute the head projection + "
+                         "log-softmax N tokens at a time (the full "
+                         "[tokens, vocab] logits never materialise; "
+                         "0 = off)")
+flags_lib.DEFINE_string("remat_policy", "full",
+                        "with remat: full (save nothing) | dots (save "
+                        "matmul outputs) | dots_no_batch")
+flags_lib.DEFINE_bool("remat", False, "checkpoint each decoder layer "
+                      "(recompute in backward; unlocks bigger batches)")
 FLAGS = flags_lib.FLAGS
 
 
@@ -88,7 +98,9 @@ def main() -> int:
     dims = dict(vocab_size=256, num_layers=FLAGS.num_layers, num_heads=4,
                 hidden_size=128, max_position=FLAGS.seq_len,
                 dtype=jnp.float32 if pp_cpu else jnp.bfloat16,
-                pipeline_stages=pp if pp > 1 else 0)
+                pipeline_stages=pp if pp > 1 else 0,
+                remat=FLAGS.remat, remat_policy=FLAGS.remat_policy,
+                loss_seq_chunk=FLAGS.loss_seq_chunk)
     if FLAGS.family == "llama":
         from distributed_tensorflow_tpu.models.llama import llama_config
         config = llama_config(num_kv_heads=2, **dims)
