@@ -78,6 +78,7 @@ _SLOW_TESTS = (
     "test_ring_flash.py::test_bert_sp_flash_matches_dense",
     "test_ring_flash.py::test_gpt_sp_flash_matches_dense",
     "test_ring_flash.py::test_gpt_gqa_sp_flash_matches_dense",
+    "test_ring_flash.py::test_ring_flash_composes_with_remat",
     "test_moe.py::test_single_expert_equals_dense_ffn",
     "test_moe.py::test_moe_gradients_flow_through_router_and_experts",
     "test_moe.py::test_tiny_capacity_drops_tokens_to_zero",
