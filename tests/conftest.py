@@ -56,6 +56,7 @@ _SLOW_TESTS = (
     "test_pallas.py::TestFlashAttention::test_fused_backward",
     "test_pallas.py::TestFlashAttention::test_gradients_match_reference",
     "test_gpt.py::TestChunkedLoss",
+    "test_gpt.py::test_remat_policies_match",
     "test_gpt.py::test_moe_gpt_trains_and_decodes",
     "test_gpt.py::test_gqa_trains_cache_shrinks_and_decode_matches_forward",
     "test_gpt.py::test_beam_search_ragged_prompts_match_solo",
