@@ -59,6 +59,13 @@ STEPS_PER_CALL = int(os.environ.get("DTTPU_BENCH_STEPS",
                                     4 if SMOKE else 64))
 WARMUP_CALLS = 1 if SMOKE else 2
 CALLS = 2 if SMOKE else 8
+# Timed windows per measurement; the headline takes the BEST window.
+# Applied symmetrically to the framework paths AND the torch baseline:
+# the two sides run minutes apart, and on a shared host a background
+# spike landing in one side's single window flips a ~1.0x ratio (the
+# r04 rehearsal measured 0.97 and 1.01 for identical configs).
+WINDOWS = 1 if SMOKE else max(1, int(os.environ.get("DTTPU_BENCH_WINDOWS",
+                                                    "3")))
 
 
 def log(msg):
@@ -230,17 +237,19 @@ def bench_framework():
     for _ in range(WARMUP_CALLS):
         state, m = multi(state, bench_batch)
     _fetch(m)
-    t0 = time.perf_counter()
-    for _ in range(CALLS):
-        state, m = multi(state, bench_batch)
-        if _sync_every_step():
-            jax.block_until_ready(m["loss"])
-    _fetch(m)
-    dt = time.perf_counter() - t0
-    steps = CALLS * k
-    eps = steps * batch / dt
+    eps = 0.0
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(CALLS):
+            state, m = multi(state, bench_batch)
+            if _sync_every_step():
+                jax.block_until_ready(m["loss"])
+        _fetch(m)
+        dt = time.perf_counter() - t0
+        steps = CALLS * k
+        eps = max(eps, steps * batch / dt)
     log(f"framework (multi-step): {eps:,.0f} examples/s total, "
-        f"{eps / n_chips:,.0f} /chip ({dt / steps * 1e3:.2f} ms/step, "
+        f"{eps / n_chips:,.0f} /chip (best of {WINDOWS} windows, "
         f"{k} steps/dispatch)")
 
     # Single-step dispatch path (what TrainSession drives per batch) — kept
@@ -250,16 +259,18 @@ def bench_framework():
     for _ in range(2 if SMOKE else 5):
         state, m = step(state, single_batch)
     _fetch(m)
-    t0 = time.perf_counter()
-    for _ in range(n_single):
-        state, m = step(state, single_batch)
-        if _sync_every_step():
-            jax.block_until_ready(m["loss"])
-    _fetch(m)
-    dts = time.perf_counter() - t0
-    eps_single = n_single * batch / dts
+    eps_single = 0.0
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(n_single):
+            state, m = step(state, single_batch)
+            if _sync_every_step():
+                jax.block_until_ready(m["loss"])
+        _fetch(m)
+        dts = time.perf_counter() - t0
+        eps_single = max(eps_single, n_single * batch / dts)
     log(f"framework (single-step): {eps_single:,.0f} examples/s total "
-        f"({dts / n_single * 1e3:.2f} ms/step)")
+        f"(best of {WINDOWS} windows)")
     return (eps / n_chips, acc, eps_single / n_chips, prov,
             flops_per_example)
 
@@ -284,9 +295,10 @@ def bench_torch_baseline():
 
 def _time_steps(step, state, batch, warmup=3, steps=12):
     """Generic throughput timing for a compiled train step.  Returns
-    (steps/sec, last loss, sec/step); per-chip normalization is the
-    caller's job.  On the CPU mesh every step is synced (see
-    ``_sync_every_step``)."""
+    (steps/sec, last loss, sec/step) from the BEST of ``WINDOWS`` timed
+    windows (same treatment as the torch baseline — see WINDOWS);
+    per-chip normalization is the caller's job.  On the CPU mesh every
+    step is synced (see ``_sync_every_step``)."""
     import jax
     if SMOKE:
         warmup, steps = min(warmup, 2), min(steps, 4)
@@ -295,14 +307,17 @@ def _time_steps(step, state, batch, warmup=3, steps=12):
         if _sync_every_step():
             jax.block_until_ready(m["loss"])
     _fetch(m)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step(state, batch)
-        if _sync_every_step():
-            jax.block_until_ready(m["loss"])
-    loss = _fetch(m)
-    dt = time.perf_counter() - t0
-    return steps / dt, loss, dt / steps
+    best = 0.0
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch)
+            if _sync_every_step():
+                jax.block_until_ready(m["loss"])
+        loss = _fetch(m)
+        dt = time.perf_counter() - t0
+        best = max(best, steps / dt)
+    return best, loss, 1.0 / best
 
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Ran out of memory", "out of memory",
@@ -367,14 +382,18 @@ def _torch_step_rate(build, warmup=2, steps=3):
         model, loss_fn, opt, inputs, batch = build()
         for _ in range(warmup):
             opt.zero_grad(); loss_fn(model(*inputs)).backward(); opt.step()
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            opt.zero_grad(); loss_fn(model(*inputs)).backward(); opt.step()
-        eps = steps * batch / (time.perf_counter() - t0)
+        eps = 0.0
+        for _ in range(WINDOWS):    # best-of, same as the framework side
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                opt.zero_grad()
+                loss_fn(model(*inputs)).backward()
+                opt.step()
+            eps = max(eps, steps * batch / (time.perf_counter() - t0))
     except Exception as e:  # pragma: no cover
         log(f"torch baseline unavailable ({e})")
         return None
-    log(f"torch CPU baseline: {eps:,.1f} examples/s")
+    log(f"torch CPU baseline: {eps:,.1f} examples/s (best of {WINDOWS})")
     return eps
 
 
@@ -1032,6 +1051,12 @@ def supervise(config: str, device: str | None = None) -> int:
     # the dryrun and the mesh test suite; the fallback's one job is an
     # honest per-device liveness number.
     cenv = dict(env, DTTPU_BENCH_ATTEMPT="-1")
+    # XLA:CPU and torch-MKL are a statistical tie on this workload
+    # (measured 0.96-1.10 across identical runs); more best-of windows on
+    # both sides tighten the ratio toward the true ~1.0.  Forced, not
+    # setdefault: a process-wide export must not silently thin the
+    # official outage-round record's sampling.
+    cenv["DTTPU_BENCH_WINDOWS"] = "5"
     # The flag may also arrive FROM the environment (the test suite and CI
     # export it process-wide) — force it to 1 rather than merely not adding
     # it, or the child silently runs the 8-way mesh anyway.
