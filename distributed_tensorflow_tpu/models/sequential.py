@@ -14,6 +14,7 @@ device already sharded — the high-level user never sees a collective.
 """
 from __future__ import annotations
 
+import collections
 import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -576,6 +577,16 @@ class Sequential:
             grad_clip_norm=kw["grad_clip_norm"], policy=kw["policy"])
         return c["sample_step"]
 
+    def _masked_eval_step(self, c) -> Any:
+        """Compiled ``eval_step(state, (x, y, w))`` excluding mask-0
+        examples from the means (multi-process ragged-tail path); built
+        lazily and cached per compile like the sample-weight step."""
+        if "masked_eval_step" not in c:
+            c["masked_eval_step"] = step_lib.make_masked_eval_step(
+                self.stack, c["loss"], metric_fns=c["metric_fns"],
+                policy=c["step_kwargs"]["policy"])
+        return c["masked_eval_step"]
+
     # -- single-batch steps (Keras train/test/predict_on_batch parity) ---
     def _mesh_batch(self, x, y, train: bool):
         """Shard an on-batch pair for a mesh-compiled model.  The train
@@ -651,11 +662,12 @@ class Sequential:
         collective-rendezvous guard.  Uploads route through
         ``prefetch_to_device`` — overlap plus the multi-host per-process
         assembly.  A batch not divisible by the mesh's data shards (the
-        ragged eval tail) is uploaded unsharded on one host, but in a
-        MULTI-process run it cannot be assembled into a consistent global
-        array, so there it is DROPPED from the means with a warning
-        (drop_remainder semantics) rather than fed divergent into the
-        mesh computation."""
+        ragged eval tail) is uploaded unsharded on one host in a
+        single-process run (exact); in a MULTI-process run it is PADDED
+        up to the next shardable size with a per-example validity mask
+        and fed through a masked eval step that excludes the padding from
+        the means — so N-process ``evaluate`` equals the 1-process means
+        instead of silently applying drop_remainder semantics."""
         c = self._require_compiled()
         if self.state is None:
             raise RuntimeError("model has no state; call fit or build first")
@@ -663,25 +675,42 @@ class Sequential:
         shards = (sharding.mesh.shape["data"] if sharding is not None
                   else 1)
         multi_process = jax.process_count() > 1
-        dropped = [0]
+        # Each process uploads its LOCAL batch; the assembled global array
+        # needs the local leading dim divisible by the process's share of
+        # the data axis (equal local tails across processes, same contract
+        # as the divisible-batch path).
+        local_shards = max(1, shards // jax.process_count())
+        # Host-side real-count carry for padded tails: prefetch preserves
+        # FIFO order, so the consumer pops the global real count matching
+        # each 3-tuple batch (device-summing the mask would sync the
+        # async dispatch queue).  Equal local tails across processes is
+        # the same contract the divisible-batch path already assumes.
+        tail_real = collections.deque()
 
         def keep(it):
             for b in it:
                 if (sharding is not None and multi_process
                         and b[0].shape[0] % shards):
-                    log.warning(
-                        "evaluate: dropping ragged batch of %d (not "
-                        "divisible by %d data shards; cannot assemble a "
-                        "consistent global array across processes)",
-                        b[0].shape[0], shards)
-                    dropped[0] += b[0].shape[0]
+                    bs = b[0].shape[0]
+                    padded = -(-bs // local_shards) * local_shards
+                    pad = padded - bs
+                    w = np.concatenate([np.ones(bs, np.float32),
+                                        np.zeros(pad, np.float32)])
+                    tail_real.append(bs * jax.process_count())
+                    # pad value is arbitrary (masked out); repeating the
+                    # last example keeps dtypes/shapes without branches
+                    yield tuple(np.concatenate(
+                        [a, np.repeat(a[-1:], pad, axis=0)]) for a in b
+                    ) + (w,)
                     continue
                 yield b
 
         it = keep(it)
 
         def batch_sharding(item):
-            if sharding is not None and item[0].shape[0] % shards == 0:
+            if sharding is None:
+                return None
+            if len(item) == 3 or item[0].shape[0] % shards == 0:
                 return sharding
             return None
 
@@ -698,20 +727,21 @@ class Sequential:
                 n += bs
             pending.clear()
 
+        masked_step = None
         for batch in prefetch_to_device(it, sharding=None,
                                         sharding_fn=batch_sharding):
-            pending.append((batch[0].shape[0],
-                            c["eval_step"](self.state, batch)))
+            if len(batch) == 3:
+                if masked_step is None:
+                    masked_step = self._masked_eval_step(c)
+                pending.append((tail_real.popleft(),
+                                masked_step(self.state, batch)))
+            else:
+                pending.append((batch[0].shape[0],
+                                c["eval_step"](self.state, batch)))
             if len(pending) >= sync_every:
                 pull_all()
         pull_all()
         out = {k: v / max(n, 1) for k, v in totals.items()}
-        if dropped[0]:
-            # Make the 1-process vs N-process divergence visible in the
-            # RESULT, not only in a log line: callers comparing eval
-            # numbers across topologies can see how many examples the
-            # N-process means exclude.
-            out["dropped_examples"] = float(dropped[0])
         if verbose:
             parts = ", ".join(f"{k}={v:.4f}" for k, v in out.items())
             print(f"evaluate: {parts}", flush=True)

@@ -31,7 +31,8 @@ from . import precision as prec_lib
 from .session import TrainState
 
 __all__ = ["make_train_step", "make_multi_train_step", "make_eval_step",
-           "make_1f1b_train_step", "init_train_state", "shard_train_state"]
+           "make_masked_eval_step", "make_1f1b_train_step",
+           "init_train_state", "shard_train_state"]
 
 
 def shard_train_state(state: "TrainState", mesh: Mesh, rules) -> "TrainState":
@@ -453,3 +454,53 @@ def make_eval_step(model, loss,
     # remainder batch (each sharding combination caches its own executable).
     del mesh, batch_spec
     return jax.jit(eval_step)
+
+
+def make_masked_eval_step(model, loss,
+                          metric_fns: Optional[Dict[str, Any]] = None,
+                          policy: Any = None) -> Callable:
+    """``eval_step(state, (x, y, w)) -> metrics`` with a per-example
+    validity weight ``w`` ([batch] float, 1 real / 0 padding).
+
+    This is what lets a MULTI-process ``evaluate`` keep its ragged tail
+    batch: the tail is padded up to a shardable size, uploaded as a global
+    array, and the padding is excluded from the means here — so N-process
+    eval equals the 1-process means instead of dropping the tail
+    (drop_remainder divergence).
+
+    Loss and metrics are computed per example — the scalar fn applied to
+    each example's own ``[1, ...]`` slice (same idiom as Sequential's
+    sample-weight step) — then mask-weight-averaged.  Exact for every
+    mean-of-per-example-terms loss/metric (all built-in losses, accuracy
+    family); for batch-ratio metrics (precision/recall/f1) the tail
+    batch's value becomes a mean of per-example ratios, which is the
+    standard Keras per-batch-averaging caveat, not a new one.
+    """
+    loss_fn = loss_lib.get(loss)
+    pol = prec_lib.policy(policy) if policy is not None else None
+
+    def masked_eval_step(state: TrainState, batch):
+        x, y, w = batch
+        model_state = state.model_state
+        if isinstance(model_state, prec_lib.LossScaled):
+            model_state = model_state.model_state
+        params = state.params
+        if pol is not None:
+            params = pol.cast_to_compute(params)
+            x = pol.cast_to_compute(x)
+        preds, _ = model.apply(params, model_state, x,
+                               train=False, rng=None)
+        if pol is not None:
+            preds = pol.cast_to_output(preds)
+
+        def masked_mean(fn):
+            per = jax.vmap(lambda pi, yi: fn(pi[None], yi[None]))(preds, y)
+            wf = w.astype(per.dtype)
+            return jnp.sum(per * wf) / jnp.maximum(jnp.sum(wf), 1.0)
+
+        metrics = {"loss": masked_mean(loss_fn)}
+        for name, fn in (metric_fns or {}).items():
+            metrics[name] = masked_mean(metric_lib.get(fn))
+        return metrics
+
+    return jax.jit(masked_eval_step)

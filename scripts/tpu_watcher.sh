@@ -1,0 +1,27 @@
+#!/bin/sh
+# Round-5 tunnel watcher: probe jax.devices() every ~4 min; at the first
+# up-window run the queued hardware measurements (tpu_followups.sh) with
+# output teed to logs/followups_r5.log.  Appends one line per probe to
+# logs/tpu_probe_r5.log so the outage window is auditable like round 4's.
+cd /root/repo || exit 1
+mkdir -p logs
+PROBELOG=logs/tpu_probe_r5.log
+RUNLOG=logs/followups_r5.log
+
+while :; do
+  if timeout 120 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) UP" >> "$PROBELOG"
+    echo "$(date -u +%FT%TZ) === tunnel up, running followups ===" >> "$RUNLOG"
+    sh scripts/tpu_followups.sh >> "$RUNLOG" 2>&1
+    rc=$?
+    echo "$(date -u +%FT%TZ) === followups exited rc=$rc ===" >> "$RUNLOG"
+    if [ "$rc" -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) QUEUE-COMPLETE" >> "$PROBELOG"
+      exit 0
+    fi
+    # mid-queue outage: fall through and keep probing for the next window
+  else
+    echo "$(date -u +%FT%TZ) DOWN" >> "$PROBELOG"
+  fi
+  sleep 240
+done

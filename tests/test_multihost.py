@@ -218,6 +218,65 @@ def test_two_process_async_sharded_save_completes_without_barrier(tmp_path):
         np.arange(24, dtype=np.float32).reshape(8, 3))
 
 
+def test_two_process_ragged_eval_matches_single_process(tmp_path):
+    """evaluate() on a dataset with a ragged tail (22 = 2x(4+4+3) local
+    batches) run as 2 REAL processes over a 4-device mesh equals the
+    1-process means: the tail is padded with a validity mask and fed
+    through the masked eval step instead of being dropped
+    (models/sequential.py _evaluate_batches; VERDICT r4 item 5)."""
+    script = tmp_path / "ragged_eval.py"
+    script.write_text(textwrap.dedent(f"""
+        import json, sys
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from distributed_tensorflow_tpu import parallel
+        parallel.initialize()
+        import numpy as np
+        from distributed_tensorflow_tpu import models, ops
+        assert jax.process_count() == 2
+        mesh = parallel.make_mesh({{"data": len(jax.devices())}})
+        model = models.Sequential([ops.Dense(8, "relu"),
+                                   ops.Dense(32, "sigmoid")])
+        model.compile(loss="mean_squared_error", optimizer="sgd",
+                      metrics=["binary_accuracy"], mesh=mesh)
+        model.build((3,), seed=1)
+        rng = np.random.default_rng(0)
+        x = rng.random((22, 3)).astype(np.float32)
+        y = (rng.random((22, 32)) > 0.5).astype(np.float32)
+        pid = jax.process_index()
+        out = model.evaluate(x[pid * 11:(pid + 1) * 11],
+                             y[pid * 11:(pid + 1) * 11],
+                             batch_size=4, verbose=0)
+        print("EVAL " + json.dumps({{k: float(v) for k, v in out.items()}}))
+    """))
+    procs, outs = _run_pair(script)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out
+
+    # the 1-process ground truth, same params (build seed), same data
+    import jax
+    from distributed_tensorflow_tpu import models, ops
+    model = models.Sequential([ops.Dense(8, "relu"),
+                               ops.Dense(32, "sigmoid")])
+    model.compile(loss="mean_squared_error", optimizer="sgd",
+                  metrics=["binary_accuracy"])
+    model.build((3,), seed=1)
+    rng = np.random.default_rng(0)
+    x = rng.random((22, 3)).astype(np.float32)
+    y = (rng.random((22, 32)) > 0.5).astype(np.float32)
+    expected = model.evaluate(x, y, batch_size=8, verbose=0)
+
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("EVAL ")]
+        assert line, out
+        got = json.loads(line[0][5:])
+        assert set(got) == set(expected)
+        for k, v in expected.items():
+            np.testing.assert_allclose(got[k], float(v),
+                                       rtol=1e-5, atol=1e-6)
+
+
 def test_sigterm_one_process_saves_and_single_process_resumes(tmp_path):
     """SIGTERM only the NON-chief mid-training: the preemption flag is
     agreed cross-process (sync_fn allgather), both processes checkpoint

@@ -784,26 +784,31 @@ class TestGroupBatches:
         assert [o[0].shape for o in out] == [(4, 3), (2, 3), (2, 4, 3)]
 
 
-def test_evaluate_surfaces_dropped_examples(monkeypatch):
-    """In a (simulated) multi-process run, the ragged eval tail that
-    cannot be assembled into a global array is dropped — and the drop is
-    surfaced in the returned metrics, not only in a log line."""
-    import jax
-    from distributed_tensorflow_tpu import parallel
-    (xt, yt), _ = data.xor_data(100, val_size=4, seed=0)
+def test_masked_eval_step_excludes_padding():
+    """make_masked_eval_step on a padded (x, y, w) batch reproduces the
+    plain eval_step on the unpadded batch exactly — the core of the
+    multi-process ragged-tail path (real 2-process equality is proven in
+    tests/test_multihost.py)."""
     model = models.Sequential([ops.Dense(8, "relu"),
                                ops.Dense(32, "sigmoid")])
     model.compile(loss="mean_squared_error", optimizer="sgd",
-                  mesh=parallel.data_parallel_mesh())
-    model.fit(xt, yt, epochs=1, batch_size=56, verbose=0)
-    monkeypatch.setattr(jax, "process_count", lambda: 2)
-    # 100 = 3*32 + 4: the 4-example tail is not divisible by 8 shards
-    out = model.evaluate(xt, yt, batch_size=32, verbose=0)
-    assert out["dropped_examples"] == 4.0
-    # single-process: tail kept, no field
-    monkeypatch.setattr(jax, "process_count", lambda: 1)
-    out1 = model.evaluate(xt, yt, batch_size=32, verbose=0)
-    assert "dropped_examples" not in out1
+                  metrics=["binary_accuracy"])
+    model.build((3,), seed=1)
+    rng = np.random.default_rng(0)
+    x = rng.random((5, 3)).astype(np.float32)
+    y = (rng.random((5, 32)) > 0.5).astype(np.float32)
+    plain = model._require_compiled()["eval_step"](
+        model.state, (x, y))
+    masked_step = model._masked_eval_step(model._require_compiled())
+    # pad with garbage rows that MUST not influence the means
+    xp = np.concatenate([x, np.full((3, 3), 7.0, np.float32)])
+    yp = np.concatenate([y, np.zeros((3, 32), np.float32)])
+    w = np.asarray([1, 1, 1, 1, 1, 0, 0, 0], np.float32)
+    masked = masked_step(model.state, (xp, yp, w))
+    assert set(masked) == set(plain)
+    for k in plain:
+        np.testing.assert_allclose(float(masked[k]), float(plain[k]),
+                                   rtol=1e-6, atol=1e-7)
 
 
 class TestGradAccum:
