@@ -132,7 +132,12 @@ def _ring_flash_fwd(q, k, v, valid, axis_name, causal, scale, block_q,
                     block_k, interpret):
     out, lse = _ring_flash_fwd_loop(q, k, v, valid, axis_name, causal,
                                     scale, block_q, block_k, interpret)
-    return out.astype(q.dtype), (q, k, v, valid, out, lse)
+    # residual in the INPUT dtype (the f32 merge accumulator would double
+    # this residual's memory for bf16 models — the backward upcasts where
+    # it matters: D = rowsum(dO·O) in f32); matches the non-ring flash
+    # path, which saves the kernel-dtype out.
+    out = out.astype(q.dtype)
+    return out, (q, k, v, valid, out, lse)
 
 
 def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,

@@ -413,6 +413,25 @@ def make_multi_train_step(model, loss, optimizer: opt_lib.Optimizer,
                    in_shardings=(state_shardings, batch_shardings))
 
 
+def _eval_forward(model, pol, state: TrainState, x):
+    """The ONE eval-phase forward shared by the plain and masked eval
+    steps (so precision-policy/state-unwrap changes can never make the
+    multi-process ragged-tail path drift from the plain path)."""
+    # A loss-scaled TrainState wraps model_state; models see through it.
+    model_state = state.model_state
+    if isinstance(model_state, prec_lib.LossScaled):
+        model_state = model_state.model_state
+    params = state.params
+    if pol is not None:
+        params = pol.cast_to_compute(params)
+        x = pol.cast_to_compute(x)
+    preds, _ = model.apply(params, model_state, x,
+                           train=False, rng=None)
+    if pol is not None:
+        preds = pol.cast_to_output(preds)
+    return preds
+
+
 def make_eval_step(model, loss,
                    metric_fns: Optional[Dict[str, Any]] = None,
                    mesh: Optional[Mesh] = None,
@@ -431,18 +450,7 @@ def make_eval_step(model, loss,
 
     def eval_step(state: TrainState, batch):
         x, y = batch
-        # A loss-scaled TrainState wraps model_state; models see through it.
-        model_state = state.model_state
-        if isinstance(model_state, prec_lib.LossScaled):
-            model_state = model_state.model_state
-        params = state.params
-        if pol is not None:
-            params = pol.cast_to_compute(params)
-            x = pol.cast_to_compute(x)
-        preds, _ = model.apply(params, model_state, x,
-                               train=False, rng=None)
-        if pol is not None:
-            preds = pol.cast_to_output(preds)
+        preds = _eval_forward(model, pol, state, x)
         metrics = {"loss": loss_fn(preds, y)}
         metrics.update(_metric_dict(metric_fns, preds, y))
         return metrics
@@ -481,17 +489,7 @@ def make_masked_eval_step(model, loss,
 
     def masked_eval_step(state: TrainState, batch):
         x, y, w = batch
-        model_state = state.model_state
-        if isinstance(model_state, prec_lib.LossScaled):
-            model_state = model_state.model_state
-        params = state.params
-        if pol is not None:
-            params = pol.cast_to_compute(params)
-            x = pol.cast_to_compute(x)
-        preds, _ = model.apply(params, model_state, x,
-                               train=False, rng=None)
-        if pol is not None:
-            preds = pol.cast_to_output(preds)
+        preds = _eval_forward(model, pol, state, x)
 
         def masked_mean(fn):
             per = jax.vmap(lambda pi, yi: fn(pi[None], yi[None]))(preds, y)

@@ -28,6 +28,18 @@ flags_lib.DEFINE_integer("steps", 150, "training steps")
 flags_lib.DEFINE_integer("batch_size", 32, "global batch size")
 flags_lib.DEFINE_integer("seq_len", 32, "sequence length")
 flags_lib.DEFINE_integer("seed", 0, "data/init seed")
+flags_lib.DEFINE_integer("mlm_steps", 0,
+                         "MLM pretrain steps before the classifier "
+                         "fine-tune (the standard BERT recipe order)")
+flags_lib.DEFINE_integer("mlm_predictions_per_seq", 0,
+                         "gather at most N masked positions before the "
+                         "MLM head (BertConfig.mlm_predictions_per_seq; "
+                         "0 = project every position)")
+flags_lib.DEFINE_bool("fused_layernorm", False,
+                         "LayerNorm via the fused Pallas kernel")
+flags_lib.DEFINE_bool("remat", False, "checkpoint each encoder layer")
+flags_lib.DEFINE_string("remat_policy", "full",
+                        "remat policy: full | dots | dots_no_batch")
 FLAGS = flags_lib.FLAGS
 
 TRIGGER = 7          # class 1 iff this token id appears in the sequence
@@ -65,7 +77,10 @@ def main() -> int:
     config = BertConfig(vocab_size=64, hidden_size=128, num_layers=2,
                         num_heads=4, intermediate_size=256,
                         max_position=FLAGS.seq_len, dropout_rate=0.1,
-                        dtype=jnp.bfloat16)
+                        dtype=jnp.bfloat16,
+                        mlm_predictions_per_seq=FLAGS.mlm_predictions_per_seq,
+                        fused_layernorm=FLAGS.fused_layernorm,
+                        remat=FLAGS.remat, remat_policy=FLAGS.remat_policy)
     model = Bert(config)
     params = model.init(jax.random.PRNGKey(FLAGS.seed))
     # fine-tune head: fresh [hidden, classes] on top of the pooler
@@ -99,6 +114,43 @@ def main() -> int:
     rng = np.random.default_rng(FLAGS.seed)
     bsh = NamedSharding(mesh, P("data"))
     batch = parallel.round_batch_to_mesh(FLAGS.batch_size, mesh)
+
+    # Optional MLM warm-up (the standard BERT recipe order: pretrain the
+    # encoder with the MLM head, then fine-tune the classifier).  This is
+    # the phase where ``mlm_predictions_per_seq`` actually executes: the
+    # masked-position gather before the vocab projection.
+    if FLAGS.mlm_steps:
+        MASK_ID = 1   # reserved: data tokens are drawn from [8, vocab)
+        mlm_step = train.make_custom_train_step(model.mlm_loss_fn(),
+                                                optimizer,
+                                                grad_clip_norm=1.0)
+        for i in range(FLAGS.mlm_steps):
+            ids = rng.integers(8, config.vocab_size,
+                               (batch, FLAGS.seq_len)).astype(np.int32)
+            mask = (rng.random((batch, FLAGS.seq_len)) < 0.15
+                    ).astype(np.float32)
+            # BERT's corruption rule at the masked positions — 80%
+            # [MASK], 10% random token, 10% keep — applied HOST-side:
+            # mlm_loss_fn forwards input_ids as-is, so without this the
+            # "MLM" phase would be a readable-identity task.
+            inp = ids.copy()
+            r = rng.random((batch, FLAGS.seq_len))
+            m = mask == 1.0
+            inp[m & (r < 0.8)] = MASK_ID
+            rand_rows = m & (r >= 0.8) & (r < 0.9)
+            inp[rand_rows] = rng.integers(
+                8, config.vocab_size, int(rand_rows.sum())).astype(np.int32)
+            mb = jax.device_put(
+                {"input_ids": inp, "labels": ids,
+                 "mlm_mask": mask,
+                 "attention_mask": np.ones_like(ids)}, bsh)
+            state, mlm_m = mlm_step(state, mb)
+            if (i + 1) % 25 == 0 or i + 1 == FLAGS.mlm_steps:
+                print(f"mlm step {i + 1}: "
+                      f"loss={float(mlm_m['loss']):.4f} "
+                      f"acc={float(mlm_m['mlm_accuracy']):.3f}",
+                      flush=True)
+
     metrics = {}
     for i in range(FLAGS.steps):
         ids, labels = make_batch(rng, config.vocab_size, batch,

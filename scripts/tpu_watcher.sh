@@ -7,6 +7,11 @@ cd /root/repo || exit 1
 mkdir -p logs
 PROBELOG=logs/tpu_probe_r5.log
 RUNLOG=logs/followups_r5.log
+# Cap full-queue attempts: a mid-queue tunnel drop deserves a retry at the
+# next window, but a REPRODUCIBLE failure (a bench bug with the tunnel up)
+# must not re-burn scarce window time forever re-running the early steps.
+attempts=0
+MAX_ATTEMPTS=4
 
 while :; do
   if timeout 120 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'" >/dev/null 2>&1; then
@@ -18,6 +23,12 @@ while :; do
     if [ "$rc" -eq 0 ]; then
       echo "$(date -u +%FT%TZ) QUEUE-COMPLETE" >> "$PROBELOG"
       exit 0
+    fi
+    attempts=$((attempts + 1))
+    if [ "$attempts" -ge "$MAX_ATTEMPTS" ]; then
+      echo "$(date -u +%FT%TZ) QUEUE-FAILED x$attempts — giving up" \
+        >> "$PROBELOG"
+      exit 1
     fi
     # mid-queue outage: fall through and keep probing for the next window
   else

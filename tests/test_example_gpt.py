@@ -1,18 +1,23 @@
-"""Smoke test for the distributed GPT example (full-stack script)."""
+"""Smoke tests for the example scripts (full-stack, real subprocesses)."""
 import os
 import subprocess
 import sys
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-def test_train_gpt_example_smoke(tmp_path):
+
+def _run(args, timeout=900):
     env = dict(os.environ,
                XLA_FLAGS="--xla_force_host_platform_device_count=8",
                JAX_PLATFORMS="cpu")
-    proc = subprocess.run(
-        [sys.executable, "examples/train_gpt.py", "--device=cpu",
-         "--steps=8", "--batch_size=16", f"--log_dir={tmp_path}"],
-        env=env, capture_output=True, text=True, timeout=900,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return subprocess.run([sys.executable] + args, env=env,
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=REPO)
+
+
+def test_train_gpt_example_smoke(tmp_path):
+    proc = _run(["examples/train_gpt.py", "--device=cpu",
+                 "--steps=8", "--batch_size=16", f"--log_dir={tmp_path}"])
     # rc 1 is the script's defined "ran fine but didn't beat the uniform
     # baseline" outcome (train_gpt.py prints the WARNING and returns 1) —
     # possible at an 8-step budget.  Anything else nonzero is a crash.
@@ -22,3 +27,30 @@ def test_train_gpt_example_smoke(tmp_path):
     assert ok, f"rc={proc.returncode}\n{proc.stderr[-2000:]}"
     assert "eval loss:" in proc.stdout
     assert any(p.startswith("ckpt-") for p in os.listdir(tmp_path))
+
+
+def test_train_gpt_levers_smoke(tmp_path):
+    """The round-4 MFU levers through the full script path (not just
+    bench configs): chunked LM loss + remat with the dots policy."""
+    proc = _run(["examples/train_gpt.py", "--device=cpu",
+                 "--steps=4", "--batch_size=16", "--loss_seq_chunk=16",
+                 "--remat", "--remat_policy=dots",
+                 f"--log_dir={tmp_path}"])
+    ok = proc.returncode == 0 or (
+        proc.returncode == 1
+        and "did not beat the uniform baseline" in proc.stderr)
+    assert ok, f"rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    assert "eval loss:" in proc.stdout
+
+
+def test_finetune_bert_mlm_gather_smoke():
+    """MLM warm-up with the masked-position gather + fused-LN/remat flags
+    through examples/finetune_bert.py (the fit-level lever surface)."""
+    proc = _run(["examples/finetune_bert.py", "--device=cpu",
+                 "--steps=6", "--mlm_steps=4",
+                 "--mlm_predictions_per_seq=8",
+                 "--remat", "--remat_policy=dots"])
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    assert "mlm step 4:" in proc.stdout
+    assert "eval accuracy:" in proc.stdout
