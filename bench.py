@@ -45,6 +45,31 @@ import time
 
 SMOKE = bool(os.environ.get("DTTPU_BENCH_SMOKE"))
 
+_PROMOTED = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "docs", "PROMOTED.json")
+
+
+def _load_promoted_defaults():
+    """docs/PROMOTED.json (written by scripts/promote_levers.py from
+    measured MFU-ablation winners) supplies DEFAULTS for the lever env
+    knobs — setdefault, so an explicitly exported env var still wins, and
+    rows that record their lever state (loss_seq_chunk / remat_policy /
+    mlm_predictions_per_seq in the result JSON) disclose what ran.
+
+    Called from main() only — importing bench as a library must not
+    mutate os.environ — and skipped under SMOKE: wiring checks measure
+    nothing, so promoted real-hardware defaults would only make their
+    behavior depend on repo state."""
+    if SMOKE or not os.path.exists(_PROMOTED):
+        return
+    try:
+        with open(_PROMOTED) as f:
+            for k, v in (json.load(f).get("env") or {}).items():
+                os.environ.setdefault(k, str(v))
+    except (OSError, ValueError) as e:
+        print(f"bench: ignoring unreadable {_PROMOTED}: {e}",
+              file=sys.stderr)
+
 # Estimated examples/sec for the reference-era stack on a single CPU host —
 # used only if the live torch baseline cannot run.  Per config: these are
 # measured torch-CPU rates from this machine (mnist/cifar) or the
@@ -824,10 +849,13 @@ def bench_gpt_decode():
     gen = jax.jit(lambda p, ids: model.generate(
         p, ids, max_new_tokens=new_tokens, temperature=0.0, max_len=seq))
     np.asarray(gen(params, prompt))              # compile + warmup
-    t0 = time.perf_counter()
-    out = gen(params, prompt)
-    np.asarray(out)                              # value fetch closes window
-    dt = time.perf_counter() - t0
+    dt = None
+    for _ in range(WINDOWS):                     # best-of, like every row
+        t0 = time.perf_counter()
+        out = gen(params, prompt)
+        np.asarray(out)                          # value fetch closes window
+        w = time.perf_counter() - t0
+        dt = w if dt is None else min(dt, w)
     tokens_s = batch * new_tokens / dt          # single-device: per chip
     log(f"gpt_decode: {tokens_s:,.0f} tokens/s/chip "
         f"({dt * 1e3 / new_tokens:.2f} ms/token at batch {batch})")
@@ -1085,6 +1113,7 @@ def supervise(config: str, device: str | None = None) -> int:
 
 
 def main():
+    _load_promoted_defaults()
     config = "mnist_mlp"
     device = os.environ.get("DTTPU_BENCH_DEVICE")
     for arg in sys.argv[1:]:

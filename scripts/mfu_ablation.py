@@ -21,14 +21,22 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# DTTPU_ABLATION_SMOKE=1: shrink every arm to a 2-layer toy so the script's
+# wiring can be validated on CPU in seconds; numbers are meaningless there.
+# ("0"/"false"/empty = off — same parse as decode_ladder.py).
+SMOKE = os.environ.get("DTTPU_ABLATION_SMOKE", "").lower() \
+    not in ("", "0", "false")
+
 import jax
+
+if SMOKE:
+    # smoke means CPU: the axon sitecustomize force-selects TPU at the
+    # config level (env var alone loses) and a dead tunnel hangs
+    # jax.devices() — override back before the backend initializes
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-
-# DTTPU_ABLATION_SMOKE=1: shrink every arm to a 2-layer toy so the script's
-# wiring can be validated on CPU in seconds; numbers are meaningless there.
-SMOKE = bool(os.environ.get("DTTPU_ABLATION_SMOKE"))
 
 PEAK = {"v5e": 197e12, "v5 lite": 197e12, "v5p": 459e12,
         "v6e": 918e12, "v4": 275e12}
@@ -117,6 +125,7 @@ def run_gpt(arms):
             toks = batch * seq / dt
             f_tok = 6.0 * n_params + 12.0 * 12 * 768 * seq
             out = {"model": "gpt", "arm": arm, "batch": batch, "seq": seq,
+                   "backend": jax.devices()[0].platform, "smoke": SMOKE,
                    "tokens_per_sec": round(toks, 1),
                    "ms_per_step": round(dt * 1e3, 2), "loss": round(loss, 3)}
             if peak:
@@ -191,6 +200,7 @@ def run_bert(arms):
             f_tok = (6.0 * n_params + 12.0 * 12 * 768 * seq
                      - mlm_gather_flops_correction(config, seq))
             out = {"model": "bert", "arm": arm, "batch": batch, "seq": seq,
+                   "backend": jax.devices()[0].platform, "smoke": SMOKE,
                    "tokens_per_sec": round(toks, 1),
                    "ms_per_step": round(dt * 1e3, 2), "loss": round(loss, 3)}
             if peak:

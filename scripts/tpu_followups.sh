@@ -1,35 +1,71 @@
 #!/bin/sh
 # Queued hardware measurements for the next tunnel-up window (run from the
 # repo root; each step prints one JSON line or a short table to stdout).
-# Order: cheapest liveness first, then the rows whose PERF.md entries are
-# pending.  Safe to re-run; every step is read-only w.r.t. the repo.
 #
-# Round-4 queue (VERDICT r3 items 2-4): the flagship headline first so a
-# short window still lands a driver-comparable number, then the pending
-# r3 rows, then the MFU ablation arms, then the d128 flash validation.
+# Round-5 queue, ordered by VERDICT r4's item priority so a SHORT window
+# lands the most important evidence first:
+#   1. flagship driver-comparable bench row (mnist_mlp)
+#   2. MFU ablation -> promote winners -> re-measure LM rows under them
+#   3. ring-flash/flash Mosaic-compiled validation (the correctness risk)
+#   4. decode rows + operating-point ladder
+#   then: gpt_long / gpt_moe / op profiles / BERT tuner.
 # The tunnel is re-probed before every step so a mid-queue outage aborts
-# in 45 s instead of burning each remaining step's full timeout.
+# in 45 s instead of burning each remaining step's full timeout; the
+# watcher (tpu_watcher.sh) retries the queue at the next window, capped.
 set -x
 
 probe() {
   timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
+# exit 2 = tunnel gone (the watcher retries at the next window without
+# counting it against its reproducible-failure cap); other nonzero codes
+# mean a step genuinely failed.
 step() {
-  probe || { echo "TUNNEL GONE — aborting queue" >&2; exit 1; }
+  probe || { echo "TUNNEL GONE — aborting queue" >&2; exit 2; }
   "$@"
 }
 
-probe || exit 1
+probe || exit 2
 
-# the driver's headline row on hardware (mnist_mlp, supervisor-wrapped)
+# stale success markers from a previous partial run must not gate today's
+# promotion on yesterday's ablation
+rm -f logs/abl_gpt.ok logs/abl_bert.ok
+
+# 1. the driver's headline row on hardware (mnist_mlp, supervisor-wrapped)
 step timeout 900 python bench.py
 
-# decode throughput after the cache-carry fix (pre-fix same-day: 7,017)
+# 2. MFU ablation: fused adam / fused LN / vocab pad / chunked loss /
+#    mlm gather / batch+seq ladder, one window so arms are comparable.
+#    Output lands in the log file FIRST (a pipe to tee would mask the
+#    ablation's exit status under POSIX sh); .ok markers gate promotion
+#    so a timeout-truncated arm table can never define bench defaults.
+step timeout 2400 sh -c 'python scripts/mfu_ablation.py gpt > logs/ablation_gpt.jsonl 2>&1 && touch logs/abl_gpt.ok; rc=$?; cat logs/ablation_gpt.jsonl; exit $rc'
+step timeout 1800 sh -c 'python scripts/mfu_ablation.py bert > logs/ablation_bert.jsonl 2>&1 && touch logs/abl_bert.ok; rc=$?; cat logs/ablation_bert.jsonl; exit $rc'
+
+#    promote the measured winners into the bench defaults — ONLY from a
+#    complete arm table — (docs/PROMOTED.json; bench.py setdefaults from
+#    it), then re-measure the LM training rows UNDER the promoted levers:
+#    the record of the promotion, not just the ablation
+step sh -c 'if [ -f logs/abl_gpt.ok ] && [ -f logs/abl_bert.ok ]; then python scripts/promote_levers.py logs/ablation_gpt.jsonl logs/ablation_bert.jsonl; else echo "ablation incomplete — skipping promotion" >&2; fi'
+step timeout 1200 python bench.py --config=gpt
+step timeout 1200 python bench.py --config=bert
+step timeout 1200 python bench.py --config=llama
+
+# 3. flash + ring-flash Mosaic-compiled validation (interpret mode hid
+#    lowering bugs twice; this gate must pass before ring-flash stays the
+#    long-seq SP default) + d128 head-dim + crossover
+step timeout 1200 python scripts/validate_flash_tpu.py
+
+# 4. decode throughput after the cache-carry fix (pre-fix: 7,017 tok/s)
 step timeout 900 python bench.py --config=gpt_decode
 
-# int8 decode row (fp rate + greedy agreement come from the same run)
+#    int8 decode row (fp rate + greedy agreement from the same run)
 step timeout 900 python bench.py --config=gpt_decode_int8
+
+#    decode operating-point ladder: batch x seq sweep (where the decode
+#    number sits vs the achievable ceiling — VERDICT r4 item 4)
+step timeout 1800 python scripts/decode_ladder.py
 
 # the flash-dispatch operating point (seq 2048)
 step timeout 1200 python bench.py --config=gpt_long
@@ -37,17 +73,9 @@ step timeout 1200 python bench.py --config=gpt_long
 # MoE row: an actual number for the 85b4bf0 claim
 step timeout 1200 python bench.py --config=gpt_moe
 
-# MFU ablation: fused adam / fused LN / vocab pad / chunked loss /
-# mlm gather / batch+seq ladder, one window so arms are comparable
-step timeout 2400 python scripts/mfu_ablation.py gpt
-step timeout 1800 python scripts/mfu_ablation.py bert
-
 # one-step op profile (top time sinks for the MFU analysis)
 step timeout 900 python scripts/profile_gpt_step.py gpt /tmp/prof_gpt
 step timeout 900 python scripts/profile_gpt_step.py bert /tmp/prof_bert
 
 # BERT remat/batch operating point (decides whether bench_bert flips remat)
 step timeout 900 python scripts/tune_bert_batch.py
-
-# flash d128 head-dim (the Llama preset) hardware validation + crossover
-step timeout 1200 python scripts/validate_flash_tpu.py

@@ -24,7 +24,12 @@ while :; do
       echo "$(date -u +%FT%TZ) QUEUE-COMPLETE" >> "$PROBELOG"
       exit 0
     fi
-    attempts=$((attempts + 1))
+    if [ "$rc" -ne 2 ]; then
+      # rc 2 = the queue's own "tunnel gone" abort: retry at the next
+      # window without counting it; anything else is a reproducible
+      # step failure and counts toward the cap
+      attempts=$((attempts + 1))
+    fi
     if [ "$attempts" -ge "$MAX_ATTEMPTS" ]; then
       echo "$(date -u +%FT%TZ) QUEUE-FAILED x$attempts — giving up" \
         >> "$PROBELOG"

@@ -176,6 +176,67 @@ class TestSupervisorProbe:
         assert calls["probe"] == 2  # probed again after the 100s child
 
 
+class TestPromoteLevers:
+    """scripts/promote_levers.py selection rule: a PURE lever arm must
+    beat base by >= 2% measured tokens/sec to become a bench default."""
+
+    def _promote(self, rows):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import promote_levers
+        finally:
+            sys.path.pop(0)
+        return promote_levers.promote(rows)
+
+    def test_winning_levers_promote(self):
+        rows = [
+            {"model": "gpt", "arm": "base", "tokens_per_sec": 100.0},
+            {"model": "gpt", "arm": "loss_chunk", "tokens_per_sec": 110.0},
+            {"model": "gpt", "arm": "remat_dots", "tokens_per_sec": 101.0},
+            {"model": "bert", "arm": "base", "tokens_per_sec": 200.0},
+            {"model": "bert", "arm": "mlm_gather", "tokens_per_sec": 230.0},
+        ]
+        env, evidence = self._promote(rows)
+        # loss_chunk (+10%) and mlm_gather (+15%) promote; remat_dots
+        # (+1%, under the 2% bar) does not
+        assert env == {"DTTPU_BENCH_LOSS_CHUNK": "512",
+                       "DTTPU_BENCH_MLM_GATHER": "1"}
+        assert {e["model"] for e in evidence} == {"gpt", "bert"}
+
+    def test_composite_arms_never_promote(self):
+        # a composite arm can WIN the table without promoting env levers:
+        # its batch move has no env knob
+        rows = [
+            {"model": "gpt", "arm": "base", "tokens_per_sec": 100.0},
+            {"model": "gpt", "arm": "loss_chunk_b192",
+             "tokens_per_sec": 150.0},
+        ]
+        env, evidence = self._promote(rows)
+        assert env == {}
+        assert evidence[0]["best"]["arm"] == "loss_chunk_b192"
+
+    def test_no_base_row_promotes_nothing(self):
+        rows = [{"model": "gpt", "arm": "loss_chunk",
+                 "tokens_per_sec": 1e9}]
+        env, _ = self._promote(rows)
+        assert env == {}
+
+    def test_parse_rejects_smoke_and_cpu_rows(self):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import promote_levers
+        finally:
+            sys.path.pop(0)
+        mk = lambda **kw: json.dumps(dict(
+            model="gpt", arm="base", tokens_per_sec=1.0, **kw))
+        lines = [mk(backend="cpu", smoke=True),
+                 mk(backend="cpu", smoke=False),
+                 mk(backend="tpu", smoke=True),
+                 mk(backend="tpu", smoke=False)]
+        assert len(promote_levers.parse(lines)) == 1
+        assert len(promote_levers.parse(lines, allow_any=True)) == 4
+
+
 class TestHelpers:
     def test_parse_last_json(self):
         text = "noise\n{\"a\": 1}\nnot json {broken\n"
