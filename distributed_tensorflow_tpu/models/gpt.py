@@ -744,53 +744,32 @@ class GPT:
             kv_mask = kv_mask + jnp.where(kv_valid, 0.0, attn_lib.NEG_INF
                                           )[:, None, None, :]
 
-        def body(carry, inputs):
-            # The caches ride the CARRY, not the scanned xs/ys: as ys each
-            # layer would write its FULL [b, max_len, h, d] cache back out
-            # every token (~600 MB/step at the bench shapes) when only one
-            # row changes; as carry the updates are in-place row writes.
-            x, k_all, v_all = carry
-            p, i = inputs
+        # rope tables built ONCE per call, not once per layer (cos/sin are
+        # identical across the layer scan — same hoist as _rope_transform)
+        rope_cs = None
+        if c.position_embedding == "rope":
+            # rotate q and THIS k at its own position; cached keys were
+            # rotated when written, matching the full-sequence path
+            pos1 = (positions[:, None] if positions is not None
+                    else jnp.full((1,), pos))
+            rope_cs = attn_lib.rope_tables(pos1, c.head_dim,
+                                           base=c.rope_base)
 
-            h = self._norm(p["ln_1"], x)
-            a = p["attention"]
-            dtype = h.dtype
-
-            def proj(pp):
-                y = jnp.einsum("bsd,dhk->bshk", h,
-                               pp["kernel"].astype(dtype))
-                if "bias" in pp:
-                    y = y + pp["bias"].astype(dtype)
-                return y
-
-            q, k, v = proj(a["query"]), proj(a["key"]), proj(a["value"])
-            if c.position_embedding == "rope":
-                # rotate q and THIS k at its own position; cached keys were
-                # rotated when written, matching the full-sequence path
-                pos1 = (positions[:, None] if positions is not None
-                        else jnp.full((1,), pos))
-                q = attn_lib.rotary_embedding(q, pos1, base=c.rope_base)
-                k = attn_lib.rotary_embedding(k, pos1, base=c.rope_base)
-            zero = jnp.zeros((), jnp.int32)
-            # write ONLY the new row [1, b, 1, h, d] into the 5-D carry,
-            # then slice this layer's cache out for the attention read
-            k_all = lax.dynamic_update_slice(k_all, k[None].astype(
-                k_all.dtype), (i, zero, pos, zero, zero))
-            v_all = lax.dynamic_update_slice(v_all, v[None].astype(
-                v_all.dtype), (i, zero, pos, zero, zero))
+        def attention(q, k_blk, v_blk, k_all, v_all, i):
+            del k_blk, v_blk   # single token: read back through the cache
             k_cache = lax.dynamic_index_in_dim(k_all, i, keepdims=False)
             v_cache = lax.dynamic_index_in_dim(v_all, i, keepdims=False)
             # GQA handled natively by the dense kernel (grouped einsum
             # against the unrepeated cache — no full-head materialization)
-            attn = attn_lib.dot_product_attention(q, k_cache, v_cache,
+            return attn_lib.dot_product_attention(q, k_cache, v_cache,
                                                   mask=kv_mask)
-            attn_out = jnp.einsum("bshk,hkd->bsd", attn,
-                                  a["out"]["kernel"].astype(dtype))
-            if "bias" in a["out"]:
-                attn_out = attn_out + a["out"]["bias"].astype(dtype)
-            x = x + attn_out
-            ffn_out, _ = self._ffn(p, x)   # aux unused at decode
-            return (x + ffn_out, k_all, v_all), None
+
+        def body(carry, inputs):
+            x, k_all, v_all = carry
+            p, i = inputs
+            return self._cache_layer(p, x, k_all, v_all, i,
+                                     write_pos=pos, rope_cs=rope_cs,
+                                     attention=attention), None
 
         (x, new_k, new_v), _ = lax.scan(
             body, (x, cache["k"], cache["v"]),
@@ -798,6 +777,123 @@ class GPT:
         x = self._norm(params["ln_f"], x)
         logits = self.logits(params, x)[:, 0, :]
         return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+
+    def _cache_layer(self, p, x, k_all, v_all, i, *, write_pos, rope_cs,
+                     attention):
+        """ONE decoder layer of the KV-cache path — shared by decode_step
+        (s=1 against the cache) and decode_block (whole-prompt prefill)
+        so the layer math can never diverge between them.  The caches
+        ride the scan CARRY, not the scanned ys: as ys each layer would
+        write its FULL [b, max_len, h, d] cache back out every call when
+        only ``write_pos`` onward changes; as carry the updates are
+        in-place slice writes.
+
+        ``attention(q, k_blk, v_blk, k_all, v_all, i)`` supplies the
+        step/block-specific attention read; ``rope_cs``: (cos, sin)
+        tables hoisted out of the layer scan.
+        """
+        h = self._norm(p["ln_1"], x)
+        a = p["attention"]
+        dtype = h.dtype
+
+        def proj(pp):
+            y = jnp.einsum("bsd,dhk->bshk", h,
+                           pp["kernel"].astype(dtype))
+            if "bias" in pp:
+                y = y + pp["bias"].astype(dtype)
+            return y
+
+        q, k, v = proj(a["query"]), proj(a["key"]), proj(a["value"])
+        if rope_cs is not None:
+            q = attn_lib.apply_rope(q, *rope_cs)
+            k = attn_lib.apply_rope(k, *rope_cs)
+        zero = jnp.zeros((), jnp.int32)
+        k_all = lax.dynamic_update_slice(
+            k_all, k[None].astype(k_all.dtype),
+            (i, zero, write_pos, zero, zero))
+        v_all = lax.dynamic_update_slice(
+            v_all, v[None].astype(v_all.dtype),
+            (i, zero, write_pos, zero, zero))
+        attn = attention(q, k, v, k_all, v_all, i)
+        attn_out = jnp.einsum("bshk,hkd->bsd", attn,
+                              a["out"]["kernel"].astype(dtype))
+        if "bias" in a["out"]:
+            attn_out = attn_out + a["out"]["bias"].astype(dtype)
+        x = x + attn_out
+        ffn_out, _ = self._ffn(p, x)   # aux unused at decode
+        return x + ffn_out, k_all, v_all
+
+    def decode_block(self, params, cache, token_ids, kv_valid=None,
+                     positions=None):
+        """Prefill: push a WHOLE [b, s] prompt block through the stack
+        into an EMPTY cache in one forward — one batched matmul pass per
+        layer instead of ``s`` sequential ``decode_step`` calls, which is
+        the difference between 1 dispatch and ``s`` dependent MXU-starved
+        steps for long prompts (time-to-first-token).
+
+        Requires ``cache['pos'] == 0`` (the generate/beam_search prefill
+        call sites — the in-block causal mask assumes the cache holds
+        nothing before the block).  ``kv_valid`` [b, s]: per-row validity
+        of the block columns (left-padded ragged prompts); ``positions``
+        [b, s]: per-row position indices for learned/RoPE embeddings.
+        Returns (logits [b, vocab] f32 at the LAST block position, cache
+        with pos advanced by ``s``).
+        """
+        c = self.config
+        b, s = token_ids.shape
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], token_ids, axis=0)            # [b,s,d]
+        if c.position_embedding == "learned":
+            pos_idx = (positions if positions is not None
+                       else jnp.arange(s))
+            x = x + jnp.take(emb["position"], pos_idx, axis=0)
+        x = x.astype(c.dtype)
+
+        # The cache beyond the block is empty, so attention reads the
+        # block's own keys — s x s scores, never s x max_len.  Past the
+        # measured crossover the causal no-padding case dispatches the
+        # fused flash kernel exactly like the full forward; ragged
+        # prompts need the per-row pad mask, which the dense path takes
+        # additively.
+        if kv_valid is None and attn_lib.resolve_use_flash(c.use_flash, s):
+            from ..ops.pallas.flash_attention import make_flash_attention_fn
+            flash_fn = make_flash_attention_fn(causal=True)
+
+            def block_attn(q, k_blk, v_blk, k_all, v_all, i):
+                del k_all, v_all, i
+                return flash_fn(q, k_blk, v_blk)
+        else:
+            mask = attn_lib.causal_mask(s)
+            if kv_valid is not None:
+                mask = mask + attn_lib.padding_mask(kv_valid)
+
+            def block_attn(q, k_blk, v_blk, k_all, v_all, i):
+                del k_all, v_all, i
+                return attn_lib.dot_product_attention(q, k_blk, v_blk,
+                                                      mask=mask)
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            rope_pos = (positions if positions is not None
+                        else jnp.arange(s))
+            rope_cs = attn_lib.rope_tables(rope_pos, c.head_dim,
+                                           base=c.rope_base)
+
+        def body(carry, inputs):
+            x, k_all, v_all = carry
+            p, i = inputs
+            return self._cache_layer(p, x, k_all, v_all, i,
+                                     write_pos=jnp.zeros((), jnp.int32),
+                                     rope_cs=rope_cs,
+                                     attention=block_attn), None
+
+        (x, new_k, new_v), _ = lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["decoder"], jnp.arange(c.num_layers)))
+        # head on the last position only — [b, s, vocab] never materializes
+        x = self._norm(params["ln_f"], x[:, -1:, :])
+        logits = self.logits(params, x)[:, 0, :]
+        return logits, {"k": new_k, "v": new_v, "pos": cache["pos"] + s}
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None,
@@ -876,6 +972,34 @@ class GPT:
             return tokens, cache, rng, finished
 
         no_finish = jnp.zeros((b,), bool)
+        finished = no_finish
+        start = 0
+        if plen > 1 and max_new_tokens > 0:
+            # Batched prefill: the whole prompt in ONE forward (decode_
+            # block) instead of plen sequential teacher-forced decode
+            # steps, then sample the first new token from its logits.
+            # Greedy output is identical to the sequential path (parity-
+            # tested); sampling paths draw from the same distributions
+            # but consume fewer rng splits.
+            if prompt_valid is not None:
+                blk = dict(kv_valid=kv_valid[:, :plen],
+                           positions=jnp.maximum(
+                               jnp.arange(plen)[None, :]
+                               - pad_len[:, None], 0))
+            else:
+                blk = {}
+            logits, cache = self.decode_block(params, cache, prompt_ids,
+                                              **blk)
+            rng, sub = jax.random.split(rng)
+            nxt = dec.sample_logits(sub, logits, temperature,
+                                    top_k=top_k, top_p=top_p)
+            if eos_id is not None:
+                nxt, finished = dec.finish_step(nxt, no_finish, eos_id,
+                                                pad)
+            tokens = lax.dynamic_update_slice_in_dim(
+                tokens, nxt[:, None], plen, axis=1)
+            start = plen
+
         if eos_id is None:
             def step(carry, i):
                 tokens, cache, rng = carry
@@ -884,12 +1008,12 @@ class GPT:
                 return (tokens, cache, rng), None
 
             (tokens, _, _), _ = lax.scan(step, (tokens, cache, rng),
-                                         jnp.arange(total - 1))
+                                         jnp.arange(start, total - 1))
             return tokens
 
         (tokens, _, _, _), _ = dec.decode_loop(
             lambda carry, i: advance(*carry, i),
-            (tokens, cache, rng, no_finish), total - 1)
+            (tokens, cache, rng, finished), total - 1, start=start)
         return tokens
 
     def _check_gen_lengths(self, plen: int, max_new_tokens: int,
@@ -945,30 +1069,29 @@ class GPT:
         else:
             pad_len = kv_valid = None
 
-        def step_kwargs(i, fold=1):
-            """decode_step kwargs for position i (beam-folded when the
-            cache rows are repeated k-fold)."""
+        def step_kwargs(i):
+            """decode_step kwargs for position i with the cache rows
+            beam-folded k-fold (the only decode_step caller left since
+            the prefill became one decode_block forward)."""
             if prompt_valid is None:
                 return {}
-            if fold == 1:
-                return dict(kv_valid=kv_valid,
-                            positions=jnp.maximum(i - pad_len, 0))
             return dict(kv_valid=kv_valid_folded,
                         positions=jnp.maximum(i - pad_len_folded, 0))
 
-        # phase 1 — prefill positions 0..plen-2 at batch b
+        # phase 1 — prefill positions 0..plen-2 at batch b, as ONE
+        # decode_block forward (phase 2's first expansion reads the token
+        # at plen-1, so the block stops one short)
         cache = self.init_cache(b, max_len)
-
-        def prefill(cache, inputs):
-            tok, i = inputs
-            _, cache = self.decode_step(params, cache, tok,
-                                        **step_kwargs(i))
-            return cache, None
-
         if plen > 1:
-            cache, _ = lax.scan(prefill, cache,
-                                (prompt_ids[:, :-1].T,
-                                 jnp.arange(plen - 1)))
+            if prompt_valid is not None:
+                blk = dict(kv_valid=kv_valid[:, :plen - 1],
+                           positions=jnp.maximum(
+                               jnp.arange(plen - 1)[None, :]
+                               - pad_len[:, None], 0))
+            else:
+                blk = {}
+            _, cache = self.decode_block(params, cache,
+                                         prompt_ids[:, :-1], **blk)
         # fold beams into the batch dim: row r of batch i -> i*k + r
         cache = {"k": jnp.repeat(cache["k"], k, axis=1),
                  "v": jnp.repeat(cache["v"], k, axis=1),
@@ -985,7 +1108,7 @@ class GPT:
             tok = lax.dynamic_slice_in_dim(
                 tokens.reshape(b * k, total), i, 1, axis=1)[:, 0]
             logits, cache = self.decode_step(params, cache, tok,
-                                             **step_kwargs(i, fold=k))
+                                             **step_kwargs(i))
             logp = jax.nn.log_softmax(logits, -1).reshape(b, k, -1)
             logp = dec.freeze_finished(logp, finished, eos_id)
             scores, beam, nxt = dec.expand_beams(scores, logp)

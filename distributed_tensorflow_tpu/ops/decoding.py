@@ -67,12 +67,13 @@ def finish_step(nxt: jnp.ndarray, finished: jnp.ndarray, eos_id: int,
     return nxt, finished | newly
 
 
-def decode_loop(advance, carry, n_steps: int):
+def decode_loop(advance, carry, n_steps: int, start: int = 0):
     """Early-exit autoregressive driver: ``carry = advance(carry, i)`` for
-    ``i`` in [0, n_steps), stopping as soon as every row has finished.
+    ``i`` in [start, n_steps), stopping as soon as every row has finished.
     ``carry[-1]`` must be the finished mask [b].  Returns
-    (final carry, steps_taken) — the shared while_loop half of
-    GPT/seq2seq ``generate(eos_id=...)``.
+    (final carry, last index) — the shared while_loop half of
+    GPT/seq2seq ``generate(eos_id=...)``.  ``start`` > 0 resumes after a
+    batched prefill already consumed the first positions.
     """
     def cond(state):
         carry, i = state
@@ -82,7 +83,7 @@ def decode_loop(advance, carry, n_steps: int):
         carry, i = state
         return advance(carry, i), i + 1
 
-    return lax.while_loop(cond, body, (carry, jnp.int32(0)))
+    return lax.while_loop(cond, body, (carry, jnp.int32(start)))
 
 
 def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,

@@ -43,6 +43,21 @@ _SLOW_TESTS = (
     # (TestSupervisorProbe, TestHelpers, TestProvenance) stay fast
     "test_bench.py::TestSupervisor::",
     "test_bench.py::TestGptLong",
+    # round-5 re-tier: every >=12 s test from the measured durations run
+    # (2026-07-31, 8-device CPU mesh) moves to the slow tier
+    "test_resnet.py::test_resnet50_forward_shape",
+    "test_resnet.py::test_resnet_partition_rules_on_mesh",
+    "test_bert.py::test_partition_rules_cover_all_big_params",
+    "test_bert.py::test_tensor_parallel_sharding_and_step",
+    "test_bert.py::test_mlm_training_reduces_loss",
+    "test_decoding.py::test_sampling_in_generate_paths",
+    "test_convert.py::test_gpt2_generate_greedy_matches_torch",
+    "test_convergence.py::test_mnist_mlp_learns_data_parallel",
+    "test_gpt.py::test_lm_training_loss_decreases",
+    # sequential-decode-loop parity variants (the base block-prefill
+    # oracle stays fast)
+    "test_gpt.py::test_decode_block_matches_sequential_prefill_rope_gqa",
+    "test_gpt.py::test_decode_block_ragged_matches_sequential_prefill",
     "test_pipeline.py::test_gpt_pipeline_loss_and_grads_match",
     "test_pipeline.py::test_gpt_1f1b_full_model_grads_match_gpipe",
     "test_pipeline.py::test_gpt_1f1b_loss_mask_matches_gpipe",

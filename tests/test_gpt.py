@@ -65,6 +65,80 @@ def test_kv_cache_decode_matches_full_forward():
                                    np.asarray(full[:, t]), atol=2e-4)
 
 
+def test_decode_block_matches_sequential_prefill():
+    """decode_block (one batched prompt forward) must produce exactly the
+    cache contents and last-position logits of plen sequential
+    decode_step calls — the prefill fast path behind generate/beam."""
+    model, params = _model_params()
+    ids = _ids(b=2, s=6)
+    seq_cache = model.init_cache(2, max_len=12)
+    for t in range(6):
+        seq_logits, seq_cache = model.decode_step(params, seq_cache,
+                                                  ids[:, t])
+    blk_cache = model.init_cache(2, max_len=12)
+    blk_logits, blk_cache = model.decode_block(params, blk_cache, ids)
+    assert int(blk_cache["pos"]) == int(seq_cache["pos"]) == 6
+    np.testing.assert_allclose(np.asarray(blk_logits),
+                               np.asarray(seq_logits), atol=2e-4)
+    for key in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(blk_cache[key]),
+                                   np.asarray(seq_cache[key]), atol=2e-4)
+
+
+def test_decode_block_matches_sequential_prefill_rope_gqa():
+    """Same block-vs-sequential oracle on the Llama-shaped recipe (RoPE
+    positions + grouped-query cache)."""
+    model, params = _model_params(position_embedding="rope", num_heads=4,
+                                  hidden_size=128, num_kv_heads=2)
+    ids = _ids(b=2, s=5)
+    seq_cache = model.init_cache(2, max_len=10)
+    for t in range(5):
+        seq_logits, seq_cache = model.decode_step(params, seq_cache,
+                                                  ids[:, t])
+    blk_cache = model.init_cache(2, max_len=10)
+    blk_logits, blk_cache = model.decode_block(params, blk_cache, ids)
+    np.testing.assert_allclose(np.asarray(blk_logits),
+                               np.asarray(seq_logits), atol=2e-4)
+    for key in ("k", "v"):
+        np.testing.assert_allclose(np.asarray(blk_cache[key]),
+                                   np.asarray(seq_cache[key]), atol=2e-4)
+
+
+def test_decode_block_ragged_matches_sequential_prefill():
+    """Block prefill with LEFT-padded ragged prompts: per-row positions
+    and pad masking must reproduce the sequential decode_step prefill
+    (cache equality on valid columns + last logits)."""
+    from distributed_tensorflow_tpu.ops import decoding as dec
+    model, params = _model_params()
+    b, plen = 2, 5
+    ids = np.asarray(_ids(b=b, s=plen))
+    valid = np.asarray([[1, 1, 1, 1, 1], [0, 0, 1, 1, 1]], np.int32)
+    ids = np.where(valid, ids, 7).astype(np.int32)
+    pad_len, kv_valid = dec.ragged_prompt_masks(
+        jnp.asarray(valid), (b, plen), 10)
+    seq_cache = model.init_cache(b, max_len=10)
+    for t in range(plen):
+        seq_logits, seq_cache = model.decode_step(
+            params, seq_cache, jnp.asarray(ids[:, t]),
+            kv_valid=kv_valid,
+            positions=jnp.maximum(t - pad_len, 0))
+    blk_cache = model.init_cache(b, max_len=10)
+    blk_logits, blk_cache = model.decode_block(
+        params, blk_cache, jnp.asarray(ids),
+        kv_valid=kv_valid[:, :plen],
+        positions=jnp.maximum(jnp.arange(plen)[None, :]
+                              - pad_len[:, None], 0))
+    np.testing.assert_allclose(np.asarray(blk_logits),
+                               np.asarray(seq_logits), atol=2e-4)
+    # pad columns hold garbage in both paths (masked from attention);
+    # compare the valid region only
+    mask = np.asarray(kv_valid[:, :plen])[None, :, :, None, None]
+    for key in ("k", "v"):
+        got = np.asarray(blk_cache[key])[:, :, :plen] * mask
+        want = np.asarray(seq_cache[key])[:, :, :plen] * mask
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+
 def test_generate_greedy_is_deterministic_and_consistent():
     model, params = _model_params()
     prompt = _ids(b=2, s=4)
