@@ -916,6 +916,79 @@ def bench_gpt_decode_int8():
                 batch=batch, new_tokens=new_tokens, seq_len=seq)
 
 
+def bench_gpt_decode_spec():
+    """Speculative greedy decode (models/speculative.py): the GPT-2-small
+    target verifies proposals from a 2-layer draft built by TRUNCATING
+    the target's own stacked decoder params (shared embeddings/head —
+    the cheapest self-distilled draft).  Reports spec and plain rates
+    from the same run, the acceptance fraction, and the exact-match
+    honesty check (speculative greedy MUST equal plain greedy by
+    construction — a mismatch means a decode-stack bug, not noise).
+    Batch 1: speculative decoding is the latency play."""
+    import dataclasses
+    import jax
+    import numpy as np
+    from distributed_tensorflow_tpu.models.gpt import GPT
+    from distributed_tensorflow_tpu.models.speculative import \
+        generate_speculative
+
+    seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
+    config = _gpt_bench_config(seq)
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    draft_layers = min(2, config.num_layers)
+    draft_model = GPT(dataclasses.replace(config,
+                                          num_layers=draft_layers))
+    # the stacked decoder tree slices by layer; everything else is shared
+    draft_params = dict(params)
+    draft_params["decoder"] = jax.tree.map(lambda a: a[:draft_layers],
+                                           params["decoder"])
+    prompt_len = 8
+    gamma = 4
+    # the learned position table has seq rows; speculative windows embed
+    # positions up to total + gamma - 2, so leave gamma - 1 headroom
+    new_tokens = 16 if SMOKE else seq - prompt_len - gamma + 1
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, config.vocab_size,
+                          (1, prompt_len)).astype(np.int32)
+
+    gen_plain = jax.jit(lambda p, ids: model.generate(
+        p, ids, max_new_tokens=new_tokens, temperature=0.0,
+        max_len=seq))
+    gen_spec = jax.jit(lambda tp, dp, ids: generate_speculative(
+        model, tp, draft_model, dp, ids, max_new_tokens=new_tokens,
+        gamma=gamma))
+
+    def timed(fn, args):
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0])      # compile + warmup
+        dt = None
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            np.asarray(jax.tree.leaves(out)[0])  # value fetch
+            w = time.perf_counter() - t0
+            dt = w if dt is None else min(dt, w)
+        return new_tokens / dt, out
+
+    plain_rate, plain_out = timed(gen_plain, (params, prompt))
+    spec_rate, (spec_out, acc) = timed(gen_spec,
+                                       (params, draft_params, prompt))
+    match = float(np.mean(np.asarray(plain_out)[:, prompt_len:]
+                          == np.asarray(spec_out)[:, prompt_len:]))
+    log(f"gpt_decode_spec: {spec_rate:,.0f} tok/s vs plain "
+        f"{plain_rate:,.0f} ({spec_rate / plain_rate:.2f}x), acceptance "
+        f"{float(acc):.3f}, greedy match {match:.3f}")
+    return dict(metric="gpt_decode_spec_tokens_per_sec",
+                value=round(spec_rate, 1), unit="tokens/sec",
+                vs_baseline=round(spec_rate / plain_rate, 3),  # plain, same run
+                plain_value=round(plain_rate, 1),
+                acceptance=round(float(acc), 4),
+                greedy_token_match=round(match, 4),
+                gamma=gamma, draft_layers=draft_layers, batch=1,
+                new_tokens=new_tokens, seq_len=seq)
+
+
 def bench_gpt_moe():
     """The gpt row with a mixture-of-experts FFN (ops.moe top-2/8 capacity
     routing + aux load-balance loss) — the measured row for the MoE
@@ -950,6 +1023,7 @@ CONFIGS = {
     "llama": bench_llama,
     "gpt_decode": bench_gpt_decode,
     "gpt_decode_int8": bench_gpt_decode_int8,
+    "gpt_decode_spec": bench_gpt_decode_spec,
 }
 
 

@@ -5,6 +5,7 @@ from .saving import load_model, save_model
 from .vit import ViT, ViTConfig, vit_base, vit_tiny
 from .bert import Bert, BertConfig, bert_base, bert_tiny
 from .gpt import GPT, GPTConfig, gpt_small, gpt_tiny
+from .speculative import generate_speculative
 from .llama import llama_config, llama_tiny, llama2_7b, llama3_8b
 from .seq2seq import Seq2Seq, Seq2SeqConfig, seq2seq_tiny
 from .callbacks import (Callback, CSVLogger, EarlyStopping, History,
@@ -21,6 +22,7 @@ __all__ = ["bert", "callbacks", "gpt", "llama", "resnet", "saving",
            "ViT", "ViTConfig", "vit_base", "vit_tiny",
            "Bert", "BertConfig",
            "GPT", "GPTConfig", "gpt_small", "gpt_tiny",
+           "generate_speculative",
            "llama_config", "llama_tiny", "llama2_7b", "llama3_8b",
            "bert_base", "bert_tiny", "Seq2Seq", "Seq2SeqConfig", "seq2seq_tiny",
            "Callback", "CSVLogger", "EarlyStopping", "History",

@@ -895,6 +895,61 @@ class GPT:
         logits = self.logits(params, x)[:, 0, :]
         return logits, {"k": new_k, "v": new_v, "pos": cache["pos"] + s}
 
+    def decode_window(self, params, cache, token_ids):
+        """``s`` tokens against a NON-empty cache in one forward.
+
+        The generalization of ``decode_block`` to ``cache['pos'] > 0``:
+        row ``j`` of the window attends every cache column ``<= pos + j``
+        (prefix plus in-window causal), K/V are written at columns
+        ``pos..pos+s-1``, and logits come back for EVERY window position
+        — [b, s, vocab] f32.  This is the verification step of
+        speculative decoding (models/speculative.py): the target model
+        scores all draft tokens in ONE dispatch instead of s sequential
+        decode_steps.  Rollback is the caller's job: setting ``pos`` back
+        masks (and later overwrites) any rejected columns.
+        """
+        c = self.config
+        b, s = token_ids.shape
+        pos = cache["pos"]
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], token_ids, axis=0)            # [b,s,d]
+        win_pos = pos + jnp.arange(s)
+        if c.position_embedding == "learned":
+            x = x + jnp.take(emb["position"], win_pos, axis=0)
+        x = x.astype(c.dtype)
+
+        max_len = cache["k"].shape[2]
+        # col visible to window row j iff col <= pos + j
+        col = jnp.arange(max_len)[None, None, None, :]
+        row = win_pos[None, None, :, None]
+        kv_mask = jnp.where(col <= row, 0.0, attn_lib.NEG_INF)
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            rope_cs = attn_lib.rope_tables(win_pos, c.head_dim,
+                                           base=c.rope_base)
+
+        def window_attn(q, k_blk, v_blk, k_all, v_all, i):
+            del k_blk, v_blk   # read back through the cache (prefix + win)
+            k_cache = lax.dynamic_index_in_dim(k_all, i, keepdims=False)
+            v_cache = lax.dynamic_index_in_dim(v_all, i, keepdims=False)
+            return attn_lib.dot_product_attention(q, k_cache, v_cache,
+                                                  mask=kv_mask)
+
+        def body(carry, inputs):
+            x, k_all, v_all = carry
+            p, i = inputs
+            return self._cache_layer(p, x, k_all, v_all, i,
+                                     write_pos=pos, rope_cs=rope_cs,
+                                     attention=window_attn), None
+
+        (x, new_k, new_v), _ = lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["decoder"], jnp.arange(c.num_layers)))
+        x = self._norm(params["ln_f"], x)
+        logits = self.logits(params, x)
+        return logits, {"k": new_k, "v": new_v, "pos": pos + s}
+
     def generate(self, params, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None,
                  max_len: Optional[int] = None,

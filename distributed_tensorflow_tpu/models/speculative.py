@@ -1,0 +1,143 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Greedy speculative decoding (Leviathan et al. 2023; Stern et al. 2018's
+blockwise verification): per round the DRAFT model autoregressively
+proposes ``gamma`` tokens with its own KV cache, then the TARGET model
+scores the window ``[current, d_1..d_gamma]`` in ONE ``decode_window``
+dispatch.  The longest prefix of draft tokens matching the target's
+greedy choices is accepted, followed by one target-chosen token (the
+correction at the first divergence, or the BONUS token after a clean
+sweep) — so every round emits 1..gamma+1 tokens for ONE target forward.
+
+Output guarantee: the emitted sequence is EXACTLY the target model's
+greedy decode (the acceptance rule only ever keeps tokens the target
+itself would have chosen) — the speedup comes from the draft's proposals
+amortizing target dispatches, never from changing the answer.  Asserted
+by tests/test_speculative.py against ``GPT.generate``.
+
+Cache rollback costs nothing: rejected positions stay in the KV cache
+but are masked (attention reads columns ``<= pos + row``) and are
+overwritten by the next round's window write.
+
+Scope: batch size 1 (speculative decoding is the LATENCY play — at large
+batch the accelerator is throughput-bound and verification wastes the
+rejected columns' FLOPs) and greedy only; temperature sampling needs the
+rejection-sampling acceptance rule, a documented follow-up.  The
+reference has no serving tier at all (SURVEY.md §2 — framework-native
+scope, like the KV cache itself).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["generate_speculative"]
+
+
+def generate_speculative(target_model, target_params, draft_model,
+                         draft_params, prompt_ids, max_new_tokens: int,
+                         gamma: int = 4,
+                         max_len: Optional[int] = None):
+    """Greedy speculative decode; returns (tokens [1, plen + new],
+    accepted_fraction scalar — the mean share of draft proposals kept).
+
+    ``target_model``/``draft_model``: GPT instances sharing the
+    tokenizer/vocab.  ``prompt_ids``: [1, plen] int32.
+    """
+    b, plen = prompt_ids.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative decoding is the batch-1 latency path; got "
+            f"batch {b} (run generate() for throughput batching)")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1; got {gamma}")
+    total = plen + max_new_tokens
+    if max_len is not None and total > max_len:
+        # same refusal contract as GPT.generate's _check_gen_lengths
+        raise ValueError(f"prompt ({plen}) + max_new_tokens "
+                         f"({max_new_tokens}) = {total} exceeds "
+                         f"max_len {max_len}")
+    # the last round starts at i <= total-2, so windows write token/cache
+    # columns up to total+gamma-1 and embed positions up to total+gamma-2;
+    # the scratch tail is sliced off before returning
+    scratch = total + gamma
+    for model, which in ((target_model, "target"), (draft_model, "draft")):
+        c = model.config
+        if (c.position_embedding == "learned"
+                and c.max_position < scratch - 1):
+            raise ValueError(
+                f"{which} model's learned position table ({c.max_position}"
+                f") is smaller than plen + max_new_tokens + gamma - 1 = "
+                f"{scratch - 1} — speculative windows need that headroom")
+
+    t_cache = target_model.init_cache(1, scratch)
+    d_cache = draft_model.init_cache(1, scratch)
+    tokens = jnp.zeros((1, scratch), jnp.int32)
+    tokens = lax.dynamic_update_slice_in_dim(tokens, prompt_ids, 0, axis=1)
+
+    # prompt prefill on BOTH models; the target's last-position logits
+    # emit the first new token
+    logits, t_cache = target_model.decode_block(target_params, t_cache,
+                                                prompt_ids)
+    first = jnp.argmax(logits, -1).astype(jnp.int32)         # [1]
+    tokens = lax.dynamic_update_slice_in_dim(tokens, first[:, None],
+                                             plen, axis=1)
+    _, d_cache = draft_model.decode_block(draft_params, d_cache,
+                                          prompt_ids)
+
+    def round_step(state):
+        tokens, t_cache, d_cache, i, n_acc, n_prop = state
+        tok_i = lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
+
+        # -- draft: gamma+1 autoregressive steps from tokens[i] ----------
+        # (the +1 consumes its own last proposal so the draft cache holds
+        # K/V for every window column even after a clean sweep; its final
+        # prediction is discarded)
+        def draft_one(carry, _):
+            d_cache, tok = carry
+            lg, d_cache = draft_model.decode_step(draft_params, d_cache,
+                                                  tok)
+            nxt = jnp.argmax(lg, -1).astype(jnp.int32)       # [1]
+            return (d_cache, nxt), nxt
+
+        (d_cache, _), proposals = lax.scan(draft_one, (d_cache, tok_i),
+                                           None, length=gamma + 1)
+        drafts = proposals[:gamma, 0]                        # [gamma]
+
+        # -- target: verify all gamma proposals (+ bonus) in ONE window --
+        window = jnp.concatenate([tok_i, drafts])[None, :]   # [1, gamma+1]
+        logits, t_cache = target_model.decode_window(target_params,
+                                                     t_cache, window)
+        greedy = jnp.argmax(logits[0], -1).astype(jnp.int32)  # [gamma+1]
+        # greedy[k] is the target's choice for token index i+k+1; the
+        # draft's claim for that index is drafts[k] (k < gamma);
+        # greedy[gamma] is the bonus token after a clean sweep
+
+        match = drafts == greedy[:gamma]
+        n = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))    # leading Trues
+        # emit accepted drafts then the target's correction/bonus
+        emit = jnp.where(jnp.arange(gamma + 1) < n,
+                         jnp.concatenate([drafts, drafts[-1:]]), greedy)
+        n_emit = jnp.minimum(n + 1, total - 1 - i)           # never overrun
+        tokens = lax.dynamic_update_slice_in_dim(
+            tokens, emit[None, :], i + 1, axis=1)
+
+        # rollback = move pos; stale columns are masked, then overwritten
+        t_cache = dict(t_cache, pos=i + n_emit)
+        d_cache = dict(d_cache, pos=i + n_emit)
+        return (tokens, t_cache, d_cache, i + n_emit,
+                n_acc + jnp.minimum(n, n_emit), n_prop + gamma)
+
+    def cond(state):
+        _, _, _, i, _, _ = state
+        return i < total - 1
+
+    state = (tokens, t_cache, d_cache, jnp.int32(plen),
+             jnp.int32(0), jnp.int32(0))
+    tokens, _, _, _, n_acc, n_prop = lax.while_loop(cond, round_step,
+                                                    state)
+    accepted_fraction = n_acc / jnp.maximum(n_prop, 1)
+    return tokens[:, :total], accepted_fraction

@@ -63,6 +63,9 @@ step timeout 900 python bench.py --config=gpt_decode
 #    int8 decode row (fp rate + greedy agreement from the same run)
 step timeout 900 python bench.py --config=gpt_decode_int8
 
+#    speculative decode row (truncated-draft; exact-match honesty check)
+step timeout 900 python bench.py --config=gpt_decode_spec
+
 #    decode operating-point ladder: batch x seq sweep (where the decode
 #    number sits vs the achievable ceiling — VERDICT r4 item 4)
 step timeout 1800 python scripts/decode_ladder.py

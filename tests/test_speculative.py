@@ -1,0 +1,100 @@
+"""Speculative decoding: greedy output must EQUAL the target's own greedy
+decode — the draft only amortizes target dispatches, never changes the
+answer (models/speculative.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+from distributed_tensorflow_tpu.models.speculative import \
+    generate_speculative
+
+
+def _prompt(s=4, vocab=512, seed=1):
+    return jax.random.randint(jax.random.PRNGKey(seed), (1, s), 0, vocab)
+
+
+def test_self_draft_matches_generate_with_high_acceptance():
+    """Draft == target: the output is exactly generate()'s greedy
+    continuation and acceptance is high.  (Not asserted == 1.0: the
+    draft proposes through decode_step and the verifier scores through
+    decode_window — different XLA reductions — so a random-init model's
+    near-uniform logits can flip argmax near-ties without affecting the
+    exactness guarantee, which IS asserted bit-for-bit.)"""
+    model = gpt_tiny(dropout_rate=0.0, max_position=64)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = _prompt()
+    want = model.generate(params, prompt, max_new_tokens=12)
+    got, acc = generate_speculative(model, params, model, params,
+                                    prompt, max_new_tokens=12, gamma=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert float(acc) >= 0.5
+
+
+def test_decode_window_matches_sequential_steps():
+    """The verification primitive: decode_window over tokens 4..9 of a
+    cache prefilled to position 4 must reproduce the per-step
+    decode_step logits and cache columns exactly."""
+    model = gpt_tiny(dropout_rate=0.0, max_position=64)
+    params = model.init(jax.random.PRNGKey(0))
+    ids = jax.random.randint(jax.random.PRNGKey(2), (1, 10), 0, 512)
+    seq_cache = model.init_cache(1, max_len=16)
+    seq_logits = []
+    for t in range(10):
+        lg, seq_cache = model.decode_step(params, seq_cache, ids[:, t])
+        seq_logits.append(np.asarray(lg))
+    win_cache = model.init_cache(1, max_len=16)
+    for t in range(4):
+        _, win_cache = model.decode_step(params, win_cache, ids[:, t])
+    win_logits, win_cache = model.decode_window(params, win_cache,
+                                               ids[:, 4:10])
+    assert int(win_cache["pos"]) == 10
+    np.testing.assert_allclose(np.asarray(win_logits)[0],
+                               np.stack([l[0] for l in seq_logits[4:]]),
+                               atol=2e-4)
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(win_cache[key])[:, :, :10],
+            np.asarray(seq_cache[key])[:, :, :10], atol=2e-4)
+
+
+def test_weak_draft_still_matches_target_greedy():
+    """A DIFFERENT (differently-initialized) draft: proposals are mostly
+    rejected, but the emitted sequence is still bit-identical to the
+    target's greedy decode — the exactness guarantee."""
+    target = gpt_tiny(dropout_rate=0.0, max_position=64)
+    t_params = target.init(jax.random.PRNGKey(0))
+    draft = gpt_tiny(dropout_rate=0.0, max_position=64, num_layers=1)
+    d_params = draft.init(jax.random.PRNGKey(7))
+    prompt = _prompt()
+    want = target.generate(t_params, prompt, max_new_tokens=10)
+    got, acc = generate_speculative(target, t_params, draft, d_params,
+                                    prompt, max_new_tokens=10, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 0.0 <= float(acc) <= 1.0
+
+
+def test_gamma_one_and_long_run():
+    model = gpt_tiny(dropout_rate=0.0, max_position=64)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = _prompt(s=2)
+    want = model.generate(params, prompt, max_new_tokens=20)
+    got, _ = generate_speculative(model, params, model, params,
+                                  prompt, max_new_tokens=20, gamma=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_rejects_bad_args():
+    model = gpt_tiny(dropout_rate=0.0, max_position=64)
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="batch-1"):
+        generate_speculative(model, params, model, params,
+                             jnp.zeros((2, 4), jnp.int32), 8)
+    with pytest.raises(ValueError, match="gamma"):
+        generate_speculative(model, params, model, params,
+                             _prompt(), 8, gamma=0)
+    with pytest.raises(ValueError, match="position table"):
+        # learned table 16 < plen + new + gamma + 1
+        generate_speculative(model, params, model, params,
+                             _prompt(), max_new_tokens=60, gamma=4)
