@@ -921,10 +921,12 @@ def bench_gpt_decode_spec():
     target verifies proposals from a 2-layer draft built by TRUNCATING
     the target's own stacked decoder params (shared embeddings/head —
     the cheapest self-distilled draft).  Reports spec and plain rates
-    from the same run, the acceptance fraction, and the exact-match
-    honesty check (speculative greedy MUST equal plain greedy by
-    construction — a mismatch means a decode-stack bug, not noise).
-    Batch 1: speculative decoding is the latency play."""
+    from the same run, the acceptance fraction, and the greedy-match
+    honesty signal: the two paths agree by construction except where
+    two vocab entries argmax-tie closer than the ~1e-4 window-vs-step
+    reduction difference (the same tie-noise class as the int8 row's
+    agreement metric) — a match well below 1.0 means a decode-stack
+    bug.  Batch 1: speculative decoding is the latency play."""
     import dataclasses
     import jax
     import numpy as np

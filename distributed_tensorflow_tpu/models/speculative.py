@@ -9,11 +9,17 @@ greedy choices is accepted, followed by one target-chosen token (the
 correction at the first divergence, or the BONUS token after a clean
 sweep) — so every round emits 1..gamma+1 tokens for ONE target forward.
 
-Output guarantee: the emitted sequence is EXACTLY the target model's
-greedy decode (the acceptance rule only ever keeps tokens the target
-itself would have chosen) — the speedup comes from the draft's proposals
-amortizing target dispatches, never from changing the answer.  Asserted
-by tests/test_speculative.py against ``GPT.generate``.
+Output guarantee: the emitted sequence is the target model's greedy
+decode — the acceptance rule only ever keeps tokens the target itself
+chose, so the speedup comes from the draft's proposals amortizing
+target dispatches, never from changing the answer.  One numerical
+caveat: corrections/bonus tokens argmax ``decode_window`` logits while
+``generate`` argmaxes ``decode_step`` logits — two XLA reductions that
+agree to ~1e-4, so a vocab pair tied closer than that at an emitted
+position can in principle flip a token between the two paths (same
+class of tie-noise as the int8 row's greedy-agreement metric).
+tests/test_speculative.py asserts bit-equality against ``GPT.generate``
+at fixed seeds on the CPU backend, where this is deterministic.
 
 Cache rollback costs nothing: rejected positions stay in the KV cache
 but are masked (attention reads columns ``<= pos + row``) and are

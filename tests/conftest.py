@@ -58,6 +58,37 @@ _SLOW_TESTS = (
     # oracle stays fast)
     "test_gpt.py::test_decode_block_matches_sequential_prefill_rope_gqa",
     "test_gpt.py::test_decode_block_ragged_matches_sequential_prefill",
+    # second re-tier pass (fast tier measured 10:57 on the 1-core host):
+    # everything >= ~5.3 s from the same durations profile
+    "test_sequential.py::test_zoo_stack_serializes_through_sequential",
+    "test_gpt.py::test_gqa_tensor_parallel_rules_and_step",
+    "test_bert.py::test_forward_shapes_and_dtypes",
+    "test_convert.py::test_gpt2_converted_shards_and_trains_on_mesh",
+    "test_bert.py::test_fused_layernorm_matches_plain",
+    "test_seq2seq.py::test_beam_search_eos_early_exit_pads_with_eos",
+    "test_vit.py::test_forward_shapes_and_dtype",
+    "test_ring_flash.py::test_causal_matches_plain_ring",
+    "test_bert.py::test_sequence_parallel_matches_dense_attention",
+    "test_bert.py::test_flash_attention_matches_dense",
+    "test_moe.py::test_ample_capacity_no_drops_and_combine_normalized",
+    "test_resnet.py::test_fresh_instance_applies_restored_params",
+    "test_vit.py::test_vit_bf16_compute",
+    "test_ema.py::test_with_ema_rides_train_step_and_checkpoints",
+    "test_ring_flash.py::test_gqa_kv_heads_unbroadcast",
+    "test_gpt.py::test_tensor_parallel_training_step",
+    "test_quant.py::test_quantized_gpt_generates",
+    "test_gpt.py::test_remat_matches_no_remat",
+    "test_seq2seq.py::test_src_padding_masked_out",
+    "test_convert.py::test_gpt2_converted_finetunes",
+    # round-5 speculative additions: keep the fast exactness oracle
+    # (self-draft); the variants and the window oracle are slow-tier
+    "test_speculative.py::test_weak_draft_still_matches_target_greedy",
+    "test_speculative.py::test_gamma_one_and_long_run",
+    "test_speculative.py::test_decode_window_matches_sequential_steps",
+    # third pass (measured 8:16): the >=10 s stragglers
+    "test_resnet.py::test_head_key_independent_of_blocks",
+    "test_seq2seq.py::test_partition_rules_compile_on_mesh",
+    "test_convert.py::test_bert_sequence_and_pooled_match_torch",
     "test_pipeline.py::test_gpt_pipeline_loss_and_grads_match",
     "test_pipeline.py::test_gpt_1f1b_full_model_grads_match_gpipe",
     "test_pipeline.py::test_gpt_1f1b_loss_mask_matches_gpipe",
