@@ -9,17 +9,19 @@ greedy choices is accepted, followed by one target-chosen token (the
 correction at the first divergence, or the BONUS token after a clean
 sweep) — so every round emits 1..gamma+1 tokens for ONE target forward.
 
-Output guarantee: the emitted sequence is the target model's greedy
-decode — the acceptance rule only ever keeps tokens the target itself
-chose, so the speedup comes from the draft's proposals amortizing
-target dispatches, never from changing the answer.  One numerical
-caveat: corrections/bonus tokens argmax ``decode_window`` logits while
-``generate`` argmaxes ``decode_step`` logits — two XLA reductions that
-agree to ~1e-4, so a vocab pair tied closer than that at an emitted
-position can in principle flip a token between the two paths (same
-class of tie-noise as the int8 row's greedy-agreement metric).
-tests/test_speculative.py asserts bit-equality against ``GPT.generate``
-at fixed seeds on the CPU backend, where this is deterministic.
+Output guarantee (greedy mode): the emitted sequence is the target
+model's greedy decode — the acceptance rule only ever keeps tokens the
+target itself chose, so the speedup comes from the draft's proposals
+amortizing target dispatches, never from changing the answer.  One
+numerical caveat: corrections/bonus tokens argmax ``decode_window``
+logits while ``generate`` argmaxes ``decode_step`` logits — two XLA
+reductions that agree to ~1e-4, so a vocab pair tied closer than that
+at an emitted position can in principle flip a token between the two
+paths (same class of tie-noise as the int8 row's greedy-agreement
+metric).  tests/test_speculative.py asserts bit-equality against
+``GPT.generate`` at fixed seeds on the CPU backend, where this is
+deterministic.  In sampled mode the guarantee is distributional: the
+output law equals token-by-token sampling from the target.
 
 Cache rollback costs nothing: rejected positions stay in the KV cache
 but are masked (attention reads columns ``<= pos + row``) and are
@@ -27,10 +29,13 @@ overwritten by the next round's window write.
 
 Scope: batch size 1 (speculative decoding is the LATENCY play — at large
 batch the accelerator is throughput-bound and verification wastes the
-rejected columns' FLOPs) and greedy only; temperature sampling needs the
-rejection-sampling acceptance rule, a documented follow-up.  The
-reference has no serving tier at all (SURVEY.md §2 — framework-native
-scope, like the KV cache itself).
+rejected columns' FLOPs).  ``temperature <= 0`` uses the greedy
+longest-matching-prefix rule above; ``temperature > 0`` uses
+``speculative_accept``'s rejection sampling, whose emitted tokens are
+distributed exactly as sampling from the target (Monte-Carlo-verified in
+tests/test_speculative.py).  top_k/top_p filters are not supported on
+the sampled path.  The reference has no serving tier at all (SURVEY.md
+§2 — framework-native scope, like the KV cache itself).
 """
 from __future__ import annotations
 
@@ -40,17 +45,63 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["generate_speculative"]
+__all__ = ["generate_speculative", "speculative_accept"]
+
+
+def speculative_accept(rng, p, q, drafts):
+    """The rejection-sampling acceptance rule (Leviathan et al. 2023,
+    Thm 1): accept draft ``d_k ~ q_k`` with prob ``min(1, p_k(d_k) /
+    q_k(d_k))``; at the first rejection emit a token from the residual
+    ``norm(max(0, p_n - q_n))``; after a clean sweep emit the bonus from
+    ``p_gamma``.  The emitted prefix is then distributed EXACTLY as
+    sampling from ``p`` token by token — the distribution-preserving
+    counterpart of the greedy longest-prefix rule (verified empirically
+    by tests/test_speculative.py's Monte-Carlo check).
+
+    ``p``: [gamma+1, V] target probabilities (row k for token index
+    i+k+1); ``q``: [gamma, V] draft proposal probabilities;
+    ``drafts``: [gamma] int32 proposed tokens.
+    Returns (n accepted [scalar int32], emit [gamma+1] int32 — rows
+    ``< n`` are accepted drafts, row ``n`` is the residual/bonus draw).
+    """
+    gamma = drafts.shape[0]
+    k_rng, r_rng = jax.random.split(rng)
+    u = jax.random.uniform(k_rng, (gamma,))
+    p_d = jnp.take_along_axis(p[:gamma], drafts[:, None], axis=1)[:, 0]
+    q_d = jnp.take_along_axis(q, drafts[:, None], axis=1)[:, 0]
+    accept = u * q_d <= p_d          # u < p/q without dividing by zero
+    n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+
+    # residual distribution at the first rejected position (row n); the
+    # bonus row gamma IS p there (q has no row gamma: pad with zeros)
+    q_pad = jnp.concatenate([q, jnp.zeros_like(p[:1])], axis=0)
+    res = jnp.maximum(p[n] - q_pad[n], 0.0)
+    tot = jnp.sum(res)
+    # tot == 0 can only happen when p == q rowwise (acceptance prob 1,
+    # so the rejection branch is unreachable); guard the normalization
+    res = jnp.where(tot > 0, res / jnp.maximum(tot, 1e-20), p[n])
+    corr = jax.random.choice(r_rng, res.shape[-1], p=res)
+    emit = jnp.where(jnp.arange(gamma + 1) < n,
+                     jnp.concatenate([drafts, drafts[-1:]]),
+                     corr.astype(jnp.int32))
+    return n, emit
 
 
 def generate_speculative(target_model, target_params, draft_model,
                          draft_params, prompt_ids, max_new_tokens: int,
                          gamma: int = 4,
+                         temperature: float = 0.0, rng=None,
                          max_len: Optional[int] = None):
-    """Greedy speculative decode; returns (tokens [1, plen + new],
+    """Speculative decode; returns (tokens [1, plen + new],
     accepted_fraction scalar — the mean share of draft proposals kept).
 
-    ``target_model``/``draft_model``: GPT instances sharing the
+    ``temperature <= 0``: greedy longest-matching-prefix acceptance
+    (output = the target's greedy decode).  ``temperature > 0``:
+    ``speculative_accept``'s rejection sampling — drafts sample from
+    ``softmax(q/T)``, the target accepts/corrects so the OUTPUT
+    distribution equals sampling from ``softmax(p/T)`` directly (the
+    Leviathan guarantee; top_k/top_p filters are not supported on this
+    path).  ``target_model``/``draft_model``: GPT instances sharing the
     tokenizer/vocab.  ``prompt_ids``: [1, plen] int32.
     """
     b, plen = prompt_ids.shape
@@ -79,6 +130,10 @@ def generate_speculative(target_model, target_params, draft_model,
                 f") is smaller than plen + max_new_tokens + gamma - 1 = "
                 f"{scratch - 1} — speculative windows need that headroom")
 
+    sampled = temperature > 0
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
     t_cache = target_model.init_cache(1, scratch)
     d_cache = draft_model.init_cache(1, scratch)
     tokens = jnp.zeros((1, scratch), jnp.int32)
@@ -88,45 +143,56 @@ def generate_speculative(target_model, target_params, draft_model,
     # emit the first new token
     logits, t_cache = target_model.decode_block(target_params, t_cache,
                                                 prompt_ids)
-    first = jnp.argmax(logits, -1).astype(jnp.int32)         # [1]
+    from ..ops import decoding as dec
+    rng, sub = jax.random.split(rng)
+    # shared next-token selection rule (temperature <= 0 is greedy there)
+    first = dec.sample_logits(sub, logits, temperature)      # [1]
     tokens = lax.dynamic_update_slice_in_dim(tokens, first[:, None],
                                              plen, axis=1)
     _, d_cache = draft_model.decode_block(draft_params, d_cache,
                                           prompt_ids)
 
     def round_step(state):
-        tokens, t_cache, d_cache, i, n_acc, n_prop = state
+        tokens, t_cache, d_cache, rng, i, n_acc, n_prop = state
         tok_i = lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
 
         # -- draft: gamma+1 autoregressive steps from tokens[i] ----------
         # (the +1 consumes its own last proposal so the draft cache holds
         # K/V for every window column even after a clean sweep; its final
         # prediction is discarded)
-        def draft_one(carry, _):
+        def draft_one(carry, step_rng):
             d_cache, tok = carry
             lg, d_cache = draft_model.decode_step(draft_params, d_cache,
                                                   tok)
-            nxt = jnp.argmax(lg, -1).astype(jnp.int32)       # [1]
-            return (d_cache, nxt), nxt
+            nxt = dec.sample_logits(step_rng, lg, temperature)   # [1]
+            probs = (jax.nn.softmax(lg[0] / temperature) if sampled
+                     else lg[0])   # q rows; unused on the greedy path
+            return (d_cache, nxt), (nxt, probs)
 
-        (d_cache, _), proposals = lax.scan(draft_one, (d_cache, tok_i),
-                                           None, length=gamma + 1)
+        rng, d_rng, a_rng = jax.random.split(rng, 3)
+        (d_cache, _), (proposals, q_rows) = lax.scan(
+            draft_one, (d_cache, tok_i),
+            jax.random.split(d_rng, gamma + 1))
         drafts = proposals[:gamma, 0]                        # [gamma]
 
         # -- target: verify all gamma proposals (+ bonus) in ONE window --
         window = jnp.concatenate([tok_i, drafts])[None, :]   # [1, gamma+1]
         logits, t_cache = target_model.decode_window(target_params,
                                                      t_cache, window)
-        greedy = jnp.argmax(logits[0], -1).astype(jnp.int32)  # [gamma+1]
-        # greedy[k] is the target's choice for token index i+k+1; the
-        # draft's claim for that index is drafts[k] (k < gamma);
-        # greedy[gamma] is the bonus token after a clean sweep
+        # row k scores token index i+k+1; the draft's claim for that
+        # index is drafts[k] (k < gamma); row gamma is the bonus position
 
-        match = drafts == greedy[:gamma]
-        n = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))    # leading Trues
-        # emit accepted drafts then the target's correction/bonus
-        emit = jnp.where(jnp.arange(gamma + 1) < n,
-                         jnp.concatenate([drafts, drafts[-1:]]), greedy)
+        if sampled:
+            p = jax.nn.softmax(logits[0] / temperature)      # [gamma+1, V]
+            n, emit = speculative_accept(a_rng, p, q_rows[:gamma], drafts)
+        else:
+            greedy = jnp.argmax(logits[0], -1).astype(jnp.int32)
+            match = drafts == greedy[:gamma]
+            n = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+            # emit accepted drafts then the target's correction/bonus
+            emit = jnp.where(jnp.arange(gamma + 1) < n,
+                             jnp.concatenate([drafts, drafts[-1:]]),
+                             greedy)
         n_emit = jnp.minimum(n + 1, total - 1 - i)           # never overrun
         tokens = lax.dynamic_update_slice_in_dim(
             tokens, emit[None, :], i + 1, axis=1)
@@ -134,16 +200,16 @@ def generate_speculative(target_model, target_params, draft_model,
         # rollback = move pos; stale columns are masked, then overwritten
         t_cache = dict(t_cache, pos=i + n_emit)
         d_cache = dict(d_cache, pos=i + n_emit)
-        return (tokens, t_cache, d_cache, i + n_emit,
+        return (tokens, t_cache, d_cache, rng, i + n_emit,
                 n_acc + jnp.minimum(n, n_emit), n_prop + gamma)
 
     def cond(state):
-        _, _, _, i, _, _ = state
+        _, _, _, _, i, _, _ = state
         return i < total - 1
 
-    state = (tokens, t_cache, d_cache, jnp.int32(plen),
+    state = (tokens, t_cache, d_cache, rng, jnp.int32(plen),
              jnp.int32(0), jnp.int32(0))
-    tokens, _, _, _, n_acc, n_prop = lax.while_loop(cond, round_step,
-                                                    state)
+    tokens, _, _, _, _, n_acc, n_prop = lax.while_loop(cond, round_step,
+                                                       state)
     accepted_fraction = n_acc / jnp.maximum(n_prop, 1)
     return tokens[:, :total], accepted_fraction

@@ -88,6 +88,63 @@ def test_gamma_one_and_long_run():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_speculative_accept_preserves_target_distribution():
+    """The rejection-sampling kernel's emitted-token marginal must equal
+    the TARGET distribution p exactly (Leviathan Thm 1) — checked by
+    Monte Carlo at gamma=1, vocab 8: first-emitted-token frequencies vs
+    p[0], and bonus-token frequencies vs p[1] on all-accept trials."""
+    from distributed_tensorflow_tpu.models.speculative import \
+        speculative_accept
+    vocab, trials = 8, 200_000
+    p0 = np.asarray([.30, .20, .15, .10, .10, .08, .05, .02], np.float32)
+    q0 = np.asarray([.10, .30, .05, .20, .05, .10, .05, .15], np.float32)
+    p1 = np.asarray([.05, .05, .40, .10, .10, .10, .10, .10], np.float32)
+    p = jnp.stack([jnp.asarray(p0), jnp.asarray(p1)])
+    q = jnp.asarray(q0)[None, :]
+
+    def trial(key):
+        k1, k2 = jax.random.split(key)
+        d = jax.random.choice(k1, vocab, p=jnp.asarray(q0))
+        n, emit = speculative_accept(k2, p, q,
+                                     d[None].astype(jnp.int32))
+        return emit[0], emit[1], n
+
+    first, bonus, n = jax.jit(jax.vmap(trial))(
+        jax.random.split(jax.random.PRNGKey(0), trials))
+    freq = np.bincount(np.asarray(first), minlength=vocab) / trials
+    np.testing.assert_allclose(freq, p0, atol=5e-3)
+    # bonus tokens (only defined when the draft was accepted) ~ p[1]
+    acc = np.asarray(n) == 1
+    freq_b = (np.bincount(np.asarray(bonus)[acc], minlength=vocab)
+              / max(acc.sum(), 1))
+    np.testing.assert_allclose(freq_b, p1, atol=8e-3)
+
+
+def test_sampled_spec_runs_and_is_plausible():
+    """temperature>0 end to end: right shapes, tokens in-vocab, prompt
+    preserved, acceptance in [0,1], and a different rng gives a
+    different continuation (it is actually sampling)."""
+    model = gpt_tiny(dropout_rate=0.0, max_position=64)
+    params = model.init(jax.random.PRNGKey(0))
+    draft = gpt_tiny(dropout_rate=0.0, max_position=64, num_layers=1)
+    d_params = draft.init(jax.random.PRNGKey(7))
+    prompt = _prompt()
+    out1, acc = generate_speculative(model, params, draft, d_params,
+                                     prompt, max_new_tokens=16, gamma=3,
+                                     temperature=1.0,
+                                     rng=jax.random.PRNGKey(1))
+    out2, _ = generate_speculative(model, params, draft, d_params,
+                                   prompt, max_new_tokens=16, gamma=3,
+                                   temperature=1.0,
+                                   rng=jax.random.PRNGKey(2))
+    assert out1.shape == (1, 20)
+    np.testing.assert_array_equal(np.asarray(out1[:, :4]),
+                                  np.asarray(prompt))
+    assert 0.0 <= float(acc) <= 1.0
+    assert np.asarray(out1).max() < 512 and np.asarray(out1).min() >= 0
+    assert not np.array_equal(np.asarray(out1), np.asarray(out2))
+
+
 def test_rejects_bad_args():
     model = gpt_tiny(dropout_rate=0.0, max_position=64)
     params = model.init(jax.random.PRNGKey(0))
