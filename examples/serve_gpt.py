@@ -1,0 +1,122 @@
+"""GPT serving demo: every decode path of the framework in one script.
+
+Runs a small randomly-initialized GPT (structure, not quality — no
+weights ship with the repo) through the serving tier:
+
+  * greedy KV-cache ``generate`` (batched prompt prefill),
+  * sampled generate (temperature / top_k / top_p),
+  * ragged-prompt batch (LEFT-padded ``prompt_valid``),
+  * beam search,
+  * weight-only int8 decode (``ops.quant``, dequantize-inside-jit),
+  * speculative decoding (layer-truncated draft; greedy exactness),
+
+printing tokens/s for each.  On CPU the absolute numbers are
+meaningless; the point is the surfaces and their composition.  Real
+checkpoints drop in via ``models/convert.py`` (HF GPT-2) — see
+examples/finetune_gpt2_hf.py.
+
+Run: ``python examples/serve_gpt.py --device=cpu --new_tokens=32``
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_tensorflow_tpu.utils import flags as flags_lib
+
+flags_lib.DEFINE_string("device", "", "cpu|tpu override (config-level)")
+flags_lib.DEFINE_integer("new_tokens", 32, "tokens to generate per path")
+flags_lib.DEFINE_integer("batch", 4, "batch size for the batched paths")
+flags_lib.DEFINE_integer("seed", 0, "init/prompt seed")
+FLAGS = flags_lib.FLAGS
+
+
+def main() -> int:
+    if FLAGS.device:
+        import jax
+        jax.config.update("jax_platforms", FLAGS.device)
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+    from distributed_tensorflow_tpu.models.speculative import \
+        generate_speculative
+    from distributed_tensorflow_tpu.ops import quant
+
+    new = FLAGS.new_tokens
+    b = FLAGS.batch
+    plen = 8
+    max_len = plen + new + 8
+    config = GPTConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                       num_heads=4, intermediate_size=512,
+                       max_position=max_len + 8, dropout_rate=0.0)
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(FLAGS.seed))
+    rng = np.random.default_rng(FLAGS.seed)
+    prompt = rng.integers(0, config.vocab_size, (b, plen)).astype(np.int32)
+
+    def timed(name, fn, tokens_out):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn()
+        out = jax.tree.map(np.asarray, out)     # value fetch
+        dt = time.perf_counter() - t0
+        print(f"{name:<28} {tokens_out / dt:10,.0f} tok/s", flush=True)
+        return out
+
+    greedy = timed("greedy generate", jax.jit(
+        lambda: model.generate(params, prompt, max_new_tokens=new,
+                               temperature=0.0, max_len=max_len)),
+        b * new)
+
+    timed("sampled (T=0.8, top_p=0.9)", jax.jit(
+        lambda: model.generate(params, prompt, max_new_tokens=new,
+                               temperature=0.8, top_p=0.9,
+                               rng=jax.random.PRNGKey(1),
+                               max_len=max_len)), b * new)
+
+    valid = np.ones((b, plen), np.int32)
+    valid[0, : plen // 2] = 0                    # one shorter prompt,
+    ragged_prompt = prompt.copy()                # LEFT-padded
+    ragged_prompt[0, : plen // 2] = 0
+    timed("ragged batch (prompt_valid)", jax.jit(
+        lambda: model.generate(params, jnp.asarray(ragged_prompt),
+                               max_new_tokens=new,
+                               prompt_valid=jnp.asarray(valid),
+                               max_len=max_len)), b * new)
+
+    timed("beam search (beam=4)", jax.jit(
+        lambda: model.beam_search(params, prompt, max_new_tokens=new,
+                                  beam_size=4, max_len=max_len)), b * new)
+
+    qparams = quant.quantize_tree(params)
+    q_out = timed("int8 weights", jax.jit(
+        lambda: model.generate(quant.dequantize_tree(qparams), prompt,
+                               max_new_tokens=new, temperature=0.0,
+                               max_len=max_len)), b * new)
+    agree = float(np.mean(np.asarray(greedy)[:, plen:]
+                          == np.asarray(q_out)[:, plen:]))
+    print(f"{'':<28} int8 greedy agreement {agree:.3f}", flush=True)
+
+    draft = GPT(dataclasses.replace(config, num_layers=2))
+    d_params = dict(params)
+    d_params["decoder"] = jax.tree.map(lambda a: a[:2], params["decoder"])
+    spec_out, acc = timed("speculative (gamma=4)", jax.jit(
+        lambda: generate_speculative(model, params, draft, d_params,
+                                     prompt[:1], max_new_tokens=new,
+                                     gamma=4)), new)
+    match = float(np.mean(np.asarray(greedy)[:1, plen:]
+                          == np.asarray(spec_out)[:, plen:]))
+    print(f"{'':<28} spec acceptance {float(acc):.3f}, greedy match "
+          f"{match:.3f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

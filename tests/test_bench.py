@@ -237,6 +237,44 @@ class TestPromoteLevers:
         assert len(promote_levers.parse(lines, allow_any=True)) == 4
 
 
+class TestPromotedDefaults:
+    """bench._load_promoted_defaults: PROMOTED.json is a DEFAULT layer —
+    explicit env wins, SMOKE runs ignore it, absence is silent."""
+
+    def test_setdefault_env_wins_and_smoke_skips(self, monkeypatch,
+                                                 tmp_path):
+        f = tmp_path / "PROMOTED.json"
+        f.write_text(json.dumps(
+            {"env": {"DTTPU_TEST_PROMOTED_KNOB": "5"}}))
+        monkeypatch.setattr(bench, "_PROMOTED", str(f))
+        monkeypatch.setattr(bench, "SMOKE", False)
+        # seed-then-delete so monkeypatch records an undo for the key —
+        # _load_promoted_defaults writes os.environ directly, and an
+        # unrecorded setdefault would leak past teardown
+        monkeypatch.setenv("DTTPU_TEST_PROMOTED_KNOB", "seed")
+        monkeypatch.delenv("DTTPU_TEST_PROMOTED_KNOB")
+        bench._load_promoted_defaults()
+        assert os.environ["DTTPU_TEST_PROMOTED_KNOB"] == "5"
+        monkeypatch.setenv("DTTPU_TEST_PROMOTED_KNOB", "9")
+        bench._load_promoted_defaults()
+        assert os.environ["DTTPU_TEST_PROMOTED_KNOB"] == "9"
+        monkeypatch.delenv("DTTPU_TEST_PROMOTED_KNOB")
+        monkeypatch.setattr(bench, "SMOKE", True)
+        bench._load_promoted_defaults()
+        assert "DTTPU_TEST_PROMOTED_KNOB" not in os.environ
+
+    def test_absent_and_corrupt_files_are_tolerated(self, monkeypatch,
+                                                    tmp_path):
+        monkeypatch.setattr(bench, "SMOKE", False)
+        monkeypatch.setattr(bench, "_PROMOTED",
+                            str(tmp_path / "missing.json"))
+        bench._load_promoted_defaults()          # no raise
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        monkeypatch.setattr(bench, "_PROMOTED", str(bad))
+        bench._load_promoted_defaults()          # warns, no raise
+
+
 class TestHelpers:
     def test_parse_last_json(self):
         text = "noise\n{\"a\": 1}\nnot json {broken\n"
@@ -308,10 +346,12 @@ class TestProvenance:
 
 
 class TestGptLong:
-    def test_gpt_long_renames_metric_and_respects_seq_override(self):
+    def test_gpt_long_metric_and_seq_pinned_against_env(self):
         """gpt_long is the gpt row pinned at seq 2048 (the flash-dispatch
-        operating point); an explicit DTTPU_BENCH_SEQ still wins so the
-        smoke test doesn't pay a 2048-seq CPU run."""
+        operating point).  Round-5 advisor fix: the row's EXPLICIT seq
+        now beats DTTPU_BENCH_SEQ — an exported env var must not
+        silently retarget a named row's defining parameter (the SMOKE
+        config keeps the run cheap on CPU despite the 2048 label)."""
         proc = _run(["--config=gpt_long", "--device=cpu"],
                     _env(DTTPU_BENCH_SEQ=128))
         assert proc.returncode == 0, proc.stderr.decode()[-2000:]
@@ -319,7 +359,7 @@ class TestGptLong:
         assert len(lines) == 1
         r = json.loads(lines[0])
         assert r["metric"].startswith("gpt_long_lm_train_tokens_per_sec")
-        assert r["seq_len"] == 128
+        assert r["seq_len"] == 2048
         assert r["value"] > 0
 
     def test_gpt_decode_int8_smoke(self):

@@ -43,6 +43,24 @@ def test_train_gpt_levers_smoke(tmp_path):
     assert "eval loss:" in proc.stdout
 
 
+def test_serve_gpt_demo_smoke():
+    """The serving demo drives every decode path (greedy, sampled,
+    ragged, beam, int8, speculative) end to end; int8 agreement and the
+    spec greedy-match honesty numbers must come out ~1."""
+    proc = _run(["examples/serve_gpt.py", "--device=cpu",
+                 "--new_tokens=12", "--batch=2"])
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    for label in ("greedy generate", "beam search", "int8 weights",
+                  "speculative"):
+        assert label in proc.stdout, proc.stdout
+    agree = [l for l in proc.stdout.splitlines()
+             if "int8 greedy agreement" in l]
+    assert agree and float(agree[0].split()[-1]) > 0.9
+    match = [l for l in proc.stdout.splitlines() if "greedy match" in l]
+    assert match and float(match[0].split()[-1]) > 0.9
+
+
 def test_finetune_bert_mlm_gather_smoke():
     """MLM warm-up with the masked-position gather + fused-LN/remat flags
     through examples/finetune_bert.py (the fit-level lever surface)."""
