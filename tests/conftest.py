@@ -78,6 +78,7 @@ _SLOW_TESTS = (
     "test_gpt.py::test_tensor_parallel_training_step",
     "test_quant.py::test_quantized_gpt_generates",
     "test_gpt.py::test_remat_matches_no_remat",
+    "test_gpt.py::test_tp_sharded_decode_matches_single_device",
     "test_seq2seq.py::test_src_padding_masked_out",
     "test_convert.py::test_gpt2_converted_finetunes",
     # round-5 speculative additions: keep the fast exactness oracle
