@@ -895,19 +895,26 @@ class GPT:
         logits = self.logits(params, x)[:, 0, :]
         return logits, {"k": new_k, "v": new_v, "pos": cache["pos"] + s}
 
-    def decode_window(self, params, cache, token_ids):
+    def decode_window(self, params, cache, token_ids, head: str = "all"):
         """``s`` tokens against a NON-empty cache in one forward.
 
         The generalization of ``decode_block`` to ``cache['pos'] > 0``:
         row ``j`` of the window attends every cache column ``<= pos + j``
         (prefix plus in-window causal), K/V are written at columns
-        ``pos..pos+s-1``, and logits come back for EVERY window position
-        — [b, s, vocab] f32.  This is the verification step of
-        speculative decoding (models/speculative.py): the target model
-        scores all draft tokens in ONE dispatch instead of s sequential
+        ``pos..pos+s-1``.  This is the verification step of speculative
+        decoding (models/speculative.py): the target model scores all
+        draft tokens in ONE dispatch instead of s sequential
         decode_steps.  Rollback is the caller's job: setting ``pos`` back
         masks (and later overwrites) any rejected columns.
+
+        ``head``: what the LM head computes — ``"all"`` ([b, s, vocab]
+        f32, the verification shape), ``"last"`` ([b, vocab], prefill's
+        next-token shape), ``"none"`` (logits is None — intermediate
+        chunked-prefill windows only feed the cache, and the [b, s,
+        vocab] tensor must not materialize for them).
         """
+        if head not in ("all", "last", "none"):
+            raise ValueError(f"head must be all|last|none; got {head!r}")
         c = self.config
         b, s = token_ids.shape
         pos = cache["pos"]
@@ -946,9 +953,53 @@ class GPT:
         (x, new_k, new_v), _ = lax.scan(
             body, (x, cache["k"], cache["v"]),
             (params["decoder"], jnp.arange(c.num_layers)))
+        new_cache = {"k": new_k, "v": new_v, "pos": pos + s}
+        if head == "none":
+            return None, new_cache
+        if head == "last":
+            x = self._norm(params["ln_f"], x[:, -1:, :])
+            return self.logits(params, x)[:, 0, :], new_cache
         x = self._norm(params["ln_f"], x)
-        logits = self.logits(params, x)
-        return logits, {"k": new_k, "v": new_v, "pos": pos + s}
+        return self.logits(params, x), new_cache
+
+    def prefill_cache(self, params, cache, token_ids,
+                      chunk: Optional[int] = None):
+        """Prompt ingestion into an empty cache, optionally CHUNKED.
+
+        ``chunk=None``: one ``decode_block`` forward (s x s attention —
+        the fast path while the whole prompt's attention fits).
+        ``chunk=W``: the prompt streams through ``decode_window`` W
+        tokens at a time, each window attending the cached prefix plus
+        itself — live attention memory is bounded by W x max_len
+        instead of s x s, the long-context serving shape (a 32k prompt
+        prefills at the memory of its window).  Exact parity with the
+        one-block path (tests/test_gpt.py::test_chunked_prefill_*).
+
+        Returns (last-position logits [b, vocab] f32, advanced cache).
+        Requires an EMPTY cache (``pos == 0``, the decode_block
+        precondition) — validated when ``pos`` is concrete; under jit
+        the caller owns it.
+        """
+        b, s = token_ids.shape
+        if s == 0:
+            raise ValueError("prefill_cache needs a non-empty prompt")
+        if chunk is not None and chunk < 1:
+            raise ValueError(f"chunk must be >= 1; got {chunk}")
+        if not isinstance(cache["pos"], jax.core.Tracer) \
+                and int(cache["pos"]) != 0:
+            raise ValueError(
+                f"prefill_cache needs an empty cache (pos == 0); got pos="
+                f"{int(cache['pos'])} — append to a live cache with "
+                "decode_window instead")
+        if chunk is None or chunk >= s:
+            return self.decode_block(params, cache, token_ids)
+        logits = None
+        for lo in range(0, s, chunk):
+            window = token_ids[:, lo:lo + chunk]
+            last = lo + chunk >= s
+            logits, cache = self.decode_window(
+                params, cache, window, head="last" if last else "none")
+        return logits, cache
 
     def generate(self, params, prompt_ids, max_new_tokens: int,
                  temperature: float = 0.0, rng=None,
@@ -957,8 +1008,14 @@ class GPT:
                  top_p: Optional[float] = None,
                  eos_id: Optional[int] = None,
                  pad_id: Optional[int] = None,
-                 prompt_valid=None) -> jnp.ndarray:
+                 prompt_valid=None,
+                 prefill_chunk: Optional[int] = None) -> jnp.ndarray:
         """Autoregressive sampling with the KV cache.
+
+        ``prefill_chunk``: stream the prompt into the cache W tokens at
+        a time (``prefill_cache``) instead of one block — bounds prefill
+        attention memory for very long prompts; not supported together
+        with ``prompt_valid``.
 
         prompt_ids: [b, p] int32.  temperature 0 = greedy; ``top_k`` /
         ``top_p`` filter the sampled distribution (ops.decoding).  Returns
@@ -985,6 +1042,12 @@ class GPT:
         """
         from ..ops import decoding as dec
         c = self.config
+        if prefill_chunk is not None and prompt_valid is not None:
+            # validated up front so the combination fails the same way
+            # regardless of prompt length / max_new_tokens
+            raise ValueError("prefill_chunk does not compose with "
+                             "prompt_valid (ragged prompts prefill as "
+                             "one block)")
         pad = dec.resolve_pad(eos_id, pad_id)
         b, plen = prompt_ids.shape
         total = plen + max_new_tokens
@@ -1037,14 +1100,15 @@ class GPT:
             # tested); sampling paths draw from the same distributions
             # but consume fewer rng splits.
             if prompt_valid is not None:
-                blk = dict(kv_valid=kv_valid[:, :plen],
-                           positions=jnp.maximum(
-                               jnp.arange(plen)[None, :]
-                               - pad_len[:, None], 0))
+                logits, cache = self.decode_block(
+                    params, cache, prompt_ids,
+                    kv_valid=kv_valid[:, :plen],
+                    positions=jnp.maximum(
+                        jnp.arange(plen)[None, :] - pad_len[:, None], 0))
             else:
-                blk = {}
-            logits, cache = self.decode_block(params, cache, prompt_ids,
-                                              **blk)
+                logits, cache = self.prefill_cache(params, cache,
+                                                   prompt_ids,
+                                                   chunk=prefill_chunk)
             rng, sub = jax.random.split(rng)
             nxt = dec.sample_logits(sub, logits, temperature,
                                     top_k=top_k, top_p=top_p)

@@ -178,6 +178,43 @@ def test_tensor_parallel_training_step():
     assert "tensor" in str(spec)
 
 
+def test_chunked_prefill_matches_one_block():
+    """prefill_cache(chunk=W) — the bounded-memory long-prompt path —
+    must reproduce the one-block prefill exactly: same last logits, same
+    cache contents, including a ragged final window (7 = 3+3+1)."""
+    model, params = _model_params()
+    ids = _ids(b=2, s=7)
+    blk_cache = model.init_cache(2, max_len=12)
+    blk_logits, blk_cache = model.prefill_cache(params, blk_cache, ids)
+    for chunk in (3, 2):
+        ch_cache = model.init_cache(2, max_len=12)
+        ch_logits, ch_cache = model.prefill_cache(params, ch_cache, ids,
+                                                  chunk=chunk)
+        assert int(ch_cache["pos"]) == 7
+        np.testing.assert_allclose(np.asarray(ch_logits),
+                                   np.asarray(blk_logits), atol=2e-4)
+        for key in ("k", "v"):
+            np.testing.assert_allclose(np.asarray(ch_cache[key]),
+                                       np.asarray(blk_cache[key]),
+                                       atol=2e-4)
+
+
+def test_generate_with_chunked_prefill_matches_default():
+    """generate(prefill_chunk=W) emits the same greedy continuation as
+    the default one-block prefill; composing with prompt_valid raises."""
+    import pytest
+    model, params = _model_params()
+    prompt = _ids(b=2, s=6)
+    want = model.generate(params, prompt, max_new_tokens=5, max_len=12)
+    got = model.generate(params, prompt, max_new_tokens=5, max_len=12,
+                         prefill_chunk=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        model.generate(params, prompt, max_new_tokens=2, max_len=12,
+                       prefill_chunk=2,
+                       prompt_valid=jnp.ones((2, 6), jnp.int32))
+
+
 def test_tp_sharded_decode_matches_single_device():
     """Multi-chip SERVING: with params sharded over a tensor mesh, the
     KV-cache decode path (prefill block + per-token steps) must produce
