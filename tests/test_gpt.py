@@ -178,6 +178,27 @@ def test_tensor_parallel_training_step():
     assert "tensor" in str(spec)
 
 
+def test_decode_block_bf16_matches_sequential_prefill():
+    """The bench decode configs run bf16 — the block-vs-sequential
+    oracle must hold at that dtype too (looser tolerance; bf16 has ~3
+    decimal digits)."""
+    model, params = _model_params(dtype=jnp.bfloat16)
+    ids = _ids(b=2, s=6)
+    seq_cache = model.init_cache(2, max_len=12)
+    for t in range(6):
+        seq_logits, seq_cache = model.decode_step(params, seq_cache,
+                                                  ids[:, t])
+    blk_cache = model.init_cache(2, max_len=12)
+    blk_logits, blk_cache = model.decode_block(params, blk_cache, ids)
+    np.testing.assert_allclose(np.asarray(blk_logits, np.float32),
+                               np.asarray(seq_logits, np.float32),
+                               atol=5e-2, rtol=5e-2)
+    for key in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(blk_cache[key], np.float32),
+            np.asarray(seq_cache[key], np.float32), atol=5e-2)
+
+
 def test_chunked_prefill_matches_one_block():
     """prefill_cache(chunk=W) — the bounded-memory long-prompt path —
     must reproduce the one-block prefill exactly: same last logits, same
