@@ -2,6 +2,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu import optim, train
@@ -65,11 +66,16 @@ def test_kv_cache_decode_matches_full_forward():
                                    np.asarray(full[:, t]), atol=2e-4)
 
 
-def test_decode_block_matches_sequential_prefill():
+@pytest.mark.parametrize("dtype,atol", [(jnp.float32, 2e-4),
+                                        (jnp.bfloat16, 5e-2)],
+                         ids=["float32", "bfloat16"])
+def test_decode_block_matches_sequential_prefill(dtype, atol):
     """decode_block (one batched prompt forward) must produce exactly the
     cache contents and last-position logits of plen sequential
-    decode_step calls — the prefill fast path behind generate/beam."""
-    model, params = _model_params()
+    decode_step calls — the prefill fast path behind generate/beam.
+    bf16 is the bench decode configs' dtype (looser tolerance; ~3
+    decimal digits)."""
+    model, params = _model_params(dtype=dtype)
     ids = _ids(b=2, s=6)
     seq_cache = model.init_cache(2, max_len=12)
     for t in range(6):
@@ -78,11 +84,13 @@ def test_decode_block_matches_sequential_prefill():
     blk_cache = model.init_cache(2, max_len=12)
     blk_logits, blk_cache = model.decode_block(params, blk_cache, ids)
     assert int(blk_cache["pos"]) == int(seq_cache["pos"]) == 6
-    np.testing.assert_allclose(np.asarray(blk_logits),
-                               np.asarray(seq_logits), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(blk_logits, np.float32),
+                               np.asarray(seq_logits, np.float32),
+                               atol=atol, rtol=atol)
     for key in ("k", "v"):
-        np.testing.assert_allclose(np.asarray(blk_cache[key]),
-                                   np.asarray(seq_cache[key]), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(blk_cache[key], np.float32),
+                                   np.asarray(seq_cache[key], np.float32),
+                                   atol=atol)
 
 
 def test_decode_block_matches_sequential_prefill_rope_gqa():
@@ -176,27 +184,6 @@ def test_tensor_parallel_training_step():
     assert np.isfinite(float(m["loss"]))
     spec = state.params["decoder"]["ffn"]["w_in"]["kernel"].sharding.spec
     assert "tensor" in str(spec)
-
-
-def test_decode_block_bf16_matches_sequential_prefill():
-    """The bench decode configs run bf16 — the block-vs-sequential
-    oracle must hold at that dtype too (looser tolerance; bf16 has ~3
-    decimal digits)."""
-    model, params = _model_params(dtype=jnp.bfloat16)
-    ids = _ids(b=2, s=6)
-    seq_cache = model.init_cache(2, max_len=12)
-    for t in range(6):
-        seq_logits, seq_cache = model.decode_step(params, seq_cache,
-                                                  ids[:, t])
-    blk_cache = model.init_cache(2, max_len=12)
-    blk_logits, blk_cache = model.decode_block(params, blk_cache, ids)
-    np.testing.assert_allclose(np.asarray(blk_logits, np.float32),
-                               np.asarray(seq_logits, np.float32),
-                               atol=5e-2, rtol=5e-2)
-    for key in ("k", "v"):
-        np.testing.assert_allclose(
-            np.asarray(blk_cache[key], np.float32),
-            np.asarray(seq_cache[key], np.float32), atol=5e-2)
 
 
 def test_chunked_prefill_matches_one_block():
