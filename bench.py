@@ -870,9 +870,12 @@ def bench_gpt_decode_int8():
     ``generate``'s argument and ``dequantize_tree`` runs INSIDE the jit,
     so weights stay int8 in HBM (4x smaller reads — decode is
     bandwidth-bound) and the scale multiply fuses into the matmul
-    prologue.  Reports the int8 rate plus the fp rate measured in the
-    same run and the greedy-token agreement between the two paths — the
-    honesty signal that rounding didn't change the decoded text."""
+    prologue.  Also measures the FULL-int8 serving point (int8 weights
+    + ``kv_cache_dtype="int8"`` — halved cache traffic on top of the
+    weight reads).  Reports all three rates from the same run and the
+    greedy-token agreement of each quantized path vs fp — the honesty
+    signal that rounding didn't change the decoded text."""
+    import dataclasses
     import jax
     import numpy as np
     from distributed_tensorflow_tpu.models.gpt import GPT
@@ -881,6 +884,7 @@ def bench_gpt_decode_int8():
     seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
     config = _gpt_bench_config(seq)
     model = GPT(config)
+    model_kv8 = GPT(dataclasses.replace(config, kv_cache_dtype="int8"))
     params = model.init(jax.random.PRNGKey(0))
     qparams = quant.quantize_tree(params)
     batch = 4 if SMOKE else 64
@@ -895,6 +899,9 @@ def bench_gpt_decode_int8():
     gen_q = jax.jit(lambda qp, ids: model.generate(
         quant.dequantize_tree(qp), ids, max_new_tokens=new_tokens,
         temperature=0.0, max_len=seq))
+    gen_q_kv8 = jax.jit(lambda qp, ids: model_kv8.generate(
+        quant.dequantize_tree(qp), ids, max_new_tokens=new_tokens,
+        temperature=0.0, max_len=seq))
 
     def timed(fn, args):
         np.asarray(fn(*args))                    # compile + warmup
@@ -905,14 +912,20 @@ def bench_gpt_decode_int8():
 
     fp_rate, fp_toks = timed(gen_fp, (params, prompt))
     q_rate, q_toks = timed(gen_q, (qparams, prompt))
+    kv8_rate, kv8_toks = timed(gen_q_kv8, (qparams, prompt))
     match = float(np.mean(fp_toks[:, prompt_len:] == q_toks[:, prompt_len:]))
+    kv8_match = float(np.mean(fp_toks[:, prompt_len:]
+                              == kv8_toks[:, prompt_len:]))
     log(f"gpt_decode_int8: {q_rate:,.0f} tokens/s/chip vs fp "
         f"{fp_rate:,.0f} ({q_rate / fp_rate:.2f}x), greedy match "
-        f"{match:.3f}")
+        f"{match:.3f}; +kv8 {kv8_rate:,.0f} "
+        f"({kv8_rate / fp_rate:.2f}x, match {kv8_match:.3f})")
     return dict(metric="gpt_decode_int8_tokens_per_sec_per_chip",
                 value=round(q_rate, 1), unit="tokens/sec/chip",
                 vs_baseline=round(q_rate / fp_rate, 3),  # fp path, same run
                 fp_value=round(fp_rate, 1), greedy_token_match=round(match, 4),
+                full_int8_value=round(kv8_rate, 1),
+                full_int8_greedy_match=round(kv8_match, 4),
                 batch=batch, new_tokens=new_tokens, seq_len=seq)
 
 

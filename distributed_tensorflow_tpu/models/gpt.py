@@ -114,8 +114,19 @@ class GPTConfig:
     # microbatches per step; 0 -> pipeline_stages (the GPipe minimum for
     # full utilization)
     pipeline_microbatches: int = 0
+    # KV-cache storage dtype at decode: None = the model dtype; "int8"
+    # stores symmetric per-(token, head) int8 with f32 scales — cache
+    # reads rival the weight reads at serving batch sizes, so this is
+    # the decode HBM-bandwidth lever (2x smaller cache traffic AND 2x
+    # the cache capacity per chip at bf16 models).  Dequantize happens
+    # at the attention operand, where XLA fuses the widen+scale (same
+    # scheme as ops.quant's weight-only path).
+    kv_cache_dtype: Optional[str] = None
 
     def __post_init__(self):
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(f"kv_cache_dtype must be None or 'int8'; "
+                             f"got {self.kv_cache_dtype!r}")
         if self.norm not in ("layernorm", "rmsnorm"):
             raise ValueError(f"norm must be 'layernorm' or 'rmsnorm'; "
                              f"got {self.norm!r}")
@@ -704,8 +715,36 @@ class GPT:
         max_len = max_len or c.max_position
         # kv_heads, not num_heads: GQA's cache is the whole point
         shape = (c.num_layers, batch_size, max_len, c.kv_heads, c.head_dim)
+        if c.kv_cache_dtype == "int8":
+            sshape = shape[:-1] + (1,)   # per-(token, head) scale
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sshape, jnp.float32),
+                    "v_scale": jnp.zeros(sshape, jnp.float32),
+                    "pos": jnp.zeros((), jnp.int32)}
         return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
                 "pos": jnp.zeros((), jnp.int32)}
+
+    @staticmethod
+    def _cache_kv(cache):
+        """The scan-carried K/V subtree of a cache dict (everything but
+        the position pointer)."""
+        return {k: v for k, v in cache.items() if k != "pos"}
+
+    def _dequant_layer_kv(self, kv, i):
+        """Layer ``i``'s (k, v) read from the carried cache subtree, in
+        the compute dtype — dequantizing int8 entries at the operand
+        (XLA fuses the widen+scale into the attention einsum)."""
+        k_all = lax.dynamic_index_in_dim(kv["k"], i, keepdims=False)
+        v_all = lax.dynamic_index_in_dim(kv["v"], i, keepdims=False)
+        if "k_scale" not in kv:
+            return k_all, v_all
+        from ..ops import quant
+        dtype = self.config.dtype
+        ks = lax.dynamic_index_in_dim(kv["k_scale"], i, keepdims=False)
+        vs = lax.dynamic_index_in_dim(kv["v_scale"], i, keepdims=False)
+        return (quant.dequantize_tensor(quant.QTensor(k_all, ks), dtype),
+                quant.dequantize_tensor(quant.QTensor(v_all, vs), dtype))
 
     def decode_step(self, params, cache, token_ids, kv_valid=None,
                     positions=None):
@@ -755,42 +794,44 @@ class GPT:
             rope_cs = attn_lib.rope_tables(pos1, c.head_dim,
                                            base=c.rope_base)
 
-        def attention(q, k_blk, v_blk, k_all, v_all, i):
+        def attention(q, k_blk, v_blk, kv, i):
             del k_blk, v_blk   # single token: read back through the cache
-            k_cache = lax.dynamic_index_in_dim(k_all, i, keepdims=False)
-            v_cache = lax.dynamic_index_in_dim(v_all, i, keepdims=False)
+            k_cache, v_cache = self._dequant_layer_kv(kv, i)
             # GQA handled natively by the dense kernel (grouped einsum
             # against the unrepeated cache — no full-head materialization)
             return attn_lib.dot_product_attention(q, k_cache, v_cache,
                                                   mask=kv_mask)
 
         def body(carry, inputs):
-            x, k_all, v_all = carry
+            x, kv = carry
             p, i = inputs
-            return self._cache_layer(p, x, k_all, v_all, i,
+            return self._cache_layer(p, x, kv, i,
                                      write_pos=pos, rope_cs=rope_cs,
                                      attention=attention), None
 
-        (x, new_k, new_v), _ = lax.scan(
-            body, (x, cache["k"], cache["v"]),
+        (x, new_kv), _ = lax.scan(
+            body, (x, self._cache_kv(cache)),
             (params["decoder"], jnp.arange(c.num_layers)))
         x = self._norm(params["ln_f"], x)
         logits = self.logits(params, x)[:, 0, :]
-        return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+        return logits, dict(new_kv, pos=pos + 1)
 
-    def _cache_layer(self, p, x, k_all, v_all, i, *, write_pos, rope_cs,
+    def _cache_layer(self, p, x, kv, i, *, write_pos, rope_cs,
                      attention):
         """ONE decoder layer of the KV-cache path — shared by decode_step
         (s=1 against the cache) and decode_block (whole-prompt prefill)
-        so the layer math can never diverge between them.  The caches
-        ride the scan CARRY, not the scanned ys: as ys each layer would
-        write its FULL [b, max_len, h, d] cache back out every call when
-        only ``write_pos`` onward changes; as carry the updates are
-        in-place slice writes.
+        so the layer math can never diverge between them.  The cache
+        subtree ``kv`` ({k, v[, k_scale, v_scale]}) rides the scan
+        CARRY, not the scanned ys: as ys each layer would write its FULL
+        [b, max_len, h, d] cache back out every call when only
+        ``write_pos`` onward changes; as carry the updates are in-place
+        slice writes.  When scale entries are present the write
+        quantizes to symmetric per-(token, head) int8 (the
+        ``kv_cache_dtype="int8"`` decode-bandwidth lever).
 
-        ``attention(q, k_blk, v_blk, k_all, v_all, i)`` supplies the
-        step/block-specific attention read; ``rope_cs``: (cos, sin)
-        tables hoisted out of the layer scan.
+        ``attention(q, k_blk, v_blk, kv, i)`` supplies the step/block-
+        specific attention read; ``rope_cs``: (cos, sin) tables hoisted
+        out of the layer scan.
         """
         h = self._norm(p["ln_1"], x)
         a = p["attention"]
@@ -808,20 +849,36 @@ class GPT:
             q = attn_lib.apply_rope(q, *rope_cs)
             k = attn_lib.apply_rope(k, *rope_cs)
         zero = jnp.zeros((), jnp.int32)
-        k_all = lax.dynamic_update_slice(
-            k_all, k[None].astype(k_all.dtype),
-            (i, zero, write_pos, zero, zero))
-        v_all = lax.dynamic_update_slice(
-            v_all, v[None].astype(v_all.dtype),
-            (i, zero, write_pos, zero, zero))
-        attn = attention(q, k, v, k_all, v_all, i)
+
+        def write(name, val):
+            if "k_scale" in kv:
+                # ONE quantization scheme repo-wide: ops.quant's
+                # symmetric int8 with a per-(token, head) scale (the
+                # last axis is the reduced one)
+                from ..ops import quant
+                qt = quant.quantize_tensor(val, reduce_axes=(-1,))
+                kv[name] = lax.dynamic_update_slice(
+                    kv[name], qt.q[None],
+                    (i, zero, write_pos, zero, zero))
+                kv[name + "_scale"] = lax.dynamic_update_slice(
+                    kv[name + "_scale"], qt.scale[None],
+                    (i, zero, write_pos, zero, zero))
+            else:
+                kv[name] = lax.dynamic_update_slice(
+                    kv[name], val[None].astype(kv[name].dtype),
+                    (i, zero, write_pos, zero, zero))
+
+        kv = dict(kv)
+        write("k", k)
+        write("v", v)
+        attn = attention(q, k, v, kv, i)
         attn_out = jnp.einsum("bshk,hkd->bsd", attn,
                               a["out"]["kernel"].astype(dtype))
         if "bias" in a["out"]:
             attn_out = attn_out + a["out"]["bias"].astype(dtype)
         x = x + attn_out
         ffn_out, _ = self._ffn(p, x)   # aux unused at decode
-        return x + ffn_out, k_all, v_all
+        return x + ffn_out, kv
 
     def decode_block(self, params, cache, token_ids, kv_valid=None,
                      positions=None):
@@ -859,16 +916,16 @@ class GPT:
             from ..ops.pallas.flash_attention import make_flash_attention_fn
             flash_fn = make_flash_attention_fn(causal=True)
 
-            def block_attn(q, k_blk, v_blk, k_all, v_all, i):
-                del k_all, v_all, i
+            def block_attn(q, k_blk, v_blk, kv, i):
+                del kv, i
                 return flash_fn(q, k_blk, v_blk)
         else:
             mask = attn_lib.causal_mask(s)
             if kv_valid is not None:
                 mask = mask + attn_lib.padding_mask(kv_valid)
 
-            def block_attn(q, k_blk, v_blk, k_all, v_all, i):
-                del k_all, v_all, i
+            def block_attn(q, k_blk, v_blk, kv, i):
+                del kv, i
                 return attn_lib.dot_product_attention(q, k_blk, v_blk,
                                                       mask=mask)
 
@@ -880,20 +937,20 @@ class GPT:
                                            base=c.rope_base)
 
         def body(carry, inputs):
-            x, k_all, v_all = carry
+            x, kv = carry
             p, i = inputs
-            return self._cache_layer(p, x, k_all, v_all, i,
+            return self._cache_layer(p, x, kv, i,
                                      write_pos=jnp.zeros((), jnp.int32),
                                      rope_cs=rope_cs,
                                      attention=block_attn), None
 
-        (x, new_k, new_v), _ = lax.scan(
-            body, (x, cache["k"], cache["v"]),
+        (x, new_kv), _ = lax.scan(
+            body, (x, self._cache_kv(cache)),
             (params["decoder"], jnp.arange(c.num_layers)))
         # head on the last position only — [b, s, vocab] never materializes
         x = self._norm(params["ln_f"], x[:, -1:, :])
         logits = self.logits(params, x)[:, 0, :]
-        return logits, {"k": new_k, "v": new_v, "pos": cache["pos"] + s}
+        return logits, dict(new_kv, pos=cache["pos"] + s)
 
     def decode_window(self, params, cache, token_ids, head: str = "all"):
         """``s`` tokens against a NON-empty cache in one forward.
@@ -936,24 +993,23 @@ class GPT:
             rope_cs = attn_lib.rope_tables(win_pos, c.head_dim,
                                            base=c.rope_base)
 
-        def window_attn(q, k_blk, v_blk, k_all, v_all, i):
+        def window_attn(q, k_blk, v_blk, kv, i):
             del k_blk, v_blk   # read back through the cache (prefix + win)
-            k_cache = lax.dynamic_index_in_dim(k_all, i, keepdims=False)
-            v_cache = lax.dynamic_index_in_dim(v_all, i, keepdims=False)
+            k_cache, v_cache = self._dequant_layer_kv(kv, i)
             return attn_lib.dot_product_attention(q, k_cache, v_cache,
                                                   mask=kv_mask)
 
         def body(carry, inputs):
-            x, k_all, v_all = carry
+            x, kv = carry
             p, i = inputs
-            return self._cache_layer(p, x, k_all, v_all, i,
+            return self._cache_layer(p, x, kv, i,
                                      write_pos=pos, rope_cs=rope_cs,
                                      attention=window_attn), None
 
-        (x, new_k, new_v), _ = lax.scan(
-            body, (x, cache["k"], cache["v"]),
+        (x, new_kv), _ = lax.scan(
+            body, (x, self._cache_kv(cache)),
             (params["decoder"], jnp.arange(c.num_layers)))
-        new_cache = {"k": new_k, "v": new_v, "pos": pos + s}
+        new_cache = dict(new_kv, pos=pos + s)
         if head == "none":
             return None, new_cache
         if head == "last":
@@ -973,7 +1029,12 @@ class GPT:
         itself — live attention memory is bounded by W x max_len
         instead of s x s, the long-context serving shape (a 32k prompt
         prefills at the memory of its window).  Exact parity with the
-        one-block path (tests/test_gpt.py::test_chunked_prefill_*).
+        one-block path (tests/test_gpt.py::test_chunked_prefill_*) —
+        except under ``kv_cache_dtype="int8"``, where each window reads
+        its own K/V back through the quantized cache (one rounding step
+        the single-block path's in-block attention doesn't take), so
+        chunked-prefill logits agree to quantization tolerance rather
+        than exactly.
 
         Returns (last-position logits [b, vocab] f32, advanced cache).
         Requires an EMPTY cache (``pos == 0``, the decode_block
@@ -1212,9 +1273,11 @@ class GPT:
             _, cache = self.decode_block(params, cache,
                                          prompt_ids[:, :-1], **blk)
         # fold beams into the batch dim: row r of batch i -> i*k + r
-        cache = {"k": jnp.repeat(cache["k"], k, axis=1),
-                 "v": jnp.repeat(cache["v"], k, axis=1),
-                 "pos": cache["pos"]}
+        # (tree-mapped over every cache entry but pos, so int8 caches'
+        # scale arrays fold with their values)
+        cache = dict(jax.tree.map(lambda a: jnp.repeat(a, k, axis=1),
+                                  self._cache_kv(cache)),
+                     pos=cache["pos"])
 
         tokens = jnp.zeros((b, k, total), jnp.int32)
         tokens = tokens.at[:, :, :plen].set(prompt_ids[:, None, :])
@@ -1238,9 +1301,9 @@ class GPT:
             if eos_id is not None:
                 finished = finished | (nxt == eos_id)
             flat = (batch_base + beam).reshape(-1)
-            cache = {"k": jnp.take(cache["k"], flat, axis=1),
-                     "v": jnp.take(cache["v"], flat, axis=1),
-                     "pos": cache["pos"]}
+            cache = dict(jax.tree.map(lambda a: jnp.take(a, flat, axis=1),
+                                      self._cache_kv(cache)),
+                         pos=cache["pos"])
             return (tokens, cache, scores, finished)
 
         # phase 2 — beam expansion from position plen-1 onward

@@ -82,6 +82,7 @@ _SLOW_TESTS = (
     "test_gpt.py::test_chunked_prefill_matches_one_block",
     # only the bf16 parametrization is slow-tiered; [float32] stays fast
     "test_gpt.py::test_decode_block_matches_sequential_prefill[bfloat16",
+    "test_gpt.py::test_int8_kv_cache_decode",
     "test_seq2seq.py::test_src_padding_masked_out",
     "test_convert.py::test_gpt2_converted_finetunes",
     # round-5 speculative additions: keep the fast exactness oracle

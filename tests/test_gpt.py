@@ -186,6 +186,37 @@ def test_tensor_parallel_training_step():
     assert "tensor" in str(spec)
 
 
+def test_int8_kv_cache_decode():
+    """kv_cache_dtype='int8': cache stores int8 + per-(token, head)
+    scales, decode logits stay within quantization tolerance of the fp
+    cache, greedy decode agrees at these seeds, and beam search's cache
+    fold/reorder carries the scale arrays."""
+    fp = gpt_tiny(dropout_rate=0.0)
+    q8 = gpt_tiny(dropout_rate=0.0, kv_cache_dtype="int8")
+    params = fp.init(jax.random.PRNGKey(0))
+    ids = _ids(b=2, s=8)
+    cq = q8.init_cache(2, 16)
+    assert cq["k"].dtype == jnp.int8 and cq["k_scale"].dtype == jnp.float32
+    assert cq["k_scale"].shape == cq["k"].shape[:-1] + (1,)
+
+    cf = fp.init_cache(2, 16)
+    lf, cf = fp.decode_block(params, cf, ids)
+    lq, cq = q8.decode_block(params, cq, ids)
+    # prefill logits attend the block's own fp K/V — identical by design
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(lf), atol=1e-5)
+    sf, cf = fp.decode_step(params, cf, ids[:, -1])
+    sq, cq = q8.decode_step(params, cq, ids[:, -1])
+    # cache reads dequantize: per-(token, head) int8 keeps logits close
+    np.testing.assert_allclose(np.asarray(sq), np.asarray(sf), atol=5e-2)
+
+    of = fp.generate(params, ids, max_new_tokens=6, max_len=16)
+    oq = q8.generate(params, ids, max_new_tokens=6, max_len=16)
+    np.testing.assert_array_equal(np.asarray(oq), np.asarray(of))
+    ob = q8.beam_search(params, ids, max_new_tokens=4, beam_size=3,
+                        max_len=16)
+    assert ob.shape == (2, 12) and int(np.asarray(ob).max()) < 512
+
+
 def test_chunked_prefill_matches_one_block():
     """prefill_cache(chunk=W) — the bounded-memory long-prompt path —
     must reproduce the one-block prefill exactly: same last logits, same
