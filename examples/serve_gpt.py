@@ -95,6 +95,11 @@ def main() -> int:
         lambda: model.beam_search(params, prompt, max_new_tokens=new,
                                   beam_size=4, max_len=max_len)), b * new)
 
+    timed("chunked prefill (W=4)", jax.jit(
+        lambda: model.generate(params, prompt, max_new_tokens=new,
+                               temperature=0.0, max_len=max_len,
+                               prefill_chunk=4)), b * new)
+
     qparams = quant.quantize_tree(params)
     q_out = timed("int8 weights", jax.jit(
         lambda: model.generate(quant.dequantize_tree(qparams), prompt,
@@ -103,6 +108,15 @@ def main() -> int:
     agree = float(np.mean(np.asarray(greedy)[:, plen:]
                           == np.asarray(q_out)[:, plen:]))
     print(f"{'':<28} int8 greedy agreement {agree:.3f}", flush=True)
+
+    kv8_model = GPT(dataclasses.replace(config, kv_cache_dtype="int8"))
+    kv8_out = timed("int8 weights + int8 KV cache", jax.jit(
+        lambda: kv8_model.generate(quant.dequantize_tree(qparams), prompt,
+                                   max_new_tokens=new, temperature=0.0,
+                                   max_len=max_len)), b * new)
+    agree8 = float(np.mean(np.asarray(greedy)[:, plen:]
+                           == np.asarray(kv8_out)[:, plen:]))
+    print(f"{'':<28} full-int8 greedy agreement {agree8:.3f}", flush=True)
 
     draft = GPT(dataclasses.replace(config, num_layers=2))
     d_params = dict(params)

@@ -54,9 +54,14 @@ def test_serve_gpt_demo_smoke():
     for label in ("greedy generate", "beam search", "int8 weights",
                   "speculative"):
         assert label in proc.stdout, proc.stdout
+    # "full-int8 ..." contains the weight-only substring; exclude it so
+    # each assertion targets exactly one printed line
     agree = [l for l in proc.stdout.splitlines()
-             if "int8 greedy agreement" in l]
+             if "int8 greedy agreement" in l and "full-int8" not in l]
     assert agree and float(agree[0].split()[-1]) > 0.9
+    full8 = [l for l in proc.stdout.splitlines()
+             if "full-int8 greedy agreement" in l]
+    assert full8 and float(full8[0].split()[-1]) > 0.9
     match = [l for l in proc.stdout.splitlines() if "greedy match" in l]
     assert match and float(match[0].split()[-1]) > 0.9
 
