@@ -91,7 +91,8 @@ def generate_speculative(target_model, target_params, draft_model,
                          draft_params, prompt_ids, max_new_tokens: int,
                          gamma: int = 4,
                          temperature: float = 0.0, rng=None,
-                         max_len: Optional[int] = None):
+                         max_len: Optional[int] = None,
+                         prefill_chunk: Optional[int] = None):
     """Speculative decode; returns (tokens [1, plen + new],
     accepted_fraction scalar — the mean share of draft proposals kept).
 
@@ -139,18 +140,21 @@ def generate_speculative(target_model, target_params, draft_model,
     tokens = jnp.zeros((1, scratch), jnp.int32)
     tokens = lax.dynamic_update_slice_in_dim(tokens, prompt_ids, 0, axis=1)
 
-    # prompt prefill on BOTH models; the target's last-position logits
-    # emit the first new token
-    logits, t_cache = target_model.decode_block(target_params, t_cache,
-                                                prompt_ids)
+    # prompt prefill on BOTH models (optionally chunked — the bounded-
+    # memory long-prompt path); the target's last-position logits emit
+    # the first new token
+    logits, t_cache = target_model.prefill_cache(target_params, t_cache,
+                                                 prompt_ids,
+                                                 chunk=prefill_chunk)
     from ..ops import decoding as dec
     rng, sub = jax.random.split(rng)
     # shared next-token selection rule (temperature <= 0 is greedy there)
     first = dec.sample_logits(sub, logits, temperature)      # [1]
     tokens = lax.dynamic_update_slice_in_dim(tokens, first[:, None],
                                              plen, axis=1)
-    _, d_cache = draft_model.decode_block(draft_params, d_cache,
-                                          prompt_ids)
+    _, d_cache = draft_model.prefill_cache(draft_params, d_cache,
+                                           prompt_ids,
+                                           chunk=prefill_chunk)
 
     def round_step(state):
         tokens, t_cache, d_cache, rng, i, n_acc, n_prop = state

@@ -145,6 +145,26 @@ def test_sampled_spec_runs_and_is_plausible():
     assert not np.array_equal(np.asarray(out1), np.asarray(out2))
 
 
+def test_spec_composes_with_chunked_prefill_and_int8_kv():
+    """Composition: speculative decoding with (a) chunked prompt
+    prefill and (b) an int8 KV cache on BOTH models still reproduces
+    the same-config generate() exactly at these seeds."""
+    model = gpt_tiny(dropout_rate=0.0, max_position=64,
+                     kv_cache_dtype="int8")
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = _prompt(s=6)
+    # baseline with the SAME chunked prefill so both sides build the
+    # identical int8 cache (one-block vs chunked prefill differ by a
+    # quantization rounding step under int8 — gpt.py prefill_cache doc)
+    want = model.generate(params, prompt, max_new_tokens=10,
+                          prefill_chunk=2)
+    got, acc = generate_speculative(model, params, model, params,
+                                    prompt, max_new_tokens=10, gamma=3,
+                                    prefill_chunk=2)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert 0.0 <= float(acc) <= 1.0
+
+
 def test_rejects_bad_args():
     model = gpt_tiny(dropout_rate=0.0, max_position=64)
     params = model.init(jax.random.PRNGKey(0))
