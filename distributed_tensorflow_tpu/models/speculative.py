@@ -92,7 +92,9 @@ def generate_speculative(target_model, target_params, draft_model,
                          gamma: int = 4,
                          temperature: float = 0.0, rng=None,
                          max_len: Optional[int] = None,
-                         prefill_chunk: Optional[int] = None):
+                         prefill_chunk: Optional[int] = None,
+                         eos_id: Optional[int] = None,
+                         pad_id: Optional[int] = None):
     """Speculative decode; returns (tokens [1, plen + new],
     accepted_fraction scalar — the mean share of draft proposals kept).
 
@@ -102,7 +104,10 @@ def generate_speculative(target_model, target_params, draft_model,
     ``softmax(q/T)``, the target accepts/corrects so the OUTPUT
     distribution equals sampling from ``softmax(p/T)`` directly (the
     Leviathan guarantee; top_k/top_p filters are not supported on this
-    path).  ``target_model``/``draft_model``: GPT instances sharing the
+    path).  ``eos_id``: generation stops at the first emitted EOS (the
+    round truncates there; later slots hold ``pad_id``, default
+    ``eos_id`` — ``generate``'s stop-token contract).
+    ``target_model``/``draft_model``: GPT instances sharing the
     tokenizer/vocab.  ``prompt_ids``: [1, plen] int32.
     """
     b, plen = prompt_ids.shape
@@ -134,10 +139,15 @@ def generate_speculative(target_model, target_params, draft_model,
     sampled = temperature > 0
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    from ..ops import decoding as dec
+    # unconditional: resolve_pad raises on pad_id-without-eos_id, the
+    # same argument contract as generate
+    resolved = dec.resolve_pad(eos_id, pad_id)
+    pad = 0 if resolved is None else resolved
 
     t_cache = target_model.init_cache(1, scratch)
     d_cache = draft_model.init_cache(1, scratch)
-    tokens = jnp.zeros((1, scratch), jnp.int32)
+    tokens = jnp.full((1, scratch), pad, jnp.int32)
     tokens = lax.dynamic_update_slice_in_dim(tokens, prompt_ids, 0, axis=1)
 
     # prompt prefill on BOTH models (optionally chunked — the bounded-
@@ -146,18 +156,19 @@ def generate_speculative(target_model, target_params, draft_model,
     logits, t_cache = target_model.prefill_cache(target_params, t_cache,
                                                  prompt_ids,
                                                  chunk=prefill_chunk)
-    from ..ops import decoding as dec
     rng, sub = jax.random.split(rng)
     # shared next-token selection rule (temperature <= 0 is greedy there)
     first = dec.sample_logits(sub, logits, temperature)      # [1]
     tokens = lax.dynamic_update_slice_in_dim(tokens, first[:, None],
                                              plen, axis=1)
+    finished0 = (jnp.any(first == eos_id) if eos_id is not None
+                 else jnp.asarray(False))
     _, d_cache = draft_model.prefill_cache(draft_params, d_cache,
                                            prompt_ids,
                                            chunk=prefill_chunk)
 
     def round_step(state):
-        tokens, t_cache, d_cache, rng, i, n_acc, n_prop = state
+        tokens, t_cache, d_cache, rng, i, n_acc, n_prop, _ = state
         tok_i = lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
 
         # -- draft: gamma+1 autoregressive steps from tokens[i] ----------
@@ -198,6 +209,16 @@ def generate_speculative(target_model, target_params, draft_model,
                              jnp.concatenate([drafts, drafts[-1:]]),
                              greedy)
         n_emit = jnp.minimum(n + 1, total - 1 - i)           # never overrun
+        finished = jnp.asarray(False)
+        if eos_id is not None:
+            # stop at the FIRST emitted EOS: truncate the round there and
+            # pad the rest of this round's write (nothing overwrites it)
+            idx = jnp.arange(gamma + 1)
+            is_eos = (emit == eos_id) & (idx < n_emit)
+            first_eos = jnp.min(jnp.where(is_eos, idx, gamma + 1))
+            finished = jnp.any(is_eos)
+            n_emit = jnp.minimum(n_emit, first_eos + 1)
+            emit = jnp.where(idx < n_emit, emit, pad)
         tokens = lax.dynamic_update_slice_in_dim(
             tokens, emit[None, :], i + 1, axis=1)
 
@@ -205,15 +226,16 @@ def generate_speculative(target_model, target_params, draft_model,
         t_cache = dict(t_cache, pos=i + n_emit)
         d_cache = dict(d_cache, pos=i + n_emit)
         return (tokens, t_cache, d_cache, rng, i + n_emit,
-                n_acc + jnp.minimum(n, n_emit), n_prop + gamma)
+                n_acc + jnp.minimum(n, n_emit), n_prop + gamma,
+                finished)
 
     def cond(state):
-        _, _, _, _, i, _, _ = state
-        return i < total - 1
+        _, _, _, _, i, _, _, finished = state
+        return (i < total - 1) & ~finished
 
     state = (tokens, t_cache, d_cache, rng, jnp.int32(plen),
-             jnp.int32(0), jnp.int32(0))
-    tokens, _, _, _, _, n_acc, n_prop = lax.while_loop(cond, round_step,
-                                                       state)
+             jnp.int32(0), jnp.int32(0), finished0)
+    tokens, _, _, _, _, n_acc, n_prop, _ = lax.while_loop(cond, round_step,
+                                                          state)
     accepted_fraction = n_acc / jnp.maximum(n_prop, 1)
     return tokens[:, :total], accepted_fraction

@@ -165,6 +165,25 @@ def test_spec_composes_with_chunked_prefill_and_int8_kv():
     assert 0.0 <= float(acc) <= 1.0
 
 
+def test_spec_eos_early_stop_matches_generate():
+    """eos_id: speculative stops at the first emitted EOS and pads the
+    rest — identical output to generate(eos_id=...) at these seeds."""
+    model = gpt_tiny(dropout_rate=0.0, max_position=64)
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = _prompt(s=4)
+    free = model.generate(params, prompt, max_new_tokens=12)
+    # pick a token the unstopped greedy continuation actually emits
+    eos = int(np.asarray(free)[0, 7])
+    want = model.generate(params, prompt, max_new_tokens=12, eos_id=eos)
+    got, _ = generate_speculative(model, params, model, params, prompt,
+                                  max_new_tokens=12, gamma=3, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # the stop actually truncated: pad fills after the first eos
+    row = np.asarray(got)[0]
+    eos_idx = int(np.argmax(row[4:] == eos)) + 4
+    assert (row[eos_idx + 1:] == eos).all()   # pad defaults to eos_id
+
+
 def test_rejects_bad_args():
     model = gpt_tiny(dropout_rate=0.0, max_position=64)
     params = model.init(jax.random.PRNGKey(0))
