@@ -33,9 +33,11 @@ rejected columns' FLOPs).  ``temperature <= 0`` uses the greedy
 longest-matching-prefix rule above; ``temperature > 0`` uses
 ``speculative_accept``'s rejection sampling, whose emitted tokens are
 distributed exactly as sampling from the target (Monte-Carlo-verified in
-tests/test_speculative.py).  top_k/top_p filters are not supported on
-the sampled path.  The reference has no serving tier at all (SURVEY.md
-§2 — framework-native scope, like the KV cache itself).
+tests/test_speculative.py).  ``top_k``/``top_p`` apply the SAME
+``ops.decoding.filtered_logits`` filter to both sides, so filtered
+sampled speculative decoding reproduces ``generate``'s filtered
+sampling law.  The reference has no serving tier at all (SURVEY.md §2 —
+framework-native scope, like the KV cache itself).
 """
 from __future__ import annotations
 
@@ -69,7 +71,11 @@ def speculative_accept(rng, p, q, drafts):
     u = jax.random.uniform(k_rng, (gamma,))
     p_d = jnp.take_along_axis(p[:gamma], drafts[:, None], axis=1)[:, 0]
     q_d = jnp.take_along_axis(q, drafts[:, None], axis=1)[:, 0]
-    accept = u * q_d <= p_d          # u < p/q without dividing by zero
+    # u < p/q without dividing by zero; STRICT so p_d == 0 (a token the
+    # filtered target excludes) can never be accepted even when u == 0.0
+    # (uniform samples [0, 1)); p_d >= q_d still accepts w.p. 1 since
+    # u*q_d < q_d <= p_d
+    accept = u * q_d < p_d
     n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
 
     # residual distribution at the first rejected position (row n); the
@@ -94,7 +100,9 @@ def generate_speculative(target_model, target_params, draft_model,
                          max_len: Optional[int] = None,
                          prefill_chunk: Optional[int] = None,
                          eos_id: Optional[int] = None,
-                         pad_id: Optional[int] = None):
+                         pad_id: Optional[int] = None,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None):
     """Speculative decode; returns (tokens [1, plen + new],
     accepted_fraction scalar — the mean share of draft proposals kept).
 
@@ -103,8 +111,11 @@ def generate_speculative(target_model, target_params, draft_model,
     ``speculative_accept``'s rejection sampling — drafts sample from
     ``softmax(q/T)``, the target accepts/corrects so the OUTPUT
     distribution equals sampling from ``softmax(p/T)`` directly (the
-    Leviathan guarantee; top_k/top_p filters are not supported on this
-    path).  ``eos_id``: generation stops at the first emitted EOS (the
+    Leviathan guarantee).  ``top_k``/``top_p`` apply the SAME filter to
+    both sides (``ops.decoding.filtered_logits``), so the output law
+    equals ``generate``'s filtered sampling — the guarantee holds for
+    the filtered target distribution.  ``eos_id``: generation stops at
+    the first emitted EOS (the
     round truncates there; later slots hold ``pad_id``, default
     ``eos_id`` — ``generate``'s stop-token contract).
     ``target_model``/``draft_model``: GPT instances sharing the
@@ -158,7 +169,8 @@ def generate_speculative(target_model, target_params, draft_model,
                                                  chunk=prefill_chunk)
     rng, sub = jax.random.split(rng)
     # shared next-token selection rule (temperature <= 0 is greedy there)
-    first = dec.sample_logits(sub, logits, temperature)      # [1]
+    first = dec.sample_logits(sub, logits, temperature,
+                              top_k=top_k, top_p=top_p)      # [1]
     tokens = lax.dynamic_update_slice_in_dim(tokens, first[:, None],
                                              plen, axis=1)
     finished0 = (jnp.any(first == eos_id) if eos_id is not None
@@ -179,9 +191,16 @@ def generate_speculative(target_model, target_params, draft_model,
             d_cache, tok = carry
             lg, d_cache = draft_model.decode_step(draft_params, d_cache,
                                                   tok)
-            nxt = dec.sample_logits(step_rng, lg, temperature)   # [1]
-            probs = (jax.nn.softmax(lg[0] / temperature) if sampled
-                     else lg[0])   # q rows; unused on the greedy path
+            if sampled:
+                # ONE filter pass: the sample and its recorded q row
+                # come from the same filtered tensor
+                fl = dec.filtered_logits(lg, temperature, top_k, top_p)
+                nxt = jax.random.categorical(step_rng, fl
+                                             ).astype(jnp.int32)  # [1]
+                probs = jax.nn.softmax(fl[0])
+            else:
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)       # [1]
+                probs = lg[0]   # unused on the greedy path
             return (d_cache, nxt), (nxt, probs)
 
         rng, d_rng, a_rng = jax.random.split(rng, 3)
@@ -198,7 +217,11 @@ def generate_speculative(target_model, target_params, draft_model,
         # index is drafts[k] (k < gamma); row gamma is the bonus position
 
         if sampled:
-            p = jax.nn.softmax(logits[0] / temperature)      # [gamma+1, V]
+            # the same filter on the target side: acceptance then
+            # reproduces the FILTERED target law, matching generate's
+            # filtered sampling semantics
+            p = jax.nn.softmax(dec.filtered_logits(
+                logits[0], temperature, top_k, top_p))       # [gamma+1, V]
             n, emit = speculative_accept(a_rng, p, q_rows[:gamma], drafts)
         else:
             greedy = jnp.argmax(logits[0], -1).astype(jnp.int32)

@@ -15,8 +15,9 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["init_beam_scores", "freeze_finished", "expand_beams",
-           "rank_beams", "sample_logits", "resolve_pad", "finish_step",
-           "decode_loop", "ragged_prompt_masks"]
+           "rank_beams", "filtered_logits", "sample_logits",
+           "resolve_pad", "finish_step", "decode_loop",
+           "ragged_prompt_masks"]
 
 
 def ragged_prompt_masks(prompt_valid, prompt_shape: Tuple[int, int],
@@ -86,19 +87,15 @@ def decode_loop(advance, carry, n_steps: int, start: int = 0):
     return lax.while_loop(cond, body, (carry, jnp.int32(start)))
 
 
-def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,
-                  top_k: Optional[int] = None,
-                  top_p: Optional[float] = None) -> jnp.ndarray:
-    """Next-token selection from [b, V] logits (shared by every generate).
-
-    ``temperature <= 0`` is greedy argmax.  ``top_k`` keeps the k highest
-    logits; ``top_p`` (nucleus) keeps the smallest prefix of the sorted
-    distribution whose cumulative probability reaches p (always at least
-    the top token).  Filters compose (k first, then p).  Static config —
-    jit recompiles per setting, as with temperature.
+def filtered_logits(logits: jnp.ndarray, temperature: float = 1.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None) -> jnp.ndarray:
+    """[b, V] logits after temperature scaling + top-k + nucleus
+    filtering — exactly the distribution ``sample_logits`` draws from,
+    exposed for consumers that need the probabilities themselves
+    (speculative decoding's acceptance rule).  Dropped tokens are -inf
+    (zero probability after softmax).  Requires ``temperature > 0``.
     """
-    if temperature <= 0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     neg = jnp.asarray(-jnp.inf, logits.dtype)
     need_k = top_k is not None and top_k < logits.shape[-1]
@@ -126,7 +123,25 @@ def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,
     elif need_k:
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, neg, logits)
-    return jax.random.categorical(rng, logits).astype(jnp.int32)
+    return logits
+
+
+def sample_logits(rng, logits: jnp.ndarray, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jnp.ndarray:
+    """Next-token selection from [b, V] logits (shared by every generate).
+
+    ``temperature <= 0`` is greedy argmax.  ``top_k`` keeps the k highest
+    logits; ``top_p`` (nucleus) keeps the smallest prefix of the sorted
+    distribution whose cumulative probability reaches p (always at least
+    the top token).  Filters compose (k first, then p).  Static config —
+    jit recompiles per setting, as with temperature.
+    """
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filtered_logits(logits, temperature, top_k, top_p)
+    ).astype(jnp.int32)
 
 
 def init_beam_scores(batch: int, beam: int) -> jnp.ndarray:

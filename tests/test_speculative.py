@@ -165,6 +165,33 @@ def test_spec_composes_with_chunked_prefill_and_int8_kv():
     assert 0.0 <= float(acc) <= 1.0
 
 
+def test_sampled_spec_with_filters_stays_in_filtered_support():
+    """top_k on the sampled path: both sides filter identically, so no
+    emitted token may fall outside the target's per-step top_k set.
+    Verified by re-scoring the emitted continuation against the target's
+    teacher-forced logits: every emitted token must rank < k."""
+    model = gpt_tiny(dropout_rate=0.0, max_position=64)
+    params = model.init(jax.random.PRNGKey(0))
+    draft = gpt_tiny(dropout_rate=0.0, max_position=64, num_layers=1)
+    d_params = draft.init(jax.random.PRNGKey(7))
+    prompt = _prompt()
+    k = 5
+    out, acc = generate_speculative(model, params, draft, d_params,
+                                    prompt, max_new_tokens=12, gamma=3,
+                                    temperature=1.0, top_k=k,
+                                    rng=jax.random.PRNGKey(3))
+    assert 0.0 <= float(acc) <= 1.0
+    full = model.logits(params, model.apply(params, out[:, :-1]))
+    toks = np.asarray(out)[0, 4:]
+    lg = np.asarray(full)[0, 3:]                 # row t scores token t+1
+    for t, tok in enumerate(toks):
+        # margin absorbs the ~1e-4 decode-window-vs-teacher-forced
+        # reduction difference so a k-th-rank near-tie can't flip the
+        # re-scored rank across backends
+        rank = int((lg[t] > lg[t, tok] + 1e-3).sum())
+        assert rank < k, (t, tok, rank)
+
+
 def test_spec_eos_early_stop_matches_generate():
     """eos_id: speculative stops at the first emitted EOS and pads the
     rest — identical output to generate(eos_id=...) at these seeds."""
