@@ -1215,7 +1215,8 @@ class GPT:
                     beam_size: int = 4, eos_id: Optional[int] = None,
                     length_penalty: float = 0.6,
                     max_len: Optional[int] = None,
-                    prompt_valid=None) -> jnp.ndarray:
+                    prompt_valid=None,
+                    prefill_chunk: Optional[int] = None) -> jnp.ndarray:
         """Jittable beam search over the KV cache.
 
         Two phases, each one ``lax.scan``: the prompt prefills the cache at
@@ -1225,6 +1226,12 @@ class GPT:
         ``ops.decoding``.  Returns the best row per batch element,
         [b, plen + max_new_tokens].
 
+        ``prefill_chunk``: stream the prompt prefill W tokens at a time
+        (``prefill_cache``) — bounds long-prompt prefill memory; not
+        supported with ``prompt_valid``, and under
+        ``kv_cache_dtype="int8"`` it matches the one-block prefill to
+        quantization tolerance only (see ``prefill_cache``).
+
         ``prompt_valid``: LEFT-padded ragged prompts, same contract as
         ``generate`` — pad slots masked from attention, per-row position
         shift through prefill and expansion.  As there, the left-padding
@@ -1233,6 +1240,12 @@ class GPT:
         from ..ops import decoding as dec
 
         c = self.config
+        if prefill_chunk is not None and prompt_valid is not None:
+            # same up-front refusal (and precedence) as generate: the
+            # combination fails identically regardless of prompt length
+            raise ValueError("prefill_chunk does not compose with "
+                             "prompt_valid (ragged prompts prefill as "
+                             "one block)")
         b, plen = prompt_ids.shape
         k = beam_size
         total = plen + max_new_tokens
@@ -1260,18 +1273,21 @@ class GPT:
 
         # phase 1 — prefill positions 0..plen-2 at batch b, as ONE
         # decode_block forward (phase 2's first expansion reads the token
-        # at plen-1, so the block stops one short)
+        # at plen-1, so the block stops one short); prefill_chunk streams
+        # it W tokens at a time instead (long-prompt memory bound)
         cache = self.init_cache(b, max_len)
         if plen > 1:
             if prompt_valid is not None:
-                blk = dict(kv_valid=kv_valid[:, :plen - 1],
-                           positions=jnp.maximum(
-                               jnp.arange(plen - 1)[None, :]
-                               - pad_len[:, None], 0))
+                _, cache = self.decode_block(
+                    params, cache, prompt_ids[:, :-1],
+                    kv_valid=kv_valid[:, :plen - 1],
+                    positions=jnp.maximum(
+                        jnp.arange(plen - 1)[None, :]
+                        - pad_len[:, None], 0))
             else:
-                blk = {}
-            _, cache = self.decode_block(params, cache,
-                                         prompt_ids[:, :-1], **blk)
+                _, cache = self.prefill_cache(params, cache,
+                                              prompt_ids[:, :-1],
+                                              chunk=prefill_chunk)
         # fold beams into the batch dim: row r of batch i -> i*k + r
         # (tree-mapped over every cache entry but pos, so int8 caches'
         # scale arrays fold with their values)

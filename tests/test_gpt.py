@@ -239,19 +239,29 @@ def test_chunked_prefill_matches_one_block():
 
 
 def test_generate_with_chunked_prefill_matches_default():
-    """generate(prefill_chunk=W) emits the same greedy continuation as
-    the default one-block prefill; composing with prompt_valid raises."""
-    import pytest
+    """generate(prefill_chunk=W) and beam_search(prefill_chunk=W) emit
+    the same outputs as the default one-block prefill; composing with
+    prompt_valid raises in both."""
     model, params = _model_params()
     prompt = _ids(b=2, s=6)
     want = model.generate(params, prompt, max_new_tokens=5, max_len=12)
     got = model.generate(params, prompt, max_new_tokens=5, max_len=12,
                          prefill_chunk=2)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    beam_want = model.beam_search(params, prompt, max_new_tokens=4,
+                                  beam_size=3, max_len=12)
+    beam_got = model.beam_search(params, prompt, max_new_tokens=4,
+                                 beam_size=3, max_len=12,
+                                 prefill_chunk=2)
+    np.testing.assert_array_equal(np.asarray(beam_got),
+                                  np.asarray(beam_want))
+    valid = jnp.ones((2, 6), jnp.int32)
     with pytest.raises(ValueError, match="prefill_chunk"):
         model.generate(params, prompt, max_new_tokens=2, max_len=12,
-                       prefill_chunk=2,
-                       prompt_valid=jnp.ones((2, 6), jnp.int32))
+                       prefill_chunk=2, prompt_valid=valid)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        model.beam_search(params, prompt, max_new_tokens=2, max_len=12,
+                          prefill_chunk=2, prompt_valid=valid)
 
 
 def test_tp_sharded_decode_matches_single_device():
