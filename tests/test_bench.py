@@ -314,6 +314,40 @@ class TestHelpers:
         assert r["mfu"] == pytest.approx(0.2)
         assert r["flops_source"] == "analytic"
 
+    def test_attach_mfu_scan_undercount_flips_to_analytic(self, monkeypatch):
+        # XLA counts a lax.scan body once, so a scanned 12-layer LM's
+        # compiled-step flops land at ~1/3 of the 6N analytic figure;
+        # the analytic model must win and the raw XLA number be recorded
+        monkeypatch.setenv("DTTPU_PEAK_FLOPS", "1e12")
+        r = bench._attach_mfu({"metric": "m"}, 1e3,
+                              flops_per_example=2.9e8, analytic=7.7e8,
+                              scanned=True)
+        assert r["flops_source"] == "analytic"
+        assert r["flops_per_example"] == pytest.approx(7.7e8)
+        assert r["flops_xla_scan_undercount"] == pytest.approx(2.9e8)
+        assert r["mfu"] == pytest.approx(0.77)
+
+    def test_attach_mfu_honest_xla_kept(self, monkeypatch):
+        # resnet-shaped case: XLA ~= 3x the forward-only analytic constant
+        # — the compiled-step figure is honest and must keep priority
+        monkeypatch.setenv("DTTPU_PEAK_FLOPS", "1e12")
+        r = bench._attach_mfu({"metric": "m"}, 1e3,
+                              flops_per_example=3.6e10, analytic=1.23e10,
+                              scanned=True)
+        assert r["flops_source"] == "xla"
+        assert "flops_xla_scan_undercount" not in r
+
+    def test_attach_mfu_unscanned_never_flips(self, monkeypatch):
+        # an unscanned row whose honest XLA figure is below a rough
+        # hard-coded analytic constant must NOT be replaced — the flip is
+        # scoped to programs where the scan-body undercount can occur
+        monkeypatch.setenv("DTTPU_PEAK_FLOPS", "1e12")
+        r = bench._attach_mfu({"metric": "m"}, 1e3,
+                              flops_per_example=7e7, analytic=1.53e8)
+        assert r["flops_source"] == "xla"
+        assert r["flops_per_example"] == pytest.approx(7e7)
+        assert "flops_xla_scan_undercount" not in r
+
 
 class TestProvenance:
     def test_no_dir_is_synthetic(self):
