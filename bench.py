@@ -580,12 +580,29 @@ def bench_bert():
     # seq) — A/B hook until the hardware ablation decides the default
     gather = (seq // 5
               if os.environ.get("DTTPU_BENCH_MLM_GATHER") == "1" else 0)
+    # DTTPU_BENCH_BERT_REMAT: "" (off) / "full" / "dots".  Evidence
+    # (docs/evidence_r5/ablation_bert.jsonl — note every ablation arm
+    # including "base" ran remat=True, unlike this row's no-remat
+    # default): dots-vs-full is +12.4% (147,351 vs 131,123 tok/s/chip),
+    # and the full lever set (dots + gather + b128, arm
+    # remat_dots_gather 168,819) beats the same window's measured bench
+    # row (gather, no remat, b96: 134,995) by +25% — that composite win
+    # is what promote_levers' mapping buys.
+    remat_policy = os.environ.get("DTTPU_BENCH_BERT_REMAT", "").strip().lower()
+    if remat_policy in ("0", "off", "false", "no", "none"):
+        remat_policy = ""  # natural disable spellings, not a policy name
+    elif remat_policy and remat_policy not in ("full", "dots",
+                                               "dots_no_batch"):
+        raise SystemExit("DTTPU_BENCH_BERT_REMAT must be ''/off/full/dots/"
+                         f"dots_no_batch; got {remat_policy!r}")
+    remat = dict(remat=True, remat_policy=remat_policy) if remat_policy \
+        else {}
     config = (BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
                          num_heads=2, intermediate_size=512,
                          max_position=seq, dtype=jnp.bfloat16,
-                         mlm_predictions_per_seq=gather) if SMOKE
+                         mlm_predictions_per_seq=gather, **remat) if SMOKE
               else BertConfig(max_position=seq, dtype=jnp.bfloat16,
-                              mlm_predictions_per_seq=gather))
+                              mlm_predictions_per_seq=gather, **remat))
     model = Bert(config)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(1e-4)
@@ -607,10 +624,17 @@ def bench_bert():
         }, bsh)
         return state, bench_batch
 
-    # 96/chip measured best on v5e (probe 2026-07-30: 109k tok/s/chip vs
-    # 85k at 32/chip; 128/chip OOMs without remat at seq 128).
+    # 96/chip measured best on v5e without levers (probe 2026-07-30:
+    # 109k tok/s/chip vs 85k at 32/chip; 128/chip OOMs without remat at
+    # seq 128).  With REMAT on, the 08-01 ablation measured batch 128
+    # fitting AND faster (remat_dots_gather b128 168,819 — the best
+    # arm), so the ladder tries 128 first; an OOM rung falls through.
+    # Gather alone does NOT unlock 128 — no arm measured b128 without
+    # remat, and the 07-30 probe says it OOMs — so that case keeps the
+    # 96-first ladder.
+    ladder = [128, 96, 48, 24] if remat_policy else [96, 48, 24]
     rate, loss, ms, batch, f_total = _run_batch_ladder(
-        "bert", [4] if SMOKE else [96, 48, 24], mesh, build, step,
+        "bert", [4] if SMOKE else ladder, mesh, build, step,
         warmup=2, steps=4 if SMOKE else 10)
     tokens = rate * batch * seq / n_chips
     log(f"bert: {tokens:,.0f} tokens/s/chip ({ms*1e3:.1f} ms/step, "
@@ -631,6 +655,8 @@ def bench_bert():
                 - mlm_gather_flops_correction(config, seq))
     if gather:
         result["mlm_predictions_per_seq"] = gather
+    if remat_policy:
+        result["remat_policy"] = remat_policy
     return _attach_mfu(
         result, tokens, _per_example_flops(f_total, batch * seq, mesh),
         analytic=analytic, scanned=True)

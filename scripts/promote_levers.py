@@ -8,6 +8,7 @@ env knobs that bench.py reads as *defaults* (explicit env still wins):
   GPT : loss_chunk  -> DTTPU_BENCH_LOSS_CHUNK
         remat_policy-> DTTPU_BENCH_REMAT_POLICY
   BERT: mlm_gather  -> DTTPU_BENCH_MLM_GATHER
+        remat_dots  -> DTTPU_BENCH_BERT_REMAT
 
 A lever is promoted only when its arm beats the model's ``base`` arm by
 >= MIN_WIN (2%) — a tie is noise, and the base path keeps one fewer
@@ -37,6 +38,14 @@ GPT_LEVERS = {
 }
 BERT_LEVERS = {
     "mlm_gather": {"DTTPU_BENCH_MLM_GATHER": "1"},
+    # Provenance caveat: mfu_ablation's BERT arms ALL run remat=True
+    # (base = policy "full"), while bench_bert's default is remat OFF —
+    # so this mapping's 1.02x gate compares dots-vs-full, and flipping
+    # the bench row to dots additionally rests on the arm-level
+    # composite win over the measured no-remat bench row (168,819 vs
+    # 134,995 tok/s/chip, 08-01 window).  bench_bert's ladder only
+    # attempts b128 when remat is on.
+    "remat_dots": {"DTTPU_BENCH_BERT_REMAT": "dots"},
 }
 
 

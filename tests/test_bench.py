@@ -203,6 +203,17 @@ class TestPromoteLevers:
                        "DTTPU_BENCH_MLM_GATHER": "1"}
         assert {e["model"] for e in evidence} == {"gpt", "bert"}
 
+    def test_bert_remat_dots_promotes(self):
+        # the 08-01 hardware table's shape: bert remat_dots is a pure
+        # +12% lever and must map onto DTTPU_BENCH_BERT_REMAT
+        rows = [
+            {"model": "bert", "arm": "base", "tokens_per_sec": 131123.0},
+            {"model": "bert", "arm": "remat_dots",
+             "tokens_per_sec": 147351.0},
+        ]
+        env, _ = self._promote(rows)
+        assert env == {"DTTPU_BENCH_BERT_REMAT": "dots"}
+
     def test_composite_arms_never_promote(self):
         # a composite arm can WIN the table without promoting env levers:
         # its batch move has no env knob
