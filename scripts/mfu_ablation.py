@@ -97,7 +97,9 @@ def run_gpt(arms):
         seq, batch = a.get("seq", 256), a.get("batch", 48)
         vocab = a.get("vocab", 50257)
         if SMOKE:
-            seq, batch = min(seq, 64), min(batch, 4)
+            # smoke batch stays tiny but must divide over the data mesh
+            seq, batch = min(seq, 64), max(min(batch, 4),
+                                           len(jax.devices()))
         config = GPTConfig(vocab_size=vocab, hidden_size=64 if SMOKE else 768,
                            num_layers=2 if SMOKE else 12,
                            num_heads=2 if SMOKE else 12,
@@ -159,12 +161,20 @@ def run_bert(arms):
         "remat_dots":        dict(remat_policy="dots"),
         "remat_dots_gather": dict(remat_policy="dots", mlm_gather=True,
                                   batch=128),
+        # fused_ln measured +6.4% pure (08-01) but its composition with
+        # the winning remat_dots_gather arm is UNMEASURED (a custom-vjp
+        # Pallas LN inside a remat region changes what gets saved) —
+        # this arm decides whether the fused-LN lever joins the default
+        "remat_dots_gather_ln": dict(remat_policy="dots", mlm_gather=True,
+                                     batch=128, fused_layernorm=True),
     }
     for arm in arms or MATRIX:
         a = MATRIX[arm]
         seq, batch = a.get("seq", 128), a.get("batch", 64)
         if SMOKE:
-            seq, batch = min(seq, 64), min(batch, 4)
+            # smoke batch stays tiny but must divide over the data mesh
+            seq, batch = min(seq, 64), max(min(batch, 4),
+                                           len(jax.devices()))
         kw = (dict(vocab_size=512, hidden_size=64, num_layers=2,
                    num_heads=2, intermediate_size=128) if SMOKE else {})
         config = BertConfig(max_position=seq, dtype=jnp.bfloat16,
@@ -211,6 +221,73 @@ def run_bert(arms):
                               "error": str(e)[:160]}), flush=True)
 
 
+def run_llama(arms):
+    """The bench_llama model (rmsnorm/swiglu/rope/GQA 12q/4kv, ~160M
+    params) through the same arm harness: the 08-01 window covered only
+    gpt/bert, so the llama row's levers are unmeasured — in particular
+    whether remat_dots helps (it did for BERT +12%, it HURT for GPT -4%).
+    No fused-LN arm: llama's rmsnorm path has no fused kernel
+    (models/gpt.py _norm dispatches rmsnorm before consulting
+    fused_layernorm), so that arm would silently measure base."""
+    from distributed_tensorflow_tpu import optim, parallel, train
+    from distributed_tensorflow_tpu.models.gpt import GPT
+    from distributed_tensorflow_tpu.models.llama import llama_config
+
+    mesh = parallel.data_parallel_mesh()
+    bsh = NamedSharding(mesh, P("data"))
+    rng = np.random.default_rng(0)
+    peak = peak_flops()
+
+    MATRIX = {
+        "base":       dict(),                      # remat full, b48 s256
+        "remat_dots": dict(remat_policy="dots"),
+        "batch96":    dict(batch=96),
+    }
+    for arm in arms or MATRIX:
+        a = MATRIX[arm]
+        seq, batch = a.get("seq", 256), a.get("batch", 48)
+        if SMOKE:
+            # smoke batch stays tiny but must divide over the data mesh
+            seq, batch = min(seq, 64), max(min(batch, 4),
+                                           len(jax.devices()))
+        kw = (dict(vocab_size=512, hidden_size=128, num_layers=2,
+                   num_heads=4, num_kv_heads=2, intermediate_size=384)
+              if SMOKE else
+              dict(vocab_size=32000, hidden_size=768, num_layers=12,
+                   num_heads=12, num_kv_heads=4, intermediate_size=2048))
+        config = llama_config(max_position=seq, dtype=jnp.bfloat16,
+                              remat=True,
+                              remat_policy=a.get("remat_policy", "full"),
+                              fused_layernorm=a.get("fused_layernorm",
+                                                    False), **kw)
+        model = GPT(config)
+        optimizer = optim.adamw(1e-4)
+        step = train.make_custom_train_step(model.lm_loss_fn(), optimizer,
+                                            grad_clip_norm=1.0)
+        try:
+            params = model.init(jax.random.PRNGKey(0))
+            n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+            state = train.TrainState.create(params, optimizer.init(params))
+            state = jax.device_put(state, NamedSharding(mesh, P()))
+            tokens = rng.integers(0, config.vocab_size,
+                                  (batch, seq + 1)).astype(np.int32)
+            bb = jax.device_put({"input_ids": tokens}, bsh)
+            dt, loss = time_step(step, state, bb)
+            toks = batch * seq / dt
+            f_tok = (6.0 * n_params
+                     + 12.0 * config.num_layers * config.hidden_size * seq)
+            out = {"model": "llama", "arm": arm, "batch": batch, "seq": seq,
+                   "backend": jax.devices()[0].platform, "smoke": SMOKE,
+                   "tokens_per_sec": round(toks, 1),
+                   "ms_per_step": round(dt * 1e3, 2), "loss": round(loss, 3)}
+            if peak:
+                out["mfu"] = round(toks * f_tok / peak, 4)
+            print(json.dumps(out), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"model": "llama", "arm": arm,
+                              "error": str(e)[:160]}), flush=True)
+
+
 def main():
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} ({getattr(dev, 'device_kind', '?')})",
@@ -221,6 +298,8 @@ def main():
         run_gpt(arms if which == "gpt" else None)
     if which in ("bert", "all"):
         run_bert(arms if which == "bert" else None)
+    if which in ("llama", "all"):
+        run_llama(arms if which == "llama" else None)
     return 0
 
 

@@ -76,6 +76,14 @@ step timeout 1200 python bench.py --config=gpt
 step timeout 1200 python bench.py --config=bert
 step timeout 1200 python bench.py --config=llama
 
+# Second-round ablation arms the 08:29Z window didn't cover: (a) the
+# fused-LN composite on top of BERT's winning remat_dots_gather arm
+# (decides whether the fused-LN lever joins the default — both arms
+# re-run in ONE window so the comparison is clean), (b) the llama arm
+# set (remat_dots helped BERT +12% but hurt GPT -4%; llama is unmeasured).
+step timeout 1200 sh -c 'python scripts/mfu_ablation.py bert remat_dots_gather remat_dots_gather_ln | tee -a logs/ablation_followup.jsonl'
+step timeout 1200 sh -c 'python scripts/mfu_ablation.py llama | tee -a logs/ablation_followup.jsonl'
+
 # one-step op profile (top time sinks for the MFU analysis)
 step timeout 900 python scripts/profile_gpt_step.py gpt /tmp/prof_gpt
 step timeout 900 python scripts/profile_gpt_step.py bert /tmp/prof_bert
