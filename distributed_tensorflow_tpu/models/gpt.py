@@ -63,10 +63,10 @@ class GPTConfig:
     # Hardware-validated + measured 2026-07-31 (docs/PERF.md): ties XLA at
     # seq <= 1024, wins 1.3-1.7x at 2048, ~3x at 4096 — "auto" is safe.
     use_flash: Any = "auto"
-    # True / False / "auto": block LayerNorms via the fused Pallas kernel
-    # (ops.pallas.fused_layernorm); auto = TPU only; layernorm norm only
-    # (the rmsnorm path has no fused kernel).  Default False until the
-    # end-to-end win is measured on hardware.
+    # True / False / "auto": block norms via the fused Pallas kernel —
+    # ops.pallas.fused_layernorm for norm="layernorm",
+    # ops.pallas.fused_rmsnorm for norm="rmsnorm"; auto = TPU only.
+    # Default False until the end-to-end win is measured on hardware.
     fused_layernorm: Any = False
     # >0: compute the LM loss ``loss_seq_chunk`` tokens at a time (head
     # projection + log-softmax reduced per chunk under jax.checkpoint) so
@@ -284,13 +284,16 @@ class GPT:
         (Llama: f32 rms, gamma scale, no centering — matches HF
         LlamaRMSNorm numerics)."""
         c = self.config
+        from ..ops.pallas import resolve_fused_ln
         if c.norm == "rmsnorm":
+            if resolve_fused_ln(c.fused_layernorm):
+                from ..ops.pallas import fused_rmsnorm
+                return fused_rmsnorm(x, p["gamma"], c.layer_norm_eps)
             xf = x.astype(jnp.float32)
             y = xf * jax.lax.rsqrt(
                 jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
                 + c.layer_norm_eps)
             return (y * p["gamma"]).astype(x.dtype)
-        from ..ops.pallas import resolve_fused_ln
         return _layer_norm(p, x, c.layer_norm_eps,
                            fused=resolve_fused_ln(c.fused_layernorm))
 

@@ -11,7 +11,7 @@ Every kernel runs in Pallas interpret mode off-TPU so the whole test suite
 exercises the real kernel code paths on the virtual CPU mesh.
 """
 from .flash_attention import flash_attention, make_flash_attention_fn
-from .fused import (fused_adam_update, fused_layernorm,
+from .fused import (fused_adam_update, fused_layernorm, fused_rmsnorm,
                     resolve_fused_ln)
 
 __all__ = [
@@ -19,5 +19,6 @@ __all__ = [
     "make_flash_attention_fn",
     "fused_adam_update",
     "fused_layernorm",
+    "fused_rmsnorm",
     "resolve_fused_ln",
 ]

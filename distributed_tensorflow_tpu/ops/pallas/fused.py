@@ -1,4 +1,4 @@
-"""Fused elementwise Pallas kernels: Adam update and LayerNorm.
+"""Fused elementwise Pallas kernels: Adam update, LayerNorm, RMSNorm.
 
 The reference's optimizer/normalisation math runs as individual C++/Eigen
 ops inside TF 1.4 (reference example.py:168-170); here the whole update is
@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fused_adam_update", "fused_layernorm", "resolve_fused_ln"]
+__all__ = ["fused_adam_update", "fused_layernorm", "fused_rmsnorm",
+           "resolve_fused_ln"]
 
 
 def resolve_fused_ln(flag) -> bool:
@@ -217,4 +218,76 @@ def fused_layernorm(x: jnp.ndarray, gamma: jnp.ndarray, beta: jnp.ndarray,
     lead = x.shape[:-1]
     out2 = _layernorm(x.reshape(-1, d), gamma, beta, float(eps),
                       bool(interpret))
+    return out2.reshape(*lead, d)
+
+
+# ---------------------------------------------------------------------------
+# Fused RMSNorm (the Llama block norm: f32 rms, gamma scale, no centering)
+# ---------------------------------------------------------------------------
+
+def _rmsnorm_kernel(x_ref, gamma_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)                       # [br, d]
+    inv = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    o_ref[:] = (x * inv * gamma_ref[:].astype(jnp.float32)
+                ).astype(o_ref.dtype)
+
+
+def _rmsnorm_forward(x2, gamma, eps, interpret):
+    rows, d = x2.shape
+    br = min(_BLOCK_ROWS, rows)
+    pad = (-rows) % br
+    xp = jnp.pad(x2, ((0, pad), (0, 0))) if pad else x2
+    out = pl.pallas_call(
+        functools.partial(_rmsnorm_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct(xp.shape, x2.dtype),
+        grid=(xp.shape[0] // br,),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, d), lambda i: (i, 0)),
+        interpret=interpret,
+    )(xp, gamma.reshape(1, d))
+    return out[:rows]
+
+
+def _rmsnorm_reference(x, gamma, eps):
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv * gamma.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rmsnorm(x2, gamma, eps, interpret):
+    return _rmsnorm_forward(x2, gamma, eps, interpret)
+
+
+def _rmsnorm_fwd(x2, gamma, eps, interpret):
+    return _rmsnorm_forward(x2, gamma, eps, interpret), (x2, gamma)
+
+
+def _rmsnorm_bwd(eps, interpret, res, g):
+    x2, gamma = res
+    _, vjp = jax.vjp(lambda x_, g_: _rmsnorm_reference(x_, g_, eps),
+                     x2, gamma)
+    return vjp(g)
+
+
+_rmsnorm.defvjp(_rmsnorm_fwd, _rmsnorm_bwd)
+
+
+def fused_rmsnorm(x: jnp.ndarray, gamma: jnp.ndarray, eps: float = 1e-6,
+                  interpret: Optional[bool] = None) -> jnp.ndarray:
+    """RMSNorm over the last axis as a single fused kernel.
+
+    ``x``: [..., d]; ``gamma``: [d].  Same structure as
+    ``fused_layernorm`` (f32 statistics, padded row blocks, XLA-reference
+    backward under ``jax.vjp``) minus the centering and bias — matches
+    the model's HF-LlamaRMSNorm numerics (models/gpt.py ``_norm``).
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    out2 = _rmsnorm(x.reshape(-1, d), gamma, float(eps), bool(interpret))
     return out2.reshape(*lead, d)

@@ -225,10 +225,9 @@ def run_llama(arms):
     """The bench_llama model (rmsnorm/swiglu/rope/GQA 12q/4kv, ~160M
     params) through the same arm harness: the 08-01 window covered only
     gpt/bert, so the llama row's levers are unmeasured — in particular
-    whether remat_dots helps (it did for BERT +12%, it HURT for GPT -4%).
-    No fused-LN arm: llama's rmsnorm path has no fused kernel
-    (models/gpt.py _norm dispatches rmsnorm before consulting
-    fused_layernorm), so that arm would silently measure base."""
+    whether remat_dots helps (it did for BERT +12%, it HURT for GPT -4%)
+    and whether the fused rmsnorm kernel (ops.pallas.fused_rmsnorm —
+    added after the window, parity-tested, Mosaic-unproven) wins."""
     from distributed_tensorflow_tpu import optim, parallel, train
     from distributed_tensorflow_tpu.models.gpt import GPT
     from distributed_tensorflow_tpu.models.llama import llama_config
@@ -241,6 +240,7 @@ def run_llama(arms):
     MATRIX = {
         "base":       dict(),                      # remat full, b48 s256
         "remat_dots": dict(remat_policy="dots"),
+        "fused_ln":   dict(fused_layernorm=True),  # fused_rmsnorm kernel
         "batch96":    dict(batch=96),
     }
     for arm in arms or MATRIX:

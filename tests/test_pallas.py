@@ -8,7 +8,7 @@ from distributed_tensorflow_tpu.ops.attention import (
     dot_product_attention, padding_mask, causal_mask)
 from distributed_tensorflow_tpu.ops.pallas import (
     flash_attention, make_flash_attention_fn, fused_adam_update,
-    fused_layernorm)
+    fused_layernorm, fused_rmsnorm)
 
 
 def _qkv(key, b=2, s=64, h=4, d=16, dtype=jnp.float32):
@@ -352,6 +352,63 @@ class TestFusedLayerNorm:
                       argnums=(0, 1, 2))(x, gamma, beta)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+class TestFusedRmsNorm:
+    def _ref(self, x, gamma, eps=1e-6):
+        x32 = x.astype(jnp.float32)
+        inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                            + eps)
+        return (x32 * inv * gamma.astype(jnp.float32)).astype(x.dtype)
+
+    def test_matches_reference(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 7, 96))
+        gamma = jax.random.normal(jax.random.PRNGKey(1), (96,)) + 1.0
+        got = fused_rmsnorm(x, gamma)
+        np.testing.assert_allclose(got, self._ref(x, gamma),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_bfloat16(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (8, 64), jnp.bfloat16)
+        gamma = jnp.ones((64,)) * 1.5
+        got = fused_rmsnorm(x, gamma)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            got.astype(np.float32),
+            self._ref(x, gamma).astype(np.float32),
+            atol=3e-2, rtol=3e-2)
+
+    def test_gradients(self):
+        x = jax.random.normal(jax.random.PRNGKey(4), (6, 32))
+        gamma = jnp.ones((32,)) * 1.5
+        g1 = jax.grad(lambda x, g: jnp.sum(fused_rmsnorm(x, g) ** 2),
+                      argnums=(0, 1))(x, gamma)
+        g2 = jax.grad(lambda x, g: jnp.sum(self._ref(x, g) ** 2),
+                      argnums=(0, 1))(x, gamma)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+    def test_llama_model_parity(self):
+        """fused_layernorm=True on a rmsnorm model must reproduce the
+        unfused logits AND gradients — the whole _norm dispatch, not
+        just the kernel in isolation."""
+        from distributed_tensorflow_tpu.models.llama import llama_tiny
+        ids = np.arange(24, dtype=np.int32).reshape(2, 12) % 512
+
+        outs, grads = [], []
+        for fused in (False, True):
+            model = llama_tiny(fused_layernorm=fused)
+            params = model.init(jax.random.PRNGKey(0))
+            outs.append(model.apply(params, ids))
+            loss = model.lm_loss_fn()
+            g = jax.grad(lambda p: loss(
+                p, {}, {"input_ids": ids}, jax.random.PRNGKey(1),
+                False)[0])(params)
+            grads.append(g)
+        np.testing.assert_allclose(outs[0], outs[1], atol=2e-5, rtol=2e-5)
+        for a, b in zip(jax.tree.leaves(grads[0]),
+                        jax.tree.leaves(grads[1])):
+            np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-3)
 
 
 class TestFlashShapeFuzz:
