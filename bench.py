@@ -597,12 +597,21 @@ def bench_bert():
                          f"dots_no_batch; got {remat_policy!r}")
     remat = dict(remat=True, remat_policy=remat_policy) if remat_policy \
         else {}
+    # DTTPU_BENCH_BERT_FUSED_LN=1: the fused Pallas LayerNorm.  The pure
+    # arm measured +6.4% (08-01 ablation) but its composition with the
+    # promoted remat_dots+gather defaults is unmeasured — promote_levers
+    # deliberately has NO mapping for it until the composite arm
+    # (remat_dots_gather_ln, queued) is, so this knob is for measured
+    # flips only.
+    fused_ln = os.environ.get("DTTPU_BENCH_BERT_FUSED_LN") == "1"
     config = (BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
                          num_heads=2, intermediate_size=512,
                          max_position=seq, dtype=jnp.bfloat16,
-                         mlm_predictions_per_seq=gather, **remat) if SMOKE
+                         mlm_predictions_per_seq=gather,
+                         fused_layernorm=fused_ln, **remat) if SMOKE
               else BertConfig(max_position=seq, dtype=jnp.bfloat16,
-                              mlm_predictions_per_seq=gather, **remat))
+                              mlm_predictions_per_seq=gather,
+                              fused_layernorm=fused_ln, **remat))
     model = Bert(config)
     params = model.init(jax.random.PRNGKey(0))
     optimizer = optim.adamw(1e-4)
@@ -657,6 +666,8 @@ def bench_bert():
         result["mlm_predictions_per_seq"] = gather
     if remat_policy:
         result["remat_policy"] = remat_policy
+    if fused_ln:
+        result["fused_layernorm"] = True
     return _attach_mfu(
         result, tokens, _per_example_flops(f_total, batch * seq, mesh),
         analytic=analytic, scanned=True)
