@@ -835,17 +835,21 @@ def bench_llama():
     # rungs fit and the rematerialised step measured faster at equal batch
     chunk = int(os.environ.get("DTTPU_BENCH_LOSS_CHUNK", "0"))
     rpol = os.environ.get("DTTPU_BENCH_REMAT_POLICY", "full")
+    # DTTPU_BENCH_LLAMA_FUSED_LN=1: the fused rmsnorm kernel — measured
+    # flips only (no promote mapping until the llama fused_ln arm lands)
+    fused_ln = os.environ.get("DTTPU_BENCH_LLAMA_FUSED_LN") == "1"
     config = (llama_config(vocab_size=512, hidden_size=128, num_layers=2,
                            num_heads=4, num_kv_heads=2,
                            intermediate_size=384, max_position=seq,
                            dtype=jnp.bfloat16, remat=True,
-                           remat_policy=rpol,
+                           remat_policy=rpol, fused_layernorm=fused_ln,
                            loss_seq_chunk=chunk) if SMOKE
               else llama_config(vocab_size=32000, hidden_size=768,
                                 num_layers=12, num_heads=12,
                                 num_kv_heads=4, intermediate_size=2048,
                                 max_position=seq, dtype=jnp.bfloat16,
                                 remat=True, remat_policy=rpol,
+                                fused_layernorm=fused_ln,
                                 loss_seq_chunk=chunk))
     model = GPT(config)
     params = model.init(jax.random.PRNGKey(0))
@@ -882,6 +886,8 @@ def bench_llama():
         result["loss_seq_chunk"] = config.loss_seq_chunk
     if config.remat_policy != "full":
         result["remat_policy"] = config.remat_policy
+    if fused_ln:
+        result["fused_layernorm"] = True
     return _attach_mfu(
         result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
         analytic=_transformer_flops_per_token(params, config.num_layers,
