@@ -604,12 +604,19 @@ def bench_bert():
     # (remat_dots_gather_ln, queued) is, so this knob is for measured
     # flips only.
     fused_ln = os.environ.get("DTTPU_BENCH_BERT_FUSED_LN") == "1"
+    # dropout_rate=0.0: aligns this row with the gpt/llama rows (and with
+    # every mfu_ablation arm) — BertConfig's 0.1 default was the ONLY LM
+    # row still paying per-layer dropout mask generation, which measured
+    # 47% on 2026-08-01 (bench row 119,627 vs the same-lever ablation arm
+    # 176,237 tok/s/chip, logs/followups_r5b.log).
     config = (BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
                          num_heads=2, intermediate_size=512,
                          max_position=seq, dtype=jnp.bfloat16,
+                         dropout_rate=0.0,
                          mlm_predictions_per_seq=gather,
                          fused_layernorm=fused_ln, **remat) if SMOKE
               else BertConfig(max_position=seq, dtype=jnp.bfloat16,
+                              dropout_rate=0.0,
                               mlm_predictions_per_seq=gather,
                               fused_layernorm=fused_ln, **remat))
     model = Bert(config)

@@ -85,6 +85,26 @@ def main():
             dv = dv.reshape(b_, s_, h_ // group, group, d_).sum(3)
         return out, (dq, dk, dv)
 
+    def gate_vs_f64(named_tensors, floor, key):
+        """Self-calibrating parity gate shared by phases 1 and 1b: each
+        kernel tensor's max-abs error vs the float64 ground truth must be
+        no worse than 2x the XLA path's own error (or inside the floor).
+        ``named_tensors`` yields (name, kernel_t, xla_t, gt_t); ``key`` is
+        the kernel-error label ("flash_vs_f64" / "ring_vs_f64")."""
+        errs, ok = {}, True
+        for tname, kern_t, xla_t, gt_t in named_tensors:
+            ek = float(np.abs(np.asarray(kern_t, np.float64) - gt_t).max())
+            ex = float(np.abs(np.asarray(xla_t, np.float64) - gt_t).max())
+            errs[tname] = {key: round(ek, 6), "xla_vs_f64": round(ex, 6)}
+            # 2.0x: same order of magnitude as the incumbent's own
+            # rounding error is noise (measured spread 0.5-1.55x across
+            # tensors); real kernel bugs show up orders of magnitude
+            # out (the interpret-hidden tiling bug gave O(1) diffs).
+            # Inverted form so a NaN error FAILS (NaN <= x is False).
+            if not ek <= max(2.0 * ex, floor):
+                ok = False
+        return errs, ok
+
     failures = 0
     cases = [
         ("plain_f32", dict(b=2, s=256, h=4, d=64, dtype=jnp.float32),
@@ -141,25 +161,11 @@ def main():
             gt_out, gt_grads = gt_fwd_bwd(q, k, v, maskkind == "causal",
                                           valid_np)
             floor = 6e-2 if shp["dtype"] == jnp.bfloat16 else 2e-4
-            errs, ok = {}, True
-            for tname, flash_t, xla_t, gt_t in [
-                    ("out", o1, o2, gt_out),
-                    ("dq", g1[0], g2[0], gt_grads[0]),
-                    ("dk", g1[1], g2[1], gt_grads[1]),
-                    ("dv", g1[2], g2[2], gt_grads[2])]:
-                ef = float(np.abs(np.asarray(flash_t, np.float64)
-                                  - gt_t).max())
-                ex = float(np.abs(np.asarray(xla_t, np.float64)
-                                  - gt_t).max())
-                errs[tname] = {"flash_vs_f64": round(ef, 6),
-                               "xla_vs_f64": round(ex, 6)}
-                # 2.0x: same order of magnitude as the incumbent's own
-                # rounding error is noise (measured spread 0.5-1.55x across
-                # tensors); real kernel bugs show up orders of magnitude
-                # out (the interpret-hidden tiling bug gave O(1) diffs).
-                # Inverted form so a NaN error FAILS (NaN > x is False).
-                if not ef <= max(2.0 * ex, floor):
-                    ok = False
+            errs, ok = gate_vs_f64(
+                [("out", o1, o2, gt_out),
+                 ("dq", g1[0], g2[0], gt_grads[0]),
+                 ("dk", g1[1], g2[1], gt_grads[1]),
+                 ("dv", g1[2], g2[2], gt_grads[2])], floor, "flash_vs_f64")
             if not ok:
                 failures += 1
             print(json.dumps({"check": name, "ok": ok, "err": errs}),
@@ -201,17 +207,20 @@ def main():
         g_rf = jax.jit(jax.grad(rf_loss, argnums=(0, 1, 2)))(q, k, v)
         g_ref = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
         o_ref = dot_product_attention(q, k, v, mask=cm512)
-        errs = {"out": float(np.abs(np.asarray(o_rf, np.float64)
-                                    - np.asarray(o_ref, np.float64)).max())}
-        for tname, a, b in zip(("dq", "dk", "dv"), g_rf, g_ref):
-            errs[tname] = float(np.abs(np.asarray(a, np.float64)
-                                       - np.asarray(b, np.float64)).max())
-        # inverted form: a NaN error FAILS (NaN < x is False)
-        ok = all(e < 6e-2 for e in errs.values())
+        # Self-calibrating gate, same as phase 1: both paths run bf16 on
+        # the MXU, so ring-vs-XLA diffs measure rounding-order noise (the
+        # 2026-08-01 window showed XLA's OWN dq/dk error vs float64 is
+        # ~0.15 at these shapes, and a fixed 6e-2 ring-vs-XLA tolerance
+        # flagged exactly that noise as a failure).  Gate each tensor on
+        # the float64 host ground truth instead.
+        gt_out, gt_grads = gt_fwd_bwd(q, k, v, True, None)
+        errs, ok = gate_vs_f64(
+            [("out", o_rf, o_ref, gt_out),
+             ("dq", g_rf[0], g_ref[0], gt_grads[0]),
+             ("dk", g_rf[1], g_ref[1], gt_grads[1]),
+             ("dv", g_rf[2], g_ref[2], gt_grads[2])], 6e-2, "ring_vs_f64")
         print(json.dumps({"check": "ring_flash_1dev_compile", "ok": ok,
-                          "max_abs_vs_xla": {t: round(e, 6)
-                                             for t, e in errs.items()}}),
-              flush=True)
+                          "err": errs}), flush=True)
         if not ok:
             return 1
     except Exception as e:  # noqa: BLE001 - report and fail
