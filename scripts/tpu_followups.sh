@@ -2,16 +2,18 @@
 # Queued hardware measurements for the next tunnel-up window (run from the
 # repo root; each step prints one JSON line or a short table to stdout).
 #
-# Round-5 queue, ordered by VERDICT r4's item priority so a SHORT window
-# lands the most important evidence first:
-#   1. flagship driver-comparable bench row (mnist_mlp)
-#   2. MFU ablation -> promote winners -> re-measure LM rows under them
-#   3. ring-flash/flash Mosaic-compiled validation (the correctness risk)
-#   4. decode rows + operating-point ladder
-#   then: gpt_long / gpt_moe / op profiles / BERT tuner.
-# The tunnel is re-probed before every step so a mid-queue outage aborts
-# in 45 s instead of burning each remaining step's full timeout; the
-# watcher (tpu_watcher.sh) retries the queue at the next window, capped.
+# Round-5 retry queue, third edition (2026-08-01 ~19:45Z).  Everything
+# from the original round-5 queue was captured at the 08:29Z and 18:35Z
+# windows (docs/PERF.md, docs/evidence_r5/): flagship bench, both MFU
+# ablations + promotion + re-measures, flash + ring-flash validation
+# (8/8 after the f64-oracle re-gate), crossover, decode rows + ladder,
+# gpt_long/gpt_moe, profiles, bert tuner, second-round ablation arms,
+# and the bert dropout-aligned row (168,983 tok/s/chip).
+#
+# Still pending — the trained-weights decode honesty rows (the 18:35Z
+# capture proved match/floor 1.000 but its fp_value was poisoned by a
+# host-resident params tree, fixed in bench.py right as the tunnel
+# dropped at ~19:40Z):
 set -x
 
 probe() {
@@ -28,69 +30,17 @@ step() {
 
 probe || exit 2
 
-# CAPTURED at the 08:29Z-09:03Z window of 2026-08-01 (logs/followups_r5.log,
-# steps removed from the queue so a retry window spends nothing re-running
-# them): flagship bench.py (mnist 19.74M ex/s/chip, vs_baseline 97.013, no
-# fallback label), both MFU ablations (25 TPU arms each, logs/ablation_*.jsonl,
-# .ok markers kept), lever promotion (docs/PROMOTED.json: MLM_GATHER=1),
-# gpt/bert/llama re-measures under the promotion (115,652 / 134,995 /
-# 138,589 tok/s/chip), and validate_flash_tpu's 7 kernel parity checks (all
-# ok, Mosaic-compiled).  The tunnel dropped mid-validate before the
-# ring-flash compile leg + crossover, so validate re-runs below.
+# int8 decode with trained weights + device-resident params: clean
+# fp_value plus the match/floor honesty fields
+step timeout 1200 python bench.py --config=gpt_decode_int8
 
-# 4 BEFORE 3 for the retry window: decode (VERDICT item 4) has ZERO
-# captured rows while item 3's headline risk is already resolved (7/7
-# kernel parity checks passed Mosaic-compiled in the first window; only
-# the ring-flash 1-dev compile leg + crossover timing remain) — a short
-# second window must land the never-measured evidence first.
+# speculative decode at a REALISTIC acceptance (target trained on the
+# Markov corpus, draft distilled 100 steps): the machinery's hardware
+# speedup, never yet measured above acceptance 0.022
+step timeout 1200 python bench.py --config=gpt_decode_spec
 
-# 4. decode throughput after the cache-carry fix (pre-fix: 7,017 tok/s)
-step timeout 900 python bench.py --config=gpt_decode
-
-#    int8 decode row (fp rate + greedy agreement from the same run)
-step timeout 900 python bench.py --config=gpt_decode_int8
-
-#    speculative decode row (truncated-draft; exact-match honesty check)
-step timeout 900 python bench.py --config=gpt_decode_spec
-
-#    decode operating-point ladder: batch x seq sweep (where the decode
-#    number sits vs the achievable ceiling — VERDICT r4 item 4)
-step timeout 1800 python scripts/decode_ladder.py
-
-# 3. flash + ring-flash Mosaic-compiled validation: the ring-flash leg +
-#    crossover are still unseen on hardware (the 7 parity checks re-run
-#    too — cheap, and a second same-day sample).
-step timeout 1200 python scripts/validate_flash_tpu.py
-
-# the flash-dispatch operating point (seq 2048)
-step timeout 1200 python bench.py --config=gpt_long
-
-# MoE row: an actual number for the 85b4bf0 claim
-step timeout 1200 python bench.py --config=gpt_moe
-
-# Rows under the corrected flops accounting (the scan-undercount fix in
-# _attach_mfu: XLA cost_analysis counts a lax.scan body once, so rounds 2-4
-# understated scanned-program mfu by ~the trip count — the LM layer stacks
-# AND the mnist K-step multi-dispatch).  Throughput should match the
-# 08:29Z window's rows; only the mfu/flops fields change meaning.  Ahead
-# of the profilers per this file's ordering rule: a short window must land
-# record-bearing rows before diagnostics.
+# re-confirm the flagship + the bert row (the one whose config changed
+# since its last capture) so the round-end driver bench has a fresh
+# same-day twin; the other main rows keep their 18:35Z samples
 step timeout 900 python bench.py
-step timeout 1200 python bench.py --config=gpt
 step timeout 1200 python bench.py --config=bert
-step timeout 1200 python bench.py --config=llama
-
-# Second-round ablation arms the 08:29Z window didn't cover: (a) the
-# fused-LN composite on top of BERT's winning remat_dots_gather arm
-# (decides whether the fused-LN lever joins the default — both arms
-# re-run in ONE window so the comparison is clean), (b) the llama arm
-# set (remat_dots helped BERT +12% but hurt GPT -4%; llama is unmeasured).
-step timeout 1200 sh -c 'python scripts/mfu_ablation.py bert remat_dots_gather remat_dots_gather_ln | tee -a logs/ablation_followup.jsonl'
-step timeout 1200 sh -c 'python scripts/mfu_ablation.py llama | tee -a logs/ablation_followup.jsonl'
-
-# one-step op profile (top time sinks for the MFU analysis)
-step timeout 900 python scripts/profile_gpt_step.py gpt /tmp/prof_gpt
-step timeout 900 python scripts/profile_gpt_step.py bert /tmp/prof_bert
-
-# BERT remat/batch operating point (decides whether bench_bert flips remat)
-step timeout 900 python scripts/tune_bert_batch.py

@@ -421,6 +421,24 @@ class TestGptLong:
         assert r["value"] > 0 and r["fp_value"] > 0
         assert r["greedy_token_match"] > 0.9
 
+    def test_gpt_decode_spec_smoke(self):
+        """Speculative decode: trains the target, distills the truncated
+        draft (the donation-sensitive deep-copy path — a dropped copy
+        deletes the target's shared embedding/head buffers and crashes
+        here), and must keep the exactness guarantee: spec output ==
+        plain greedy output."""
+        proc = _run(["--config=gpt_decode_spec", "--device=cpu"],
+                    _env(DTTPU_BENCH_SEQ=64))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1
+        r = json.loads(lines[0])
+        assert r["metric"].startswith("gpt_decode_spec_tokens_per_sec")
+        assert r["value"] > 0 and r["plain_value"] > 0
+        assert r["greedy_token_match"] > 0.9
+        assert 0.0 <= r["acceptance"] <= 1.0
+        assert r["trained_steps"] > 0
+
     def test_gpt_moe_smoke(self):
         proc = _run(["--config=gpt_moe", "--device=cpu"],
                     _env(DTTPU_BENCH_SEQ=64))
