@@ -9,6 +9,8 @@ HBM-bandwidth-bound: throughput should rise with batch until the cache
 traffic saturates, then flatten).
 
 Run on TPU (queued in tpu_followups.sh):  python scripts/decode_ladder.py
+Full-int8 cells (int8 weights + int8 KV cache — the serving ceiling):
+                   python scripts/decode_ladder.py int8
 CPU wiring check:  DTTPU_ABLATION_SMOKE=1 python scripts/decode_ladder.py
 """
 from __future__ import annotations
@@ -29,13 +31,27 @@ def main() -> int:
     if SMOKE:
         import jax
         jax.config.update("jax_platforms", "cpu")
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
     from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
 
+    # "int8" argv: the FULL-int8 serving point (int8 weights in HBM +
+    # int8 KV cache) over the same cells — decode is bandwidth-bound, so
+    # this is the achievable serving ceiling the fp ladder can't show.
+    # Unknown args fail FAST: a typo must not burn an 1800s queue slot
+    # re-measuring the fp ladder mislabeled.
+    extra = [a for a in sys.argv[1:] if a != "int8"]
+    if extra:
+        print(f"unknown argument(s) {extra}; only 'int8' is accepted",
+              file=sys.stderr)
+        return 1
+    int8 = "int8" in sys.argv[1:]
     dev = jax.devices()[0]
-    print(f"device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    print(f"device: {dev.platform} ({dev.device_kind})"
+          + (" [full-int8]" if int8 else ""), file=sys.stderr)
 
     # the bench.py gpt model (GPT-2-small) so cells are comparable to the
     # recorded gpt_decode row; SMOKE shrinks like bench.py's smoke config
@@ -56,15 +72,24 @@ def main() -> int:
     prompt_len = 8
     rng = np.random.default_rng(0)
     rows = []
+    if int8:
+        from distributed_tensorflow_tpu.ops import quant
+        prep = quant.dequantize_tree          # runs INSIDE the jit
+    else:
+        prep = lambda t: t  # noqa: E731 - identity for the fp cells
     for seq, config in cfgs.items():
+        if int8:
+            config = dataclasses.replace(config, kv_cache_dtype="int8")
         model = GPT(config)
         params = model.init(jax.random.PRNGKey(0))
+        if int8:
+            params = quant.quantize_tree(params)
         new_tokens = (16 if SMOKE else seq - prompt_len)
         for batch in batches:
             prompt = rng.integers(0, config.vocab_size,
                                   (batch, prompt_len)).astype(np.int32)
             gen = jax.jit(lambda p, ids, m=model, nt=new_tokens, s=seq:
-                          m.generate(p, ids, max_new_tokens=nt,
+                          m.generate(prep(p), ids, max_new_tokens=nt,
                                      temperature=0.0, max_len=s))
             try:
                 np.asarray(gen(params, prompt))      # compile + warmup
@@ -88,17 +113,17 @@ def main() -> int:
             print(f"seq {seq} batch {batch:4d}: {rate:10,.0f} tok/s/chip "
                   f"({dt * 1e3 / new_tokens:7.3f} ms/token)", flush=True)
 
+    name = "gpt_decode_ladder_int8" if int8 else "gpt_decode_ladder"
     for r in rows:
-        print(json.dumps({"metric": "gpt_decode_ladder", **r}))
+        print(json.dumps({"metric": name, **r}))
     if not rows:
         # every rung failed: say so loudly AND fail the queue step — a
         # silent rc 0 here would let the watcher log QUEUE-COMPLETE with
         # the ladder evidence missing
-        print(json.dumps({"metric": "gpt_decode_ladder_FAILED",
-                          "value": 0.0}))
+        print(json.dumps({"metric": name + "_FAILED", "value": 0.0}))
         return 1
     best = max(rows, key=lambda r: r["tokens_per_sec_per_chip"])
-    print(json.dumps({"metric": "gpt_decode_ladder_best", **best}))
+    print(json.dumps({"metric": name + "_best", **best}))
     return 0
 
 

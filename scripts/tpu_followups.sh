@@ -44,3 +44,9 @@ step timeout 1200 python bench.py --config=gpt_decode_spec
 # same-day twin; the other main rows keep their 18:35Z samples
 step timeout 900 python bench.py
 step timeout 1200 python bench.py --config=bert
+
+# full-int8 decode ladder: the serving CEILING (int8 weights + int8 KV
+# over the same batch x seq cells as the captured fp ladder — decode is
+# bandwidth-bound, so halved weight+cache traffic should push the
+# batch-256 ceiling well past the fp 59,099)
+step timeout 1800 python scripts/decode_ladder.py int8
