@@ -788,6 +788,11 @@ def bench_gpt(seq=None, experts=None):
     ladder = ([4] if SMOKE else
               [max(1, 48 * 256 // seq), max(1, 24 * 256 // seq),
                max(1, 12 * 256 // seq)])
+    if config.loss_seq_chunk and not SMOKE:
+        # chunked LM loss removes the [tokens, vocab] logits wall (~2.5GB f32
+        # at seq 2048 batch 6) — the explicit A/B lever earns a 2x rung
+        # the plain ladder can't attempt
+        ladder = [max(1, 96 * 256 // seq)] + ladder
     rate, loss, ms, batch, f_total = _run_batch_ladder(
         "gpt", ladder, mesh, build, step,
         warmup=2, steps=4 if SMOKE else 10)

@@ -50,3 +50,9 @@ step timeout 1200 python bench.py --config=bert
 # bandwidth-bound, so halved weight+cache traffic should push the
 # batch-256 ceiling well past the fp 59,099)
 step timeout 1800 python scripts/decode_ladder.py int8
+
+# gpt_long A/B: chunked LM loss at seq 2048 — removes the ~2.5GB f32 logits
+# materialisation and earns a batch-12 ladder rung (captured plain row:
+# 68,670 tok/s at batch 6, mfu 0.341; the chunk lever measured neutral
+# at seq 256 where logits are small, but 2048 is where it exists for)
+step timeout 1500 sh -c 'DTTPU_BENCH_LOSS_CHUNK=512 python bench.py --config=gpt_long'
