@@ -56,3 +56,10 @@ step timeout 1800 python scripts/decode_ladder.py int8
 # 68,670 tok/s at batch 6, mfu 0.341; the chunk lever measured neutral
 # at seq 256 where logits are small, but 2048 is where it exists for)
 step timeout 1500 sh -c 'DTTPU_BENCH_LOSS_CHUNK=512 python bench.py --config=gpt_long'
+
+# mnist dispatch ladder: the headline is dispatch-bound (mfu 0.06 at
+# K=64, ~160us of device work per RTT-amortised step) — measure K=128
+# and K=256; if one wins, flip STEPS_PER_CALL's default so the
+# driver's round-end plain `python bench.py` inherits it
+step timeout 900 sh -c 'DTTPU_BENCH_STEPS=128 python bench.py'
+step timeout 900 sh -c 'DTTPU_BENCH_STEPS=256 python bench.py'
