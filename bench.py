@@ -1156,7 +1156,10 @@ def bench_gpt_decode_spec():
                                  2 if SMOKE else 100, sample,
                                  min(128, seq), seed=11)
     prompt_len = 8
-    gamma = 4
+    # DTTPU_BENCH_SPEC_GAMMA: proposals per verify step — the speedup
+    # curve's x-axis (more proposals amortise the target pass further
+    # but waste more draft work per rejection); 4 is the bench default
+    gamma = int(os.environ.get("DTTPU_BENCH_SPEC_GAMMA", "4"))
     # the learned position table has seq rows; speculative windows embed
     # positions up to total + gamma - 2, so leave gamma - 1 headroom
     new_tokens = 16 if SMOKE else seq - prompt_len - gamma + 1

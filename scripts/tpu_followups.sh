@@ -63,3 +63,8 @@ step timeout 1500 sh -c 'DTTPU_BENCH_LOSS_CHUNK=512 python bench.py --config=gpt
 # driver's round-end plain `python bench.py` inherits it
 step timeout 900 sh -c 'DTTPU_BENCH_STEPS=128 python bench.py'
 step timeout 900 sh -c 'DTTPU_BENCH_STEPS=256 python bench.py'
+
+# speculative gamma pair: one point on either side of the default 4 —
+# the acceptance-vs-amortisation tradeoff curve (row discloses gamma)
+step timeout 1200 sh -c 'DTTPU_BENCH_SPEC_GAMMA=8 python bench.py --config=gpt_decode_spec'
+step timeout 1200 sh -c 'DTTPU_BENCH_SPEC_GAMMA=2 python bench.py --config=gpt_decode_spec'
