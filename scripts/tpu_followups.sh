@@ -68,3 +68,7 @@ step timeout 900 sh -c 'DTTPU_BENCH_STEPS=256 python bench.py'
 # the acceptance-vs-amortisation tradeoff curve (row discloses gamma)
 step timeout 1200 sh -c 'DTTPU_BENCH_SPEC_GAMMA=8 python bench.py --config=gpt_decode_spec'
 step timeout 1200 sh -c 'DTTPU_BENCH_SPEC_GAMMA=2 python bench.py --config=gpt_decode_spec'
+
+# flash validation with the extended crossover (4096 leg added): backs
+# the "~3x at 4096" builder probe with a validation-script measurement
+step timeout 1500 python scripts/validate_flash_tpu.py

@@ -229,8 +229,13 @@ def main():
         return 1
 
     # ---- phase 2: crossover timing --------------------------------------
+    # 4096 at batch 4: same token count as 2048 x 8 — the long-seq point
+    # backing PERF.md's "~3x at 4096" (builder probe) with a
+    # validation-script measurement
     b, h, d = 8, 12, 64
-    for seq in (512, 1024, 2048):
+    for seq in (512, 1024, 2048, 4096):
+        if seq == 4096:
+            b = 4
         q, k, v = qkv(jax.random.PRNGKey(1), b, seq, h, d, jnp.bfloat16)
         t_flash = time_fwd_bwd(
             lambda q, k, v: jnp.sum(flash_attention(
