@@ -1,0 +1,37 @@
+"""dtlint — JAX-aware static analysis for distributed-training hazards.
+
+Catches, *before anything is traced or compiled*, the bug classes that
+otherwise surface as silent recompiles or wrong numerics on the TPU:
+host syncs inside jit (DT101), PRNG key reuse (DT102), collectives naming
+unbound mesh axes (DT103), non-hashable static args (DT104), jit wrappers
+built in loop bodies (DT105), and reads of donated buffers (DT106).
+
+Run it as a module::
+
+    python -m distributed_tensorflow_tpu.analysis pkg/ --format json
+
+or programmatically::
+
+    from distributed_tensorflow_tpu import analysis
+    findings = analysis.analyze_paths(["distributed_tensorflow_tpu"])
+
+Suppress a single site with ``# dtlint: disable=DT101`` on the flagged
+line; grandfather existing debt with ``--write-baseline`` /
+``--baseline`` (see docs/ANALYSIS.md).  The analysis modules themselves
+are pure stdlib — analyzed code is parsed, never imported or traced
+(``python -m distributed_tensorflow_tpu.analysis`` does execute the
+parent package ``__init__``; set ``JAX_PLATFORMS=cpu`` where no
+accelerator should be touched).
+"""
+from .baseline import load_baseline, partition, write_baseline
+from .cli import analyze_file, analyze_paths, collect_files, main
+from .report import Finding, Severity, render_json, render_text
+from .rules import RULES, rule_catalog, run_rules
+from .walker import Source, SourceError
+
+__all__ = [
+    "Finding", "Severity", "Source", "SourceError", "RULES",
+    "analyze_file", "analyze_paths", "collect_files", "main",
+    "render_json", "render_text", "rule_catalog", "run_rules",
+    "load_baseline", "partition", "write_baseline",
+]

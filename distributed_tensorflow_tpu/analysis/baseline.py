@@ -1,0 +1,81 @@
+"""Baseline handling: let existing debt through, block new findings.
+
+A baseline entry fingerprints a finding by (path, rule, stripped source
+line text, occurrence index) — NOT by line number, so unrelated edits above
+a baselined finding don't invalidate it.  Workflow:
+
+  python -m distributed_tensorflow_tpu.analysis pkg --write-baseline FILE
+  python -m distributed_tensorflow_tpu.analysis pkg --baseline FILE   # CI
+
+New findings (no fingerprint in the file) fail the run; fixed findings
+leave stale entries behind, which ``--baseline`` reports as prunable.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Iterable, List, Tuple
+
+from .report import Finding
+
+__all__ = ["fingerprints", "write_baseline", "load_baseline",
+           "partition"]
+
+_VERSION = 1
+
+
+def _fp(path: str, rule: str, source_line: str, index: int) -> str:
+    blob = f"{path}::{rule}::{source_line}::{index}".encode("utf-8")
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def fingerprints(findings: Iterable[Finding]) -> List[Tuple[str, Finding]]:
+    """Stable (fingerprint, finding) pairs; duplicates get an index."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out: List[Tuple[str, Finding]] = []
+    for f in sorted(findings, key=Finding.sort_key):
+        key = (f.path, f.rule, f.source_line)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        out.append((_fp(*key, idx), f))
+    return out
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> int:
+    pairs = fingerprints(findings)
+    doc = {
+        "version": _VERSION,
+        "tool": "dtlint",
+        "entries": {fp: {"rule": f.rule, "path": f.path,
+                         "line": f.line, "message": f.message}
+                    for fp, f in pairs},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(pairs)
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("version") != _VERSION:
+        raise ValueError(f"{path}: unsupported baseline version "
+                         f"{doc.get('version')!r}")
+    return dict(doc.get("entries", {}))
+
+
+def partition(findings: Iterable[Finding], baseline: Dict[str, dict]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, baselined, stale_fingerprints)."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    used = set()
+    for fp, f in fingerprints(findings):
+        if fp in baseline:
+            old.append(f)
+            used.add(fp)
+        else:
+            new.append(f)
+    stale = sorted(set(baseline) - used)
+    return new, old, stale

@@ -1,0 +1,123 @@
+"""dtlint command line.
+
+  python -m distributed_tensorflow_tpu.analysis [paths...]
+      --format text|json       (default text)
+      --baseline FILE          tolerate findings recorded in FILE
+      --write-baseline FILE    snapshot current findings and exit 0
+      --select DT101,DT102     run only these rules
+      --ignore DT105           skip these rules
+      --list-rules             print the rule catalog
+
+Exit status: 0 when no non-baselined findings, 1 when new findings exist,
+2 on usage/parse errors.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Iterable, List, Optional, Set
+
+from . import baseline as baseline_lib
+from .context import mesh_axes_for
+from .report import Finding, render_json, render_text
+from .rules import rule_catalog, run_rules
+from .walker import Source, SourceError
+
+__all__ = ["main", "collect_files", "analyze_file", "analyze_paths"]
+
+
+def collect_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith((".", "__pycache__")))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def analyze_file(path: str, select: Optional[Set[str]] = None,
+                 ignore: Optional[Set[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    src = Source(path, text)
+    return run_rules(src, mesh_axes_for(path), select=select, ignore=ignore)
+
+
+def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
+                  ignore: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in collect_files(paths):
+        findings.extend(analyze_file(path, select=select, ignore=ignore))
+    return findings
+
+
+def _rule_set(spec: Optional[str]) -> Optional[Set[str]]:
+    if not spec:
+        return None
+    return {s.strip() for s in spec.split(",") if s.strip()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_tensorflow_tpu.analysis",
+        description="dtlint: static analysis for distributed-JAX hazards")
+    ap.add_argument("paths", nargs="*", default=["."],
+                    help="files or directories to analyze (default: .)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", metavar="FILE")
+    ap.add_argument("--write-baseline", metavar="FILE")
+    ap.add_argument("--select", metavar="IDS")
+    ap.add_argument("--ignore", metavar="IDS")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, sev, summary in rule_catalog():
+            print(f"{rid}  [{sev:7s}]  {summary}")
+        return 0
+
+    paths = args.paths or ["."]
+    try:
+        findings = analyze_paths(paths, select=_rule_set(args.select),
+                                 ignore=_rule_set(args.ignore))
+    except (FileNotFoundError, SourceError) as e:
+        print(f"dtlint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        n = baseline_lib.write_baseline(args.write_baseline, findings)
+        print(f"dtlint: wrote {n} finding(s) to {args.write_baseline}")
+        return 0
+
+    stale: List[str] = []
+    baselined: List[Finding] = []
+    if args.baseline:
+        try:
+            entries = baseline_lib.load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"dtlint: error: {e}", file=sys.stderr)
+            return 2
+        findings, baselined, stale = baseline_lib.partition(
+            findings, entries)
+
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings))
+        if baselined:
+            print(f"dtlint: {len(baselined)} baselined finding(s) "
+                  "suppressed")
+        if stale:
+            print(f"dtlint: {len(stale)} stale baseline entr(ies) — "
+                  "re-run --write-baseline to prune")
+    return 1 if findings else 0

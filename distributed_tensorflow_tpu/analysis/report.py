@@ -1,0 +1,78 @@
+"""Finding model and renderers for dtlint.
+
+A ``Finding`` is one diagnostic: rule ID, severity, location, message, and
+the stripped source line it anchors to (the line text is what the baseline
+fingerprints, so findings survive unrelated line-number churn).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, List
+
+__all__ = ["Severity", "Finding", "render_text", "render_json"]
+
+
+class Severity:
+    """Ordered severity labels (no enum dependency so json stays plain)."""
+
+    INFO = "info"
+    WARNING = "warning"
+    ERROR = "error"
+
+    _ORDER = {INFO: 0, WARNING: 1, ERROR: 2}
+
+    @classmethod
+    def rank(cls, sev: str) -> int:
+        return cls._ORDER.get(sev, 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str            # "DT101"
+    severity: str        # Severity.*
+    path: str            # path as given on the command line (relative kept)
+    line: int            # 1-based
+    col: int             # 0-based, ast convention
+    message: str
+    source_line: str = ""  # stripped text of the offending line
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "source_line": self.source_line,
+        }
+
+
+def render_text(findings: Iterable[Finding]) -> str:
+    lines: List[str] = []
+    ordered = sorted(findings, key=Finding.sort_key)
+    for f in ordered:
+        lines.append(f"{f.path}:{f.line}:{f.col + 1}: "
+                     f"{f.rule} [{f.severity}] {f.message}")
+        if f.source_line:
+            lines.append(f"    {f.source_line}")
+    counts = {}
+    for f in ordered:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    if ordered:
+        summary = ", ".join(f"{n} {sev}" for sev, n in sorted(
+            counts.items(), key=lambda kv: -Severity.rank(kv[0])))
+        lines.append(f"dtlint: {len(ordered)} finding(s) ({summary})")
+    else:
+        lines.append("dtlint: clean")
+    return "\n".join(lines)
+
+
+def render_json(findings: Iterable[Finding]) -> str:
+    ordered = sorted(findings, key=Finding.sort_key)
+    return json.dumps({"findings": [f.to_dict() for f in ordered],
+                       "count": len(ordered)}, indent=2)

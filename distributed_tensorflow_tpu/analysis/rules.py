@@ -1,0 +1,630 @@
+"""dtlint rule set — distributed-JAX hazards checkable before trace time.
+
+Rule IDs are stable API (baselines and suppressions reference them):
+
+  DT101  error    host sync / tracer leak inside a jitted scope
+  DT102  error    PRNG key consumed twice without split/fold_in
+  DT103  error    collective/PartitionSpec references an unbound mesh axis
+  DT104  error    non-hashable value bound to a static jit argument
+  DT105  warning  jit/pjit/pmap/shard_map constructed inside a loop body
+  DT106  error    buffer read after being donated via donate_argnums
+
+Analysis is lexical and intra-module by design: no imports of the analyzed
+code, no JAX dependency, so the linter can gate CI on a machine with no
+accelerator.  Interprocedural flows (a traced fn calling a helper defined
+elsewhere) are out of scope — the cost is false negatives, never noise.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .context import JIT_WRAPPERS, JitRegistry
+from .report import Finding, Severity
+from .walker import (Source, assigned_names, enclosing, is_ancestor,
+                     literal_strings, names_in)
+
+__all__ = ["ModuleContext", "RULES", "run_rules", "rule_catalog"]
+
+
+class ModuleContext:
+    def __init__(self, src: Source, registry: JitRegistry,
+                 mesh_axes: Sequence[str]):
+        self.src = src
+        self.registry = registry
+        self.mesh_axes = tuple(mesh_axes)
+
+    def finding(self, rule: str, severity: str, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, severity=severity, path=self.src.path,
+                       line=line, col=col, message=message,
+                       source_line=self.src.line_text(line))
+
+
+class Rule:
+    id: str = "DT000"
+    severity: str = Severity.ERROR
+    summary: str = ""
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- DT101
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_HOST_NUMPY = {"numpy.asarray", "numpy.array", "numpy.float32",
+               "numpy.float64", "numpy.int32", "numpy.int64"}
+
+
+def _taint(fn: ast.AST, static: Set[str]) -> Set[str]:
+    """Names carrying traced values inside a traced def.
+
+    Roots: the def's (and nested defs') parameters minus static ones.
+    Propagated through plain assignments / for-targets / walrus whose RHS
+    mentions a tainted name; fixpoint over a bounded number of passes.
+    """
+    tainted: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg not in static and a.arg != "self":
+                    tainted.add(a.arg)
+            if args.vararg:
+                tainted.add(args.vararg.arg)
+        elif isinstance(node, ast.Lambda):
+            for a in node.args.posonlyargs + node.args.args:
+                tainted.add(a.arg)
+
+    for _ in range(10):
+        grew = False
+        for node in ast.walk(fn):
+            value: Optional[ast.AST] = None
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, list(node.targets)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) \
+                    and node.value is not None:
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.NamedExpr):
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.For):
+                value, targets = node.iter, [node.target]
+            if value is None:
+                continue
+            if names_in(value) & tainted:
+                for t in targets:
+                    new = assigned_names(t)
+                    if not new <= tainted:
+                        tainted |= new
+                        grew = True
+        if not grew:
+            break
+    return tainted
+
+
+class HostSyncInJit(Rule):
+    id = "DT101"
+    severity = Severity.ERROR
+    summary = ("host sync / tracer leak inside a jitted scope "
+               "(.item()/float()/np.asarray/device_get/print on traced "
+               "values)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        reg, src = ctx.registry, ctx.src
+        # outermost traced defs only — nested defs are covered by the walk
+        roots = [d for d in reg.traced_defs
+                 if reg.in_traced_scope(d) is None]
+        for fn in roots:
+            tainted = _taint(fn, reg.static_param_names(fn))
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = src.call_canonical(node)
+                hit = self._classify(src, node, name, tainted)
+                if hit is not None:
+                    msg, sev = hit
+                    yield ctx.finding(self.id, sev, node, msg)
+
+    @staticmethod
+    def _args_tainted(node: ast.Call, tainted: Set[str]) -> bool:
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            if names_in(a) & tainted:
+                return True
+        return False
+
+    def _classify(self, src: Source, node: ast.Call, name: Optional[str],
+                  tainted: Set[str]):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _HOST_SYNC_METHODS:
+            if names_in(node.func.value) & tainted:
+                return (f".{node.func.attr}() forces a host sync on a "
+                        "traced value inside jit — it leaks the tracer "
+                        "(ConcretizationTypeError) or blocks dispatch",
+                        Severity.ERROR)
+            return None
+        if name in _HOST_CASTS and self._args_tainted(node, tainted):
+            return (f"{name}() on a traced value inside jit concretizes "
+                    "the tracer; use jnp casts or keep it on device",
+                    Severity.ERROR)
+        if name in _HOST_NUMPY and self._args_tainted(node, tainted):
+            short = name.split(".", 1)[1]
+            return (f"np.{short}() materializes a traced value on host "
+                    "inside jit; use jnp equivalents",
+                    Severity.ERROR)
+        if name == "jax.device_get":
+            return ("jax.device_get inside a jitted scope is a host "
+                    "round-trip per trace; hoist it out of the compiled "
+                    "function", Severity.ERROR)
+        if name == "print" and self._args_tainted(node, tainted):
+            return ("print() on a traced value runs once at trace time "
+                    "with abstract values; use jax.debug.print for "
+                    "runtime values", Severity.WARNING)
+        return None
+
+
+# --------------------------------------------------------------- DT102
+
+_KEY_PRODUCERS = {"jax.random.PRNGKey", "jax.random.key",
+                  "jax.random.split", "jax.random.fold_in",
+                  "jax.random.clone"}
+_KEY_REFRESHERS = {"split", "fold_in", "clone", "PRNGKey", "key",
+                   "wrap_key_data", "key_data", "key_impl"}
+_KEY_PARAM_HINTS = ("key", "rng", "prng")
+
+
+def _is_key_param(name: str) -> bool:
+    low = name.lower()
+    return any(low == h or low.endswith("_" + h) or low.startswith(h)
+               for h in _KEY_PARAM_HINTS)
+
+
+class KeyReuse(Rule):
+    id = "DT102"
+    severity = Severity.ERROR
+    summary = ("the same PRNG key is consumed by more than one "
+               "jax.random call (or consumed inside a loop) without an "
+               "intervening split/fold_in")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        scopes = [ctx.src.tree] + [
+            n for n in ast.walk(ctx.src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx: ModuleContext,
+                     scope: ast.AST) -> Iterator[Finding]:
+        src = ctx.src
+        # last assignment node & consumption state per key name
+        last_assign: Dict[str, ast.AST] = {}
+        consumed_at: Dict[str, ast.AST] = {}
+        key_vars: Set[str] = set()
+
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = scope.args
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                if _is_key_param(a.arg):
+                    key_vars.add(a.arg)
+                    last_assign[a.arg] = scope
+
+        own = self._own_nodes(scope)
+        events = sorted(own, key=lambda n: (n.lineno, n.col_offset))
+        for node in events:
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.NamedExpr,
+                                 ast.AugAssign, ast.For)):
+                value = node.iter if isinstance(node, ast.For) \
+                    else node.value
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for nm in assigned_names(t):
+                        last_assign[nm] = node
+                        consumed_at.pop(nm, None)
+                        if value is not None and self._produces_key(
+                                src, value):
+                            key_vars.add(nm)
+            elif isinstance(node, ast.Call):
+                key_arg = self._consumed_key(src, node)
+                if key_arg is None or key_arg not in key_vars:
+                    continue
+                prior = consumed_at.get(key_arg)
+                if prior is not None and self._exclusive_branches(
+                        prior, node):
+                    continue  # if/else arms: only one runs per call
+                if prior is not None:
+                    if not src.suppressed(self.id, node.lineno):
+                        yield ctx.finding(
+                            self.id, self.severity, node,
+                            f"PRNG key '{key_arg}' already consumed at "
+                            f"line {prior.lineno}; reuse yields identical "
+                            "random bits — split or fold_in first")
+                    continue
+                loop = self._loop_outside_assignment(
+                    node, last_assign.get(key_arg), scope)
+                if loop is not None:
+                    if not src.suppressed(self.id, node.lineno):
+                        yield ctx.finding(
+                            self.id, self.severity, node,
+                            f"PRNG key '{key_arg}' is consumed inside a "
+                            "loop but produced outside it — every "
+                            "iteration reuses the same bits; fold_in the "
+                            "loop index")
+                    continue
+                consumed_at[key_arg] = node
+
+    def _own_nodes(self, scope: ast.AST) -> List[ast.AST]:
+        """Nodes belonging to this scope (not to a nested def)."""
+        return [n for n in ast.walk(scope)
+                if n is not scope and hasattr(n, "lineno")
+                and self._nearest_def(n) is scope]
+
+    @staticmethod
+    def _exclusive_branches(a: ast.AST, b: ast.AST) -> bool:
+        """True when ``a`` and ``b`` sit in different arms of the same
+        If/Try — at most one of them executes per call."""
+
+        def arms(node: ast.AST) -> Dict[int, int]:
+            out: Dict[int, int] = {}
+            cur, prev = getattr(node, "parent", None), node
+            while cur is not None:
+                if isinstance(cur, (ast.If, ast.Try)):
+                    groups = [cur.body, getattr(cur, "orelse", [])]
+                    if isinstance(cur, ast.Try):
+                        for h in cur.handlers:
+                            groups.append(h.body)
+                    for gi, group in enumerate(groups):
+                        if any(is_ancestor(stmt, prev) for stmt in group):
+                            out[id(cur)] = gi
+                prev, cur = cur, getattr(cur, "parent", None)
+            return out
+
+        arms_a, arms_b = arms(a), arms(b)
+        return any(k in arms_b and arms_b[k] != v
+                   for k, v in arms_a.items())
+
+    @staticmethod
+    def _nearest_def(node: ast.AST) -> ast.AST:
+        cur = getattr(node, "parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Module)):
+                return cur
+            cur = getattr(cur, "parent", None)
+        return node
+
+    @staticmethod
+    def _produces_key(src: Source, value: ast.AST) -> bool:
+        calls = [value] if isinstance(value, ast.Call) else [
+            n for n in ast.walk(value) if isinstance(n, ast.Call)]
+        for c in calls:
+            if src.call_canonical(c) in _KEY_PRODUCERS:
+                return True
+        return False
+
+    @staticmethod
+    def _consumed_key(src: Source, node: ast.Call) -> Optional[str]:
+        name = src.call_canonical(node)
+        if not name or not name.startswith("jax.random."):
+            return None
+        if name.rsplit(".", 1)[1] in _KEY_REFRESHERS:
+            return None
+        if node.args and isinstance(node.args[0], ast.Name):
+            return node.args[0].id
+        for k in node.keywords:
+            if k.arg == "key" and isinstance(k.value, ast.Name):
+                return k.value.id
+        return None
+
+    @staticmethod
+    def _loop_outside_assignment(use: ast.AST, assign: Optional[ast.AST],
+                                 scope: ast.AST) -> Optional[ast.AST]:
+        if assign is None:
+            return None
+        cur = getattr(use, "parent", None)
+        while cur is not None and cur is not scope:
+            if isinstance(cur, (ast.For, ast.While)) \
+                    and not is_ancestor(cur, assign):
+                return cur
+            cur = getattr(cur, "parent", None)
+        return None
+
+
+# --------------------------------------------------------------- DT103
+
+_COLLECTIVES_AXIS_ARG1 = {"jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax",
+                          "jax.lax.pmin", "jax.lax.psum_scatter",
+                          "jax.lax.all_gather", "jax.lax.all_to_all",
+                          "jax.lax.ppermute", "jax.lax.pshuffle",
+                          "jax.lax.pbroadcast"}
+_COLLECTIVES_AXIS_ARG0 = {"jax.lax.axis_index", "jax.lax.axis_size"}
+_SPEC_MAKERS = ("PartitionSpec",)
+_MESH_MAKERS = ("Mesh",)
+
+
+class UnknownMeshAxis(Rule):
+    id = "DT103"
+    severity = Severity.ERROR
+    summary = ("a collective / PartitionSpec / named_sharding references "
+               "an axis name not declared in mesh.AXIS_ORDER or bound by "
+               "an enclosing pmap/vmap axis_name")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        src = ctx.src
+        allowed = set(ctx.mesh_axes) | ctx.registry.module_axis_bindings
+        allowed |= self._locally_declared(src)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = src.call_canonical(node)
+            if not name:
+                continue
+            for axis, site in self._axis_literals(node, name):
+                if axis not in allowed:
+                    yield ctx.finding(
+                        self.id, self.severity, site,
+                        f"axis '{axis}' is not a mesh axis "
+                        f"{tuple(sorted(ctx.mesh_axes))} and no "
+                        "axis_name binding in this module declares it")
+
+    @staticmethod
+    def _locally_declared(src: Source) -> Set[str]:
+        """Axis names introduced by literal Mesh(...)/make_mesh({...})
+        constructions and axis_names=frozenset({...}) kwargs."""
+        out: Set[str] = set()
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = src.call_canonical(node) or ""
+            short = name.rsplit(".", 1)[-1]
+            if short in _MESH_MAKERS and len(node.args) >= 2:
+                out.update(literal_strings(node.args[1]))
+            if short == "make_mesh" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Dict):
+                    for k in arg.keys:
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            out.add(k.value)
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names", "names"):
+                    v = kw.value
+                    if isinstance(v, ast.Call):
+                        vals: List[str] = []
+                        for a in v.args:
+                            vals.extend(literal_strings(a))
+                        out.update(vals)
+                    else:
+                        out.update(literal_strings(v))
+        return out
+
+    @staticmethod
+    def _axis_literals(node: ast.Call, name: str
+                       ) -> Iterator[Tuple[str, ast.AST]]:
+        short = name.rsplit(".", 1)[-1]
+        if name in _COLLECTIVES_AXIS_ARG1:
+            cand = node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    cand = kw.value
+            if cand is not None:
+                for s in literal_strings(cand):
+                    yield s, cand
+        elif name in _COLLECTIVES_AXIS_ARG0:
+            cand = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    cand = kw.value
+            if cand is not None:
+                for s in literal_strings(cand):
+                    yield s, cand
+        elif short in _SPEC_MAKERS:
+            for a in node.args:
+                for s in literal_strings(a):
+                    yield s, a
+        elif short == "named_sharding":
+            for a in node.args[1:]:
+                for s in literal_strings(a):
+                    yield s, a
+
+
+# --------------------------------------------------------------- DT104
+
+_UNHASHABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                        ast.DictComp, ast.SetComp)
+_UNHASHABLE_CTORS = {"list", "dict", "set", "bytearray"}
+
+
+class NonHashableStatic(Rule):
+    id = "DT104"
+    severity = Severity.ERROR
+    summary = ("a list/dict/set is bound to a static_argnums/"
+               "static_argnames parameter — jit static args must be "
+               "hashable, this raises at call time and defeats the "
+               "compile cache")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        src, reg = ctx.src, ctx.registry
+        # a site can be registered under both the wrapped def's name and
+        # the assigned alias — run the signature check once per site
+        sig_checked: Set[int] = set()
+        for fname, site in reg.site_by_name.items():
+            target = site.target
+            params: List[str] = []
+            if isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                a = target.args
+                params = [p.arg for p in a.posonlyargs + a.args]
+                kwonly = [p.arg for p in a.kwonlyargs]
+                first_sig = id(site) not in sig_checked
+                sig_checked.add(id(site))
+                for sname in site.static_argnames:
+                    if sname not in params + kwonly and site.call \
+                            and first_sig:
+                        yield ctx.finding(
+                            self.id, self.severity, site.call,
+                            f"static_argnames '{sname}' is not a "
+                            f"parameter of '{fname}'")
+            if not (site.static_argnums or site.static_argnames):
+                continue
+            static_names = set(site.static_argnames)
+            for i in site.static_argnums:
+                if 0 <= i < len(params):
+                    static_names.add(params[i])
+            for call in self._call_sites(src, fname):
+                for i in site.static_argnums:
+                    if i < len(call.args) and self._unhashable(
+                            src, call.args[i]):
+                        yield ctx.finding(
+                            self.id, self.severity, call.args[i],
+                            f"non-hashable value passed to static arg "
+                            f"#{i} of jitted '{fname}' — every call "
+                            "raises TypeError (unhashable static)")
+                for kw in call.keywords:
+                    if kw.arg in static_names and self._unhashable(
+                            src, kw.value):
+                        yield ctx.finding(
+                            self.id, self.severity, kw.value,
+                            f"non-hashable value passed to static arg "
+                            f"'{kw.arg}' of jitted '{fname}'")
+
+    @staticmethod
+    def _call_sites(src: Source, fname: str) -> List[ast.Call]:
+        return [n for n in ast.walk(src.tree)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name) and n.func.id == fname]
+
+    @staticmethod
+    def _unhashable(src: Source, node: ast.AST) -> bool:
+        if isinstance(node, _UNHASHABLE_LITERALS):
+            return True
+        if isinstance(node, ast.Call):
+            name = src.call_canonical(node)
+            return name in _UNHASHABLE_CTORS
+        return False
+
+
+# --------------------------------------------------------------- DT105
+
+class JitInLoop(Rule):
+    id = "DT105"
+    severity = Severity.WARNING
+    summary = ("jit/pjit/pmap/shard_map constructed inside a loop body — "
+               "each iteration builds a fresh wrapper with an empty "
+               "compile cache (silent retrace every pass)")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        src = ctx.src
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if src.call_canonical(node) not in JIT_WRAPPERS:
+                continue
+            loop = enclosing(node, (ast.For, ast.While),
+                             stop=(ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda))
+            if loop is not None:
+                yield ctx.finding(
+                    self.id, self.severity, node,
+                    "jit wrapper constructed inside a loop: the compile "
+                    "cache keys on function identity, so every iteration "
+                    "recompiles — hoist the wrapped function out of the "
+                    "loop")
+
+
+# --------------------------------------------------------------- DT106
+
+class DonatedReuse(Rule):
+    id = "DT106"
+    severity = Severity.ERROR
+    summary = ("a buffer passed through donate_argnums is read after the "
+               "donating call — the buffer is invalidated in place")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        src, reg = ctx.src, ctx.registry
+        for fname, site in reg.site_by_name.items():
+            if not site.donate_argnums:
+                continue
+            for call in NonHashableStatic._call_sites(src, fname):
+                for i in site.donate_argnums:
+                    if i >= len(call.args):
+                        continue
+                    arg = call.args[i]
+                    if not isinstance(arg, ast.Name):
+                        continue
+                    reuse = self._use_after(src, call, arg.id)
+                    if reuse is not None:
+                        yield ctx.finding(
+                            self.id, self.severity, reuse,
+                            f"'{arg.id}' was donated to '{fname}' "
+                            f"(donate_argnums={site.donate_argnums}) at "
+                            f"line {call.lineno} and is read here — the "
+                            "donated buffer is dead; rebind the result "
+                            "instead")
+
+    @staticmethod
+    def _use_after(src: Source, call: ast.Call,
+                   name: str) -> Optional[ast.AST]:
+        scope = enclosing(call, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) or src.tree
+        call_pos = (call.end_lineno or call.lineno,
+                    call.end_col_offset or call.col_offset)
+        events: List[Tuple[Tuple[int, int], str, ast.AST]] = []
+        for node in ast.walk(scope):
+            stmt = node
+            if isinstance(node, ast.Name) and node.id == name:
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    # stores take effect at the end of their statement
+                    owner = node
+                    while owner is not None and not isinstance(
+                            owner, ast.stmt):
+                        owner = getattr(owner, "parent", None)
+                    pos_node = owner or node
+                    pos = (pos_node.end_lineno or pos_node.lineno,
+                           pos_node.end_col_offset or pos_node.col_offset)
+                    events.append((pos, "store", node))
+                else:
+                    pos = (node.lineno, node.col_offset)
+                    events.append((pos, "load", node))
+            del stmt
+        # stores sort before loads at the same position: the enclosing
+        # statement's own rebind (``state, m = step(state, b)``) lands
+        # exactly at the call's end and must count as protecting
+        events.sort(key=lambda e: (e[0], e[1] != "store"))
+        for pos, kind, node in events:
+            if kind == "store":
+                if pos >= call_pos:
+                    return None
+                continue
+            if pos <= call_pos or is_ancestor(call, node):
+                continue
+            return node
+        return None
+
+
+RULES: List[Rule] = [HostSyncInJit(), KeyReuse(), UnknownMeshAxis(),
+                     NonHashableStatic(), JitInLoop(), DonatedReuse()]
+
+
+def rule_catalog() -> List[Tuple[str, str, str]]:
+    return [(r.id, r.severity, r.summary) for r in RULES]
+
+
+def run_rules(src: Source, mesh_axes: Sequence[str],
+              select: Optional[Set[str]] = None,
+              ignore: Optional[Set[str]] = None) -> List[Finding]:
+    registry = JitRegistry(src)
+    ctx = ModuleContext(src, registry, mesh_axes)
+    out: List[Finding] = []
+    for rule in RULES:
+        if select and rule.id not in select:
+            continue
+        if ignore and rule.id in ignore:
+            continue
+        for f in rule.check(ctx):
+            if not src.suppressed(f.rule, f.line):
+                out.append(f)
+    return out

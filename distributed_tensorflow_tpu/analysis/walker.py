@@ -1,0 +1,201 @@
+"""Source model for dtlint: parse, parent links, aliases, suppressions.
+
+``Source`` wraps one parsed Python file with everything the rules need:
+
+* an AST whose nodes carry ``.parent`` back-links (``ast`` does not);
+* an import-alias map so ``jnp.asarray`` / ``P('data')`` resolve to their
+  canonical dotted names (``numpy.asarray``, ``jax.sharding.PartitionSpec``)
+  no matter how the module spelled the import;
+* per-line suppression sets parsed from ``# dtlint: disable=DT101[,DT102]``
+  comments (``# dtlint: disable`` with no list suppresses every rule on the
+  line; ``# dtlint: disable-file=DT103`` anywhere suppresses file-wide).
+
+The analysis modules are pure stdlib — no JAX import, no device touch
+(the ``python -m`` entry still executes the parent package ``__init__``,
+which imports JAX; run with ``JAX_PLATFORMS=cpu`` in CI images).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Source", "call_name", "walk_in_order", "enclosing",
+           "names_in", "SourceError"]
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dtlint:\s*(disable(?:-file)?)\s*(?:=\s*([A-Z0-9,\s]+))?")
+
+
+class SourceError(Exception):
+    """Raised when a file cannot be parsed (syntax error, bad encoding)."""
+
+
+def _link_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+    tree.parent = None  # type: ignore[attr-defined]
+
+
+class Source:
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines: List[str] = text.splitlines()
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except (SyntaxError, ValueError) as e:
+            raise SourceError(f"{path}: {e}") from e
+        _link_parents(self.tree)
+        self.aliases = self._collect_aliases()
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._collect_suppressions()
+
+    # ---------------------------------------------------------- aliases
+
+    def _collect_aliases(self) -> Dict[str, str]:
+        """local name -> canonical dotted prefix.
+
+        ``import jax.numpy as jnp``                 jnp -> jax.numpy
+        ``from jax import lax``                     lax -> jax.lax
+        ``from jax.sharding import PartitionSpec as P``
+                                                    P -> jax.sharding.PartitionSpec
+        """
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    canonical = a.name if a.asname else a.name.split(".")[0]
+                    aliases[local] = canonical
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and not node.level:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def canonical(self, dotted: Optional[str]) -> Optional[str]:
+        """Expand the first segment of a dotted name via the alias map."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        base = self.aliases.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+    def call_canonical(self, node: ast.Call) -> Optional[str]:
+        return self.canonical(call_name(node))
+
+    # ------------------------------------------------------ suppressions
+
+    def _collect_suppressions(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                kind, ids = m.group(1), m.group(2)
+                ruleset = ({r.strip() for r in ids.split(",") if r.strip()}
+                           if ids else {"*"})
+                if kind == "disable-file":
+                    self.file_suppressions |= ruleset
+                else:
+                    self.line_suppressions.setdefault(
+                        tok.start[0], set()).update(ruleset)
+        except tokenize.TokenizeError:
+            pass  # already parsed fine; comment scan is best-effort
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {"*", rule} & self.file_suppressions:
+            return True
+        at = self.line_suppressions.get(line, set())
+        return bool({"*", rule} & at)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+# ------------------------------------------------------------- helpers
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Dotted name of a call target: ``jax.random.split`` / ``print``."""
+    parts: List[str] = []
+    cur: ast.AST = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_in_order(node: ast.AST) -> Iterator[ast.AST]:
+    """Depth-first traversal in source order (ast.iter_child_nodes order)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from walk_in_order(child)
+
+
+def enclosing(node: ast.AST, kinds: Tuple[type, ...],
+              stop: Tuple[type, ...] = ()) -> Optional[ast.AST]:
+    """Nearest ancestor of one of ``kinds``, halting at ``stop`` kinds."""
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        if stop and isinstance(cur, stop):
+            return None
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """All Name identifiers loaded anywhere inside ``node``."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def is_ancestor(anc: ast.AST, node: ast.AST) -> bool:
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if cur is anc:
+            return True
+        cur = getattr(cur, "parent", None)
+    return False
+
+
+def assigned_names(target: ast.AST) -> Set[str]:
+    """Plain names bound by an assignment target (tuples unpacked)."""
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+    return out
+
+
+def literal_strings(node: ast.AST) -> Sequence[str]:
+    """String constants in a node that is a str or tuple/list of strs."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return out
+    return []

@@ -6,7 +6,6 @@ boundaries; per-step observability belongs to ``train.hooks``.
 """
 from __future__ import annotations
 
-import time
 from typing import Dict, Optional
 
 from ..summary import SummaryWriter

@@ -21,7 +21,7 @@ BERT encoder (models/bert.py) with the same TPU-first machinery:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp

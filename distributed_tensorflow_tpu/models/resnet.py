@@ -9,7 +9,7 @@ global-batch stats come out of the partitioner automatically).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp

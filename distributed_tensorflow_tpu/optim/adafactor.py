@@ -19,7 +19,7 @@ factored vectors are O(r + c), so replicating them costs ~nothing).
 """
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,47 @@
+"""JAX version compatibility for the manual-collectives entry point.
+
+The framework is written against the modern ``jax.shard_map`` API
+(``axis_names=`` set of *manual* axes, ``check_vma=``).  Older JAX
+releases (<= 0.4.x) only ship ``jax.experimental.shard_map.shard_map``
+with the complementary ``auto=`` parameter (the mesh axes that STAY
+automatic) and ``check_rep=``.  This wrapper speaks the modern calling
+convention and translates when running on the legacy API, so every
+``parallel/`` call site works on both.
+"""
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=True):
+    """Modern-signature shard_map that degrades to the legacy API.
+
+    ``axis_names``: the mesh axes the body is manual over (None = all).
+    ``check_vma``: replication checking (legacy name: ``check_rep``).
+    """
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        return native(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+    from jax.experimental.shard_map import shard_map as legacy
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    if auto:
+        # The legacy partial-auto path CHECK-fails inside XLA's SPMD
+        # partitioner (IsManualSubgroup mismatch) — a fatal process
+        # abort, not an exception.  Refuse up front so callers see a
+        # catchable error instead of a dead interpreter.
+        raise NotImplementedError(
+            f"partial-manual shard_map over {sorted(axis_names)} with "
+            f"auto axes {sorted(auto)} requires the modern jax.shard_map "
+            f"API; this JAX ({jax.__version__}) only ships the legacy "
+            "experimental one, whose partial-auto path aborts in the "
+            "SPMD partitioner")
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)

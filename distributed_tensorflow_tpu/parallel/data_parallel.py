@@ -25,9 +25,10 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ._compat import shard_map
 
 from ..ops import losses as loss_lib
 from ..ops import metrics as metric_lib
@@ -84,7 +85,7 @@ def make_psum_train_step(model, loss, optimizer: opt_lib.Optimizer,
                           opt_state=new_opt_state,
                           model_state=new_model_state), metrics
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         replica_step, mesh=mesh,
         in_specs=(P(), (P(axis), P(axis))),
         out_specs=(P(), P()),

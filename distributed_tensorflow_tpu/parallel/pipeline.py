@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ._compat import shard_map
+
 __all__ = ["pipeline_apply", "stack_pipeline_params", "pipeline_rules_spec",
            "pipeline_value_and_grad"]
 
@@ -131,7 +133,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
         out = lax.psum(jnp.where(is_last, buf, 0.0), axis)
         return out.reshape(x.shape[0], *x.shape[1:])
 
-    return jax.shard_map(
+    return shard_map(
         inner, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis), stacked_params), P()),
         out_specs=P(),
@@ -345,7 +347,7 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
             f"microbatch_weights shape {w_in.shape} != "
             f"({num_microbatches},) — clamp-indexing would silently "
             "mis-scale the loss")
-    loss, grads, aux_grads, dx = jax.shard_map(
+    loss, grads, aux_grads, dx = shard_map(
         inner, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis), stacked_params), P(),
                   jax.tree.map(lambda _: P(), y),

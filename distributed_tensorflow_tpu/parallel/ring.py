@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ._compat import shard_map
+
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 
@@ -113,7 +115,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
 
     if kv_valid is None:
         kv_valid = jnp.ones(q.shape[:2], jnp.bool_)
-    return jax.shard_map(inner, mesh=mesh,
+    return shard_map(inner, mesh=mesh,
                          in_specs=(spec, spec, spec, vspec),
                          out_specs=spec,
                          axis_names=frozenset({seq_axis}),

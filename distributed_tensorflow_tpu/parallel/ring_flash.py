@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ._compat import shard_map
+
 from ..ops.pallas.common import use_interpret as _use_interpret
 from ..ops.pallas.flash_attention import _flash_backward, _flash_forward
 
@@ -227,7 +229,7 @@ def ring_flash_attention_sharded(q, k, v, mesh: Mesh,
 
     if kv_valid is None:
         kv_valid = jnp.ones(q.shape[:2], jnp.bool_)
-    return jax.shard_map(inner, mesh=mesh,
+    return shard_map(inner, mesh=mesh,
                          in_specs=(spec, spec, spec, vspec),
                          out_specs=spec,
                          axis_names=frozenset({seq_axis}),

@@ -26,7 +26,6 @@ import struct
 import time
 from typing import Dict, Optional, Union
 
-from .crc32c import masked_crc32c
 
 __all__ = ["EventFileWriter", "SummaryWriter", "model_graph_nodes"]
 

@@ -25,11 +25,10 @@ Pieces:
 """
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Union
+from typing import Any, NamedTuple, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 __all__ = ["Policy", "policy", "all_finite", "NoLossScale",
            "StaticLossScale", "DynamicLossScale", "LossScaled",

@@ -85,12 +85,16 @@ def main() -> int:
         if int8:
             params = quant.quantize_tree(params)
         new_tokens = (16 if SMOKE else seq - prompt_len)
+        # One wrapper per config (DT105 fix: was rebuilt per batch rung,
+        # discarding the compile cache); each batch shape still traces
+        # once, but inside the SAME cache.  The per-config construction
+        # that remains is inherent — model/new_tokens change the program.
+        gen = jax.jit(lambda p, ids, m=model, nt=new_tokens, s=seq:  # dtlint: disable=DT105
+                      m.generate(prep(p), ids, max_new_tokens=nt,
+                                 temperature=0.0, max_len=s))
         for batch in batches:
             prompt = rng.integers(0, config.vocab_size,
                                   (batch, prompt_len)).astype(np.int32)
-            gen = jax.jit(lambda p, ids, m=model, nt=new_tokens, s=seq:
-                          m.generate(prep(p), ids, max_new_tokens=nt,
-                                     temperature=0.0, max_len=s))
             try:
                 np.asarray(gen(params, prompt))      # compile + warmup
                 dt = None

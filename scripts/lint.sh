@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Repo lint gate: ruff (pyflakes + import hygiene, config in
+# pyproject.toml) then dtlint (distributed-JAX hazards, docs/ANALYSIS.md)
+# against the committed baseline.  Extra args pass through to dtlint,
+# e.g. scripts/lint.sh --format json.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v ruff >/dev/null 2>&1; then
+  ruff check distributed_tensorflow_tpu examples scripts tests
+else
+  echo "lint.sh: ruff not installed; skipping pyflakes tier" >&2
+fi
+
+exec python -m distributed_tensorflow_tpu.analysis \
+  distributed_tensorflow_tpu examples scripts \
+  --baseline .dtlint-baseline.json "$@"

@@ -10,7 +10,6 @@ TF 2.21) into a self-time-ranked op table, i.e. the ResNet-quality
 Usage: python scripts/profile_gpt_step.py [gpt|bert] [trace_dir]
 """
 import glob
-import gzip
 import json
 import os
 import sys

@@ -30,7 +30,6 @@ def main():
             import jax
             jax.config.update("jax_platforms", arg.split("=", 1)[1])
     import jax
-    import numpy as np
 
     from distributed_tensorflow_tpu import data, ops, optim, train
 

@@ -1,0 +1,487 @@
+"""dtlint (distributed_tensorflow_tpu.analysis): rule-by-rule fixtures.
+
+Each rule family gets a true-positive fixture, a clean-negative fixture,
+and a suppression fixture; the closing self-check asserts the package
+itself lints clean modulo the committed baseline — the same gate CI runs
+via scripts/lint.sh.
+
+Analyzed fixtures are parsed, never imported — no tracing, no devices,
+so the whole suite runs in a few seconds.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from distributed_tensorflow_tpu import analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(code, select=None, path="fixture.py"):
+    src = analysis.Source(path, textwrap.dedent(code))
+    mesh_axes = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+    sel = {select} if isinstance(select, str) else select
+    return analysis.run_rules(src, mesh_axes, select=sel)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------- DT101
+
+def test_dt101_item_float_asarray_print_in_jit():
+    findings = lint("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(state, batch):
+            loss = (state - batch) ** 2
+            host = float(loss)          # concretizes the tracer
+            loss.item()                 # host sync
+            np.asarray(loss)            # host materialization
+            print(loss)                 # trace-time print
+            return host
+    """, select="DT101")
+    assert len(findings) == 4
+    assert {f.severity for f in findings} == {"error", "warning"}
+    assert all(f.rule == "DT101" for f in findings)
+
+
+def test_dt101_wrapper_call_idiom_and_device_get():
+    # the repo's builder idiom: def step(...): ... ; jax.jit(step, ...)
+    findings = lint("""
+        import jax
+
+        def make_step():
+            def step(state, batch):
+                jax.device_get(state)
+                return state + batch
+            return jax.jit(step, donate_argnums=0)
+    """, select="DT101")
+    assert rules_of(findings) == ["DT101"]
+
+
+def test_dt101_negative_host_code_and_static_args():
+    findings = lint("""
+        import jax
+        from functools import partial
+
+        def report(metrics):            # not jitted: host side is fine
+            print(float(metrics["loss"]))
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, cfg):
+            return x * float(cfg.scale)    # cfg is static -> concrete
+    """, select="DT101")
+    assert findings == []
+
+
+def test_dt101_suppression():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            print(x)  # dtlint: disable=DT101
+            return x
+    """, select="DT101")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT102
+
+def test_dt102_key_reused_twice():
+    findings = lint("""
+        import jax
+
+        def init(key):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+    """, select="DT102")
+    assert rules_of(findings) == ["DT102"]
+    assert "already consumed" in findings[0].message
+
+
+def test_dt102_key_consumed_in_loop():
+    findings = lint("""
+        import jax
+
+        def rollout(key, n):
+            outs = []
+            for _ in range(n):
+                outs.append(jax.random.normal(key, (2,)))
+            return outs
+    """, select="DT102")
+    assert rules_of(findings) == ["DT102"]
+    assert "inside a loop" in findings[0].message
+
+
+def test_dt102_negative_split_fold_in_branches():
+    findings = lint("""
+        import jax
+
+        def good(key, n, flag):
+            k1, k2, k3 = jax.random.split(key, 3)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.uniform(k2, (2,))
+            for i in range(n):
+                k = jax.random.fold_in(key, i)
+                a = a + jax.random.normal(k, (2,))
+            if flag:                    # exclusive arms may share a key
+                c = jax.random.normal(k3, (1,))
+            else:
+                c = jax.random.uniform(k3, (1,))
+            return a, b, c
+    """, select="DT102")
+    assert findings == []
+
+
+def test_dt102_reassignment_resets():
+    findings = lint("""
+        import jax
+
+        def ok(key):
+            x = jax.random.normal(key, (2,))
+            key = jax.random.fold_in(key, 1)
+            y = jax.random.normal(key, (2,))
+            return x + y
+    """, select="DT102")
+    assert findings == []
+
+
+def test_dt102_suppression():
+    findings = lint("""
+        import jax
+
+        def same_bits_on_purpose(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))  # dtlint: disable=DT102
+            return a, b
+    """, select="DT102")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT103
+
+def test_dt103_unknown_axis_in_collective_and_spec():
+    findings = lint("""
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def allreduce(x):
+            return lax.psum(x, "dataa")     # typo
+
+        spec = P("data", "tesnor")          # typo
+    """, select="DT103")
+    assert rules_of(findings) == ["DT103", "DT103"]
+    msgs = " ".join(f.message for f in findings)
+    assert "dataa" in msgs and "tesnor" in msgs
+
+
+def test_dt103_negative_mesh_axes_and_bindings():
+    findings = lint("""
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def grads(x):
+            return lax.pmean(x, "data")     # canonical mesh axis
+
+        spec = P(("data", "fsdp"), None, "tensor")
+
+        def per_device(x):
+            return lax.psum(x, "batch")     # bound below by pmap
+
+        fn = jax.pmap(per_device, axis_name="batch")
+    """, select="DT103")
+    assert findings == []
+
+
+def test_dt103_axis_name_variable_is_not_checked():
+    # axis passed through a variable: out of lexical reach, must not flag
+    findings = lint("""
+        from jax import lax
+
+        def reduce_over(x, axis_name):
+            return lax.psum(x, axis_name)
+    """, select="DT103")
+    assert findings == []
+
+
+def test_dt103_suppression():
+    findings = lint("""
+        from jax.sharding import PartitionSpec as P
+        spec = P("stage")  # dtlint: disable=DT103
+    """, select="DT103")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT104
+
+def test_dt104_list_passed_to_static_arg():
+    findings = lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def apply(x, dims):
+            return x
+
+        y = apply(1.0, [128, 256])
+    """, select="DT104")
+    assert rules_of(findings) == ["DT104"]
+    assert "non-hashable" in findings[0].message
+
+
+def test_dt104_static_argnames_not_a_parameter():
+    findings = lint("""
+        import jax
+
+        def step(x, n):
+            return x * n
+
+        step_c = jax.jit(step, static_argnames=("num",))
+    """, select="DT104")
+    assert rules_of(findings) == ["DT104"]
+    assert "'num'" in findings[0].message
+
+
+def test_dt104_negative_hashable_static():
+    findings = lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def apply(x, dims):
+            return x
+
+        y = apply(1.0, (128, 256))      # tuple: hashable
+    """, select="DT104")
+    assert findings == []
+
+
+def test_dt104_suppression():
+    findings = lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def apply(x, dims):
+            return x
+
+        y = apply(1.0, [128])  # dtlint: disable=DT104
+    """, select="DT104")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT105
+
+def test_dt105_jit_inside_loop():
+    findings = lint("""
+        import jax
+
+        def sweep(xs):
+            outs = []
+            for x in xs:
+                f = jax.jit(lambda v: v * 2)
+                outs.append(f(x))
+            return outs
+    """, select="DT105")
+    assert rules_of(findings) == ["DT105"]
+    assert findings[0].severity == "warning"
+
+
+def test_dt105_negative_hoisted_and_nested_def():
+    findings = lint("""
+        import jax
+
+        f = jax.jit(lambda v: v * 2)
+
+        def sweep(xs):
+            return [f(x) for x in xs]
+
+        def build_many(configs):
+            # a def inside the loop resets the lexical boundary
+            for c in configs:
+                def local(v):
+                    return jax.jit(lambda u: u + c)
+            return local
+    """, select="DT105")
+    assert findings == []
+
+
+def test_dt105_suppression():
+    findings = lint("""
+        import jax
+
+        def per_case(cases):
+            for c in cases:
+                g = jax.jit(lambda v: v * c)  # dtlint: disable=DT105
+                yield g
+    """, select="DT105")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT106
+
+def test_dt106_read_after_donation():
+    findings = lint("""
+        import jax
+
+        def step_fn(state, batch):
+            return state + batch, {}
+
+        step = jax.jit(step_fn, donate_argnums=0)
+
+        def run(state, batch):
+            new_state, metrics = step(state, batch)
+            return state.params          # donated buffer
+    """, select="DT106")
+    assert rules_of(findings) == ["DT106"]
+    assert "donated" in findings[0].message
+
+
+def test_dt106_negative_rebind_same_name():
+    findings = lint("""
+        import jax
+
+        def step_fn(state, batch):
+            return state + batch, {}
+
+        step = jax.jit(step_fn, donate_argnums=0)
+
+        def run(state, batches):
+            for b in batches:
+                state, metrics = step(state, b)
+            return state
+    """, select="DT106")
+    assert findings == []
+
+
+def test_dt106_cross_module_train_step_builder():
+    # examples never see the jax.jit call — the builder contract implies
+    # donation of arg 0
+    findings = lint("""
+        from distributed_tensorflow_tpu import train
+
+        def main(batches, state):
+            step = train.make_custom_train_step(None, None)
+            out, m = step(state, batches[0])
+            return state.params          # donated
+    """, select="DT106")
+    assert rules_of(findings) == ["DT106"]
+
+
+def test_dt106_suppression():
+    findings = lint("""
+        import jax
+
+        def step_fn(state, batch):
+            return state + batch, {}
+
+        step = jax.jit(step_fn, donate_argnums=0)
+
+        def run(state, batch):
+            new_state, _ = step(state, batch)
+            return state  # dtlint: disable=DT106 -- CPU-only helper
+    """, select="DT106")
+    assert findings == []
+
+
+# ----------------------------------------------------- infrastructure
+
+def test_file_level_suppression():
+    findings = lint("""
+        # dtlint: disable-file=DT102
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a, b
+    """)
+    assert findings == []
+
+
+def test_baseline_partition_roundtrip(tmp_path):
+    code = """
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a, b
+    """
+    findings = lint(code, select="DT102")
+    assert len(findings) == 1
+    bl = tmp_path / "baseline.json"
+    analysis.write_baseline(str(bl), findings)
+    entries = analysis.load_baseline(str(bl))
+    new, old, stale = analysis.partition(findings, entries)
+    assert new == [] and len(old) == 1 and stale == []
+    # a different finding is NOT covered by the baseline
+    other = lint(code.replace("(2,)", "(3,)"), select="DT102")
+    new, old, stale = analysis.partition(other, entries)
+    assert len(new) == 1 and old == [] and len(stale) == 1
+
+
+def test_rule_catalog_covers_all_families():
+    ids = [rid for rid, _, _ in analysis.rule_catalog()]
+    assert ids == ["DT101", "DT102", "DT103", "DT104", "DT105", "DT106"]
+
+
+def test_cli_json_output_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a, b
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(bad), "--format", "json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["count"] == 1
+    assert doc["findings"][0]["rule"] == "DT102"
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(good), "--format", "json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["count"] == 0
+
+
+def test_syntax_error_is_reported_not_crashed(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(bad)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 2
+    assert "error" in proc.stderr
+
+
+def test_self_check_package_lints_clean_modulo_baseline():
+    """The committed gate: the package + examples + scripts produce no
+    findings beyond .dtlint-baseline.json (exactly what CI runs)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         "distributed_tensorflow_tpu", "examples", "scripts",
+         "--format", "json", "--baseline", ".dtlint-baseline.json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["count"] == 0

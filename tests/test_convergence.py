@@ -1,7 +1,6 @@
 """End-to-end convergence oracles (SURVEY.md §4: the XOR task as the
 integration-level correctness signal, reference example.py:222-226)."""
 import jax
-import numpy as np
 
 from distributed_tensorflow_tpu import data, models, ops, optim, parallel, train
 

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_tensorflow_tpu import models, optim, train
+from distributed_tensorflow_tpu import optim, train
 from distributed_tensorflow_tpu.models.llama import llama_config, llama_tiny
 
 

@@ -2,7 +2,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu import optim, train
 from distributed_tensorflow_tpu.models.resnet import (ResNet, resnet50,

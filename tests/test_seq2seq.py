@@ -5,8 +5,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_tensorflow_tpu import optim, train
-from distributed_tensorflow_tpu.models.seq2seq import (Seq2Seq,
-                                                       seq2seq_tiny)
+from distributed_tensorflow_tpu.models.seq2seq import seq2seq_tiny
 from distributed_tensorflow_tpu.parallel import make_mesh
 from distributed_tensorflow_tpu.parallel.sharding import shard_pytree
 

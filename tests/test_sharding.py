@@ -51,7 +51,7 @@ def test_fsdp_shards_params_and_optimizer_moments():
     sharded run matches the replicated run numerically."""
     import numpy as np
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from distributed_tensorflow_tpu import optim, train
     from distributed_tensorflow_tpu.models.gpt import gpt_tiny
     from distributed_tensorflow_tpu.parallel import make_mesh

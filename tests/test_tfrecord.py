@@ -1,5 +1,4 @@
 """TFRecord container IO tests (framing shared with the event writer)."""
-import struct
 
 import pytest
 

@@ -3,7 +3,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_tensorflow_tpu import models, optim, train
+from distributed_tensorflow_tpu import optim, train
 from distributed_tensorflow_tpu.models.vit import vit_tiny
 
 
