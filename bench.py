@@ -1465,7 +1465,22 @@ def main():
     n = len(jax.devices())   # blocks here when the tunnel is hung
     ready.set()
     log(f"backend up: {n} device(s)")
-    result = CONFIGS[config]()
+    # Warn-only retrace sanitizer (analysis/sanitizer.py): every jit built
+    # during the bench gets a trace budget, so a rate that was silently
+    # dominated by recompiles arrives annotated instead of trusted.  The
+    # budget default leaves room for the batch ladder's legitimate
+    # shape-driven retraces (one lower() + one call per rung); warnings
+    # go to stderr with an arg-diff, and the JSON line carries the count.
+    if os.environ.get("DTTPU_BENCH_SANITIZE", "1") != "0":
+        from distributed_tensorflow_tpu.analysis.sanitizer import RetraceGuard
+        budget = int(os.environ.get("DTTPU_BENCH_RETRACE_BUDGET", "6"))
+        with RetraceGuard(budget=budget, mode="warn",
+                          enforce_donation=False) as guard:
+            result = CONFIGS[config]()
+        if guard.violations:
+            result["retrace_warnings"] = len(guard.violations)
+    else:
+        result = CONFIGS[config]()
     if claim_report():
         print(json.dumps(result), flush=True)
 

@@ -1,10 +1,20 @@
 """dtlint — JAX-aware static analysis for distributed-training hazards.
 
 Catches, *before anything is traced or compiled*, the bug classes that
-otherwise surface as silent recompiles or wrong numerics on the TPU:
-host syncs inside jit (DT101), PRNG key reuse (DT102), collectives naming
-unbound mesh axes (DT103), non-hashable static args (DT104), jit wrappers
-built in loop bodies (DT105), and reads of donated buffers (DT106).
+otherwise surface as silent recompiles or wrong numerics on the TPU.
+Two tiers share one file walk:
+
+* per-module (lexical): host syncs inside jit (DT101), PRNG key reuse
+  (DT102), collectives naming unbound mesh axes (DT103), non-hashable
+  static args (DT104), jit wrappers built in loop bodies (DT105), and
+  reads of donated buffers (DT106);
+* interprocedural (call-graph + dataflow summaries, ``callgraph.py`` /
+  ``dataflow.py``): keys passed unsplit to multiple consumers across
+  function boundaries (DT201), mesh-axis names flowing through
+  cross-module constants and ``make_mesh`` dicts (DT202), collective
+  sequences diverging across ``lax.cond`` branches inside shard_map
+  (DT203), and the donation contract propagated through the call graph
+  (DT204).
 
 Run it as a module::
 
@@ -15,6 +25,11 @@ or programmatically::
     from distributed_tensorflow_tpu import analysis
     findings = analysis.analyze_paths(["distributed_tensorflow_tpu"])
 
+The static tier's runtime sibling lives in ``analysis.sanitizer``:
+``RetraceGuard`` budgets jit retraces (with an actionable arg-diff per
+unexpected recompile) and enforces donated-buffer invalidation at
+execution time — see docs/ANALYSIS.md.
+
 Suppress a single site with ``# dtlint: disable=DT101`` on the flagged
 line; grandfather existing debt with ``--write-baseline`` /
 ``--baseline`` (see docs/ANALYSIS.md).  The analysis modules themselves
@@ -24,14 +39,27 @@ parent package ``__init__``; set ``JAX_PLATFORMS=cpu`` where no
 accelerator should be touched).
 """
 from .baseline import load_baseline, partition, write_baseline
-from .cli import analyze_file, analyze_paths, collect_files, main
-from .report import Finding, Severity, render_json, render_text
-from .rules import RULES, rule_catalog, run_rules
+from .callgraph import FunctionInfo, Project, module_name_for
+from .cli import (analyze_file, analyze_paths, collect_files,
+                  full_rule_catalog, main)
+from .dataflow import ProjectDataflow
+from .project_rules import (PROJECT_RULES, project_rule_catalog,
+                            run_project_rules)
+from .report import (Finding, Severity, render_github, render_json,
+                     render_text)
+from .rules import RULES, run_rules
+from .sanitizer import RetraceBudgetExceeded, RetraceGuard, retrace_guard
 from .walker import Source, SourceError
 
+rule_catalog = full_rule_catalog
+
 __all__ = [
-    "Finding", "Severity", "Source", "SourceError", "RULES",
-    "analyze_file", "analyze_paths", "collect_files", "main",
-    "render_json", "render_text", "rule_catalog", "run_rules",
-    "load_baseline", "partition", "write_baseline",
+    "Finding", "FunctionInfo", "PROJECT_RULES", "Project",
+    "ProjectDataflow", "RULES", "RetraceBudgetExceeded", "RetraceGuard",
+    "Severity", "Source", "SourceError",
+    "analyze_file", "analyze_paths", "collect_files", "full_rule_catalog",
+    "load_baseline", "main", "module_name_for", "partition",
+    "project_rule_catalog", "render_github", "render_json", "render_text",
+    "retrace_guard", "rule_catalog", "run_project_rules", "run_rules",
+    "write_baseline",
 ]

@@ -1,0 +1,365 @@
+"""Project model for dtlint's interprocedural pass: modules, functions,
+imports, and call resolution.
+
+``Project`` owns every parsed ``Source`` in an analysis run, keyed by
+dotted module name derived from the file path (``pkg/train/step.py`` →
+``pkg.train.step``; ``__init__.py`` names the package itself).  On top of
+that it builds:
+
+* a **function index** — every module-level ``def`` and every class
+  method, addressable as ``(module, qualname)``;
+* an **import table** per module — the walker's absolute-alias map plus
+  relative imports (``from .step import make_train_step``) resolved
+  against the module's package, which the walker deliberately skips;
+* **call resolution** — a best-effort mapping from a ``Call`` node to the
+  ``FunctionInfo`` it invokes, chasing re-export chains through package
+  ``__init__`` barrels (``train.make_train_step`` →
+  ``train.step.make_train_step``).
+
+Resolution is deliberately conservative: bare names resolve to same-module
+defs, dotted names resolve through imports/exports, ``self.method`` and
+``cls.method`` resolve within the enclosing class.  Arbitrary
+``obj.method`` attribute calls do NOT resolve (no type inference) — the
+interprocedural rules err toward silence, never noise, exactly like the
+per-module tier.  Pure stdlib, no JAX import.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .context import JitRegistry
+from .walker import Source, call_name
+
+__all__ = ["ClassInfo", "FunctionInfo", "Project", "module_name_for"]
+
+_RESOLVE_DEPTH = 12  # re-export chains are short; bound against cycles
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a file path, e.g. ``pkg/a/b.py`` → ``pkg.a.b``.
+
+    Leading ``./`` and drive/absolute prefixes are stripped; the caller is
+    expected to hand in repo-relative paths (what ``collect_files`` emits).
+    ``__init__.py`` maps to its package name.
+    """
+    norm = os.path.normpath(path).replace(os.sep, "/").lstrip("/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    parts = [p for p in norm.split("/") if p and p != "."]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One addressable function: a module-level def or a class method."""
+
+    module: str
+    qualname: str               # "fn" or "Class.method"
+    node: ast.AST               # FunctionDef / AsyncFunctionDef
+    src: Source
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}::{self.qualname}"
+
+    def param_names(self, drop_self: bool = True) -> List[str]:
+        a = self.node.args  # type: ignore[attr-defined]
+        names = [p.arg for p in a.posonlyargs + a.args]
+        if drop_self and "." in self.qualname and names \
+                and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """A project class — the anchor for instance-method resolution."""
+
+    module: str
+    name: str
+    node: ast.ClassDef
+    src: Source
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}::{self.name}"
+
+
+def _relative_base(module: str, is_package: bool, level: int) -> Optional[str]:
+    """Package that a level-``level`` relative import resolves against."""
+    parts = module.split(".") if module else []
+    if not is_package:
+        parts = parts[:-1]
+    up = level - 1
+    if up > len(parts):
+        return None
+    return ".".join(parts[:len(parts) - up] if up else parts)
+
+
+class Project:
+    """All sources of one analysis run, with cross-module indexes."""
+
+    def __init__(self, sources: Dict[str, Source],
+                 packages: Optional[set] = None):
+        # module name -> Source.  ``packages`` marks which module names are
+        # packages (came from __init__.py) so relative imports resolve.
+        self.sources = dict(sources)
+        self.packages = set(packages or ())
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}
+        self._registries: Dict[str, JitRegistry] = {}
+        self._type_envs: Dict[int, Dict[str, str]] = {}
+        for mod, src in self.sources.items():
+            self._index_functions(mod, src)
+            self.imports[mod] = self._import_table(mod, src)
+
+    # ----------------------------------------------------------- build
+
+    @classmethod
+    def from_files(cls, paths: List[str]) -> "Project":
+        sources: Dict[str, Source] = {}
+        packages = set()
+        for path in paths:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+                src = Source(path, text)
+            except Exception:
+                continue   # unparsable files are reported by the per-file pass
+            mod = module_name_for(path)
+            if not mod:
+                continue
+            sources[mod] = src
+            if os.path.basename(path) == "__init__.py":
+                packages.add(mod)
+        return cls(sources, packages)
+
+    @classmethod
+    def from_sources(cls, sources: Dict[str, Source],
+                     packages: Optional[set] = None) -> "Project":
+        return cls(sources, packages)
+
+    def _index_functions(self, mod: str, src: Source) -> None:
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = FunctionInfo(mod, node.name, node, src)
+                self.functions[info.key] = info
+            elif isinstance(node, ast.ClassDef):
+                cinfo = ClassInfo(mod, node.name, node, src)
+                self.classes[cinfo.key] = cinfo
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        info = FunctionInfo(mod, f"{node.name}.{item.name}",
+                                            item, src)
+                        self.functions[info.key] = info
+
+    def _import_table(self, mod: str, src: Source) -> Dict[str, str]:
+        """local name -> dotted target, including RELATIVE imports (which
+        the walker's alias map skips — it has no module context)."""
+        table = dict(src.aliases)
+        is_pkg = mod in self.packages
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ImportFrom) or not node.level:
+                continue
+            base = _relative_base(mod, is_pkg, node.level)
+            if base is None:
+                continue
+            target = f"{base}.{node.module}" if node.module else base
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                table[a.asname or a.name] = f"{target}.{a.name}"
+        return table
+
+    # ----------------------------------------------------------- query
+
+    def registry(self, mod: str) -> JitRegistry:
+        reg = self._registries.get(mod)
+        if reg is None:
+            reg = self._registries[mod] = JitRegistry(self.sources[mod])
+        return reg
+
+    def function(self, mod: str, qualname: str) -> Optional[FunctionInfo]:
+        return self.functions.get(f"{mod}::{qualname}")
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        return iter(self.functions.values())
+
+    def expand(self, mod: str, dotted: str) -> str:
+        """Expand the head of ``dotted`` through ``mod``'s import table."""
+        head, _, rest = dotted.partition(".")
+        base = self.imports.get(mod, {}).get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+    def resolve_dotted(self, dotted: str,
+                      _depth: int = 0) -> Optional[FunctionInfo]:
+        """Absolute dotted name -> FunctionInfo, chasing re-exports."""
+        hit = self._resolve_dotted_any(dotted, _depth)
+        return hit if isinstance(hit, FunctionInfo) else None
+
+    def resolve_class_dotted(self, dotted: str) -> Optional[ClassInfo]:
+        hit = self._resolve_dotted_any(dotted, 0)
+        return hit if isinstance(hit, ClassInfo) else None
+
+    def _resolve_dotted_any(self, dotted: str, _depth: int):
+        if _depth > _RESOLVE_DEPTH:
+            return None
+        # longest module prefix wins: "pkg.train.step.make" tries
+        # "pkg.train.step" before "pkg.train" before "pkg"
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod not in self.sources:
+                continue
+            rest = parts[cut:]
+            if len(rest) <= 2:
+                info = self.function(mod, ".".join(rest))
+                if info is not None:
+                    return info
+            if len(rest) == 1:
+                cinfo = self.classes.get(f"{mod}::{rest[0]}")
+                if cinfo is not None:
+                    return cinfo
+            # re-export chase: the first remaining segment may be an
+            # imported name inside ``mod`` (package barrel idiom)
+            table = self.imports.get(mod, {})
+            target = table.get(rest[0])
+            if target is not None:
+                tail = ".".join([target] + rest[1:])
+                return self._resolve_dotted_any(tail, _depth + 1)
+            return None
+        return None
+
+    def resolve_call(self, mod: str, call: ast.Call,
+                     enclosing_class: Optional[str] = None,
+                     types: Optional[Dict[str, str]] = None
+                     ) -> Optional[FunctionInfo]:
+        """Best-effort resolution of a Call in module ``mod``.
+
+        ``types`` maps local instance names to ClassInfo keys (from
+        :meth:`instance_types`), resolving ``model.init(...)`` when the
+        scope contains ``model = GPT(...)``.
+        """
+        return self.resolve_name(mod, call_name(call), enclosing_class,
+                                 types)
+
+    def resolve_name(self, mod: str, dotted: Optional[str],
+                     enclosing_class: Optional[str] = None,
+                     types: Optional[Dict[str, str]] = None
+                     ) -> Optional[FunctionInfo]:
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if head in ("self", "cls") and enclosing_class and rest \
+                and "." not in rest:
+            return self.function(mod, f"{enclosing_class}.{rest}")
+        if types and head in types and rest and "." not in rest:
+            cmod, _, cname = types[head].partition("::")
+            return self.function(cmod, f"{cname}.{rest}")
+        if not rest:
+            # bare name: same-module def first, then imported function
+            info = self.function(mod, head)
+            if info is not None:
+                return info
+        target = self.imports.get(mod, {}).get(head)
+        if target is None:
+            return None
+        tail = f"{target}.{rest}" if rest else target
+        return self.resolve_dotted(tail)
+
+    def resolve_class(self, mod: str, dotted: Optional[str]
+                      ) -> Optional[ClassInfo]:
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            cinfo = self.classes.get(f"{mod}::{head}")
+            if cinfo is not None:
+                return cinfo
+        target = self.imports.get(mod, {}).get(head)
+        if target is None:
+            return None
+        tail = f"{target}.{rest}" if rest else target
+        return self.resolve_class_dotted(tail)
+
+    def instance_types(self, mod: str, scope: ast.AST) -> Dict[str, str]:
+        """name -> ClassInfo key for ``x = SomeProjectClass(...)`` bindings
+        visible in ``scope`` (module-level bindings merged under function
+        scopes; conflicting rebinds drop to unknown).  Flow-insensitive —
+        enough for the ``model = GPT(cfg); model.init(key)`` idiom."""
+        cached = self._type_envs.get(id(scope))
+        if cached is not None:
+            return cached
+        env: Dict[str, str] = {}
+        src = self.sources.get(mod)
+        at_module = src is not None and scope is src.tree
+        if src is not None and not at_module:
+            env.update(self.instance_types(mod, src.tree))
+        # module scope: only top-level statements bind module names —
+        # a function-local ``model = GPT()`` must not leak module-wide
+        nodes = (scope.body if at_module
+                 else [n for n in ast.walk(scope)])
+        poisoned = set()
+        for node in nodes:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            key = None
+            if isinstance(node.value, ast.Call):
+                cinfo = self.resolve_class(mod, call_name(node.value))
+                if cinfo is not None:
+                    key = cinfo.key
+            if key is None:
+                poisoned.add(tgt.id)
+            elif env.get(tgt.id, key) != key:
+                poisoned.add(tgt.id)
+            else:
+                env[tgt.id] = key
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = scope.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                poisoned.add(p.arg)
+            if a.vararg:
+                poisoned.add(a.vararg.arg)
+            if a.kwarg:
+                poisoned.add(a.kwarg.arg)
+        for name in poisoned:
+            env.pop(name, None)
+        self._type_envs[id(scope)] = env
+        return env
+
+
+def enclosing_class_of(node: ast.AST) -> Optional[str]:
+    """Name of the nearest enclosing ClassDef, for self.method resolution."""
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def positional_index(call: ast.Call, params: List[str],
+                     name: str) -> Optional[Tuple[int, ast.AST]]:
+    """(param index, arg node) at which plain Name ``name`` is passed."""
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Name) and a.id == name:
+            return i, a
+    for k in call.keywords:
+        if k.arg and isinstance(k.value, ast.Name) and k.value.id == name:
+            if k.arg in params:
+                return params.index(k.arg), k.value
+    return None

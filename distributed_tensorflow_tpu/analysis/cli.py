@@ -1,12 +1,19 @@
 """dtlint command line.
 
   python -m distributed_tensorflow_tpu.analysis [paths...]
-      --format text|json       (default text)
+      --format text|json|github (default text; github emits workflow
+                                 ::error/::warning annotations)
       --baseline FILE          tolerate findings recorded in FILE
       --write-baseline FILE    snapshot current findings and exit 0
-      --select DT101,DT102     run only these rules
+      --select DT101,DT201     run only these rules
       --ignore DT105           skip these rules
+      --jobs N                 parallel per-file pass (0 = cpu count)
+      --no-project             skip the interprocedural DT2xx pass
       --list-rules             print the rule catalog
+
+Two passes share one file walk: the per-module tier (DT1xx) runs file by
+file (parallelizable with ``--jobs``), then the interprocedural tier
+(DT2xx) runs once over the whole parsed project.
 
 Exit status: 0 when no non-baselined findings, 1 when new findings exist,
 2 on usage/parse errors.
@@ -14,17 +21,22 @@ Exit status: 0 when no non-baselined findings, 1 when new findings exist,
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import sys
-from typing import Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set
 
 from . import baseline as baseline_lib
+from .callgraph import Project, module_name_for
 from .context import mesh_axes_for
-from .report import Finding, render_json, render_text
-from .rules import rule_catalog, run_rules
+from .project_rules import project_rule_catalog, run_project_rules
+from .report import Finding, render_github, render_json, render_text
+from .rules import rule_catalog as _file_rule_catalog
+from .rules import run_rules
 from .walker import Source, SourceError
 
-__all__ = ["main", "collect_files", "analyze_file", "analyze_paths"]
+__all__ = ["main", "collect_files", "analyze_file", "analyze_paths",
+           "full_rule_catalog"]
 
 
 def collect_files(paths: Iterable[str]) -> List[str]:
@@ -45,19 +57,78 @@ def collect_files(paths: Iterable[str]) -> List[str]:
     return out
 
 
+def full_rule_catalog():
+    return _file_rule_catalog() + project_rule_catalog()
+
+
+def _load_source(path: str) -> Source:
+    with open(path, "r", encoding="utf-8") as fh:
+        return Source(path, fh.read())
+
+
 def analyze_file(path: str, select: Optional[Set[str]] = None,
                  ignore: Optional[Set[str]] = None) -> List[Finding]:
-    with open(path, "r", encoding="utf-8") as fh:
-        text = fh.read()
-    src = Source(path, text)
+    src = _load_source(path)
     return run_rules(src, mesh_axes_for(path), select=select, ignore=ignore)
 
 
+def _project_module(path: str) -> str:
+    """Module name for the interprocedural index: repo-relative when the
+    path lives under the working directory, so dotted imports match."""
+    rel = path
+    try:
+        cand = os.path.relpath(path)
+        if not cand.startswith(".."):
+            rel = cand
+    except ValueError:      # different drive (windows)
+        pass
+    return module_name_for(rel)
+
+
 def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
-                  ignore: Optional[Set[str]] = None) -> List[Finding]:
+                  ignore: Optional[Set[str]] = None, jobs: int = 1,
+                  project_pass: bool = True) -> List[Finding]:
+    files = collect_files(paths)
     findings: List[Finding] = []
-    for path in collect_files(paths):
-        findings.extend(analyze_file(path, select=select, ignore=ignore))
+    sources: Dict[str, Source] = {}
+    packages: Set[str] = set()
+
+    if jobs == 0:
+        jobs = os.cpu_count() or 1
+    if jobs > 1 and len(files) > 1:
+        import concurrent.futures as cf
+        worker = functools.partial(analyze_file, select=select,
+                                   ignore=ignore)
+        with cf.ProcessPoolExecutor(max_workers=jobs) as ex:
+            for per_file in ex.map(worker, files):
+                findings.extend(per_file)
+        if project_pass:
+            for path in files:
+                try:
+                    src = _load_source(path)
+                except SourceError:
+                    continue      # already reported by the per-file pass
+                mod = _project_module(path)
+                if mod:
+                    sources[mod] = src
+                    if os.path.basename(path) == "__init__.py":
+                        packages.add(mod)
+    else:
+        for path in files:
+            src = _load_source(path)   # SourceError propagates, as before
+            findings.extend(run_rules(src, mesh_axes_for(path),
+                                      select=select, ignore=ignore))
+            mod = _project_module(path)
+            if mod:
+                sources[mod] = src
+                if os.path.basename(path) == "__init__.py":
+                    packages.add(mod)
+
+    if project_pass and sources:
+        project = Project.from_sources(sources, packages)
+        axes = mesh_axes_for(files[0]) if files else ()
+        findings.extend(run_project_rules(project, axes, select=select,
+                                          ignore=ignore))
     return findings
 
 
@@ -73,23 +144,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="dtlint: static analysis for distributed-JAX hazards")
     ap.add_argument("paths", nargs="*", default=["."],
                     help="files or directories to analyze (default: .)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
     ap.add_argument("--baseline", metavar="FILE")
     ap.add_argument("--write-baseline", metavar="FILE")
     ap.add_argument("--select", metavar="IDS")
     ap.add_argument("--ignore", metavar="IDS")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parallel workers for the per-file pass "
+                         "(0 = cpu count; the project pass stays serial)")
+    ap.add_argument("--no-project", action="store_true",
+                    help="skip the interprocedural DT2xx pass")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for rid, sev, summary in rule_catalog():
+        for rid, sev, summary in full_rule_catalog():
             print(f"{rid}  [{sev:7s}]  {summary}")
         return 0
 
     paths = args.paths or ["."]
     try:
         findings = analyze_paths(paths, select=_rule_set(args.select),
-                                 ignore=_rule_set(args.ignore))
+                                 ignore=_rule_set(args.ignore),
+                                 jobs=args.jobs,
+                                 project_pass=not args.no_project)
     except (FileNotFoundError, SourceError) as e:
         print(f"dtlint: error: {e}", file=sys.stderr)
         return 2
@@ -112,6 +191,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.format == "json":
         print(render_json(findings))
+    elif args.format == "github":
+        out = render_github(findings)
+        if out:
+            print(out)
     else:
         print(render_text(findings))
         if baselined:

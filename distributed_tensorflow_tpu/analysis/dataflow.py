@@ -1,0 +1,336 @@
+"""Interprocedural dataflow for dtlint: abstract values + fn summaries.
+
+The DT2xx rules need three whole-program facts the per-module tier cannot
+compute; this module derives them from a ``callgraph.Project``:
+
+* **PRNG-key consumption** — which parameters of each function feed a
+  ``jax.random.*`` call, directly or through a callee.  Passing one key
+  unsplit to two such consumers replays random bits even when each callee
+  splits internally (every derived stream is a pure function of the key).
+* **Donation** — which parameters each function passes into a
+  ``donate_argnums`` position (its own jit sites, a train-step-builder
+  result, or transitively a donating callee), plus which functions RETURN
+  a donating callable (the ``return jax.jit(step, donate_argnums=0)``
+  builder idiom, resolved structurally instead of by name).
+* **Collective signatures** — the ordered sequence of ``lax.p*``
+  collectives a function executes, expanded through project-local calls;
+  ``lax.cond``/``lax.switch`` branches with mismatched signatures inside
+  ``shard_map``/``pmap`` deadlock when predicates diverge across devices.
+
+Abstract values form a small lattice: BOTTOM (no fact) < concrete
+(frozen axis-name set / param set) < TOP (unknowable — e.g. an axis name
+computed at runtime).  Every transfer function goes to TOP rather than
+guess, so the rules inherit the linter's contract: false negatives are
+the cost, noise is not.  Pure stdlib, no JAX import.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (FunctionInfo, Project, enclosing_class_of,
+                        positional_index)
+from .context import _STEP_BUILDER_RE, _kw, _literal_ints
+from .walker import is_ancestor, literal_strings
+
+__all__ = ["TOP", "AxisConsts", "FunctionSummary", "ProjectDataflow"]
+
+_FIXPOINT_LIMIT = 40       # summary lattices are tiny; this never binds
+_SIGNATURE_DEPTH = 8       # transitive collective expansion bound
+
+# jax.random.* callees that refresh rather than consume entropy state;
+# everything else that takes a key consumes it (mirrors rules._KEY_REFRESHERS
+# minus the producers — split/fold_in DO consume for the cross-function rule:
+# two callees each splitting the same base key derive identical streams).
+_KEY_ARG_CALLS_PREFIX = "jax.random."
+
+# Communication collectives whose sequence must agree across SPMD branches.
+# axis_index/axis_size are local reads, not rendezvous points — excluded.
+COMM_COLLECTIVES = {
+    "jax.lax.psum": "psum", "jax.lax.pmean": "pmean",
+    "jax.lax.pmax": "pmax", "jax.lax.pmin": "pmin",
+    "jax.lax.psum_scatter": "psum_scatter",
+    "jax.lax.all_gather": "all_gather", "jax.lax.all_to_all": "all_to_all",
+    "jax.lax.ppermute": "ppermute", "jax.lax.pshuffle": "pshuffle",
+    "jax.lax.pbroadcast": "pbroadcast",
+}
+
+
+class _Top:
+    """Unknowable abstract value (runtime-computed axis names etc.)."""
+
+    def __repr__(self) -> str:
+        return "TOP"
+
+
+TOP = _Top()
+
+AxisValue = object  # FrozenSet[str] | TOP
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    """Per-function abstract facts (param names exclude self/cls)."""
+
+    key_params: Set[str] = dataclasses.field(default_factory=set)
+    donated_params: Set[str] = dataclasses.field(default_factory=set)
+    returns_donate_argnums: Tuple[int, ...] = ()
+    collectives: Optional[Tuple[str, ...]] = None  # filled lazily
+
+
+class AxisConsts:
+    """Module-level string/tuple-of-string constants, project-wide.
+
+    ``TENSOR_AXIS = "tensor"`` in one module, imported and used as
+    ``P(TENSOR_AXIS)`` in another, resolves to ``frozenset({"tensor"})``;
+    anything reassigned, conditional, or non-literal resolves to TOP.
+    """
+
+    def __init__(self, project: Project):
+        self.project = project
+        self._local: Dict[str, Dict[str, AxisValue]] = {}
+        for mod, src in project.sources.items():
+            self._local[mod] = self._collect(src)
+
+    @staticmethod
+    def _collect(src) -> Dict[str, AxisValue]:
+        out: Dict[str, AxisValue] = {}
+        for node in src.tree.body:
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = list(node.targets), node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            strs = literal_strings(value)
+            val: AxisValue
+            if isinstance(value, ast.Constant) and isinstance(value.value,
+                                                             str):
+                val = frozenset({value.value})
+            elif isinstance(value, (ast.Tuple, ast.List)) and strs \
+                    and len(strs) == len(value.elts):
+                val = frozenset(strs)
+            else:
+                val = TOP
+            for n in names:
+                # reassignment of a tracked constant -> unknowable
+                out[n] = TOP if n in out else val
+        return out
+
+    def value_of(self, mod: str, dotted: str,
+                 _depth: int = 0) -> AxisValue:
+        """Abstract value of a (possibly imported) name used in ``mod``."""
+        if _depth > 8:
+            return TOP
+        head, _, rest = dotted.partition(".")
+        local = self._local.get(mod, {})
+        if not rest and head in local:
+            return local[head]
+        target = self.project.imports.get(mod, {}).get(head)
+        if target is None:
+            return TOP
+        full = f"{target}.{rest}" if rest else target
+        parts = full.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            owner = ".".join(parts[:cut])
+            if owner in self.project.sources:
+                remainder = ".".join(parts[cut:])
+                if "." in remainder:
+                    return TOP
+                owned = self._local.get(owner, {})
+                if remainder in owned:
+                    return owned[remainder]
+                # chase one more re-export hop
+                via = self.project.imports.get(owner, {}).get(remainder)
+                if via is not None:
+                    tail = via.rsplit(".", 1)
+                    if len(tail) == 2 and tail[0] in self.project.sources:
+                        return self._local.get(tail[0], {}).get(
+                            tail[1], TOP)
+                return TOP
+        return TOP
+
+
+def _own_calls(fn: ast.AST) -> List[ast.Call]:
+    """Calls lexically inside ``fn`` excluding nested def bodies."""
+    out: List[ast.Call] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Call):
+                out.append(child)
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+class ProjectDataflow:
+    """Fixpoint summaries over a Project's call graph."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.consts = AxisConsts(project)
+        self.summaries: Dict[str, FunctionSummary] = {
+            info.key: FunctionSummary() for info in project.iter_functions()}
+        self._seed_summaries()
+        self._fixpoint()
+
+    # ------------------------------------------------------- summaries
+
+    def summary(self, info: FunctionInfo) -> FunctionSummary:
+        return self.summaries[info.key]
+
+    def _seed_summaries(self) -> None:
+        for info in self.project.iter_functions():
+            s = self.summaries[info.key]
+            params = set(info.param_names())
+            src = info.src
+            reg = self.project.registry(info.module)
+            for call in _own_calls(info.node):
+                name = src.call_canonical(call)
+                # direct jax.random consumption (split/fold_in included:
+                # derived streams are pure functions of the base key)
+                if name and name.startswith(_KEY_ARG_CALLS_PREFIX):
+                    for a in list(call.args[:1]) + [
+                            k.value for k in call.keywords
+                            if k.arg == "key"]:
+                        if isinstance(a, ast.Name) and a.id in params:
+                            s.key_params.add(a.id)
+                # direct donation through a module-local jit site or a
+                # step-builder-made callable
+                callee = call.func
+                if isinstance(callee, ast.Name):
+                    site = reg.site_by_name.get(callee.id)
+                    if site is not None and site.donate_argnums:
+                        for i in site.donate_argnums:
+                            if i < len(call.args) and isinstance(
+                                    call.args[i], ast.Name) \
+                                    and call.args[i].id in params:
+                                s.donated_params.add(call.args[i].id)
+            s.returns_donate_argnums = self._returned_donation(info)
+
+    def _returned_donation(self, info: FunctionInfo) -> Tuple[int, ...]:
+        """donate_argnums of the jit call whose result ``info`` returns
+        (the builder idiom), () when the function is not such a builder."""
+        src = info.src
+        # names assigned from jax.jit(..., donate_argnums=...) in this body
+        donating_names: Dict[str, Tuple[int, ...]] = {}
+        reg = self.project.registry(info.module)
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                nums = self._jit_donate_argnums(src, node.value)
+                if nums:
+                    donating_names[node.targets[0].id] = nums
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                nums = self._jit_donate_argnums(src, v)
+                if nums:
+                    return nums
+                # returning another builder's result propagates its contract
+                cname = src.call_canonical(v) or ""
+                if _STEP_BUILDER_RE.search(cname.rsplit(".", 1)[-1]):
+                    return (0,)
+            elif isinstance(v, ast.Name):
+                if v.id in donating_names:
+                    return donating_names[v.id]
+                site = reg.site_by_name.get(v.id)
+                if site is not None and site.donate_argnums \
+                        and site.call is not None \
+                        and is_ancestor(info.node, site.call):
+                    return site.donate_argnums
+        return ()
+
+    @staticmethod
+    def _jit_donate_argnums(src, call: ast.Call) -> Tuple[int, ...]:
+        from .context import JIT_WRAPPERS
+        if src.call_canonical(call) in JIT_WRAPPERS:
+            return _literal_ints(_kw(call, "donate_argnums"))
+        return ()
+
+    def _fixpoint(self) -> None:
+        for _ in range(_FIXPOINT_LIMIT):
+            grew = False
+            for info in self.project.iter_functions():
+                s = self.summaries[info.key]
+                params = info.param_names()
+                pset = set(params)
+                cls = enclosing_class_of(info.node)
+                types = self.project.instance_types(info.module, info.node)
+                for call in _own_calls(info.node):
+                    callee = self.project.resolve_call(info.module, call,
+                                                       cls, types)
+                    if callee is None or callee.key == info.key:
+                        continue
+                    cs = self.summaries[callee.key]
+                    cparams = callee.param_names()
+                    for p in pset:
+                        hit = positional_index(call, cparams, p)
+                        if hit is None:
+                            continue
+                        i, _node = hit
+                        if i < len(cparams):
+                            if cparams[i] in cs.key_params \
+                                    and p not in s.key_params:
+                                s.key_params.add(p)
+                                grew = True
+                            if cparams[i] in cs.donated_params \
+                                    and p not in s.donated_params:
+                                s.donated_params.add(p)
+                                grew = True
+            if not grew:
+                return
+
+    # ---------------------------------------------- collective signatures
+
+    def collective_signature(self, info: FunctionInfo) -> Tuple[str, ...]:
+        s = self.summaries[info.key]
+        if s.collectives is None:
+            s.collectives = self._signature_of(info.node, info, set(), 0)
+        return s.collectives
+
+    def signature_of_node(self, body: ast.AST,
+                          home: FunctionInfo) -> Tuple[str, ...]:
+        """Collective signature of an arbitrary AST region (branch lambda
+        body / resolved branch function) in ``home``'s module context."""
+        return self._signature_of(body, home, set(), 0)
+
+    def _signature_of(self, region: ast.AST, home: FunctionInfo,
+                      seen: Set[str], depth: int) -> Tuple[str, ...]:
+        if depth > _SIGNATURE_DEPTH:
+            return ()
+        out: List[str] = []
+        src = home.src
+        cls = enclosing_class_of(region)
+        scope = region if isinstance(
+            region, (ast.FunctionDef, ast.AsyncFunctionDef)) else home.node
+        types = self.project.instance_types(home.module, scope) \
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Module)) else {}
+        calls = [n for n in ast.walk(region) if isinstance(n, ast.Call)]
+        calls.sort(key=lambda n: (n.lineno, n.col_offset))
+        for call in calls:
+            name = src.call_canonical(call)
+            if name in COMM_COLLECTIVES:
+                out.append(COMM_COLLECTIVES[name])
+                continue
+            callee = self.project.resolve_call(home.module, call, cls,
+                                               types)
+            if callee is None or callee.key in seen:
+                continue
+            sub = self._signature_of(callee.node, callee,
+                                     seen | {callee.key}, depth + 1)
+            out.extend(sub)
+        return tuple(out)

@@ -1,0 +1,544 @@
+"""dtlint DT2xx rules — interprocedural hazards over a whole Project.
+
+  DT201  error    PRNG key passed unsplit to two consumers across
+                  function boundaries (callee summaries, not names)
+  DT202  error    mesh-axis names flowing through constants / make_mesh
+                  checked against the project-wide axis registry
+  DT203  error    lax.cond/lax.switch branches with mismatched collective
+                  sequences inside shard_map/pmap (SPMD deadlock hazard)
+  DT204  error    buffer read after a call to a function whose summary
+                  donates that parameter (DT106's contract propagated
+                  through the call graph)
+
+These run AFTER the per-module tier over the same parsed sources; every
+rule consumes ``dataflow.ProjectDataflow`` summaries and keeps the
+family contract: resolution failures mean silence, never noise.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import (FunctionInfo, Project, enclosing_class_of,
+                        positional_index)
+from .dataflow import TOP, ProjectDataflow
+from .context import JitRegistry
+from .report import Finding, Severity
+from .rules import DonatedReuse, KeyReuse, UnknownMeshAxis, _is_key_param
+from .walker import Source, assigned_names
+
+__all__ = ["PROJECT_RULES", "run_project_rules", "project_rule_catalog"]
+
+
+class ProjectContext:
+    def __init__(self, project: Project, mesh_axes: Sequence[str]):
+        self.project = project
+        self.mesh_axes = tuple(mesh_axes)
+        self.flow = ProjectDataflow(project)
+
+    def finding(self, rule: str, severity: str, src: Source, node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, severity=severity, path=src.path,
+                       line=line, col=col, message=message,
+                       source_line=src.line_text(line))
+
+
+class ProjectRule:
+    id: str = "DT200"
+    severity: str = Severity.ERROR
+    summary: str = ""
+
+    def check(self, pctx: ProjectContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- DT201
+
+class CrossFunctionKeyReuse(ProjectRule):
+    id = "DT201"
+    severity = Severity.ERROR
+    summary = ("a PRNG key is passed unsplit to two key-consuming callees "
+               "(or to one callee inside a loop) — every consumer derives "
+               "identical random streams; split/fold_in per consumer")
+
+    def check(self, pctx: ProjectContext) -> Iterator[Finding]:
+        for mod, src in pctx.project.sources.items():
+            scopes = [src.tree] + [
+                n for n in ast.walk(src.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+            for scope in scopes:
+                yield from self._check_scope(pctx, mod, src, scope)
+
+    def _check_scope(self, pctx: ProjectContext, mod: str, src: Source,
+                     scope: ast.AST) -> Iterator[Finding]:
+        last_assign: Dict[str, ast.AST] = {}
+        # key var -> (node, "direct" | callee description)
+        consumed_at: Dict[str, Tuple[ast.AST, Optional[str]]] = {}
+        key_vars: Set[str] = set()
+        cls = enclosing_class_of(scope)
+        types = pctx.project.instance_types(mod, scope)
+
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = scope.args
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                if _is_key_param(a.arg):
+                    key_vars.add(a.arg)
+                    last_assign[a.arg] = scope
+
+        own = [n for n in ast.walk(scope)
+               if n is not scope and hasattr(n, "lineno")
+               and KeyReuse._nearest_def(n) is scope]
+        for node in sorted(own, key=lambda n: (n.lineno, n.col_offset)):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.NamedExpr,
+                                 ast.AugAssign, ast.For)):
+                value = node.iter if isinstance(node, ast.For) \
+                    else node.value
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for nm in assigned_names(t):
+                        last_assign[nm] = node
+                        consumed_at.pop(nm, None)
+                        if value is not None and KeyReuse._produces_key(
+                                src, value):
+                            key_vars.add(nm)
+                        elif value is not None:
+                            key_vars.discard(nm)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            key_arg, kind = self._consumption(pctx, mod, src, node,
+                                              key_vars, cls, types)
+            if key_arg is None:
+                continue
+            prior = consumed_at.get(key_arg)
+            if prior is not None and KeyReuse._exclusive_branches(
+                    prior[0], node):
+                continue
+            if prior is not None:
+                # at least one side must be an interprocedural consumer —
+                # direct/direct pairs are DT102's finding, not ours
+                if kind is None and prior[1] is None:
+                    continue
+                who = kind or "a jax.random call"
+                prior_who = prior[1] or "a jax.random call"
+                if not src.suppressed(self.id, node.lineno):
+                    yield pctx.finding(
+                        self.id, self.severity, src, node,
+                        f"PRNG key '{key_arg}' already consumed by "
+                        f"{prior_who} at line {prior[0].lineno} and is "
+                        f"passed unsplit to {who} — both derive the same "
+                        "random stream; split or fold_in between "
+                        "consumers")
+                continue
+            if kind is not None:
+                loop = KeyReuse._loop_outside_assignment(
+                    node, last_assign.get(key_arg), scope)
+                if loop is not None:
+                    if not src.suppressed(self.id, node.lineno):
+                        yield pctx.finding(
+                            self.id, self.severity, src, node,
+                            f"PRNG key '{key_arg}' is passed unsplit to "
+                            f"{kind} inside a loop but produced outside "
+                            "it — every iteration replays the same "
+                            "stream; fold_in the loop index")
+                    continue
+            consumed_at[key_arg] = (node, kind)
+
+    @staticmethod
+    def _consumption(pctx: ProjectContext, mod: str, src: Source,
+                     call: ast.Call, key_vars: Set[str],
+                     cls: Optional[str],
+                     types: Optional[Dict[str, str]] = None
+                     ) -> Tuple[Optional[str], Optional[str]]:
+        """(consumed key var, consumer description|None-for-direct)."""
+        direct = KeyReuse._consumed_key(src, call)
+        if direct is not None and direct in key_vars:
+            return direct, None
+        callee = pctx.project.resolve_call(mod, call, cls, types)
+        if callee is None:
+            return None, None
+        summ = pctx.flow.summary(callee)
+        if not summ.key_params:
+            return None, None
+        cparams = callee.param_names()
+        for kv in key_vars:
+            hit = positional_index(call, cparams, kv)
+            if hit is None:
+                continue
+            i, _node = hit
+            if i < len(cparams) and cparams[i] in summ.key_params:
+                return kv, (f"'{callee.qualname}' "
+                            f"({callee.module}, key-consuming)")
+        return None, None
+
+
+# --------------------------------------------------------------- DT202
+
+class CrossFileMeshAxis(ProjectRule):
+    id = "DT202"
+    severity = Severity.ERROR
+    summary = ("an axis name reaching a collective/PartitionSpec through a "
+               "module-level constant — or a make_mesh axis dict — names "
+               "an axis no mesh construction in the project binds")
+
+    def check(self, pctx: ProjectContext) -> Iterator[Finding]:
+        allowed = set(pctx.mesh_axes)
+        for mod, src in pctx.project.sources.items():
+            allowed |= UnknownMeshAxis._locally_declared(src)
+            allowed |= pctx.project.registry(mod).module_axis_bindings
+        for mod, src in pctx.project.sources.items():
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = src.call_canonical(node)
+                if not name:
+                    continue
+                yield from self._check_constant_axes(
+                    pctx, mod, src, node, name, allowed)
+                yield from self._check_make_mesh(pctx, mod, src, node,
+                                                 name)
+
+    def _check_constant_axes(self, pctx, mod, src, node, name, allowed
+                             ) -> Iterator[Finding]:
+        """DT103's call positions, but for Name/Attribute operands that
+        resolve to module-level string constants (cross-file reach)."""
+        for cand in self._axis_operands(node, name):
+            dotted = self._dotted(cand)
+            if dotted is None:
+                continue
+            val = pctx.flow.consts.value_of(mod, dotted)
+            if val is TOP:
+                continue
+            for axis in sorted(val):          # type: ignore[arg-type]
+                if axis in allowed:
+                    continue
+                if src.suppressed(self.id, cand.lineno):
+                    continue
+                yield pctx.finding(
+                    self.id, self.severity, src, cand,
+                    f"axis '{axis}' (via constant '{dotted}') is not in "
+                    f"AXIS_ORDER {tuple(sorted(pctx.mesh_axes))} and no "
+                    "mesh construction or axis_name binding anywhere in "
+                    "the project declares it")
+
+    def _check_make_mesh(self, pctx, mod, src, node, name
+                         ) -> Iterator[Finding]:
+        """make_mesh({'axis': n}) keys must come from AXIS_ORDER — the
+        runtime check raises ValueError only once a device mesh is built,
+        typically deep inside a TPU window."""
+        if name.rsplit(".", 1)[-1] != "make_mesh" or not node.args:
+            return
+        arg = node.args[0]
+        keys: List[Tuple[str, ast.AST]] = []
+        if isinstance(arg, ast.Dict):
+            for k in arg.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.append((k.value, k))
+                elif isinstance(k, (ast.Name, ast.Attribute)):
+                    dotted = self._dotted(k)
+                    if dotted is not None:
+                        val = pctx.flow.consts.value_of(mod, dotted)
+                        if val is not TOP:
+                            keys.extend((a, k) for a in sorted(val))
+        for axis, knode in keys:
+            if axis in pctx.mesh_axes:
+                continue
+            if src.suppressed(self.id, knode.lineno):
+                continue
+            yield pctx.finding(
+                self.id, self.severity, src, knode,
+                f"make_mesh axis '{axis}' is not in AXIS_ORDER "
+                f"{tuple(sorted(pctx.mesh_axes))} — make_mesh raises "
+                "ValueError at runtime; fix the name or extend "
+                "parallel/mesh.py AXIS_ORDER")
+
+    @staticmethod
+    def _axis_operands(node: ast.Call, name: str) -> Iterator[ast.AST]:
+        """Axis-position operands that are Names/Attributes (the literal
+        positions are DT103's, single-file)."""
+        from .rules import (_COLLECTIVES_AXIS_ARG0, _COLLECTIVES_AXIS_ARG1,
+                            _SPEC_MAKERS)
+        short = name.rsplit(".", 1)[-1]
+        cands: List[ast.AST] = []
+        if name in _COLLECTIVES_AXIS_ARG1:
+            if len(node.args) > 1:
+                cands.append(node.args[1])
+        elif name in _COLLECTIVES_AXIS_ARG0:
+            if node.args:
+                cands.append(node.args[0])
+        elif short in _SPEC_MAKERS:
+            cands.extend(node.args)
+        elif short == "named_sharding":
+            cands.extend(node.args[1:])
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                cands.append(kw.value)
+        for c in cands:
+            if isinstance(c, (ast.Name, ast.Attribute)):
+                yield c
+
+    @staticmethod
+    def _dotted(node: ast.AST) -> Optional[str]:
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            return ".".join(reversed(parts))
+        return None
+
+
+# --------------------------------------------------------------- DT203
+
+_COND_NAMES = {"jax.lax.cond": "lax.cond", "jax.lax.switch": "lax.switch"}
+
+
+class BranchCollectiveMismatch(ProjectRule):
+    id = "DT203"
+    severity = Severity.ERROR
+    summary = ("lax.cond/lax.switch branches inside shard_map/pmap execute "
+               "different collective sequences — if the predicate diverges "
+               "across devices, the mismatched rendezvous deadlocks the "
+               "mesh")
+
+    def check(self, pctx: ProjectContext) -> Iterator[Finding]:
+        regions = self._spmd_regions(pctx)
+        seen: Set[int] = set()
+        for info_like, region in regions:
+            for node in ast.walk(region):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                name = info_like.src.call_canonical(node)
+                if name not in _COND_NAMES:
+                    continue
+                seen.add(id(node))
+                yield from self._check_cond(pctx, info_like, node,
+                                            _COND_NAMES[name])
+
+    def _spmd_regions(self, pctx: ProjectContext
+                      ) -> List[Tuple[FunctionInfo, ast.AST]]:
+        """(context fn, AST region) pairs traced by shard_map/pmap,
+        plus project functions reachable from them via resolved calls."""
+        out: List[Tuple[FunctionInfo, ast.AST]] = []
+        work: List[FunctionInfo] = []
+        done: Set[str] = set()
+        for mod, src in pctx.project.sources.items():
+            reg = pctx.project.registry(mod)
+            for site in reg.sites:
+                if "shard_map" not in site.wrapper \
+                        and site.wrapper != "jax.pmap":
+                    continue
+                if site.target is None:
+                    continue
+                home = FunctionInfo(mod, getattr(site.target, "name",
+                                                 "<lambda>"),
+                                    site.target, src)
+                out.append((home, site.target))
+                work.append(home)
+        while work:
+            home = work.pop()
+            cls = enclosing_class_of(home.node)
+            types = pctx.project.instance_types(home.module, home.node) \
+                if isinstance(home.node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) else {}
+            for call in [n for n in ast.walk(home.node)
+                         if isinstance(n, ast.Call)]:
+                callee = pctx.project.resolve_call(home.module, call, cls,
+                                                   types)
+                if callee is None or callee.key in done:
+                    continue
+                done.add(callee.key)
+                out.append((callee, callee.node))
+                work.append(callee)
+        return out
+
+    def _check_cond(self, pctx: ProjectContext, home: FunctionInfo,
+                    call: ast.Call, what: str) -> Iterator[Finding]:
+        branches = self._branches(call, what)
+        if branches is None or len(branches) < 2:
+            return
+        sigs: List[Tuple[str, Tuple[str, ...]]] = []
+        for label, branch in branches:
+            sig = self._branch_signature(pctx, home, branch)
+            if sig is None:
+                return        # unresolvable branch: stay silent
+            sigs.append((label, sig))
+        baseline = sigs[0][1]
+        for label, sig in sigs[1:]:
+            if sig != baseline:
+                if home.src.suppressed(self.id, call.lineno):
+                    return
+                yield pctx.finding(
+                    self.id, self.severity, home.src, call,
+                    f"{what} branches disagree on collectives: "
+                    f"{sigs[0][0]} runs {list(baseline) or 'none'}, "
+                    f"{label} runs {list(sig) or 'none'} — inside "
+                    "shard_map/pmap a divergent predicate deadlocks the "
+                    "mesh; hoist the collectives out of the branches")
+                return
+
+    @staticmethod
+    def _branches(call: ast.Call, what: str
+                  ) -> Optional[List[Tuple[str, ast.AST]]]:
+        if what == "lax.cond":
+            if len(call.args) < 3:
+                return None
+            return [("true branch", call.args[1]),
+                    ("false branch", call.args[2])]
+        if len(call.args) < 2:
+            return None
+        seq = call.args[1]
+        if not isinstance(seq, (ast.Tuple, ast.List)):
+            return None
+        return [(f"branch {i}", b) for i, b in enumerate(seq.elts)]
+
+    def _branch_signature(self, pctx: ProjectContext, home: FunctionInfo,
+                          branch: ast.AST
+                          ) -> Optional[Tuple[str, ...]]:
+        if isinstance(branch, ast.Lambda):
+            return pctx.flow.signature_of_node(branch.body, home)
+        if isinstance(branch, ast.Name):
+            local = self._local_def(home.node, branch.id)
+            if local is not None:
+                return pctx.flow.signature_of_node(
+                    local, FunctionInfo(home.module, branch.id, local,
+                                        home.src))
+            callee = pctx.project.resolve_name(
+                home.module, branch.id, enclosing_class_of(home.node))
+            if callee is not None:
+                return pctx.flow.collective_signature(callee)
+        return None
+
+    @staticmethod
+    def _local_def(scope: ast.AST, name: str) -> Optional[ast.AST]:
+        best = None
+        for n in ast.walk(scope):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == name:
+                best = n
+        return best
+
+
+# --------------------------------------------------------------- DT204
+
+class InterprocDonatedReuse(ProjectRule):
+    id = "DT204"
+    severity = Severity.ERROR
+    summary = ("a buffer is read after a call to a function whose summary "
+               "donates that parameter (directly, transitively, or via a "
+               "returned jit-with-donation callable) — dead buffer on TPU")
+
+    def check(self, pctx: ProjectContext) -> Iterator[Finding]:
+        for mod, src in pctx.project.sources.items():
+            reg = pctx.project.registry(mod)
+            builder_sites = self._builder_assignments(pctx, mod, src, reg)
+            for call in [n for n in ast.walk(src.tree)
+                         if isinstance(n, ast.Call)]:
+                yield from self._check_call(pctx, mod, src, reg,
+                                            builder_sites, call)
+
+    def _builder_assignments(self, pctx: ProjectContext, mod: str,
+                             src: Source, reg: JitRegistry
+                             ) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+        """local name -> (donate_argnums, builder qualname) for names
+        assigned from a resolved builder whose returned callable donates.
+        Names the per-module registry already tracks (jit sites and the
+        make_*train_step regex contract) stay DT106's — skipped here."""
+        out: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            if tgt.id in reg.site_by_name:
+                continue
+            callee = pctx.project.resolve_call(
+                mod, node.value, enclosing_class_of(node))
+            if callee is None:
+                continue
+            nums = pctx.flow.summary(callee).returns_donate_argnums
+            if nums:
+                out[tgt.id] = (nums, callee.qualname)
+        return out
+
+    def _check_call(self, pctx: ProjectContext, mod: str, src: Source,
+                    reg: JitRegistry,
+                    builder_sites: Dict[str, Tuple[Tuple[int, ...], str]],
+                    call: ast.Call) -> Iterator[Finding]:
+        func = call.func
+        donated: List[Tuple[int, str]] = []   # (argnum, contract descr)
+        if isinstance(func, ast.Name) and func.id in builder_sites:
+            nums, builder = builder_sites[func.id]
+            donated = [(i, f"built by '{builder}' (returns jit with "
+                           f"donate_argnums={nums})") for i in nums]
+        else:
+            if isinstance(func, ast.Name) and func.id in reg.site_by_name:
+                return                      # DT106's per-module domain
+            scope = KeyReuse._nearest_def(call)
+            types = pctx.project.instance_types(mod, scope) \
+                if isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) else None
+            callee = pctx.project.resolve_call(mod, call,
+                                              enclosing_class_of(call),
+                                              types)
+            if callee is None:
+                return
+            summ = pctx.flow.summary(callee)
+            if not summ.donated_params:
+                return
+            params = callee.param_names()
+            donated = [(i, f"'{callee.qualname}' ({callee.module}) "
+                           f"donates parameter '{p}'")
+                       for i, p in enumerate(params)
+                       if p in summ.donated_params]
+        for i, descr in donated:
+            if i >= len(call.args):
+                continue
+            arg = call.args[i]
+            if not isinstance(arg, ast.Name):
+                continue
+            reuse = DonatedReuse._use_after(src, call, arg.id)
+            if reuse is None:
+                continue
+            if src.suppressed(self.id, reuse.lineno):
+                continue
+            yield pctx.finding(
+                self.id, self.severity, src, reuse,
+                f"'{arg.id}' is read here but was donated at line "
+                f"{call.lineno}: {descr} — the buffer is dead on TPU; "
+                "rebind the result instead")
+
+
+PROJECT_RULES: List[ProjectRule] = [
+    CrossFunctionKeyReuse(), CrossFileMeshAxis(),
+    BranchCollectiveMismatch(), InterprocDonatedReuse()]
+
+
+def project_rule_catalog() -> List[Tuple[str, str, str]]:
+    return [(r.id, r.severity, r.summary) for r in PROJECT_RULES]
+
+
+def run_project_rules(project: Project, mesh_axes: Sequence[str],
+                      select: Optional[Set[str]] = None,
+                      ignore: Optional[Set[str]] = None) -> List[Finding]:
+    pctx = ProjectContext(project, mesh_axes)
+    by_path = {src.path: src for src in project.sources.values()}
+    out: List[Finding] = []
+    for rule in PROJECT_RULES:
+        if select and rule.id not in select:
+            continue
+        if ignore and rule.id in ignore:
+            continue
+        for f in rule.check(pctx):
+            src = by_path.get(f.path)
+            if src is not None and src.suppressed(f.rule, f.line):
+                continue
+            out.append(f)
+    return out

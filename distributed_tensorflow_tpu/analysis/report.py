@@ -10,7 +10,8 @@ import dataclasses
 import json
 from typing import Iterable, List
 
-__all__ = ["Severity", "Finding", "render_text", "render_json"]
+__all__ = ["Severity", "Finding", "render_text", "render_json",
+           "render_github"]
 
 
 class Severity:
@@ -76,3 +77,28 @@ def render_json(findings: Iterable[Finding]) -> str:
     ordered = sorted(findings, key=Finding.sort_key)
     return json.dumps({"findings": [f.to_dict() for f in ordered],
                        "count": len(ordered)}, indent=2)
+
+
+_GH_LEVEL = {Severity.ERROR: "error", Severity.WARNING: "warning",
+             Severity.INFO: "notice"}
+
+
+def _gh_escape(text: str, property_value: bool = False) -> str:
+    """GitHub workflow-command escaping (docs: toolkit/command.ts)."""
+    out = text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if property_value:
+        out = out.replace(":", "%3A").replace(",", "%2C")
+    return out
+
+
+def render_github(findings: Iterable[Finding]) -> str:
+    """GitHub Actions annotation lines — one ``::error``/``::warning``
+    workflow command per finding, so `scripts/lint.sh --format github`
+    surfaces findings inline on the PR diff."""
+    lines: List[str] = []
+    for f in sorted(findings, key=Finding.sort_key):
+        level = _GH_LEVEL.get(f.severity, "warning")
+        props = (f"file={_gh_escape(f.path, True)},line={f.line},"
+                 f"col={f.col + 1},title={_gh_escape(f.rule, True)}")
+        lines.append(f"::{level} {props}::{_gh_escape(f.message)}")
+    return "\n".join(lines)

@@ -9,10 +9,12 @@ Rule IDs are stable API (baselines and suppressions reference them):
   DT105  warning  jit/pjit/pmap/shard_map constructed inside a loop body
   DT106  error    buffer read after being donated via donate_argnums
 
-Analysis is lexical and intra-module by design: no imports of the analyzed
-code, no JAX dependency, so the linter can gate CI on a machine with no
-accelerator.  Interprocedural flows (a traced fn calling a helper defined
-elsewhere) are out of scope — the cost is false negatives, never noise.
+Analysis in this module is lexical and intra-module: no imports of the
+analyzed code, no JAX dependency, so the linter can gate CI on a machine
+with no accelerator.  Interprocedural flows (a traced fn calling a helper
+defined elsewhere) are the DT2xx tier's job (``project_rules.py`` over a
+``callgraph.Project``); both tiers share the contract that the cost of
+imprecision is false negatives, never noise.
 """
 from __future__ import annotations
 

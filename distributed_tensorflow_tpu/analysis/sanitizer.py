@@ -1,0 +1,252 @@
+"""Runtime sanitizer: retrace budgets and donated-buffer enforcement.
+
+The static tier (DT105/DT106/DT2xx) catches the retrace/donation hazards
+it can prove from source; this module catches the rest at execution time,
+where the evidence is exact.  ``RetraceGuard`` is a context manager that
+patches ``jax.jit`` for its dynamic extent so that every jitted function
+*created inside the guard*:
+
+* counts its traces — each trace beyond the per-function budget is an
+  unexpected recompile, reported with an **arg-diff** against the
+  previous trace (which leaf changed shape/dtype/weak-type, which static
+  argument changed value) so the fix is actionable, not forensic;
+* optionally has donation *enforced*: after each call, argument buffers
+  in ``donate_argnums`` positions are invalidated host-side
+  (``jax.Array.delete()``).  JAX itself deletes donated args whose
+  aliasing the backend accepts; the guard closes the remaining hole —
+  when XLA **rejects** the donation ("Some donated buffers were not
+  usable", routine on the CPU mesh) the buffer stays silently readable
+  and tests pass code whose donation semantics differ on TPU.  Under the
+  guard, a read of any buffer the caller *declared* donated raises,
+  whichever backend ran.
+
+Usage::
+
+    with RetraceGuard(budget=1) as guard:          # raise on 2nd trace
+        step = jax.jit(train_step, donate_argnums=0)
+        ...
+    # pytest (tests/conftest.py wires the marker):
+    @pytest.mark.retrace_guard(budget=2)
+    def test_hot_loop_compiles_once(...): ...
+    # bench.py runs warn-only and reports `retrace_warnings` in its JSON
+
+Scope/limits: only ``jax.jit``/``jax.pjit`` wrappers **constructed while
+the guard is active** are instrumented (a ``functools.partial(jax.jit,
+...)`` captured at import time bypasses the patch); donation enforcement
+covers positional ``donate_argnums`` (not ``donate_argnames``).  The
+module imports JAX lazily — importing it (e.g. via the analysis package)
+stays pure-stdlib.
+"""
+from __future__ import annotations
+
+import functools
+import sys
+from typing import Any, Dict, List, Tuple
+
+__all__ = ["RetraceGuard", "RetraceBudgetExceeded", "retrace_guard"]
+
+_MAX_STATIC_REPR = 80
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """A guarded function traced more times than its budget allows."""
+
+
+def _leaf_desc(leaf: Any) -> str:
+    aval = getattr(leaf, "aval", None)
+    if aval is not None:
+        return str(aval)
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}{list(shape)}"
+    r = repr(leaf)
+    return r if len(r) <= _MAX_STATIC_REPR else r[:_MAX_STATIC_REPR] + "…"
+
+
+def _signature(args: tuple, kwargs: dict) -> Dict[str, str]:
+    """path -> abstract description of every leaf of one trace's inputs."""
+    import jax
+    flat = jax.tree_util.tree_flatten_with_path(
+        (args, dict(sorted(kwargs.items()))))[0]
+    return {jax.tree_util.keystr(path): _leaf_desc(leaf)
+            for path, leaf in flat}
+
+
+def _diff(prev: Dict[str, str], cur: Dict[str, str]) -> str:
+    lines: List[str] = []
+    for path in sorted(set(prev) | set(cur)):
+        a, b = prev.get(path), cur.get(path)
+        if a == b:
+            continue
+        if a is None:
+            lines.append(f"  + {path}: {b}")
+        elif b is None:
+            lines.append(f"  - {path}: {a}")
+        else:
+            lines.append(f"  ~ {path}: {a} -> {b}")
+    if not lines:
+        return ("  (identical argument signature — a cache-defeating "
+                "static arg, weak-type flip on a Python scalar, or an "
+                "explicit lower()/AOT trace)")
+    return "\n".join(lines)
+
+
+class _FnTraces:
+    def __init__(self, name: str):
+        self.name = name
+        self.signatures: List[Dict[str, str]] = []
+
+    def note(self, sig: Dict[str, str]) -> int:
+        self.signatures.append(sig)
+        return len(self.signatures)
+
+    def describe(self) -> str:
+        n = len(self.signatures)
+        head = (f"'{self.name}' traced {n} time(s); trace #{n} vs "
+                f"#{n - 1} arg-diff:\n")
+        return head + _diff(self.signatures[-2], self.signatures[-1])
+
+
+class _DonationEnforcer:
+    """Call-through wrapper that kills donated input buffers after each
+    call, making read-after-donate raise on backends that ignore
+    donation.  Attribute access (lower, clear_cache, …) delegates."""
+
+    def __init__(self, jitted: Any, donate: Tuple[int, ...]):
+        self._jitted = jitted
+        self._donate = donate
+        functools.update_wrapper(self, jitted, updated=())
+
+    def __call__(self, *args, **kwargs):
+        out = self._jitted(*args, **kwargs)
+        self._invalidate(args, out)
+        return out
+
+    def _invalidate(self, args: tuple, out: Any) -> None:
+        import jax
+        out_ids = {id(leaf) for leaf in jax.tree_util.tree_leaves(out)}
+        for i in self._donate:
+            if i >= len(args):
+                continue
+            for leaf in jax.tree_util.tree_leaves(args[i]):
+                if not isinstance(leaf, jax.Array) \
+                        or isinstance(leaf, jax.core.Tracer):
+                    continue
+                if id(leaf) in out_ids:
+                    continue     # aliased through: donation took effect
+                try:
+                    if not leaf.is_deleted():
+                        leaf.delete()
+                except Exception:   # committed-elsewhere etc.: best effort
+                    pass
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._jitted, name)
+
+
+def _donate_argnums(kwargs: dict) -> Tuple[int, ...]:
+    v = kwargs.get("donate_argnums")
+    if v is None:
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    try:
+        return tuple(int(i) for i in v)
+    except TypeError:
+        return ()
+
+
+class RetraceGuard:
+    """Patch ``jax.jit`` to budget retraces and enforce donation.
+
+    Args:
+      budget: traces allowed per jitted function before a violation
+        (1 = "compiles once").  Distinct input shapes legitimately
+        retrace — the arg-diff in the report shows whether a violation
+        was a shape change or a genuine cache defeat.
+      mode: ``"raise"`` aborts on the first violation with
+        :class:`RetraceBudgetExceeded`; ``"warn"`` records it (and prints
+        to ``stream``) and keeps going — the bench integration.
+      enforce_donation: invalidate donated argument buffers after each
+        call so read-after-donate raises even where XLA ignores donation.
+      stream: where warn-mode messages go (default ``sys.stderr``).
+    """
+
+    def __init__(self, budget: int = 1, mode: str = "raise",
+                 enforce_donation: bool = True, stream=None):
+        if mode not in ("raise", "warn"):
+            raise ValueError(f"mode must be 'raise' or 'warn', got {mode!r}")
+        self.budget = max(1, int(budget))
+        self.mode = mode
+        self.enforce_donation = enforce_donation
+        self.stream = stream
+        self.violations: List[str] = []
+        self.traces: Dict[int, _FnTraces] = {}
+        self._saved: List[Tuple[Any, str, Any]] = []
+
+    # ------------------------------------------------------------ patch
+
+    def __enter__(self) -> "RetraceGuard":
+        import jax
+        self._patch(jax, "jit", jax.jit)
+        if hasattr(jax, "pjit"):
+            self._patch(jax, "pjit", jax.pjit)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for owner, name, orig in reversed(self._saved):
+            setattr(owner, name, orig)
+        self._saved.clear()
+
+    def _patch(self, owner: Any, name: str, orig: Any) -> None:
+        guard = self
+
+        @functools.wraps(orig)
+        def guarded(fun, *args, **kwargs):
+            wrapped = guard._counting(fun)
+            jitted = orig(wrapped, *args, **kwargs)
+            donate = _donate_argnums(kwargs)
+            if donate and guard.enforce_donation:
+                return _DonationEnforcer(jitted, donate)
+            return jitted
+
+        self._saved.append((owner, name, orig))
+        setattr(owner, name, guarded)
+
+    def _counting(self, fun: Any):
+        name = getattr(fun, "__qualname__",
+                       getattr(fun, "__name__", repr(fun)))
+        rec = _FnTraces(name)
+        self.traces[id(rec)] = rec
+        guard = self
+
+        @functools.wraps(fun)
+        def traced(*args, **kwargs):
+            n = rec.note(_signature(args, kwargs))
+            if n > guard.budget:
+                msg = (f"retrace budget exceeded (budget={guard.budget}): "
+                       + rec.describe())
+                guard.violations.append(msg)
+                if guard.mode == "raise":
+                    raise RetraceBudgetExceeded(msg)
+                print(f"RetraceGuard: {msg}",
+                      file=guard.stream or sys.stderr, flush=True)
+            return fun(*args, **kwargs)
+
+        return traced
+
+    # ----------------------------------------------------------- report
+
+    def report(self) -> str:
+        if not self.violations:
+            return "RetraceGuard: clean"
+        return "\n".join(self.violations)
+
+
+def retrace_guard(budget: int = 1, mode: str = "raise",
+                  enforce_donation: bool = True,
+                  stream=None) -> RetraceGuard:
+    """Functional alias: ``with retrace_guard(budget=2): ...``."""
+    return RetraceGuard(budget=budget, mode=mode,
+                        enforce_donation=enforce_donation, stream=stream)

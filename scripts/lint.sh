@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
 # Repo lint gate: ruff (pyflakes + import hygiene, config in
-# pyproject.toml) then dtlint (distributed-JAX hazards, docs/ANALYSIS.md)
-# against the committed baseline.  Extra args pass through to dtlint,
-# e.g. scripts/lint.sh --format json.
+# pyproject.toml) then dtlint (distributed-JAX hazards, docs/ANALYSIS.md:
+# per-module DT1xx + interprocedural DT2xx) against the committed
+# baseline.  Extra args pass through to dtlint, e.g.
+#   scripts/lint.sh --format github     # PR-diff annotations in CI
+#   DTLINT_JOBS=4 scripts/lint.sh       # parallel per-file pass
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,4 +16,5 @@ fi
 
 exec python -m distributed_tensorflow_tpu.analysis \
   distributed_tensorflow_tpu examples scripts \
+  --jobs "${DTLINT_JOBS:-0}" \
   --baseline .dtlint-baseline.json "$@"

@@ -14,10 +14,19 @@ for f in tests/test_*.py; do
       *UP*)
         # ignore a STALE UP (dead watcher leaves the last line frozen —
         # without an age check this loop would yield forever)
-        ts=$(date -u -d "$(echo "$line" | cut -d' ' -f1)" +%s 2>/dev/null \
-             || echo 0)
-        now=$(date -u +%s)
-        [ $((now - ts)) -lt 900 ] && up=1
+        ts=$(date -u -d "$(echo "$line" | cut -d' ' -f1)" +%s 2>/dev/null)
+        if [ -z "$ts" ]; then
+          # Unparsable timestamp on a live UP line: fail TOWARD yielding.
+          # The old fallback (ts=0) made the line look ancient, so this
+          # CPU-heavy loop would run straight through a live TPU window —
+          # measurement windows are scarcer than CPU time.
+          echo "WARNING: unparsable probe timestamp in '$line';" \
+               "assuming TPU window is LIVE and yielding" >&2
+          up=1
+        else
+          now=$(date -u +%s)
+          [ $((now - ts)) -lt 900 ] && up=1
+        fi
         ;;
     esac
     [ "$up" = "0" ] && [ "$busy" = "0" ] && break

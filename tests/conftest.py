@@ -160,3 +160,26 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(slow)
         elif any(nodeid.startswith(p) for p in _SLOW_TESTS):
             item.add_marker(slow)
+
+
+# ---------------------------------------------------------------------------
+# Opt-in runtime sanitizer (analysis/sanitizer.py, docs/ANALYSIS.md):
+#
+#   @pytest.mark.retrace_guard            # budget=1: "compiles once"
+#   @pytest.mark.retrace_guard(budget=2, enforce_donation=False)
+#
+# wraps the test in a RetraceGuard, so jit functions built inside the test
+# fail it on unexpected recompiles (with an arg-diff) and donated-buffer
+# reads raise even when XLA rejects the donation (routine on this CPU
+# mesh).  Opt-in by marker: the guard patches jax.jit for its extent,
+# which must never leak into unmarked tests.
+
+@pytest.fixture(autouse=True)
+def _retrace_guard_marker(request):
+    marker = request.node.get_closest_marker("retrace_guard")
+    if marker is None:
+        yield
+        return
+    from distributed_tensorflow_tpu.analysis.sanitizer import RetraceGuard
+    with RetraceGuard(*marker.args, **marker.kwargs):
+        yield

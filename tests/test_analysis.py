@@ -19,11 +19,23 @@ from distributed_tensorflow_tpu import analysis
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+MESH_AXES = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+
 def lint(code, select=None, path="fixture.py"):
     src = analysis.Source(path, textwrap.dedent(code))
-    mesh_axes = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
     sel = {select} if isinstance(select, str) else select
-    return analysis.run_rules(src, mesh_axes, select=sel)
+    return analysis.run_rules(src, MESH_AXES, select=sel)
+
+
+def lint_project(files, select=None, packages=()):
+    """Run the interprocedural DT2xx tier over {module: code} fixtures."""
+    sources = {mod: analysis.Source(mod.replace(".", "/") + ".py",
+                                    textwrap.dedent(code))
+               for mod, code in files.items()}
+    project = analysis.Project.from_sources(sources, set(packages))
+    sel = {select} if isinstance(select, str) else select
+    return analysis.run_project_rules(project, MESH_AXES, select=sel)
 
 
 def rules_of(findings):
@@ -393,6 +405,433 @@ def test_dt106_suppression():
     assert findings == []
 
 
+# ------------------------------------------------------------- DT201
+
+HELPERS_MOD = """
+    import jax
+
+    def init_weights(key, shape):
+        k1, k2 = jax.random.split(key)
+        return jax.random.normal(k1, shape) + jax.random.uniform(k2, shape)
+"""
+
+
+def test_dt201_key_passed_unsplit_to_two_callees():
+    findings = lint_project({
+        "pkg.helpers": HELPERS_MOD,
+        "pkg.main": """
+            from pkg.helpers import init_weights
+
+            def build(key):
+                w1 = init_weights(key, (4, 4))
+                w2 = init_weights(key, (4, 4))
+                return w1, w2
+        """}, select="DT201")
+    assert rules_of(findings) == ["DT201"]
+    assert "init_weights" in findings[0].message
+    assert findings[0].path == "pkg/main.py"
+
+
+def test_dt201_mixed_direct_and_callee_consumption():
+    findings = lint_project({
+        "pkg.helpers": HELPERS_MOD,
+        "pkg.main": """
+            import jax
+            from pkg.helpers import init_weights
+
+            def build(key):
+                w = init_weights(key, (4,))
+                noise = jax.random.normal(key, (4,))
+                return w, noise
+        """}, select="DT201")
+    assert rules_of(findings) == ["DT201"]
+
+
+def test_dt201_instance_method_consumption():
+    # model = Model(cfg); model.init(key) resolves through the local
+    # instance-type environment — the headline cross-module idiom
+    findings = lint_project({
+        "pkg.model": """
+            import jax
+
+            class Model:
+                def init(self, key):
+                    return jax.random.normal(key, (4,))
+        """,
+        "pkg.main": """
+            import jax
+            from pkg.model import Model
+
+            def main(key):
+                model = Model()
+                params = model.init(key)
+                data = jax.random.uniform(key, (8,))
+                return params, data
+        """}, select="DT201")
+    assert rules_of(findings) == ["DT201"]
+    assert "Model.init" in findings[0].message
+
+
+def test_dt201_callee_in_loop():
+    findings = lint_project({
+        "pkg.helpers": HELPERS_MOD,
+        "pkg.main": """
+            from pkg.helpers import init_weights
+
+            def stack(key, n):
+                outs = []
+                for _ in range(n):
+                    outs.append(init_weights(key, (4,)))
+                return outs
+        """}, select="DT201")
+    assert rules_of(findings) == ["DT201"]
+    assert "inside a loop" in findings[0].message
+
+
+def test_dt201_negative_split_between_consumers():
+    findings = lint_project({
+        "pkg.helpers": HELPERS_MOD,
+        "pkg.main": """
+            import jax
+            from pkg.helpers import init_weights
+
+            def build(key):
+                k1, k2 = jax.random.split(key)
+                return init_weights(k1, (4,)), init_weights(k2, (4,))
+        """}, select="DT201")
+    assert findings == []
+
+
+def test_dt201_negative_non_key_consumer_and_numpy_rng():
+    # a callee that never touches jax.random (numpy Generator idiom)
+    # must not count as a key consumer, however its param is named
+    findings = lint_project({
+        "pkg.data": """
+            def make_batch(rng, batch):
+                return rng.integers(0, 10, (batch,))
+        """,
+        "pkg.main": """
+            import numpy as np
+            from pkg.data import make_batch
+
+            def run(steps):
+                rng = np.random.default_rng(0)
+                for _ in range(steps):
+                    b = make_batch(rng, 32)
+                yield b
+        """}, select="DT201")
+    assert findings == []
+
+
+def test_dt201_negative_exclusive_branches():
+    findings = lint_project({
+        "pkg.helpers": HELPERS_MOD,
+        "pkg.main": """
+            from pkg.helpers import init_weights
+
+            def build(key, wide):
+                if wide:
+                    return init_weights(key, (8, 8))
+                else:
+                    return init_weights(key, (4, 4))
+        """}, select="DT201")
+    assert findings == []
+
+
+def test_dt201_suppression():
+    findings = lint_project({
+        "pkg.helpers": HELPERS_MOD,
+        "pkg.main": """
+            from pkg.helpers import init_weights
+
+            def replay(key):
+                a = init_weights(key, (4,))
+                b = init_weights(key, (4,))  # dtlint: disable=DT201 -- replay
+                return a, b
+        """}, select="DT201")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT202
+
+def test_dt202_typo_axis_through_cross_module_constant():
+    findings = lint_project({
+        "pkg.axes": 'TP_AXIS = "tesnor"\n',
+        "pkg.rules": """
+            from jax.sharding import PartitionSpec as P
+            from pkg.axes import TP_AXIS
+
+            spec = P(TP_AXIS, None)
+        """}, select="DT202")
+    assert rules_of(findings) == ["DT202"]
+    assert "tesnor" in findings[0].message and "TP_AXIS" in findings[0].message
+
+
+def test_dt202_valid_axes_through_constants():
+    findings = lint_project({
+        "pkg.axes": 'TP_AXIS = "tensor"\nBATCH_AXES = ("data", "fsdp")\n',
+        "pkg.rules": """
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from pkg.axes import TP_AXIS, BATCH_AXES
+
+            spec = P(BATCH_AXES, TP_AXIS)
+
+            def allreduce(x):
+                return lax.psum(x, TP_AXIS)
+        """}, select="DT202")
+    assert findings == []
+
+
+def test_dt202_make_mesh_unknown_axis():
+    findings = lint_project({
+        "pkg.main": """
+            from distributed_tensorflow_tpu import parallel
+
+            mesh = parallel.make_mesh({"data": 4, "modle": 2})
+        """}, select="DT202")
+    assert rules_of(findings) == ["DT202"]
+    assert "make_mesh axis 'modle'" in findings[0].message
+
+
+def test_dt202_make_mesh_valid_and_runtime_axis_skipped():
+    findings = lint_project({
+        "pkg.main": """
+            from distributed_tensorflow_tpu import parallel
+
+            def build(n, axis_arg):
+                mesh = parallel.make_mesh({"data": n, "tensor": 2})
+                other = parallel.make_mesh(axis_arg)   # runtime: out of reach
+                return mesh, other
+        """}, select="DT202")
+    assert findings == []
+
+
+def test_dt202_axis_bound_by_other_modules_mesh_is_allowed():
+    findings = lint_project({
+        "pkg.topo": """
+            from jax.sharding import Mesh
+            mesh = Mesh(devices, ("stage", "worker"))
+        """,
+        "pkg.use": """
+            STAGE = "stage"
+            from jax.sharding import PartitionSpec as P
+            spec = P(STAGE)
+        """}, select="DT202")
+    assert findings == []
+
+
+def test_dt202_suppression():
+    findings = lint_project({
+        "pkg.axes": 'FUTURE_AXIS = "ring"\n',
+        "pkg.rules": """
+            from jax.sharding import PartitionSpec as P
+            from pkg.axes import FUTURE_AXIS
+
+            spec = P(FUTURE_AXIS)  # dtlint: disable=DT202 -- planned axis
+        """}, select="DT202")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT203
+
+def test_dt203_cond_branches_disagree_on_collectives():
+    findings = lint_project({
+        "pkg.sp": """
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+
+            def make(mesh):
+                def inner(x):
+                    def with_sum(v):
+                        return lax.psum(v, "data")
+                    def without(v):
+                        return v * 2
+                    return lax.cond(x.sum() > 0, with_sum, without, x)
+                return shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None)
+        """}, select="DT203")
+    assert rules_of(findings) == ["DT203"]
+    assert "psum" in findings[0].message
+
+
+def test_dt203_switch_and_transitive_callee_collectives():
+    # branch collectives hidden one call deep in another module still count
+    findings = lint_project({
+        "pkg.comm": """
+            from jax import lax
+
+            def reduce_all(v):
+                return lax.psum(v, "data")
+        """,
+        "pkg.sp": """
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+            from pkg.comm import reduce_all
+
+            def make(mesh):
+                def inner(x):
+                    def a(v):
+                        return reduce_all(v)
+                    def b(v):
+                        return v
+                    def c(v):
+                        return reduce_all(v)
+                    return lax.switch(x.astype(int), (a, b, c), x)
+                return shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None)
+        """}, select="DT203")
+    assert rules_of(findings) == ["DT203"]
+
+
+def test_dt203_negative_matching_branches_and_outside_spmd():
+    findings = lint_project({
+        "pkg.sp": """
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+
+            def make(mesh):
+                def inner(x):
+                    def a(v):
+                        return lax.psum(v * 2, "data")
+                    def b(v):
+                        return lax.psum(v, "data")
+                    return lax.cond(x.sum() > 0, a, b, x)
+                return shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None)
+
+            def host_only(x):
+                # same shape of code OUTSIDE shard_map: predicates are
+                # globally consistent under jit, not a deadlock hazard
+                def a(v):
+                    return lax.psum(v, "data")
+                def b(v):
+                    return v
+                return lax.cond(x.sum() > 0, a, b, x)
+        """}, select="DT203")
+    assert findings == []
+
+
+def test_dt203_suppression():
+    findings = lint_project({
+        "pkg.sp": """
+            from jax import lax
+            from jax.experimental.shard_map import shard_map
+
+            def make(mesh):
+                def inner(x):
+                    def a(v):
+                        return lax.psum(v, "data")
+                    def b(v):
+                        return v
+                    return lax.cond(x.sum() > 0, a, b, x)  # dtlint: disable=DT203 -- uniform pred
+                return shard_map(inner, mesh=mesh, in_specs=None,
+                                 out_specs=None)
+        """}, select="DT203")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT204
+
+TRAIN_MOD = """
+    import jax
+
+    def _step(state, batch):
+        return state + batch, {}
+
+    step = jax.jit(_step, donate_argnums=0)
+
+    def train_epoch(state, batches):
+        for b in batches:
+            state, m = step(state, b)
+        return state
+"""
+
+
+def test_dt204_read_after_cross_module_donating_call():
+    findings = lint_project({
+        "pkg.train": TRAIN_MOD,
+        "pkg.main": """
+            from pkg.train import train_epoch
+
+            def run(state, batches):
+                out = train_epoch(state, batches)
+                return state
+        """}, select="DT204")
+    assert rules_of(findings) == ["DT204"]
+    assert "train_epoch" in findings[0].message
+    assert findings[0].path == "pkg/main.py"
+
+
+def test_dt204_builder_returning_donating_jit():
+    # generic builder (name does NOT match make_*train_step): the donation
+    # contract comes from the returned jax.jit(..., donate_argnums=...)
+    findings = lint_project({
+        "pkg.build": """
+            import jax
+
+            def build_updater(opt):
+                def _apply(state, grads):
+                    return state
+                return jax.jit(_apply, donate_argnums=0)
+        """,
+        "pkg.main": """
+            from pkg.build import build_updater
+
+            def run(state, grads):
+                updater = build_updater(None)
+                new = updater(state, grads)
+                return state.params
+        """}, select="DT204")
+    assert rules_of(findings) == ["DT204"]
+    assert "build_updater" in findings[0].message
+
+
+def test_dt204_transitive_donation_through_two_hops():
+    findings = lint_project({
+        "pkg.train": TRAIN_MOD,
+        "pkg.loop": """
+            from pkg.train import train_epoch
+
+            def fit(state, data):
+                return train_epoch(state, data)
+        """,
+        "pkg.main": """
+            from pkg.loop import fit
+
+            def run(state, data):
+                final = fit(state, data)
+                return state
+        """}, select="DT204")
+    assert [f.path for f in findings] == ["pkg/main.py"]
+
+
+def test_dt204_negative_rebind_same_name():
+    findings = lint_project({
+        "pkg.train": TRAIN_MOD,
+        "pkg.main": """
+            from pkg.train import train_epoch
+
+            def run(state, batches):
+                state = train_epoch(state, batches)
+                return state
+        """}, select="DT204")
+    assert findings == []
+
+
+def test_dt204_suppression():
+    findings = lint_project({
+        "pkg.train": TRAIN_MOD,
+        "pkg.main": """
+            from pkg.train import train_epoch
+
+            def run(state, batches):
+                out = train_epoch(state, batches)
+                return state  # dtlint: disable=DT204 -- CPU-only helper
+        """}, select="DT204")
+    assert findings == []
+
+
 # ----------------------------------------------------- infrastructure
 
 def test_file_level_suppression():
@@ -432,7 +871,8 @@ def test_baseline_partition_roundtrip(tmp_path):
 
 def test_rule_catalog_covers_all_families():
     ids = [rid for rid, _, _ in analysis.rule_catalog()]
-    assert ids == ["DT101", "DT102", "DT103", "DT104", "DT105", "DT106"]
+    assert ids == ["DT101", "DT102", "DT103", "DT104", "DT105", "DT106",
+                   "DT201", "DT202", "DT203", "DT204"]
 
 
 def test_cli_json_output_and_exit_codes(tmp_path):
@@ -462,6 +902,104 @@ def test_cli_json_output_and_exit_codes(tmp_path):
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 0
     assert json.loads(proc.stdout)["count"] == 0
+
+
+def test_cli_project_pass_catches_cross_file_bug(tmp_path):
+    """DT2xx through the real CLI: a two-file package with a cross-module
+    donation bug that no single-file pass can see."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "train.py").write_text(textwrap.dedent("""
+        import jax
+
+        def _step(state, batch):
+            return state + batch, {}
+
+        step = jax.jit(_step, donate_argnums=0)
+
+        def train_epoch(state, batches):
+            for b in batches:
+                state, m = step(state, b)
+            return state
+    """))
+    (pkg / "main.py").write_text(textwrap.dedent("""
+        from pkg.train import train_epoch
+
+        def run(state, batches):
+            out = train_epoch(state, batches)
+            return state
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         "pkg", "--format", "json"],
+        capture_output=True, text=True, cwd=str(tmp_path),
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["DT204"]
+    # --no-project drops the interprocedural tier
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         "pkg", "--format", "json", "--no-project"],
+        capture_output=True, text=True, cwd=str(tmp_path),
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["count"] == 0
+
+
+def test_cli_github_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        def f(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a, b
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(bad), "--format", "github"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    line = proc.stdout.strip().splitlines()[0]
+    assert line.startswith("::error ")
+    assert "title=DT102" in line and f"line=" in line
+    # clean tree emits nothing (annotation commands only on findings)
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(good), "--format", "github"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    assert proc.stdout.strip() == ""
+
+
+def test_cli_jobs_parallel_matches_serial(tmp_path):
+    for i in range(3):
+        (tmp_path / f"m{i}.py").write_text(textwrap.dedent("""
+            import jax
+
+            def f(key):
+                a = jax.random.normal(key, (2,))
+                b = jax.random.normal(key, (2,))
+                return a, b
+        """))
+
+    def run(extra):
+        proc = subprocess.run(
+            [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+             str(tmp_path), "--format", "json"] + extra,
+            capture_output=True, text=True, cwd=REPO)
+        return proc.returncode, json.loads(proc.stdout)
+
+    rc_s, doc_s = run([])
+    rc_p, doc_p = run(["--jobs", "2"])
+    assert rc_s == rc_p == 1
+    assert doc_s == doc_p
+    assert doc_s["count"] == 3
 
 
 def test_syntax_error_is_reported_not_crashed(tmp_path):

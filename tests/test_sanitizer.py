@@ -1,0 +1,172 @@
+"""Runtime sanitizer (analysis/sanitizer.py): retrace budgets, arg-diff
+reporting, donated-buffer enforcement, and the pytest marker wiring.
+
+The seeded-retrace tests are the contract from ISSUE 2: a retrace storm
+that is invisible without the sanitizer (first test proves the storm runs
+silently) must fail loudly under the guard (second test).
+"""
+import io
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu.analysis.sanitizer import (
+    RetraceBudgetExceeded, RetraceGuard, retrace_guard)
+
+
+def _double(x):
+    return x * 2
+
+
+def _storm(jitted, n=4):
+    """Seed a retrace per call: every iteration changes the arg shape."""
+    for i in range(1, n + 1):
+        jitted(jnp.ones((i,)))
+
+
+# ------------------------------------------------------------- retraces
+
+def test_seeded_retrace_storm_is_silent_without_sanitizer():
+    # the hazard the sanitizer exists for: nothing raises, nothing warns
+    _storm(jax.jit(_double))
+
+
+def test_seeded_retrace_storm_fails_under_guard():
+    with pytest.raises(RetraceBudgetExceeded) as ei:
+        with RetraceGuard(budget=2):
+            _storm(jax.jit(_double))
+    msg = str(ei.value)
+    assert "budget=2" in msg
+    # the report carries an actionable arg-diff, not just a count
+    assert "->" in msg and "float32[2]" in msg and "float32[3]" in msg
+
+
+def test_stable_shapes_stay_within_budget():
+    with RetraceGuard(budget=1) as guard:
+        f = jax.jit(_double)
+        for _ in range(5):
+            f(jnp.ones((4,)))
+    assert guard.violations == []
+    assert guard.report() == "RetraceGuard: clean"
+
+
+def test_warn_mode_records_and_continues():
+    buf = io.StringIO()
+    with RetraceGuard(budget=1, mode="warn", stream=buf) as guard:
+        _storm(jax.jit(_double), n=3)
+    assert len(guard.violations) == 2           # traces 2 and 3
+    assert "arg-diff" in buf.getvalue()
+
+
+def test_static_arg_cache_defeat_reports_value_change():
+    def f(x, cfg):
+        return x * cfg[0]
+
+    with pytest.raises(RetraceBudgetExceeded) as ei:
+        with RetraceGuard(budget=1):
+            g = jax.jit(f, static_argnums=(1,))
+            g(jnp.ones((2,)), (2,))
+            g(jnp.ones((2,)), (3,))             # new static value: retrace
+    assert "2 -> 3" in str(ei.value)            # leaf-level value diff
+
+
+def test_guard_restores_jit_on_exit():
+    orig = jax.jit
+    with RetraceGuard(budget=1):
+        assert jax.jit is not orig
+    assert jax.jit is orig
+    # and on the exception path
+    try:
+        with RetraceGuard(budget=1):
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    assert jax.jit is orig
+
+
+# ------------------------------------------------------------- donation
+
+def _step(state, batch):
+    return state + batch, {"loss": state.sum()}
+
+
+def test_donated_read_raises_under_guard_even_when_backend_rejects():
+    # a donation XLA cannot use (output aliases nothing): jax leaves the
+    # buffer readable — the guard enforces the *declared* contract anyway
+    def shrink(state, b):
+        return (state[:2] + b[:2]).astype(jnp.bfloat16)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with RetraceGuard(budget=2):
+            f = jax.jit(shrink, donate_argnums=0)
+            state = jnp.ones((8,))
+            out = f(state, jnp.ones((8,)))
+            np.asarray(out)                     # result stays readable
+            with pytest.raises(RuntimeError, match="deleted"):
+                np.asarray(state)
+
+
+def test_donated_read_passes_silently_without_guard():
+    # the hole the guard closes: same rejected donation, no guard — the
+    # read succeeds and a test would happily pass TPU-divergent code
+    def shrink(state, b):
+        return (state[:2] + b[:2]).astype(jnp.bfloat16)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f = jax.jit(shrink, donate_argnums=0)
+        state = jnp.ones((8,))
+        f(state, jnp.ones((8,)))
+        assert float(np.asarray(state)[0]) == 1.0
+
+
+def test_donation_chain_with_rebinding_is_clean():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with RetraceGuard(budget=2) as guard:
+            step = jax.jit(_step, donate_argnums=0)
+            state = jnp.ones((8,))
+            for _ in range(3):
+                state, m = step(state, jnp.ones((8,)))
+            assert float(np.asarray(m["loss"])) > 0
+    assert guard.violations == []
+
+
+def test_enforcer_delegates_jit_attributes():
+    with RetraceGuard(budget=2):
+        step = jax.jit(_step, donate_argnums=0)
+        lowered = step.lower(jnp.ones((4,)), jnp.ones((4,)))
+        assert lowered.compile() is not None
+
+
+def test_enforce_donation_off_leaves_buffers_alone():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with RetraceGuard(budget=2, enforce_donation=False):
+            def shrink(state, b):
+                return (state[:2] + b[:2]).astype(jnp.bfloat16)
+            f = jax.jit(shrink, donate_argnums=0)
+            state = jnp.ones((8,))
+            f(state, jnp.ones((8,)))
+            assert float(np.asarray(state)[0]) == 1.0
+
+
+# ------------------------------------------------------------- fixture
+
+@pytest.mark.retrace_guard(budget=1)
+def test_marker_wraps_test_in_guard():
+    f = jax.jit(_double)
+    f(jnp.ones((4,)))
+    f(jnp.ones((4,)))                           # cache hit: no retrace
+    with pytest.raises(RetraceBudgetExceeded):
+        f(jnp.ones((5,)))                       # second trace: over budget
+
+
+def test_functional_alias():
+    with retrace_guard(budget=3) as guard:
+        assert isinstance(guard, RetraceGuard)
+        assert guard.budget == 3
