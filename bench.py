@@ -31,6 +31,14 @@ Image benches carry ``data: real|synthetic`` provenance (real files under
 ``DTTPU_DATA_DIR`` vs the procedural stand-ins in data/datasets.py) and gate
 convergence on the provenance-appropriate threshold.
 
+Telemetry (obs/): unless ``DTTPU_BENCH_TELEMETRY=0``, train-config JSON
+lines carry ``step_time_p50_ms``/``step_time_p95_ms`` (per-update host
+latency, every sample closed with a completion barrier) and
+``trace_file`` — a Chrome-trace/Perfetto host timeline of the measured
+dispatches plus every jit compile/retrace the sanitizer observed
+(``DTTPU_BENCH_TRACE_FILE`` overrides the path,
+``DTTPU_BENCH_LATENCY_STEPS`` sizes the async latency pass).
+
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md:
 "published: {}"), so the baseline is a measured stand-in for its
 CPU/GPU-era stack: the SAME model/batch/optimizer stepped with torch on CPU
@@ -44,6 +52,17 @@ import sys
 import time
 
 SMOKE = bool(os.environ.get("DTTPU_BENCH_SMOKE"))
+
+# Telemetry (obs/): DTTPU_BENCH_TELEMETRY=0 disables.  When on, the run
+# records a host timeline (dispatch spans + RetraceGuard compile/retrace
+# instants) whose file path lands in the JSON line as `trace_file`, and
+# per-update host latencies (each closed with a completion barrier, never
+# the async-dispatch lie dtlint DT107 flags) feed `step_time_p50_ms` /
+# `step_time_p95_ms`.  Measured overhead on the CPU smoke bench is under
+# 1% (docs/OBSERVABILITY.md).
+TELEMETRY = os.environ.get("DTTPU_BENCH_TELEMETRY", "1") != "0"
+_STEP_TIMES = []   # per-update seconds, barrier-closed (see _time_steps)
+LATENCY_STEPS = int(os.environ.get("DTTPU_BENCH_LATENCY_STEPS", "10"))
 
 _PROMOTED = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "docs", "PROMOTED.json")
@@ -280,7 +299,8 @@ def bench_framework():
     f_total = _flops_of(multi, state, bench_batch)
     flops_per_example = _per_example_flops(f_total, k * batch, mesh)
     rate, _, sec, state = _time_steps(multi, state, bench_batch,
-                                      warmup=WARMUP_CALLS, steps=CALLS)
+                                      warmup=WARMUP_CALLS, steps=CALLS,
+                                      updates_per_call=k)
     eps = rate * k * batch
     log(f"framework (multi-step): {eps:,.0f} examples/s total, "
         f"{eps / n_chips:,.0f} /chip ({sec / k * 1e3:.2f} ms/step, "
@@ -320,15 +340,26 @@ def bench_torch_baseline():
     return _torch_step_rate(build, warmup=3, steps=4 if SMOKE else 40)
 
 
-def _time_steps(step, state, batch, warmup=3, steps=12):
+def _time_steps(step, state, batch, warmup=3, steps=12, updates_per_call=1):
     """Generic throughput timing for a compiled train step.  Returns
     (steps/sec, last loss, sec/step, final state) from the BEST of
     ``WINDOWS`` timed windows (same treatment as the torch baseline —
     see WINDOWS); per-chip normalization is the caller's job.  The input
     ``state`` is DONATED into the step chain — callers continuing to
     step must use the returned state.  On the CPU mesh every step is
-    synced (see ``_sync_every_step``)."""
+    synced (see ``_sync_every_step``).
+
+    Telemetry side channel (``TELEMETRY``): each timed dispatch is
+    wrapped in an obs "dispatch" span, and per-UPDATE host latencies are
+    collected into ``_STEP_TIMES`` for the JSON line's p50/p95 — only
+    where a completion barrier closes the step: inline on the synced CPU
+    mesh, and via a short dedicated pass (``LATENCY_STEPS``, each step
+    closed with a value fetch) on async backends, where a per-step host
+    clock inside the pipelined window would time dispatch (the DT107
+    lie).  ``updates_per_call``: scanned multi-step dispatches report
+    per-update latency, not per-dispatch."""
     import jax
+    from distributed_tensorflow_tpu.obs import trace as obs_trace
     if SMOKE:
         warmup, steps = min(warmup, 2), min(steps, 4)
     for _ in range(warmup):
@@ -336,19 +367,33 @@ def _time_steps(step, state, batch, warmup=3, steps=12):
         if _sync_every_step():
             jax.block_until_ready(m["loss"])
     _fetch(m)
+    sync = _sync_every_step()
     # every window's (dt, loss) is captured together so the returned rate,
     # sec/step and loss all come from the SAME (best) window
     best_dt, best_loss = None, None
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
         for _ in range(steps):
-            state, m = step(state, batch)
-            if _sync_every_step():
+            t_step = time.perf_counter()
+            with obs_trace.span("dispatch", updates=updates_per_call):
+                state, m = step(state, batch)
+            if sync:
                 jax.block_until_ready(m["loss"])
+                if TELEMETRY:
+                    _STEP_TIMES.append(
+                        (time.perf_counter() - t_step) / updates_per_call)
         loss = _fetch(m)
         dt = time.perf_counter() - t0
         if best_dt is None or dt < best_dt:
             best_dt, best_loss = dt, loss
+    if TELEMETRY and not sync:
+        for _ in range(min(steps, LATENCY_STEPS)):
+            t_step = time.perf_counter()
+            with obs_trace.span("dispatch", updates=updates_per_call):
+                state, m = step(state, batch)
+            _fetch(m)   # value fetch: the only honest barrier (docstring)
+            _STEP_TIMES.append(
+                (time.perf_counter() - t_step) / updates_per_call)
     return steps / best_dt, best_loss, best_dt / steps, state
 
 
@@ -1471,6 +1516,13 @@ def main():
     # budget default leaves room for the batch ladder's legitimate
     # shape-driven retraces (one lower() + one call per rung); warnings
     # go to stderr with an arg-diff, and the JSON line carries the count.
+    # Telemetry tracer: active for the whole measurement so _time_steps'
+    # dispatch spans AND the sanitizer's jit_compile/retrace instants land
+    # on one host timeline, written next to the JSON line as `trace_file`.
+    tracer = None
+    if TELEMETRY:
+        from distributed_tensorflow_tpu.obs import trace as obs_trace
+        tracer = obs_trace.activate(obs_trace.Tracer(enabled=True))
     if os.environ.get("DTTPU_BENCH_SANITIZE", "1") != "0":
         from distributed_tensorflow_tpu.analysis.sanitizer import RetraceGuard
         budget = int(os.environ.get("DTTPU_BENCH_RETRACE_BUDGET", "6"))
@@ -1481,6 +1533,23 @@ def main():
             result["retrace_warnings"] = len(guard.violations)
     else:
         result = CONFIGS[config]()
+    if _STEP_TIMES:
+        # barrier-closed per-update host latencies (see _time_steps);
+        # decode configs time whole generate() calls instead and carry
+        # no step-time fields
+        ts = sorted(_STEP_TIMES)
+        result["step_time_p50_ms"] = round(ts[int(0.50 * (len(ts) - 1))]
+                                           * 1e3, 3)
+        result["step_time_p95_ms"] = round(ts[int(0.95 * (len(ts) - 1))]
+                                           * 1e3, 3)
+    if tracer is not None:
+        import tempfile
+        path = os.environ.get("DTTPU_BENCH_TRACE_FILE") or os.path.join(
+            tempfile.gettempdir(), f"dttpu-bench-{config}-trace.json")
+        try:
+            result["trace_file"] = tracer.save(path)
+        except OSError as e:
+            log(f"could not write trace file {path}: {e}")
     if claim_report():
         print(json.dumps(result), flush=True)
 

@@ -6,8 +6,9 @@ Two tiers share one file walk:
 
 * per-module (lexical): host syncs inside jit (DT101), PRNG key reuse
   (DT102), collectives naming unbound mesh axes (DT103), non-hashable
-  static args (DT104), jit wrappers built in loop bodies (DT105), and
-  reads of donated buffers (DT106);
+  static args (DT104), jit wrappers built in loop bodies (DT105), reads
+  of donated buffers (DT106), and wall-clock timing of unsynced jitted
+  calls — the async-dispatch measurement lie (DT107);
 * interprocedural (call-graph + dataflow summaries, ``callgraph.py`` /
   ``dataflow.py``): keys passed unsplit to multiple consumers across
   function boundaries (DT201), mesh-axis names flowing through

@@ -8,6 +8,8 @@ Rule IDs are stable API (baselines and suppressions reference them):
   DT104  error    non-hashable value bound to a static jit argument
   DT105  warning  jit/pjit/pmap/shard_map constructed inside a loop body
   DT106  error    buffer read after being donated via donate_argnums
+  DT107  warning  wall-clock timer brackets a jitted call with no
+                  completion barrier — times dispatch, not compute
 
 Analysis in this module is lexical and intra-module: no imports of the
 analyzed code, no JAX dependency, so the linter can gate CI on a machine
@@ -607,8 +609,138 @@ class DonatedReuse(Rule):
         return None
 
 
+# --------------------------------------------------------------- DT107
+
+_TIMER_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                "time.time_ns", "time.perf_counter_ns",
+                "time.monotonic_ns"}
+
+
+class AsyncDispatchTiming(Rule):
+    id = "DT107"
+    severity = Severity.WARNING
+    summary = ("time.time/perf_counter interval brackets a jitted call "
+               "with no completion barrier in between — async dispatch "
+               "returns before the device finishes, so the measurement "
+               "times dispatch, not compute")
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        scopes = [ctx.src.tree] + [
+            n for n in ast.walk(ctx.src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            yield from self._check_scope(ctx, scope)
+
+    @staticmethod
+    def _is_timer_call(src: Source, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Call)
+                and src.call_canonical(node) in _TIMER_CALLS)
+
+    def _check_scope(self, ctx: ModuleContext,
+                     scope: ast.AST) -> Iterator[Finding]:
+        src, reg = ctx.src, ctx.registry
+        own = [n for n in ast.walk(scope)
+               if n is not scope and hasattr(n, "lineno")
+               and KeyReuse._nearest_def(n) is scope]
+        events = sorted(own, key=lambda n: (n.lineno, n.col_offset))
+        open_timers: Dict[str, ast.AST] = {}   # var -> its timer assign
+        # jitted calls dispatched since a timer opened, awaiting a barrier
+        pending: List[Tuple[ast.AST, str]] = []
+        pending_names: Set[str] = set()        # names bound from them
+
+        for node in events:
+            if isinstance(node, ast.Assign) \
+                    and self._is_timer_call(src, node.value):
+                for t in node.targets:
+                    for nm in assigned_names(t):
+                        open_timers[nm] = node
+                continue
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                start_var = self._closes(src, node, open_timers)
+                if start_var is not None:
+                    if pending:
+                        fnames = ", ".join(
+                            sorted({f"'{f}'" for _, f in pending}))
+                        yield ctx.finding(
+                            self.id, self.severity, node,
+                            f"wall-clock interval (opened line "
+                            f"{open_timers[start_var].lineno}) closes here "
+                            f"but the jitted call(s) {fnames} it brackets "
+                            "were never synced — jit returns before the "
+                            "device finishes, so this times dispatch, not "
+                            "compute; block_until_ready or fetch a value "
+                            "before reading the clock")
+                    open_timers.pop(start_var, None)
+                    pending.clear()
+                    pending_names.clear()
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_timer_call(src, node):
+                continue
+            # barrier/consumption: ANY call whose arguments (or method
+            # receiver) mention a pending result counts as a sync —
+            # block_until_ready, np.asarray, float, a _fetch helper, a
+            # print.  Conservative by family contract: imprecision costs
+            # false negatives, never noise.
+            mentioned: Set[str] = set()
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                mentioned |= names_in(a)
+            if isinstance(node.func, ast.Attribute):
+                mentioned |= names_in(node.func.value)
+            if mentioned & pending_names:
+                pending.clear()
+                pending_names.clear()
+                continue
+            if not open_timers or not isinstance(node.func, ast.Name):
+                continue
+            fname = node.func.id
+            if fname not in reg.site_by_name:
+                continue
+            # nested inside another call (np.asarray(step(...))): the
+            # result is consumed by construction
+            if enclosing(node, (ast.Call,),
+                         stop=(ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)) is not None:
+                continue
+            pending.append((node, fname))
+            pending_names |= self._result_names(node)
+
+    @staticmethod
+    def _closes(src: Source, node: ast.BinOp,
+                open_timers: Dict[str, ast.AST]) -> Optional[str]:
+        """The opening timer var when ``node`` is ``<now> - t0`` (or
+        ``t1 - t0`` between two timer vars); None otherwise."""
+        sides = []
+        for side in (node.left, node.right):
+            if AsyncDispatchTiming._is_timer_call(src, side):
+                sides.append("<now>")
+            elif isinstance(side, ast.Name) and side.id in open_timers:
+                sides.append(side.id)
+            else:
+                return None
+        named = [s for s in sides if s != "<now>"]
+        return named[-1] if named else None
+
+    @staticmethod
+    def _result_names(call: ast.Call) -> Set[str]:
+        """Names the enclosing assignment binds from this call's result."""
+        cur = getattr(call, "parent", None)
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = getattr(cur, "parent", None)
+        if isinstance(cur, ast.Assign):
+            out: Set[str] = set()
+            for t in cur.targets:
+                out |= assigned_names(t)
+            return out
+        if isinstance(cur, (ast.AnnAssign, ast.AugAssign)):
+            return assigned_names(cur.target)
+        return set()
+
+
 RULES: List[Rule] = [HostSyncInJit(), KeyReuse(), UnknownMeshAxis(),
-                     NonHashableStatic(), JitInLoop(), DonatedReuse()]
+                     NonHashableStatic(), JitInLoop(), DonatedReuse(),
+                     AsyncDispatchTiming()]
 
 
 def rule_catalog() -> List[Tuple[str, str, str]]:

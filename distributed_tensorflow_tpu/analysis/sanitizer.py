@@ -36,6 +36,13 @@ the guard is active** are instrumented (a ``functools.partial(jax.jit,
 covers positional ``donate_argnums`` (not ``donate_argnames``).  The
 module imports JAX lazily — importing it (e.g. via the analysis package)
 stays pure-stdlib.
+
+Telemetry: when an ``obs.trace`` tracer is active (``obs.Telemetry`` in
+a TrainSession, or bench's trace file), every trace of a guarded
+function lands on the host timeline as an instant event —
+``jit_compile`` for the first trace, ``retrace`` (with the arg-diff)
+for each one after — so recompiles show up exactly where the step-time
+spans stretch.  No tracer active = no work.
 """
 from __future__ import annotations
 
@@ -90,6 +97,22 @@ def _diff(prev: Dict[str, str], cur: Dict[str, str]) -> str:
                 "static arg, weak-type flip on a Python scalar, or an "
                 "explicit lower()/AOT trace)")
     return "\n".join(lines)
+
+
+def _emit_trace_instant(rec: "_FnTraces", n: int) -> None:
+    """Mirror a (re)trace onto the active obs tracer's host timeline.
+    ``obs.trace`` is pure stdlib, so this keeps the no-JAX import
+    contract; with no active tracer it is a dict lookup and a return."""
+    from ..obs import trace as obs_trace
+    tracer = obs_trace.active_tracer()
+    if tracer is None or not tracer.enabled:
+        return
+    if n == 1:
+        tracer.instant("jit_compile", fn=rec.name)
+    else:
+        tracer.instant("retrace", fn=rec.name, trace=n,
+                       arg_diff=_diff(rec.signatures[-2],
+                                      rec.signatures[-1]))
 
 
 class _FnTraces:
@@ -224,6 +247,7 @@ class RetraceGuard:
         @functools.wraps(fun)
         def traced(*args, **kwargs):
             n = rec.note(_signature(args, kwargs))
+            _emit_trace_instant(rec, n)
             if n > guard.budget:
                 msg = (f"retrace budget exceeded (budget={guard.budget}): "
                        + rec.describe())

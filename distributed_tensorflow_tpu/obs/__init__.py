@@ -1,0 +1,168 @@
+"""obs — the unified telemetry layer (tracing, metrics, device health).
+
+One subsystem shared by training, serving, and bench, three pillars:
+
+* **tracing** (``obs.trace``) — a Chrome-trace-event/Perfetto JSON span
+  recorder for the host timeline: ``span("data_load")`` /
+  ``span("dispatch")`` / ``span("checkpoint")`` plus instant events for
+  jit compiles and retraces (``analysis.sanitizer.RetraceGuard`` emits
+  them into the active tracer, arg-diff included).
+* **metrics** (``obs.metrics`` + ``obs.http``) — a Prometheus-style
+  counter/gauge/histogram registry with text exposition, served at
+  ``/metrics`` (+ ``/healthz``) by a daemon-thread stdlib HTTP server.
+* **device health** (``obs.device``) — in-graph grad-norm/nonfinite
+  accumulators that ride the step's existing metrics dict (no extra
+  device->host syncs), and host-side ``jax.live_arrays`` byte totals.
+
+``Telemetry`` is the façade that wires the pillars together and plugs
+into ``train.TrainSession(telemetry=...)`` with the ``TraceHook`` /
+``MetricsExportHook`` pair (train/hooks.py)::
+
+    from distributed_tensorflow_tpu import obs, train
+
+    tele = obs.Telemetry(trace_dir=logdir, metrics_port=9100)
+    with train.TrainSession(state, step, telemetry=tele,
+                            hooks=[train.TraceHook(tele),
+                                   train.MetricsExportHook(tele),
+                                   train.StopAtStepHook(1000)]) as sess:
+        ...
+    tele.close()     # writes trace-host0.json, stops the endpoint
+
+Everything here is pure stdlib (``obs.device`` imports JAX lazily
+inside its functions); disabled telemetry costs one attribute check per
+step.  See docs/OBSERVABILITY.md for span names, the metric catalog,
+and measured overhead.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+from . import device, http, metrics, trace
+from .http import MetricsServer
+from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
+                      parse_exposition)
+from .trace import Tracer
+
+__all__ = ["Telemetry", "Tracer", "MetricsServer", "Registry", "REGISTRY",
+           "Counter", "Gauge", "Histogram", "parse_exposition",
+           "device", "http", "metrics", "trace"]
+
+
+class Telemetry:
+    """Bundle of one Tracer + one metrics Registry + one HTTP endpoint.
+
+    Args:
+      trace_dir: where to write the per-host Chrome trace JSON
+        (``trace-host{i}.json``); ``None`` disables tracing (the tracer
+        stays wired but records nothing).
+      metrics_port: serve ``/metrics`` + ``/healthz`` on this port
+        (``0`` = ephemeral, read ``telemetry.server.port`` after
+        ``start()``); ``None`` disables the endpoint (the registry still
+        collects — bench reads it in-process).
+      registry: share an existing Registry (default: a fresh one, so two
+        Telemetry objects in one process never mix series).
+      host_index: the multi-host process index used as the trace "pid"
+        and the trace filename suffix.  Default reads the ``PROCESS_ID``
+        env var (the cluster-bootstrap convention, parallel/cluster.py)
+        — deliberately NOT ``jax.process_index()``, which would
+        force-initialize the backend at telemetry construction; pass it
+        explicitly after ``jax.distributed`` init when you have it.
+      service: label reported by ``/healthz`` ("train", "serve", ...).
+      health_fn: extra health fields merged into the ``/healthz`` doc.
+    """
+
+    def __init__(self, trace_dir: Optional[str] = None,
+                 metrics_port: Optional[int] = None,
+                 registry: Optional[Registry] = None,
+                 host_index: Optional[int] = None,
+                 service: str = "train",
+                 health_fn: Optional[Callable[[], Dict]] = None):
+        if host_index is None:
+            try:
+                host_index = int(os.environ.get("PROCESS_ID", "0"))
+            except ValueError:
+                host_index = 0
+        self.host_index = host_index
+        self.trace_dir = trace_dir
+        self.service = service
+        self.health_fn = health_fn
+        self.tracer = Tracer(enabled=trace_dir is not None, pid=host_index)
+        self.registry = registry if registry is not None else Registry()
+        self.server: Optional[MetricsServer] = None
+        if metrics_port is not None:
+            self.server = MetricsServer(self.registry, port=metrics_port,
+                                        health_fn=self._health)
+        self._started = False
+        self._closed = False
+
+    # ------------------------------------------------------------ health
+
+    def _health(self) -> Dict:
+        doc: Dict = {"status": "ok", "service": self.service,
+                     "host_index": self.host_index}
+        steps = self.registry.get("dttpu_steps_total")
+        if steps is not None:
+            doc["steps_total"] = steps.value
+        if self.health_fn is not None:
+            doc.update(self.health_fn())
+        return doc
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> "Telemetry":
+        """Idempotent: activate the tracer as the process-wide sink (so
+        RetraceGuard retrace instants land here) and bring up the HTTP
+        endpoint.  Hooks call this from ``begin`` — explicit calls are
+        only needed outside a TrainSession."""
+        if self._started:
+            return self
+        self._started = True
+        if self.tracer.enabled:
+            trace.activate(self.tracer)
+        if self.server is not None:
+            self.server.start()
+        return self
+
+    @property
+    def trace_path(self) -> Optional[str]:
+        if self.trace_dir is None:
+            return None
+        return os.path.join(self.trace_dir,
+                            f"trace-host{self.host_index}.json")
+
+    def save_trace(self) -> Optional[str]:
+        """Write the trace file (call as often as you like; the file is
+        rewritten whole each time)."""
+        path = self.trace_path
+        if path is None or not self.tracer.enabled:
+            return None
+        return self.tracer.save(path)
+
+    def close(self) -> None:
+        """Write the trace, deactivate the tracer, stop the endpoint."""
+        if self._closed:
+            return
+        self._closed = True
+        self.save_trace()
+        trace.deactivate(self.tracer)
+        if self.server is not None:
+            self.server.stop()
+
+    def __enter__(self) -> "Telemetry":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------- shared instruments
+
+    def checkpoint_seconds(self) -> Histogram:
+        return self.registry.histogram(
+            "dttpu_checkpoint_save_seconds",
+            "Wall-clock duration of TrainSession.save() calls.")
+
+    def metrics_url(self) -> Optional[str]:
+        if self.server is None:
+            return None
+        return self.server.url + "/metrics"

@@ -1,0 +1,88 @@
+"""Device-health accumulators: in-graph signals + host memory totals.
+
+The in-graph half computes replica-health scalars *inside* the compiled
+step and returns them through the metrics dict the step already emits —
+the same contract ``train/hooks.py`` documents: hooks that don't fire
+never pull a value, so the hot loop stays async-dispatch clean and the
+health signals cost two small reductions fused into the step's XLA
+program (no extra device->host syncs, no extra dispatches).
+
+* ``grad_health(grads)`` — global gradient L2 norm + the count of
+  non-finite gradient elements.  A rising ``grad_norm`` gauge is the
+  earliest divergence tell; a nonzero ``nonfinite_grads`` pinpoints the
+  step an overflow started (NaNHook then tells you when the *loss* went
+  bad — usually later).
+* ``tree_bytes(tree)`` — in-graph-free static accounting of a pytree's
+  device footprint.
+
+The host half — ``live_arrays_bytes()`` — totals ``jax.live_arrays()``
+buffer sizes: the "is this replica leaking device memory" gauge that
+``MetricsExportHook`` exports.  It walks a host-side list (no device
+sync) but the list can be long, so it runs at hook cadence, never
+per step.
+
+JAX imports are local to each function: the obs package stays importable
+(and the trace/metrics/http pillars fully usable) on machines without
+JAX.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["grad_health", "tree_bytes", "live_arrays_bytes",
+           "GRAD_NORM_KEY", "NONFINITE_KEY"]
+
+# Metric-dict keys the train-step builders emit and MetricsExportHook
+# recognizes — one name, three layers.
+GRAD_NORM_KEY = "grad_norm"
+NONFINITE_KEY = "nonfinite_grads"
+
+
+def grad_health(grads: Any) -> Dict[str, Any]:
+    """In-graph gradient health: ``{grad_norm, nonfinite_grads}``.
+
+    Call inside a (possibly jitted) step function on the gradient pytree
+    and merge the result into the step's metrics dict.  Norm is computed
+    in f32 whatever the gradient dtype (bf16 squares overflow at ~2e19).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [jnp.asarray(g) for g in jax.tree_util.tree_leaves(grads)]
+    if not leaves:
+        zero = jnp.zeros((), jnp.float32)
+        return {GRAD_NORM_KEY: zero, NONFINITE_KEY: zero}
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    bad = sum(jnp.sum(~jnp.isfinite(g.astype(jnp.float32))) for g in leaves)
+    return {GRAD_NORM_KEY: jnp.sqrt(sq),
+            NONFINITE_KEY: bad.astype(jnp.float32)}
+
+
+def tree_bytes(tree: Any) -> int:
+    """Static byte size of a pytree's array leaves (shape/dtype only —
+    no device access, safe on abstract values)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return total
+
+
+def live_arrays_bytes() -> int:
+    """Total bytes of all live ``jax.Array`` buffers in this process —
+    the device-memory-leak gauge.  Host-side bookkeeping only."""
+    import jax
+
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            total += int(a.nbytes)
+        except Exception:  # deleted/donated between list and read
+            pass
+    return total

@@ -4,9 +4,10 @@ from . import checkpoint, hooks, precision, sharded_checkpoint
 from .precision import (DynamicLossScale, Policy, StaticLossScale,
                         attach_loss_scale)
 from .sharded_checkpoint import restore_sharded, save_sharded
-from .hooks import (CheckpointHook, EvalHook, Hook, LoggingHook, NaNHook,
-                    PreemptionHook, ProfilerHook, StepCounterHook,
-                    StopAtStepHook, SummaryHook, WatchdogHook)
+from .hooks import (CheckpointHook, EvalHook, Hook, LoggingHook,
+                    MetricsExportHook, NaNHook, PreemptionHook,
+                    ProfilerHook, StepCounterHook, StopAtStepHook,
+                    SummaryHook, TraceHook, WatchdogHook)
 from .session import TrainSession, TrainState
 from .step import (init_train_state, make_1f1b_train_step,
                    make_custom_train_step, make_eval_step,
@@ -17,9 +18,9 @@ __all__ = ["checkpoint", "hooks", "precision", "sharded_checkpoint",
            "save_sharded", "restore_sharded", "Policy", "StaticLossScale",
            "DynamicLossScale", "attach_loss_scale",
            "CheckpointHook", "EvalHook", "Hook",
-           "LoggingHook",
+           "LoggingHook", "MetricsExportHook",
            "NaNHook", "PreemptionHook", "ProfilerHook", "StepCounterHook",
-           "StopAtStepHook", "SummaryHook", "WatchdogHook",
+           "StopAtStepHook", "SummaryHook", "TraceHook", "WatchdogHook",
            "TrainSession", "TrainState", "init_train_state", "make_multi_train_step", "shard_train_state",
            "make_1f1b_train_step", "make_custom_train_step", "make_eval_step",
            "make_train_step"]

@@ -24,7 +24,8 @@ log = logging.getLogger(__name__)
 
 __all__ = ["Hook", "StopAtStepHook", "CheckpointHook", "SummaryHook",
            "LoggingHook", "NaNHook", "ProfilerHook", "PreemptionHook",
-           "WatchdogHook", "EvalHook", "StepCounterHook"]
+           "WatchdogHook", "EvalHook", "StepCounterHook", "TraceHook",
+           "MetricsExportHook"]
 
 
 class Hook:
@@ -243,26 +244,50 @@ class NaNHook(Hook):
 
 
 class ProfilerHook(Hook):
-    """Captures a jax.profiler trace for steps [start, start+count)."""
+    """Captures a jax.profiler trace for exactly ``num_steps`` steps:
+    the ones whose post-execution global step (the ``session.step``
+    value after the step ran — the same numbering ``StopAtStepHook``
+    and checkpoint filenames use) lands in
+    ``{start_step, ..., start_step + num_steps - 1}``.
+
+    The seed version mixed numberings — ``==`` on the *pre*-step counter
+    to start, ``>=`` on the *post*-step counter to stop — which shifted
+    the window one step late under the global-step convention and made a
+    restore landing past ``start_step`` skip the trace entirely.  The
+    traced-step set is pinned by
+    tests/test_session.py::test_profiler_hook_traces_exact_step_set.
+    """
 
     def __init__(self, log_dir: str, start_step: int = 10,
                  num_steps: int = 5):
         self.log_dir = log_dir
         self.start_step = start_step
-        self.stop_step = start_step + num_steps
+        self.num_steps = num_steps
         self._active = False
+        self._done = False
+        self._traced = 0
 
     def before_step(self, session) -> None:
         import jax
-        if not self._active and session.step == self.start_step:
+        # >= (not ==): a session restored past start_step still traces
+        # its next num_steps steps instead of never starting.
+        if (not self._done and not self._active
+                and session.step >= self.start_step - 1):
             jax.profiler.start_trace(self.log_dir)
             self._active = True
 
     def after_step(self, session, metrics) -> None:
         import jax
-        if self._active and session.step >= self.stop_step:
+        if not self._active:
+            return
+        self._traced += 1
+        # count traced steps rather than compare against a stop step:
+        # immune to the pre/post numbering mismatch and exact under
+        # restore-shifted starts.
+        if self._traced >= self.num_steps:
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
 
     def close(self, session) -> None:
         # close, not end: a trace left running after an exception would leak.
@@ -436,6 +461,170 @@ class WatchdogHook(Hook):
         if self._stop_evt is not None:
             self._stop_evt.set()
             self._thread.join(timeout=5)
+
+
+class TraceHook(Hook):
+    """Host-timeline spans for the training loop (``obs.trace``).
+
+    Per step this hook records a ``data_load`` span — the host gap from
+    the previous step's completion to this step's dispatch, which is
+    where batch fetch and hook work live — and a ``step`` span over the
+    whole ``run_step``.  The ``dispatch`` span nested inside comes from
+    ``TrainSession(telemetry=...)`` itself, ``checkpoint`` spans from
+    ``session.save()``, and jit compile/retrace instants from
+    ``analysis.sanitizer.RetraceGuard`` via the active tracer.  The
+    trace file is written at ``end`` AND ``close``, so a crashed run
+    still leaves its timeline on disk.
+
+    Step numbers in span args come from a host-side counter seeded once
+    at ``begin`` — reading ``session.step`` every step would pull the
+    device step scalar and block async dispatch.
+    """
+
+    def __init__(self, telemetry, save_every_steps: int = 0):
+        self.telemetry = telemetry
+        self.save_every_steps = save_every_steps
+        self._step = 0
+        self._gap_t0: Optional[float] = None
+        self._step_t0: Optional[float] = None
+
+    def begin(self, session) -> None:
+        from ..obs import trace as obs_trace
+        self.telemetry.start()
+        self._step = session.step
+        self.telemetry.tracer.instant("session_begin", step=self._step)
+        self._gap_t0 = obs_trace.now_us()
+
+    def before_step(self, session) -> None:
+        from ..obs import trace as obs_trace
+        now = obs_trace.now_us()
+        if self._gap_t0 is not None:
+            self.telemetry.tracer.add_span("data_load", self._gap_t0, now,
+                                           step=self._step + 1)
+        self._step_t0 = now
+
+    def after_step(self, session, metrics) -> None:
+        from ..obs import trace as obs_trace
+        now = obs_trace.now_us()
+        self._step += 1
+        if self._step_t0 is not None:
+            self.telemetry.tracer.add_span("step", self._step_t0, now,
+                                           step=self._step)
+        self._gap_t0 = now
+        if self.save_every_steps and \
+                self._step % self.save_every_steps == 0:
+            self.telemetry.save_trace()
+
+    def end(self, session) -> None:
+        self.telemetry.tracer.instant("session_end", step=self._step)
+        self.telemetry.save_trace()
+
+    def close(self, session) -> None:
+        self.telemetry.save_trace()
+
+
+class MetricsExportHook(Hook):
+    """Prometheus export for the training loop (``obs.metrics`` — the
+    instruments a ``/metrics`` scrape of a training replica sees; the
+    full catalog lives in docs/OBSERVABILITY.md):
+
+    * ``dttpu_steps_total`` — counter, +1 per completed step;
+    * ``dttpu_step_time_seconds`` — histogram of host wall time per
+      ``run_step`` (on the CPU mesh each step is synced so this is real
+      step time; under TPU async dispatch it is dispatch+hook time and
+      the throughput gauges below carry the honest rate);
+    * ``dttpu_steps_per_second`` (+ ``dttpu_tokens_per_second`` /
+      ``dttpu_examples_per_second`` when sized) — window rates at hook
+      cadence;
+    * ``dttpu_retraces_total`` — counter fed from the telemetry
+      tracer's retrace instants (RetraceGuard wiring);
+    * ``dttpu_live_arrays_bytes`` — gauge, ``obs.device``'s
+      device-memory-leak signal;
+    * ``dttpu_loss``, ``dttpu_grad_norm``, ``dttpu_nonfinite_grads`` —
+      gauges pulled from the step's metrics dict when present (the
+      latter two ride steps built with ``device_health=True``).
+
+    Per-step cost is two clock reads and two in-memory bumps; anything
+    that pulls a device value fires only every ``every_steps`` — the
+    module's hooks-don't-sync contract.
+    """
+
+    _PULLED = ("loss", "grad_norm", "nonfinite_grads")
+
+    def __init__(self, telemetry, every_steps: int = 10,
+                 tokens_per_step: Optional[int] = None,
+                 examples_per_step: Optional[int] = None):
+        self.telemetry = telemetry
+        self.every_steps = max(1, every_steps)
+        self.tokens_per_step = tokens_per_step
+        self.examples_per_step = examples_per_step
+        self._window = _RateWindow()
+        self._step = 0
+        self._t0: Optional[float] = None
+        self._retraces_seen = 0
+
+    def begin(self, session) -> None:
+        self.telemetry.start()
+        reg = self.telemetry.registry
+        self._steps = reg.counter(
+            "dttpu_steps_total", "Training steps completed.")
+        self._step_time = reg.histogram(
+            "dttpu_step_time_seconds",
+            "Host wall time per run_step (dispatch-only under async).")
+        self._rate = reg.gauge(
+            "dttpu_steps_per_second", "Steps/s over the last export window.")
+        self._retraces = reg.counter(
+            "dttpu_retraces_total",
+            "jit retraces observed by the telemetry tracer (RetraceGuard).")
+        self._live_bytes = reg.gauge(
+            "dttpu_live_arrays_bytes",
+            "Total bytes of live jax.Array buffers in this process.")
+        self._step = session.step
+        self._window.reset(self._step)
+
+    def before_step(self, session) -> None:
+        self._t0 = time.perf_counter()
+
+    def after_step(self, session, metrics) -> None:
+        if self._t0 is not None:
+            self._step_time.observe(time.perf_counter() - self._t0)
+        self._steps.inc()
+        self._step += 1
+        if self._step % self.every_steps:
+            return
+        self._export(metrics)
+
+    def _export(self, metrics: Optional[Dict]) -> None:
+        from ..obs import device as obs_device
+        reg = self.telemetry.registry
+        # empty window (the end-of-session flush right after a periodic
+        # export): keep the last rate instead of publishing a zero
+        if self._step > self._window._step0:
+            rate = self._window.rate(self._step)
+            self._rate.set(rate)
+            if self.tokens_per_step:
+                reg.gauge("dttpu_tokens_per_second",
+                          "Training throughput.").set(
+                              rate * self.tokens_per_step)
+            if self.examples_per_step:
+                reg.gauge("dttpu_examples_per_second",
+                          "Training throughput.").set(
+                              rate * self.examples_per_step)
+        seen = self.telemetry.tracer.instant_counts.get("retrace", 0)
+        if seen > self._retraces_seen:
+            self._retraces.inc(seen - self._retraces_seen)
+            self._retraces_seen = seen
+        self._live_bytes.set(obs_device.live_arrays_bytes())
+        if metrics:
+            for key in self._PULLED:
+                value = metrics.get(key)
+                if value is not None and _is_scalar(value):
+                    reg.gauge(f"dttpu_{key}",
+                              f"Last exported value of metrics[{key!r}]."
+                              ).set(float(value))
+
+    def end(self, session) -> None:
+        self._export(None)   # final window flush
 
 
 def _is_scalar(v) -> bool:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -78,11 +79,19 @@ class TrainSession:
                  max_to_keep: int = 5,
                  restore: bool = True,
                  async_checkpoint: bool = False,
-                 sharded_checkpoint: bool = False):
+                 sharded_checkpoint: bool = False,
+                 telemetry=None):
         self.state = state
         self.step_fn = step_fn
         self.checkpoint_dir = checkpoint_dir
         self.hooks = list(hooks)
+        # Optional obs.Telemetry: run_step wraps the compiled-step dispatch
+        # in a "dispatch" span and save() in a "checkpoint" span (+ a
+        # save-duration histogram).  Telemetry off = one attr check per
+        # step.  Pair with train.TraceHook/MetricsExportHook for the
+        # host-timeline and /metrics halves; the session never closes a
+        # user-provided telemetry object.
+        self.telemetry = telemetry
         self.is_chief = cluster.is_chief() if is_chief is None else is_chief
         self.max_to_keep = max_to_keep
         self.last_saved_step = None
@@ -138,7 +147,12 @@ class TrainSession:
         """One training step: hooks, compiled step fn, cursor advance."""
         for hook in self.hooks:
             hook.before_step(self)
-        new_state, metrics = self.step_fn(self.state, *args, **kwargs)
+        if self.telemetry is not None:
+            with self.telemetry.tracer.span("dispatch"):
+                new_state, metrics = self.step_fn(self.state, *args,
+                                                  **kwargs)
+        else:
+            new_state, metrics = self.step_fn(self.state, *args, **kwargs)
         self.state = new_state
         for hook in self.hooks:
             hook.after_step(self, metrics)
@@ -150,6 +164,16 @@ class TrainSession:
         example.py:74-76); non-chief calls are no-ops — except in sharded
         mode, where EVERY process writes the chunks it owns and only the
         manifest is chief-only (inside save_sharded)."""
+        if self.telemetry is None:
+            return self._save_impl()
+        t0 = time.perf_counter()
+        with self.telemetry.tracer.span("checkpoint", step=self.step):
+            path = self._save_impl()
+        self.telemetry.checkpoint_seconds().observe(
+            time.perf_counter() - t0)
+        return path
+
+    def _save_impl(self) -> Optional[str]:
         if not self.checkpoint_dir:
             return None
         if self.sharded:
@@ -204,6 +228,8 @@ class TrainSession:
     # -- context manager --------------------------------------------------
     def __enter__(self) -> "TrainSession":
         self._entered = True
+        if self.telemetry is not None:
+            self.telemetry.start()   # idempotent; hooks also call it
         for hook in self.hooks:
             hook.begin(self)
         return self

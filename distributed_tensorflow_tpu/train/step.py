@@ -134,7 +134,8 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                     grad_clip_norm: Optional[float] = None,
                     accum_steps: int = 1,
                     policy: Any = None,
-                    loss_scale: bool = False) -> Callable:
+                    loss_scale: bool = False,
+                    device_health: bool = False) -> Callable:
     """Build ``step(state, (x, y)) -> (new_state, metrics)``.
 
     Thin adapter over ``make_custom_train_step``: wraps the (model, loss,
@@ -167,7 +168,8 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                                   batch_shardings=batch_shardings, jit=jit,
                                   grad_clip_norm=grad_clip_norm,
                                   accum_steps=accum_steps, policy=policy,
-                                  loss_scale=loss_scale)
+                                  loss_scale=loss_scale,
+                                  device_health=device_health)
 
 
 def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
@@ -179,7 +181,8 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
                            grad_clip_norm: Optional[float] = None,
                            accum_steps: int = 1,
                            policy: Any = None,
-                           loss_scale: bool = False) -> Callable:
+                           loss_scale: bool = False,
+                           device_health: bool = False) -> Callable:
     """Generalized step builder for model families with structured batches.
 
     ``loss_fn(params, model_state, batch, rng, train) ->
@@ -210,6 +213,14 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
     the step scales the loss, unscales the gradients, SKIPS the update on
     non-finite gradients, and threads the adjusted scale forward (reported
     as ``metrics['loss_scale']`` / ``metrics['grads_finite']``).
+
+    ``device_health=True``: replica-health accumulators (``obs.device``:
+    global grad L2 norm + non-finite gradient element count) are computed
+    IN-GRAPH and ride the returned metrics dict — the telemetry contract:
+    the health scalars are two reductions fused into the step, hooks pull
+    them only when they fire, and the hot loop gains no device->host
+    syncs.  (``grad_clip_norm`` already reports ``grad_norm``; the health
+    key defers to it.)
     """
     base_key = jax.random.PRNGKey(seed)
     pol = prec_lib.policy(policy) if policy is not None else None
@@ -305,6 +316,10 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
             loss_value = pol.cast_to_output(loss_value)
             metrics = pol.cast_to_output(metrics)
         metrics = {"loss": loss_value, **metrics}
+        if device_health:
+            from ..obs import device as obs_device
+            for k, v in obs_device.grad_health(grads).items():
+                metrics.setdefault(k, v)
         if grad_clip_norm is not None:
             grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)
             metrics["grad_norm"] = gnorm

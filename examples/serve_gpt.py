@@ -15,11 +15,19 @@ meaningless; the point is the surfaces and their composition.  Real
 checkpoints drop in via ``models/convert.py`` (HF GPT-2) — see
 examples/finetune_gpt2_hf.py.
 
+While decoding, the demo serves live telemetry (obs/): ``/metrics``
+exposes per-path token counters, decode-duration histograms, and
+tokens/s gauges in Prometheus text format, ``/healthz`` a JSON liveness
+doc — the same endpoint a production serving replica would register
+with a scraper (docs/OBSERVABILITY.md).  ``--metrics_port=-1`` turns it
+off; the default picks an ephemeral port and prints the URL.
+
 Run: ``python examples/serve_gpt.py --device=cpu --new_tokens=32``
 """
 from __future__ import annotations
 
 import os
+import re
 import sys
 import time
 
@@ -31,6 +39,9 @@ flags_lib.DEFINE_string("device", "", "cpu|tpu override (config-level)")
 flags_lib.DEFINE_integer("new_tokens", 32, "tokens to generate per path")
 flags_lib.DEFINE_integer("batch", 4, "batch size for the batched paths")
 flags_lib.DEFINE_integer("seed", 0, "init/prompt seed")
+flags_lib.DEFINE_integer("metrics_port", 0,
+                         "serve /metrics + /healthz during the demo "
+                         "(0 = ephemeral port, -1 = off)")
 FLAGS = flags_lib.FLAGS
 
 
@@ -43,10 +54,18 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    from distributed_tensorflow_tpu import obs
     from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
     from distributed_tensorflow_tpu.models.speculative import \
         generate_speculative
     from distributed_tensorflow_tpu.ops import quant
+
+    telemetry = None
+    if FLAGS.metrics_port >= 0:
+        telemetry = obs.Telemetry(metrics_port=FLAGS.metrics_port,
+                                  service="serve").start()
+        print(f"telemetry: {telemetry.metrics_url()} (+ /healthz)",
+              flush=True)
 
     new = FLAGS.new_tokens
     b = FLAGS.batch
@@ -68,6 +87,19 @@ def main() -> int:
         out = jax.tree.map(np.asarray, out)     # value fetch
         dt = time.perf_counter() - t0
         print(f"{name:<28} {tokens_out / dt:10,.0f} tok/s", flush=True)
+        if telemetry is not None:
+            # one label value per decode path; static cardinality
+            path = re.sub(r"[^a-z0-9]+", "_", name.lower()).strip("_")
+            reg = telemetry.registry
+            reg.counter("dttpu_decode_tokens_total",
+                        "Tokens generated, by decode path.",
+                        labels={"path": path}).inc(tokens_out)
+            reg.histogram("dttpu_decode_seconds",
+                          "Wall time per timed decode call.",
+                          labels={"path": path}).observe(dt)
+            reg.gauge("dttpu_decode_tokens_per_second",
+                      "Decode throughput, by path.",
+                      labels={"path": path}).set(tokens_out / dt)
         return out
 
     greedy = timed("greedy generate", jax.jit(
@@ -129,6 +161,18 @@ def main() -> int:
                           == np.asarray(spec_out)[:, plen:]))
     print(f"{'':<28} spec acceptance {float(acc):.3f}, greedy match "
           f"{match:.3f}", flush=True)
+    if telemetry is not None:
+        # self-scrape: prove the endpoint a scraper would hit is live and
+        # carrying the decode series recorded above
+        import urllib.request
+        with urllib.request.urlopen(telemetry.metrics_url(),
+                                    timeout=5) as resp:
+            text = resp.read().decode("utf-8")
+        samples = [l for l in text.splitlines()
+                   if l and not l.startswith("#")]
+        print(f"{'':<28} /metrics scrape: {len(samples)} samples",
+              flush=True)
+        telemetry.close()
     return 0
 
 

@@ -405,6 +405,114 @@ def test_dt106_suppression():
     assert findings == []
 
 
+# ------------------------------------------------------------- DT107
+
+def test_dt107_timer_brackets_jitted_call_without_barrier():
+    findings = lint("""
+        import time
+        import jax
+
+        step = jax.jit(lambda s, b: s)
+
+        def bench(state, batch):
+            t0 = time.perf_counter()
+            state = step(state, batch)
+            dt = time.perf_counter() - t0   # async: times dispatch only
+            return dt
+    """, select="DT107")
+    assert rules_of(findings) == ["DT107"]
+    assert "dispatch" in findings[0].message
+    assert findings[0].severity == "warning"
+
+
+def test_dt107_two_timer_vars_and_decorated_fn():
+    findings = lint("""
+        import time
+        import jax
+
+        @jax.jit
+        def step(s):
+            return s
+
+        def bench(s):
+            t0 = time.time()
+            step(s)                   # result never synced
+            t1 = time.time()
+            return t1 - t0
+    """, select="DT107")
+    assert rules_of(findings) == ["DT107"]
+
+
+def test_dt107_train_step_builder_contract():
+    # the cross-module make_*train_step contract DT106 already knows:
+    # its result is a jitted step, so timing it unsynced is the same lie
+    findings = lint("""
+        import time
+        from distributed_tensorflow_tpu import train
+
+        def bench(model, opt, state, batch):
+            step = train.make_train_step(model, "mse", opt)
+            t0 = time.perf_counter()
+            state, m = step(state, batch)
+            return time.perf_counter() - t0
+    """, select="DT107")
+    assert rules_of(findings) == ["DT107"]
+
+
+def test_dt107_negative_barriers_and_unknown_callees():
+    findings = lint("""
+        import time
+        import numpy as np
+        import jax
+
+        step = jax.jit(lambda s: s)
+        gen = jax.jit(lambda p: p)
+
+        def blocked(s):
+            t0 = time.perf_counter()
+            out = step(s)
+            jax.block_until_ready(out)          # explicit barrier
+            return time.perf_counter() - t0
+
+        def fetched(s, fetch):
+            t0 = time.perf_counter()
+            state, m = step(s)
+            loss = fetch(m)                     # any consuming call counts
+            return time.perf_counter() - t0, loss
+
+        def nested(p):
+            t0 = time.perf_counter()
+            out = np.asarray(gen(p))            # consumed by construction
+            return time.perf_counter() - t0, out
+
+        def unknown(fn):
+            t0 = time.perf_counter()
+            fn()                                # not provably jitted
+            return time.perf_counter() - t0
+
+        def host_only():
+            t0 = time.perf_counter()
+            x = sum(range(10))
+            return time.perf_counter() - t0, x
+    """, select="DT107")
+    assert findings == []
+
+
+def test_dt107_suppression():
+    findings = lint("""
+        import time
+        import jax
+
+        step = jax.jit(lambda s: s)
+
+        def bench(s):
+            t0 = time.perf_counter()
+            out = step(s)
+            return time.perf_counter() - t0  # dtlint: disable=DT107 -- dispatch latency is the metric here
+    """, select="DT107")
+    assert findings == []
+
+
 # ------------------------------------------------------------- DT201
 
 HELPERS_MOD = """
@@ -872,7 +980,7 @@ def test_baseline_partition_roundtrip(tmp_path):
 def test_rule_catalog_covers_all_families():
     ids = [rid for rid, _, _ in analysis.rule_catalog()]
     assert ids == ["DT101", "DT102", "DT103", "DT104", "DT105", "DT106",
-                   "DT201", "DT202", "DT203", "DT204"]
+                   "DT107", "DT201", "DT202", "DT203", "DT204"]
 
 
 def test_cli_json_output_and_exit_codes(tmp_path):
@@ -1011,6 +1119,16 @@ def test_syntax_error_is_reported_not_crashed(tmp_path):
         capture_output=True, text=True, cwd=REPO)
     assert proc.returncode == 2
     assert "error" in proc.stderr
+
+
+def test_walk_covers_obs_package():
+    """The lint gate's file walk must include the telemetry subsystem —
+    a new top-level package silently skipped would rot unchecked."""
+    files = analysis.collect_files(["distributed_tensorflow_tpu"])
+    rel = {os.path.relpath(f, REPO).replace(os.sep, "/") for f in files}
+    for mod in ("obs/__init__.py", "obs/trace.py", "obs/metrics.py",
+                "obs/http.py", "obs/device.py"):
+        assert f"distributed_tensorflow_tpu/{mod}" in rel
 
 
 def test_self_check_package_lints_clean_modulo_baseline():

@@ -53,6 +53,27 @@ class TestSupervisor:
         assert r["vs_baseline"] > 0
         # XLA:CPU reports flops, so the FLOP accounting fields must appear.
         assert r.get("flops_per_example", 0) > 0
+        # telemetry fields (default-on): barrier-closed per-update
+        # latency percentiles + the host-timeline trace file, whose
+        # dispatch spans and jit_compile instants must parse as Chrome
+        # trace JSON (docs/OBSERVABILITY.md)
+        assert r["step_time_p50_ms"] > 0
+        assert r["step_time_p95_ms"] >= r["step_time_p50_ms"]
+        assert os.path.exists(r["trace_file"])
+        trace = json.load(open(r["trace_file"]))
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "dispatch" in names and "jit_compile" in names
+
+    def test_telemetry_off_drops_fields(self):
+        """DTTPU_BENCH_TELEMETRY=0: no trace file, no latency fields —
+        the schema change is strictly opt-out."""
+        proc = _run(["--device=cpu"], _env(DTTPU_BENCH_TELEMETRY=0))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        r = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+        assert r["value"] > 0
+        assert "step_time_p50_ms" not in r
+        assert "step_time_p95_ms" not in r
+        assert "trace_file" not in r
 
     def test_dead_backend_falls_back_to_cpu_with_label(self):
         """Both simulated-TPU attempts die -> supervisor measures on the

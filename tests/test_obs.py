@@ -1,0 +1,310 @@
+"""obs/ telemetry layer tests: Chrome-trace validity, Prometheus text
+round-trip, the /metrics + /healthz endpoint, in-graph device health,
+and the end-to-end TrainSession acceptance path (TraceHook +
+MetricsExportHook + RetraceGuard retrace instants + a live scrape).
+"""
+import json
+import math
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import data, obs, ops, optim, train
+from distributed_tensorflow_tpu.obs import device as obs_device
+from distributed_tensorflow_tpu.obs import trace as obs_trace
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+# ------------------------------------------------------------- tracing
+
+class TestTrace:
+    def test_chrome_trace_json_valid(self, tmp_path):
+        t = obs.Tracer(enabled=True, pid=3, host="hostX")
+        with t.span("dispatch", step=1):
+            pass
+        t.add_span("data_load", 10.0, 20.0, step=2)
+        t.instant("retrace", fn="step", arg_diff="~ x: f32[2] -> f32[3]")
+        path = t.save(str(tmp_path / "trace.json"))
+        doc = json.load(open(path))
+        assert doc["displayTimeUnit"] == "ms"
+        events = doc["traceEvents"]
+        by_name = {e["name"]: e for e in events}
+        # metadata record carries the host label for multi-host merging
+        assert by_name["process_name"]["ph"] == "M"
+        assert "hostX" in by_name["process_name"]["args"]["name"]
+        assert by_name["dispatch"]["ph"] == "X"
+        assert by_name["dispatch"]["dur"] >= 0
+        assert by_name["data_load"]["dur"] == pytest.approx(10.0)
+        assert by_name["retrace"]["ph"] == "i"
+        assert all(e["pid"] == 3 for e in events)
+        # every non-metadata event is timestamped (merge-sortable)
+        assert all("ts" in e for e in events if e["ph"] != "M")
+
+    def test_disabled_tracer_records_nothing(self):
+        t = obs.Tracer(enabled=False)
+        with t.span("dispatch"):
+            pass
+        t.instant("retrace")
+        assert [e for e in t.events() if e["ph"] != "M"] == []
+
+    def test_active_tracer_module_sink(self):
+        t = obs.Tracer(enabled=True)
+        obs_trace.instant("orphan")          # no active tracer: no-op
+        with obs_trace.activated(t):
+            obs_trace.instant("mark", k=1)
+            with obs_trace.span("s"):
+                pass
+        obs_trace.instant("after")           # deactivated again
+        names = [e["name"] for e in t.events() if e["ph"] != "M"]
+        assert names == ["mark", "s"]
+        assert t.instant_counts == {"mark": 1}
+
+
+# ------------------------------------------------------------- metrics
+
+class TestMetrics:
+    def test_exposition_roundtrips_prometheus_text(self):
+        reg = obs.Registry()
+        reg.counter("requests_total", "Requests.",
+                    labels={"path": "a"}).inc(3)
+        reg.counter("requests_total", "Requests.",
+                    labels={"path": "b"}).inc()
+        reg.gauge("temp_celsius", "Temp.").set(-1.5)
+        h = reg.histogram("lat_seconds", "Latency.", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.5, 5.0):
+            h.observe(v)
+        text = reg.expose()
+        parsed = obs.parse_exposition(text)
+        assert parsed["requests_total"]["type"] == "counter"
+        assert parsed["requests_total"]["samples"][
+            ("requests_total", (("path", "a"),))] == 3.0
+        assert parsed["requests_total"]["samples"][
+            ("requests_total", (("path", "b"),))] == 1.0
+        assert parsed["temp_celsius"]["samples"][
+            ("temp_celsius", ())] == -1.5
+        hs = parsed["lat_seconds"]["samples"]
+        # cumulative buckets + +Inf + sum/count — the full histogram law
+        assert hs[("lat_seconds_bucket", (("le", "0.1"),))] == 1.0
+        assert hs[("lat_seconds_bucket", (("le", "1"),))] == 3.0
+        assert hs[("lat_seconds_bucket", (("le", "+Inf"),))] == 4.0
+        assert hs[("lat_seconds_count", ())] == 4.0
+        assert hs[("lat_seconds_sum", ())] == pytest.approx(6.05)
+
+    def test_get_or_create_shares_series_and_checks_types(self):
+        reg = obs.Registry()
+        a = reg.counter("steps_total", "Steps.")
+        b = reg.counter("steps_total")
+        assert a is b
+        a.inc(2)
+        assert b.value == 2
+        with pytest.raises(ValueError):
+            reg.gauge("steps_total")
+        with pytest.raises(ValueError):
+            reg.counter("bad name!")
+        with pytest.raises(ValueError):
+            a.inc(-1)
+
+    def test_histogram_quantile_estimate(self):
+        h = obs.Histogram("h", "", (), buckets=(0.01, 0.1, 1.0))
+        assert math.isnan(h.quantile(0.5))
+        for _ in range(9):
+            h.observe(0.05)
+        h.observe(5.0)
+        assert h.quantile(0.5) == 0.1
+        assert h.quantile(0.99) == float("inf")
+
+
+# ---------------------------------------------------------------- http
+
+class TestHttp:
+    def test_metrics_and_healthz_endpoints(self):
+        reg = obs.Registry()
+        reg.counter("ticks_total", "Ticks.").inc(7)
+        server = obs.MetricsServer(reg, port=0,
+                                   health_fn=lambda: {"status": "ok",
+                                                      "replica": 2})
+        server.start()
+        try:
+            assert server.port != 0   # ephemeral port resolved
+            status, text = _get(server.url + "/metrics")
+            assert status == 200
+            parsed = obs.parse_exposition(text)
+            assert parsed["ticks_total"]["samples"][
+                ("ticks_total", ())] == 7.0
+            status, body = _get(server.url + "/healthz")
+            assert status == 200
+            assert json.loads(body)["replica"] == 2
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.url + "/nope")
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+    def test_healthz_failure_is_503_not_a_crash(self):
+        def sick():
+            raise RuntimeError("replica wedged")
+
+        server = obs.MetricsServer(obs.Registry(), port=0,
+                                   health_fn=sick).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.url + "/healthz")
+            assert e.value.code == 503
+            assert "wedged" in e.value.read().decode()
+        finally:
+            server.stop()
+
+
+# -------------------------------------------------------- device health
+
+class TestDeviceHealth:
+    def test_grad_health_in_graph_counts_nonfinite(self):
+        grads = {"a": jnp.asarray([3.0, 4.0]),
+                 "b": jnp.asarray([[float("nan"), float("inf")],
+                                   [0.0, 0.0]])}
+
+        @jax.jit
+        def health(g):
+            return obs_device.grad_health(g)
+
+        out = health(grads)
+        assert float(out[obs_device.NONFINITE_KEY]) == 2.0
+        assert not math.isfinite(float(out[obs_device.GRAD_NORM_KEY]))
+        clean = health({"a": jnp.asarray([3.0, 4.0])})
+        assert float(clean[obs_device.GRAD_NORM_KEY]) == pytest.approx(5.0)
+        assert float(clean[obs_device.NONFINITE_KEY]) == 0.0
+
+    def test_train_step_device_health_rides_metrics_dict(self):
+        model = ops.serial(ops.Dense(8, "relu"), ops.Dense(32, "sigmoid"))
+        opt = optim.adam()
+        state = train.init_train_state(model, opt, jax.random.PRNGKey(0),
+                                       (64,))
+        step = train.make_train_step(model, "mse", opt, device_health=True)
+        (xt, yt), _ = data.xor_data(100, val_size=10, seed=0)
+        state, m = step(state, (xt[:50], yt[:50]))
+        assert float(m[obs_device.GRAD_NORM_KEY]) > 0
+        assert float(m[obs_device.NONFINITE_KEY]) == 0.0
+
+    def test_live_arrays_bytes_counts_new_buffer(self):
+        before = obs_device.live_arrays_bytes()
+        keep = jnp.ones((256, 256), jnp.float32)
+        keep.block_until_ready()
+        after = obs_device.live_arrays_bytes()
+        assert after - before >= 256 * 256 * 4
+        del keep
+
+
+# ------------------------------------------------- end-to-end acceptance
+
+def test_session_telemetry_end_to_end(tmp_path):
+    """ISSUE 3 acceptance: a short TrainSession run with TraceHook +
+    MetricsExportHook yields (a) valid Chrome trace JSON containing
+    dispatch and retrace events and (b) a live /metrics scrape showing
+    the step counter and the step-time histogram."""
+    from distributed_tensorflow_tpu.analysis.sanitizer import RetraceGuard
+
+    tele = obs.Telemetry(trace_dir=str(tmp_path), metrics_port=0)
+    (xt, yt), _ = data.xor_data(200, val_size=10, seed=0)
+    with RetraceGuard(budget=1, mode="warn",
+                      stream=open("/dev/null", "w")) as guard:
+        model = ops.serial(ops.Dense(16, "relu"), ops.Dense(32, "sigmoid"))
+        opt = optim.adam()
+        state = train.init_train_state(model, opt, jax.random.PRNGKey(0),
+                                       (64,))
+        # built INSIDE the guard: traces are counted and mirrored onto
+        # the active tracer as jit_compile/retrace instants
+        step = train.make_train_step(model, "mse", opt, device_health=True)
+        with train.TrainSession(
+                state, step, telemetry=tele,
+                hooks=[train.TraceHook(tele),
+                       train.MetricsExportHook(tele, every_steps=1,
+                                               examples_per_step=50),
+                       train.StopAtStepHook(4)]) as sess:
+            n = 0
+            while not sess.should_stop():
+                # last batch changes shape: a real retrace, on purpose
+                b = (xt[:30], yt[:30]) if n == 3 else (xt[:50], yt[:50])
+                sess.run_step(b)
+                n += 1
+        status, text = _get(tele.metrics_url())
+    tele.close()
+    assert guard.violations, "the shape change must have retraced"
+
+    # (a) the trace file is valid Chrome trace JSON with the span/instant
+    # vocabulary docs/OBSERVABILITY.md documents
+    doc = json.load(open(tele.trace_path))
+    events = doc["traceEvents"]
+    names = {}
+    for e in events:
+        names[e["name"]] = names.get(e["name"], 0) + 1
+    assert names["dispatch"] == 4        # one per run_step, from session
+    assert names["step"] == 4            # TraceHook host-step spans
+    assert names["data_load"] == 4       # inter-step host gap spans
+    assert names["jit_compile"] >= 1     # first trace instant
+    assert names["retrace"] == 1         # the shape-change recompile
+    retrace = next(e for e in events if e["name"] == "retrace")
+    assert "arg_diff" in retrace["args"]         # actionable, not forensic
+    assert "[30,64]" in retrace["args"]["arg_diff"]
+    steps_args = sorted(e["args"]["step"] for e in events
+                        if e["name"] == "step")
+    assert steps_args == [1, 2, 3, 4]
+
+    # (b) the live scrape carried the step counter + step-time histogram
+    assert status == 200
+    parsed = obs.parse_exposition(text)
+    assert parsed["dttpu_steps_total"]["type"] == "counter"
+    assert parsed["dttpu_steps_total"]["samples"][
+        ("dttpu_steps_total", ())] == 4.0
+    hist = parsed["dttpu_step_time_seconds"]
+    assert hist["type"] == "histogram"
+    assert hist["samples"][("dttpu_step_time_seconds_count", ())] == 4.0
+    assert hist["samples"][("dttpu_step_time_seconds_sum", ())] > 0
+    # throughput, retrace count, device health, memory gauge all exported
+    assert parsed["dttpu_examples_per_second"]["samples"][
+        ("dttpu_examples_per_second", ())] > 0
+    assert parsed["dttpu_retraces_total"]["samples"][
+        ("dttpu_retraces_total", ())] == 1.0
+    assert parsed["dttpu_live_arrays_bytes"]["samples"][
+        ("dttpu_live_arrays_bytes", ())] > 0
+    assert ("dttpu_grad_norm", ()) in parsed["dttpu_grad_norm"]["samples"]
+
+
+def test_telemetry_checkpoint_span_and_duration(tmp_path):
+    """session.save() under telemetry: a 'checkpoint' span lands on the
+    timeline and the save-duration histogram observes it."""
+    model = ops.serial(ops.Dense(8, "relu"), ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    step = train.make_train_step(model, "mse", opt)
+    (xt, yt), _ = data.xor_data(100, val_size=10, seed=0)
+    tele = obs.Telemetry(trace_dir=str(tmp_path))
+    with train.TrainSession(state, step, checkpoint_dir=str(tmp_path / "ck"),
+                            telemetry=tele,
+                            hooks=[train.StopAtStepHook(2)]) as sess:
+        while not sess.should_stop():
+            sess.run_step((xt[:50], yt[:50]))
+    tele.close()
+    doc = json.load(open(tele.trace_path))
+    assert any(e["name"] == "checkpoint" for e in doc["traceEvents"])
+    h = tele.registry.get("dttpu_checkpoint_save_seconds")
+    assert h is not None and h.count >= 1
+
+
+def test_telemetry_off_is_inert(tmp_path):
+    """No trace_dir, no metrics_port: spans are no-ops, nothing is
+    written, and the session hot path takes the telemetry-off branch."""
+    tele = obs.Telemetry()
+    assert tele.trace_path is None and tele.metrics_url() is None
+    with tele.tracer.span("dispatch"):
+        pass
+    assert tele.save_trace() is None
+    assert [e for e in tele.tracer.events() if e["ph"] != "M"] == []
+    tele.close()

@@ -117,6 +117,80 @@ def test_profiler_hook_closes_open_trace(tmp_path):
     assert not hook._active  # close() stopped it
 
 
+class _FakeProfiler:
+    """Monkeypatch stand-in for jax.profiler: tracks active state only."""
+
+    def __init__(self):
+        self.active = False
+        self.starts = 0
+
+    def start_trace(self, log_dir):
+        assert not self.active, "start_trace while a trace is running"
+        self.active = True
+        self.starts += 1
+
+    def stop_trace(self):
+        assert self.active, "stop_trace with no trace running"
+        self.active = False
+
+
+def _traced_steps(monkeypatch, state, step, ds, hook, last_step):
+    """Post-execution global-step values whose step ran under the trace."""
+    import jax
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+
+    class Spy(train.Hook):
+        # placed AFTER ProfilerHook: before_step sees the trace state the
+        # upcoming execution runs under
+        def __init__(self):
+            self.traced = []
+            self._pre_active = False
+
+        def before_step(self, session):
+            self._pre_active = fake.active
+
+        def after_step(self, session, metrics):
+            if self._pre_active:
+                self.traced.append(session.step)
+
+    spy = Spy()
+    with train.TrainSession(state, step,
+                            hooks=[hook, spy,
+                                   train.StopAtStepHook(last_step)]) as s:
+        run_session(s, ds)
+    return spy.traced, fake
+
+
+def test_profiler_hook_traces_exact_step_set(monkeypatch):
+    """Regression for the seed off-by-one: the start check used the
+    PRE-step counter (==) while the stop check used the POST-step counter
+    (>=), so under the global-step numbering every other hook uses the
+    traced window was {start+1, ..., start+num} — one step late.  Pin the
+    contract: exactly num_steps steps, global steps
+    {start_step, ..., start_step + num_steps - 1}."""
+    _, _, state, step, ds = make_bits()
+    hook = train.ProfilerHook("/tmp/unused", start_step=3, num_steps=2)
+    traced, fake = _traced_steps(monkeypatch, state, step, ds, hook,
+                                 last_step=8)
+    assert traced == [3, 4]
+    assert fake.starts == 1 and not fake.active
+
+
+def test_profiler_hook_starts_after_restore_past_start(monkeypatch):
+    """A session restored beyond start_step still captures num_steps steps
+    (the seed's == start check silently skipped the trace forever)."""
+    import jax.numpy as jnp
+    _, _, state, step, ds = make_bits()
+    state = state._replace(step=jnp.asarray(5, jnp.int32))  # "restored"
+    hook = train.ProfilerHook("/tmp/unused", start_step=2, num_steps=3)
+    traced, fake = _traced_steps(monkeypatch, state, step, ds, hook,
+                                 last_step=12)
+    assert traced == [6, 7, 8]
+    assert fake.starts == 1 and not fake.active
+
+
 def test_summary_hook(tmp_path):
     import glob
     from distributed_tensorflow_tpu.summary import SummaryWriter
