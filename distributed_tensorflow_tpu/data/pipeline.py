@@ -14,7 +14,7 @@ shard of the global batch (``process_shard``) for multi-host feeding.
 """
 from __future__ import annotations
 
-import collections
+import queue
 import threading
 from typing import Iterable, Iterator, Sequence, Tuple
 
@@ -141,9 +141,20 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
     ``sharding_fn``: optional ``item -> sharding`` override for streams
     whose items need different layouts (Sequential's steps_per_execution
     mixes [K, batch, ...] groups with plain-batch epoch tails).
+
+    The consumer may abandon the generator at any point (break out of an
+    epoch, ``.close()``, garbage collection): the producer thread is
+    unblocked and terminated, releasing the up-to-``size`` device
+    batches it was pinning.  Handoff is a blocking ``queue.Queue`` —
+    no busy-polling on either side.
     """
-    queue: collections.deque = collections.deque()
+    # Unbounded handoff queue + a semaphore bounding device-RESIDENT
+    # batches to ``size``: the capacity ticket is taken BEFORE the
+    # device_put, so at most ``size`` uploaded batches exist at once
+    # (a bounded queue would admit size+1: one blocked mid-put).
+    handoff: queue.Queue = queue.Queue()
     sem = threading.Semaphore(size)
+    stop = threading.Event()
     done = object()
     err: list = []
 
@@ -161,21 +172,32 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
         try:
             for item in iterator:
                 sem.acquire()
-                queue.append(put(item))
+                # checked after acquire: an abandoning consumer releases
+                # the semaphore once to unblock exactly this wait
+                if stop.is_set():
+                    return
+                handoff.put(put(item))
         except Exception as e:  # surfaced on the consumer side
             err.append(e)
-        queue.append(done)
+        finally:
+            handoff.put(done)
 
-    thread = threading.Thread(target=producer, daemon=True)
+    thread = threading.Thread(target=producer, daemon=True,
+                              name="dttpu-prefetch")
     thread.start()
 
-    while True:
-        while not queue:
-            thread.join(timeout=0.001)
-        item = queue.popleft()
-        if item is done:
-            if err:
-                raise err[0]
-            return
+    try:
+        while True:
+            item = handoff.get()     # blocking handoff, no poll
+            if item is done:
+                if err:
+                    raise err[0]
+                return
+            yield item               # GeneratorExit lands here on close
+            sem.release()
+    finally:
+        # Normal exhaustion, consumer abandonment, or an error: wake the
+        # producer if it is parked in sem.acquire and let it exit.
+        stop.set()
         sem.release()
-        yield item
+        thread.join(timeout=5.0)

@@ -819,6 +819,67 @@ class GPT:
         logits = self.logits(params, x)[:, 0, :]
         return logits, dict(new_kv, pos=pos + 1)
 
+    def decode_step_slots(self, params, kv, token_ids, write_col,
+                          kv_valid, positions):
+        """One token per row against a SLOT cache (continuous batching).
+
+        The serving tier's hot step (serve/): ``kv`` is a position-free
+        cache subtree ({k, v[, k_scale, v_scale]} — the ``init_cache``
+        layout minus ``pos``) whose batch dimension is a bank of SLOTS,
+        each holding an independent request.  Per-row state replaces the
+        scalar ``pos``: row r's incoming token is written at column
+        ``write_col[r]`` (per-row scatter, see ``_cache_layer``),
+        attention sees the columns flagged in ``kv_valid[r]`` plus the
+        token's own column, and ``positions[r]`` supplies the row's
+        position index — the token count, which differs from
+        ``write_col`` when the slot was spliced from a LEFT-padded
+        ragged prefill.  Per row the math is exactly ``decode_step`` at
+        ``pos = write_col[r]``, and every op is row-independent, so
+        admitting or retiring one slot cannot change another slot's
+        logits (bit-identity pinned by tests/test_serve.py).
+
+        Returns (logits [b, vocab] f32, new kv).  State advancement —
+        marking the written column valid, bumping write_col/positions —
+        is the caller's job (serve.slots.decode_slots_step), because
+        only the scheduler knows which rows are live.
+        """
+        c = self.config
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], token_ids, axis=0)[:, None, :]  # [b,1,d]
+        if c.position_embedding == "learned":
+            x = x + jnp.take(emb["position"], positions,
+                             axis=0)[:, None, :]
+        x = x.astype(c.dtype)
+
+        max_len = kv["k"].shape[2]
+        valid = kv_valid | (jnp.arange(max_len)[None, :]
+                            == write_col[:, None])
+        kv_mask = jnp.where(valid, 0.0, attn_lib.NEG_INF)[:, None, None, :]
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            rope_cs = attn_lib.rope_tables(positions[:, None], c.head_dim,
+                                           base=c.rope_base)
+
+        def attention(q, k_blk, v_blk, kv, i):
+            del k_blk, v_blk   # single token: read back through the cache
+            k_cache, v_cache = self._dequant_layer_kv(kv, i)
+            return attn_lib.dot_product_attention(q, k_cache, v_cache,
+                                                  mask=kv_mask)
+
+        def body(carry, inputs):
+            x, kv = carry
+            p, i = inputs
+            return self._cache_layer(p, x, kv, i,
+                                     write_pos=write_col, rope_cs=rope_cs,
+                                     attention=attention), None
+
+        (x, new_kv), _ = lax.scan(
+            body, (x, dict(kv)),
+            (params["decoder"], jnp.arange(c.num_layers)))
+        x = self._norm(params["ln_f"], x)
+        return self.logits(params, x)[:, 0, :], new_kv
+
     def _cache_layer(self, p, x, kv, i, *, write_pos, rope_cs,
                      attention):
         """ONE decoder layer of the KV-cache path — shared by decode_step
@@ -835,6 +896,12 @@ class GPT:
         ``attention(q, k_blk, v_blk, kv, i)`` supplies the step/block-
         specific attention read; ``rope_cs``: (cos, sin) tables hoisted
         out of the layer scan.
+
+        ``write_pos`` may be a scalar (one column for the whole batch —
+        the generate/beam path) or a [b] vector (per-row columns — the
+        slot-serving path, ``decode_step_slots``): vector positions
+        write by scatter, one (row, column-run) per batch row, so slots
+        at different sequence lengths share one compiled step.
         """
         h = self._norm(p["ln_1"], x)
         a = p["attention"]
@@ -852,6 +919,35 @@ class GPT:
             q = attn_lib.apply_rope(q, *rope_cs)
             k = attn_lib.apply_rope(k, *rope_cs)
         zero = jnp.zeros((), jnp.int32)
+        per_row = jnp.ndim(write_pos) == 1
+        if per_row:
+            b, s = x.shape[:2]
+            if s == 1:
+                # single-token serving step: a per-row masked overwrite
+                # of the layer slice beats XLA's general scatter
+                # (measured ~1.5x on CPU), and the slice is read back by
+                # attention anyway.  hit: [b, max_len, 1, 1]
+                max_len = kv["k"].shape[2]
+                hit = (jnp.arange(max_len)[None, :]
+                       == write_pos[:, None])[:, :, None, None]
+            else:
+                rows = jnp.arange(b)[:, None]                      # [b,1]
+                cols = write_pos[:, None] + jnp.arange(s)[None, :]  # [b,s]
+
+        def row_write(name, val):
+            """Per-row positions: masked layer overwrite for s=1, a
+            scatter for window writes.  Out-of-bounds columns (a slot
+            past max_len) hit nothing / are dropped — never clamped
+            onto live entries."""
+            if s == 1:
+                layer = lax.dynamic_index_in_dim(kv[name], i,
+                                                 keepdims=False)
+                layer = jnp.where(hit, val.astype(layer.dtype), layer)
+                kv[name] = lax.dynamic_update_slice(
+                    kv[name], layer[None], (i,) + (zero,) * layer.ndim)
+            else:
+                kv[name] = kv[name].at[i, rows, cols].set(
+                    val.astype(kv[name].dtype))
 
         def write(name, val):
             if "k_scale" in kv:
@@ -860,12 +956,18 @@ class GPT:
                 # last axis is the reduced one)
                 from ..ops import quant
                 qt = quant.quantize_tensor(val, reduce_axes=(-1,))
-                kv[name] = lax.dynamic_update_slice(
-                    kv[name], qt.q[None],
-                    (i, zero, write_pos, zero, zero))
-                kv[name + "_scale"] = lax.dynamic_update_slice(
-                    kv[name + "_scale"], qt.scale[None],
-                    (i, zero, write_pos, zero, zero))
+                if per_row:
+                    row_write(name, qt.q)
+                    row_write(name + "_scale", qt.scale)
+                else:
+                    kv[name] = lax.dynamic_update_slice(
+                        kv[name], qt.q[None],
+                        (i, zero, write_pos, zero, zero))
+                    kv[name + "_scale"] = lax.dynamic_update_slice(
+                        kv[name + "_scale"], qt.scale[None],
+                        (i, zero, write_pos, zero, zero))
+            elif per_row:
+                row_write(name, val)
             else:
                 kv[name] = lax.dynamic_update_slice(
                     kv[name], val[None].astype(kv[name].dtype),

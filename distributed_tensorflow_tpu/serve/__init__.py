@@ -1,0 +1,34 @@
+"""serve — continuous-batching serving engine (slot-scheduled KV cache).
+
+The serving tier above the GPT-family decode primitives: ONE jitted
+decode step stays hot while requests are admitted and retired with no
+retracing — the batch dimension of the KV cache becomes a bank of
+SLOTS, each an independent request at its own length.
+
+Three layers (docs/SERVING.md):
+
+* ``serve.slots`` — the slot cache state: per-slot kv_valid/write_col/
+  positions, the ``insert_slot`` splice, the all-slots decode step.
+* ``serve.scheduler`` — the state machine: chunked prefill (one
+  fixed-width window per tick), K-step decode dispatches, EOS/budget
+  retirement, slot reuse.
+* ``serve.engine`` — the façade: ``submit(prompt) -> handle`` with
+  streaming token callbacks, obs/ metrics (queue depth, active slots,
+  TTFT and per-request decode histograms, token counters) on the
+  existing ``/metrics`` endpoint.
+
+Measured by ``bench.py --config=gpt_serve`` against a lock-step-batching
+baseline in the same process; exactness (single request == greedy
+``GPT.generate``, admission never perturbs other slots) is pinned by
+tests/test_serve.py.
+"""
+from . import engine, scheduler, slots
+from .engine import Engine, RequestHandle, ServeMetrics
+from .scheduler import Request, SlotScheduler
+from .slots import (decode_slots_step, init_slot_cache, insert_slot,
+                    slot_kv_valid, strip_pos)
+
+__all__ = ["Engine", "RequestHandle", "ServeMetrics", "Request",
+           "SlotScheduler", "decode_slots_step", "init_slot_cache",
+           "insert_slot", "slot_kv_valid", "strip_pos", "engine",
+           "scheduler", "slots"]

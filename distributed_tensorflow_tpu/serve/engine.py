@@ -1,0 +1,186 @@
+"""Engine façade: submit() -> handle, streaming callbacks, obs metrics.
+
+The thin public layer over ``serve.scheduler.SlotScheduler``::
+
+    from distributed_tensorflow_tpu import serve
+
+    eng = serve.Engine(model, params, num_slots=8, max_len=256,
+                       prefill_chunk=32)
+    h = eng.submit(prompt_ids, max_new_tokens=64,
+                   on_token=lambda toks: print(toks))
+    eng.drain()                     # or pump eng.step() yourself
+    h.tokens                        # the generated ids (incl. EOS)
+
+The engine is synchronous — the caller pumps ``step()``/``drain()``
+(examples/serve_gpt.py ``--engine`` and ``bench.py --config=gpt_serve``
+are the reference drivers); a thread wrapping ``drain()`` gives a
+background server loop when needed.
+
+Metrics (``registry=`` — defaults to the process registry served at the
+existing ``/metrics`` endpoint, docs/OBSERVABILITY.md):
+
+* ``dttpu_serve_queue_depth`` / ``dttpu_serve_active_slots`` gauges,
+* ``dttpu_serve_ttft_seconds`` histogram (submit -> first token on host),
+* ``dttpu_serve_request_decode_seconds`` histogram (first -> last token),
+* ``dttpu_serve_tokens_total`` / ``dttpu_serve_requests_total`` counters
+  (rates are the scraper's job, e.g. ``rate(...[1m])``).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..obs import metrics as metrics_lib
+from .scheduler import Request, SlotScheduler
+
+__all__ = ["Engine", "RequestHandle", "ServeMetrics"]
+
+
+class ServeMetrics:
+    """obs wiring for the scheduler's duck-typed metrics sink."""
+
+    # TTFT is queue-position dependent; sub-ms to minutes, so a wide grid
+    _TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, registry: Optional[metrics_lib.Registry] = None):
+        reg = registry if registry is not None else metrics_lib.REGISTRY
+        self.registry = reg
+        self.queue_depth = reg.gauge(
+            "dttpu_serve_queue_depth",
+            "Requests queued, not yet prefilling.")
+        self.active_slots = reg.gauge(
+            "dttpu_serve_active_slots",
+            "Slots holding an in-flight request.")
+        self.ttft = reg.histogram(
+            "dttpu_serve_ttft_seconds",
+            "Submit to first generated token on the host.",
+            buckets=self._TTFT_BUCKETS)
+        self.request_decode = reg.histogram(
+            "dttpu_serve_request_decode_seconds",
+            "First to last generated token, per request.")
+        self.tokens = reg.counter(
+            "dttpu_serve_tokens_total",
+            "Generated tokens delivered to callers.")
+        self.requests = reg.counter(
+            "dttpu_serve_requests_total",
+            "Requests submitted to the engine.")
+
+    # -- scheduler hooks --------------------------------------------------
+
+    def submitted(self, req: Request) -> None:
+        self.requests.inc()
+
+    def admitted(self, req: Request) -> None:
+        if req.ttft_s is not None:
+            self.ttft.observe(req.ttft_s)
+
+    def emitted(self, req: Request, n: int) -> None:
+        self.tokens.inc(n)
+
+    def finished(self, req: Request) -> None:
+        if req.ttft_s is None:
+            return
+        if req.first_token_time is not None and req.finish_time is not None:
+            self.request_decode.observe(
+                req.finish_time - req.first_token_time)
+
+    def depth(self, queued: int, active: int) -> None:
+        self.queue_depth.set(queued)
+        self.active_slots.set(active)
+
+
+class RequestHandle:
+    """Caller-facing view of one request."""
+
+    def __init__(self, req: Request, engine: "Engine"):
+        self._req = req
+        self._engine = engine
+
+    @property
+    def rid(self) -> int:
+        return self._req.rid
+
+    @property
+    def tokens(self) -> List[int]:
+        """Generated ids so far (includes the EOS token when one fired)."""
+        return list(self._req.tokens)
+
+    @property
+    def done(self) -> bool:
+        return self._req.done.is_set()
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return self._req.ttft_s
+
+    @property
+    def decode_s(self) -> Optional[float]:
+        if self._req.first_token_time is None \
+                or self._req.finish_time is None:
+            return None
+        return self._req.finish_time - self._req.first_token_time
+
+    def result(self) -> List[int]:
+        """Pump the engine until this request finishes; return its
+        tokens.  (Synchronous engine: waiting IS driving.)"""
+        while not self.done:
+            if not self._engine.step():
+                break
+        return self.tokens
+
+
+class Engine:
+    """Continuous-batching serving engine over one jitted decode step.
+
+    Args mirror ``SlotScheduler`` (num_slots, max_len, prefill_chunk,
+    tick_steps, temperature/top_k/top_p, eos_id/pad_id, rng) plus:
+
+      registry: obs metrics registry to record into (default: the
+        process registry ``obs.metrics.REGISTRY`` — served by any
+        ``MetricsServer``/``Telemetry`` endpoint already running).
+      default_max_new_tokens: ``submit()`` budget when none is given.
+    """
+
+    def __init__(self, model, params, *,
+                 registry: Optional[metrics_lib.Registry] = None,
+                 default_max_new_tokens: int = 64, **scheduler_kwargs):
+        self.metrics = ServeMetrics(registry)
+        self.default_max_new_tokens = default_max_new_tokens
+        self.scheduler = SlotScheduler(model, params,
+                                       metrics=self.metrics,
+                                       **scheduler_kwargs)
+
+    # ----------------------------------------------------------- intake
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               on_token: Optional[Callable[[List[int]], None]] = None
+               ) -> RequestHandle:
+        """Queue one prompt ([plen] ids, any length per request) ->
+        handle.  ``on_token`` streams each delivered token batch."""
+        req = self.scheduler.submit(
+            prompt, max_new_tokens or self.default_max_new_tokens,
+            on_token=on_token)
+        return RequestHandle(req, self)
+
+    # ------------------------------------------------------------ drive
+
+    @property
+    def busy(self) -> bool:
+        return self.scheduler.busy
+
+    def step(self) -> bool:
+        """One scheduler tick; False when fully idle."""
+        return self.scheduler.step()
+
+    def drain(self) -> None:
+        """Run until every submitted request has finished."""
+        self.scheduler.drain()
+
+    def generate_batch(self, prompts,
+                       max_new_tokens: Optional[int] = None
+                       ) -> List[List[int]]:
+        """Convenience: submit a list of prompts, drain, return each
+        request's generated tokens (in submission order)."""
+        handles = [self.submit(p, max_new_tokens) for p in prompts]
+        self.drain()
+        return [h.tokens for h in handles]

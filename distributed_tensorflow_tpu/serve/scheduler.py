@@ -1,0 +1,361 @@
+"""Slot scheduler: admission, chunked prefill, decode ticks, retirement.
+
+The control plane of the continuous-batching engine (docs/SERVING.md).
+All device work goes through FOUR jitted functions built once at
+construction — a mid-prefill window, a last-prefill window (+ first
+token sample), the slot splice (admission), and the K-step decode tick —
+each with fully static shapes, so admitting and retiring requests never
+recompiles anything (pinned by tests/test_serve.py under the runtime
+sanitizer, and warn-checked by ``bench.py --config=gpt_serve``).
+
+Request lifecycle::
+
+    QUEUED --admission--> PREFILLING --insert_slot--> ACTIVE --> FINISHED
+                (free slot)   (chunked)    (first token)  (EOS/budget)
+
+* **Chunked prefill**: the prompt is RIGHT-padded to a multiple of
+  ``prefill_chunk`` and streamed through ``GPT.decode_window`` one
+  fixed-width window per tick, into a pooled batch-1 prefill cache — so
+  a long prompt never stalls in-flight decodes for more than one window
+  per tick, and every prompt length reuses the same two executables.
+  Free slots are filled eagerly: up to one prefill per free slot runs
+  concurrently (each advancing one window per tick), so a burst of
+  arrivals admits at slot rate, not one request per tick.  The pad
+  columns are written but never flagged valid, so they are dead weight,
+  not state.  The last window gathers logits at the prompt's real final
+  position, samples the first token, and splices the cache into its
+  slot in the SAME dispatch (time-to-first-token stops when that token
+  reaches the host).
+* **Decode tick**: ``tick_steps`` decode steps scanned inside ONE
+  dispatch (the same dispatch-amortization lever as
+  ``train.make_multi_train_step``), sampling in-graph and freezing rows
+  as they finish via ``ops.decoding.finish_step`` — finished rows emit
+  ``pad`` and stop advancing, exactly the generate() semantics.  Tokens
+  stream to the host once per tick, so retirement/admission decisions
+  lag at most one tick.
+* **Retirement**: EOS (when configured) or the request's token budget.
+  A retired slot is immediately admissible; ``insert_slot``'s validity
+  window guarantees the newcomer never attends the departed request's
+  K/V.
+
+Exactness contract: with one request in flight the emitted tokens equal
+``GPT.generate``'s greedy output token-for-token, and admission
+mid-decode leaves other slots' logits bit-identical — see
+``GPT.decode_step_slots`` and tests/test_serve.py.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..ops import decoding as dec
+from . import slots as slots_lib
+
+__all__ = ["Request", "SlotScheduler"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request (host-side bookkeeping)."""
+    rid: int
+    prompt: np.ndarray                       # [plen] int32
+    max_new_tokens: int
+    on_token: Optional[Callable[[List[int]], None]] = None
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+
+class _NullMetrics:
+    """Duck-typed metrics sink; the engine supplies a real one."""
+
+    def submitted(self, req):
+        pass
+
+    def admitted(self, req):
+        pass
+
+    def emitted(self, req, n):
+        pass
+
+    def finished(self, req):
+        pass
+
+    def depth(self, queued, active):
+        pass
+
+
+class SlotScheduler:
+    """Drive a slot cache for a GPT-family ``model``/``params`` pair.
+
+    Synchronous by design: callers pump ``step()`` (one tick: at most
+    one prefill window + one K-step decode dispatch) or ``drain()``.
+    Sampling config (temperature/top_k/top_p/eos) is static — it is
+    baked into the compiled tick, like generate()'s.
+    """
+
+    def __init__(self, model, params, *, num_slots: int = 8,
+                 max_len: Optional[int] = None, prefill_chunk: int = 32,
+                 tick_steps: int = 4, temperature: float = 0.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None, pad_id: Optional[int] = None,
+                 rng=None, metrics=None):
+        import jax
+        import jax.numpy as jnp
+
+        c = model.config
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1; got {num_slots}")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1; got {prefill_chunk}")
+        if tick_steps < 1:
+            raise ValueError(f"tick_steps must be >= 1; got {tick_steps}")
+        max_len = max_len or c.max_position
+        if max_len > c.max_position and c.position_embedding == "learned":
+            raise ValueError(f"max_len {max_len} exceeds max_position "
+                             f"{c.max_position}")
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.prefill_chunk = prefill_chunk
+        self.tick_steps = tick_steps
+        self.eos_id = eos_id
+        self.pad_id = dec.resolve_pad(eos_id, pad_id)
+        self.metrics = metrics if metrics is not None else _NullMetrics()
+        self._next_rid = 0
+        self._queue: collections.deque = collections.deque()
+        self._slots: List[Optional[Request]] = [None] * num_slots
+        # in-flight prefills: [req, windows [n, 1, W], next index, cache]
+        self._prefills: List[list] = []
+        # spare batch-1 prefill caches, reused across requests (stale
+        # columns are masked by the slot validity window, never read)
+        self._pf_pool: List[dict] = []
+
+        # -- device state -------------------------------------------------
+        self._cache = slots_lib.init_slot_cache(model, num_slots, max_len)
+        self._tokens = jnp.zeros((num_slots,), jnp.int32)
+        self._finished = jnp.ones((num_slots,), bool)   # empty = finished
+        self._remaining = jnp.zeros((num_slots,), jnp.int32)
+        self._key = rng if rng is not None else jax.random.PRNGKey(0)
+
+        # -- the three hot executables (built ONCE; static shapes) --------
+        pad = self.pad_id if self.pad_id is not None else 0
+
+        def win_mid(params, cache, window):
+            return model.decode_window(params, cache, window,
+                                       head="none")[1]
+
+        def last_admit(params, pf_cache, window, last_idx, key,
+                       cache, tokens, finished, remaining,
+                       slot_idx, length, budget):
+            """Last prefill window + first-token sample + slot splice in
+            ONE dispatch.  ``pf_cache`` is NOT donated: the pool entry
+            stays host-valid for the next request (its columns become
+            stale, which the slot validity window masks)."""
+            logits, pf_cache = model.decode_window(params, pf_cache,
+                                                   window, head="all")
+            row = jax.lax.dynamic_index_in_dim(logits[0], last_idx,
+                                               keepdims=False)
+            key, sub = jax.random.split(key)
+            tok = dec.sample_logits(sub, row[None], temperature,
+                                    top_k=top_k, top_p=top_p)[0]
+            cache = slots_lib.insert_slot(
+                cache, slot_idx, slots_lib.strip_pos(pf_cache), length)
+            tokens = tokens.at[slot_idx].set(tok)
+            done0 = budget <= 1
+            if eos_id is not None:
+                done0 = done0 | (tok == eos_id)
+            finished = finished.at[slot_idx].set(done0)
+            # the first token was already emitted from the prefill logits
+            remaining = remaining.at[slot_idx].set(budget - 1)
+            return tok, cache, tokens, finished, remaining, key
+
+        def tick(params, cache, tokens, finished, remaining, key):
+            def one(carry, _):
+                cache, tokens, finished, remaining, key = carry
+                live = ~finished
+                logits, cache = slots_lib.decode_slots_step(
+                    model, params, cache, tokens, live)
+                key, sub = jax.random.split(key)
+                nxt = dec.sample_logits(sub, logits, temperature,
+                                        top_k=top_k, top_p=top_p)
+                if eos_id is not None:
+                    nxt, finished = dec.finish_step(nxt, finished,
+                                                    eos_id, pad)
+                remaining = remaining - live.astype(jnp.int32)
+                emitted = jnp.where(live, nxt, jnp.int32(pad))
+                finished = finished | (remaining <= 0)
+                tokens = jnp.where(live, nxt, tokens)
+                return (cache, tokens, finished, remaining, key), \
+                    (emitted, live)
+
+            carry, (em, mask) = jax.lax.scan(
+                one, (cache, tokens, finished, remaining, key), None,
+                length=tick_steps)
+            return carry, em, mask
+
+        self._win_mid = jax.jit(win_mid, donate_argnums=(1,))
+        self._last_admit = jax.jit(last_admit,
+                                   donate_argnums=(4, 5, 6, 7, 8))
+        self._tick = jax.jit(tick, donate_argnums=(1, 2, 3, 4, 5))
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, prompt, max_new_tokens: int,
+               on_token: Optional[Callable[[List[int]], None]] = None
+               ) -> Request:
+        """Queue one request.  ``prompt``: [plen] int token ids (no
+        padding — slots are per-request, unequal lengths batch freely).
+        Enforces generate()'s length rule: prompt + max_new_tokens must
+        fit ``max_len``, and the chunk-padded prompt must too."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = prompt.size
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1; got {max_new_tokens}")
+        padded = -(-plen // self.prefill_chunk) * self.prefill_chunk
+        if plen + max_new_tokens > self.max_len or padded > self.max_len:
+            raise ValueError(
+                f"prompt ({plen}, chunk-padded {padded}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len {self.max_len}")
+        req = Request(rid=self._next_rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      on_token=on_token, submit_time=time.perf_counter())
+        self._next_rid += 1
+        self._queue.append(req)
+        self.metrics.submitted(req)
+        self._report_depth()
+        return req
+
+    # ---------------------------------------------------------- the tick
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue) or bool(self._prefills) \
+            or any(r is not None for r in self._slots)
+
+    def step(self) -> bool:
+        """One tick: advance every in-flight prefill by one window
+        (starting new prefills for free slots first), then one decode
+        dispatch over the slots.  Returns False when fully idle."""
+        did = False
+        free = sum(r is None for r in self._slots)
+        while self._queue and len(self._prefills) < free:
+            self._prefills.append(self._begin_prefill(
+                self._queue.popleft()))
+        if self._prefills:
+            did = True
+            self._prefills = [st for st in self._prefills
+                              if not self._advance_prefill(st)]
+        if any(r is not None for r in self._slots):
+            did = True
+            self._decode_tick()
+        return did
+
+    def drain(self) -> None:
+        """Pump until every queued/in-flight request has finished."""
+        while self.busy:
+            self.step()
+
+    # ---------------------------------------------------------- prefill
+
+    def _begin_prefill(self, req: Request) -> list:
+        w = self.prefill_chunk
+        plen = req.prompt.size
+        n_win = -(-plen // w)
+        padded = np.zeros((n_win * w,), np.int32)
+        padded[:plen] = req.prompt
+        windows = padded.reshape(n_win, 1, w)
+        kv = (self._pf_pool.pop() if self._pf_pool
+              else slots_lib.strip_pos(self.model.init_cache(
+                  1, self.max_len)))
+        return [req, windows, 0, dict(kv, pos=np.int32(0))]
+
+    def _advance_prefill(self, st: list) -> bool:
+        """One window for one in-flight prefill; True when the request
+        left the prefill phase (admitted or finished)."""
+        req, windows, i, cache = st
+        if i < len(windows) - 1:
+            st[3] = self._win_mid(self.params, cache, windows[i])
+            st[2] = i + 1
+            return False
+        plen = req.prompt.size
+        last_idx = np.int32(plen - 1 - (len(windows) - 1)
+                            * self.prefill_chunk)
+        slot = self._slots.index(None)
+        tok, self._cache, self._tokens, self._finished, \
+            self._remaining, self._key = self._last_admit(
+                self.params, cache, windows[-1], last_idx, self._key,
+                self._cache, self._tokens, self._finished,
+                self._remaining, np.int32(slot), np.int32(plen),
+                np.int32(req.max_new_tokens))
+        first = int(tok)          # host fetch: the TTFT barrier
+        req.first_token_time = time.perf_counter()
+        # the pool entry was not donated — reusable for the next request
+        self._pf_pool.append(slots_lib.strip_pos(cache))
+        self.metrics.admitted(req)
+        self._deliver(req, [first])
+        if req.max_new_tokens <= 1 or (self.eos_id is not None
+                                       and first == self.eos_id):
+            self._finish(req)      # spliced but already finished: the
+            # slot stays free host-side and the splice is dead weight
+        else:
+            self._slots[slot] = req
+        self._report_depth()
+        return True
+
+    # ----------------------------------------------------------- decode
+
+    def _decode_tick(self) -> None:
+        (self._cache, self._tokens, self._finished, self._remaining,
+         self._key), em, mask = self._tick(
+            self.params, self._cache, self._tokens, self._finished,
+            self._remaining, self._key)
+        em = np.asarray(em)                      # [K, S]
+        mask = np.asarray(mask)
+        fin = np.asarray(self._finished)
+        for r, req in enumerate(self._slots):
+            if req is None:
+                continue
+            toks = em[:, r][mask[:, r]]
+            if toks.size:
+                self._deliver(req, [int(t) for t in toks])
+            if fin[r]:
+                self._slots[r] = None
+                self._finish(req)
+        self._report_depth()
+
+    # ------------------------------------------------------ bookkeeping
+
+    def _deliver(self, req: Request, toks: List[int]) -> None:
+        req.tokens.extend(toks)
+        self.metrics.emitted(req, len(toks))
+        if req.on_token is not None:
+            req.on_token(toks)
+
+    def _finish(self, req: Request) -> None:
+        req.finish_time = time.perf_counter()
+        self.metrics.finished(req)
+        req.done.set()
+
+    def _report_depth(self) -> None:
+        self.metrics.depth(len(self._queue),
+                           sum(r is not None for r in self._slots))

@@ -1,0 +1,128 @@
+"""Slot-based KV cache: the state layer of the continuous-batching engine.
+
+The batch dimension of the standard ``GPT.init_cache`` layout becomes a
+bank of ``num_slots`` SLOTS, each holding one independent in-flight
+request, with per-slot state replacing the cache's scalar ``pos``:
+
+* ``kv`` — the position-free cache subtree ({k, v[, k_scale, v_scale]}
+  with shapes ``[L, num_slots, max_len, kv_heads, head_dim]``, including
+  the int8 + scales layout when ``kv_cache_dtype="int8"``),
+* ``start_col`` / ``write_col`` [S] — the slot's kv-valid column window
+  ``[start_col, write_col)``: a request's tokens always occupy a
+  contiguous column run (left-pad before ``start_col`` for ragged
+  splices, stale or unwritten columns from ``write_col`` on), so
+  per-slot validity is two ints, not a [S, max_len] mask — the boolean
+  ``kv_valid`` view handed to the model is derived per step
+  (``slot_kv_valid``), never stored or scatter-updated,
+* ``positions`` [S] — the slot's token count = its next position index
+  (``write_col - start_col``; kept explicit so the decode step never
+  recomputes meaning from the window).
+
+Everything here is pure and jittable with STATIC shapes: ``insert_slot``
+takes the slot index and lengths as traced scalars, the decode step
+takes the whole state as traced arrays — so admission, retirement, and
+slot reuse all run through ONE compiled executable per function
+(``docs/SERVING.md``; the retrace-free property is pinned by
+tests/test_serve.py under the runtime sanitizer).
+
+Stale K/V safety: retiring a slot is a host-side bookkeeping act — its
+columns simply fall outside the next occupant's validity window; masked
+columns contribute exp(NEG_INF) = 0 attention weight, so whatever a
+previous request left behind is multiplied by an exact zero and
+``insert_slot`` never needs to scrub the row.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["init_slot_cache", "strip_pos", "insert_slot",
+           "slot_kv_valid", "decode_slots_step"]
+
+
+def strip_pos(cache):
+    """The position-free K/V subtree of a standard ``init_cache`` dict —
+    what ``insert_slot`` splices and the slot cache carries."""
+    return {k: v for k, v in cache.items() if k != "pos"}
+
+
+def init_slot_cache(model, num_slots: int, max_len: int):
+    """Empty slot cache for ``model`` (a GPT-family instance): the
+    ``init_cache(num_slots, max_len)`` arrays plus per-slot state.  All
+    slots start retired (empty validity window at column 0)."""
+    kv = strip_pos(model.init_cache(num_slots, max_len))
+    # three distinct arrays: a shared zeros buffer would alias three
+    # leaves of a donated argument pytree, which XLA rejects
+    return {"kv": kv,
+            "start_col": jnp.zeros((num_slots,), jnp.int32),
+            "write_col": jnp.zeros((num_slots,), jnp.int32),
+            "positions": jnp.zeros((num_slots,), jnp.int32)}
+
+
+def slot_kv_valid(cache):
+    """[S, max_len] bool view of each slot's valid cache columns."""
+    cols = jnp.arange(cache["kv"]["k"].shape[2])[None, :]
+    return ((cols >= cache["start_col"][:, None])
+            & (cols < cache["write_col"][:, None]))
+
+
+def insert_slot(cache, slot_idx, prefilled, length, pad_len=0):
+    """Splice a freshly prefilled request into slot ``slot_idx``.
+
+    ``prefilled``: the position-free subtree (``strip_pos``) of a
+    batch-1 cache at the SAME max_len/dtype layout as the slot cache —
+    a chunked-prefill cache (``GPT.decode_window`` windows) or a
+    ``decode_block`` prefill.  The whole [L, 1, max_len, ...] row is
+    copied in (``dynamic_update_slice`` at a traced ``slot_idx`` — one
+    executable for every slot), including int8 scale planes, so the
+    splice round-trips quantized caches bit-for-bit.
+
+    ``length``: the request's REAL token count; ``pad_len``: left-pad
+    columns before the real tokens (nonzero when the prefill row came
+    out of a LEFT-padded ragged batch, ``decode_block(kv_valid=...)``).
+    The slot's valid window becomes ``[pad_len, pad_len + length)``,
+    its write head ``pad_len + length``, its position index ``length``.
+    Columns outside the window — pads, prefill-chunk right-padding, or
+    a previous occupant's leftovers — stay masked forever.
+
+    Pure function; jit with the slot cache donated and admission never
+    recompiles.
+    """
+    kv = {}
+    for name, buf in cache["kv"].items():
+        starts = (jnp.int32(0), jnp.asarray(slot_idx, jnp.int32)) \
+            + (jnp.int32(0),) * (buf.ndim - 2)
+        kv[name] = lax.dynamic_update_slice(
+            buf, prefilled[name].astype(buf.dtype), starts)
+    return {
+        "kv": kv,
+        "start_col": cache["start_col"].at[slot_idx].set(
+            jnp.asarray(pad_len, jnp.int32)),
+        "write_col": cache["write_col"].at[slot_idx].set(
+            jnp.asarray(pad_len + length, jnp.int32)),
+        "positions": cache["positions"].at[slot_idx].set(
+            jnp.asarray(length, jnp.int32)),
+    }
+
+
+def decode_slots_step(model, params, cache, tokens, live):
+    """One decode step for every slot -> (logits [S, vocab], new cache).
+
+    ``tokens`` [S]: each live slot's input token (its previously emitted
+    token); dead rows compute too (static shapes — that is the price of
+    never recompiling) but their state is FROZEN: only ``live`` rows
+    advance write_col/positions, so a dead row's garbage write lands
+    outside every validity window and is fully overwritten by the next
+    ``insert_slot``.  Row independence makes live rows' logits
+    bit-identical whatever the dead rows hold.
+    """
+    logits, kv = model.decode_step_slots(
+        params, cache["kv"], tokens, cache["write_col"],
+        slot_kv_valid(cache), cache["positions"])
+    live = live.astype(jnp.int32)
+    return logits, {
+        "kv": kv,
+        "start_col": cache["start_col"],
+        "write_col": cache["write_col"] + live,
+        "positions": cache["positions"] + live,
+    }

@@ -42,6 +42,11 @@ flags_lib.DEFINE_integer("seed", 0, "init/prompt seed")
 flags_lib.DEFINE_integer("metrics_port", 0,
                          "serve /metrics + /healthz during the demo "
                          "(0 = ephemeral port, -1 = off)")
+flags_lib.DEFINE_bool("engine", False,
+                      "also run the greedy/sampled/ragged demos through "
+                      "the continuous-batching engine (serve/) — same "
+                      "tokens/s lines, lock-step paths stay as the "
+                      "baseline; serve metrics land on /metrics")
 FLAGS = flags_lib.FLAGS
 
 
@@ -149,6 +154,58 @@ def main() -> int:
     agree8 = float(np.mean(np.asarray(greedy)[:, plen:]
                            == np.asarray(kv8_out)[:, plen:]))
     print(f"{'':<28} full-int8 greedy agreement {agree8:.3f}", flush=True)
+
+    if FLAGS.engine:
+        # Continuous-batching engine (serve/): per-request slots, chunked
+        # prefill, retrace-free admission.  Greedy must match the
+        # lock-step greedy output token-for-token (the engine exactness
+        # contract, docs/SERVING.md); the ragged path needs no padding at
+        # all — unequal prompts are simply unequal requests.
+        from distributed_tensorflow_tpu import serve
+
+        reg = telemetry.registry if telemetry is not None else None
+
+        def timed_engine(name, eng, plist, tokens_out):
+            def run():
+                handles = [eng.submit(p, new) for p in plist]
+                eng.drain()          # drain fetches tokens: wall closes
+                return handles
+            run()                    # warmup: compiles the engine's jits
+            t0 = time.perf_counter()
+            handles = run()
+            dt = time.perf_counter() - t0
+            print(f"{name:<28} {tokens_out / dt:10,.0f} tok/s",
+                  flush=True)
+            if telemetry is not None:
+                path = re.sub(r"[^a-z0-9]+", "_", name.lower()).strip("_")
+                reg.counter("dttpu_decode_tokens_total",
+                            "Tokens generated, by decode path.",
+                            labels={"path": path}).inc(tokens_out)
+                reg.gauge("dttpu_decode_tokens_per_second",
+                          "Decode throughput, by path.",
+                          labels={"path": path}).set(tokens_out / dt)
+            return handles
+
+        eng = serve.Engine(model, params, num_slots=b, max_len=max_len,
+                           prefill_chunk=4, tick_steps=4, registry=reg)
+        hs = timed_engine("engine greedy", eng, list(prompt), b * new)
+        agree_eng = float(np.mean([
+            h.tokens == np.asarray(greedy)[i, plen:].tolist()
+            for i, h in enumerate(hs)]))
+        print(f"{'':<28} engine==lock-step greedy {agree_eng:.3f}",
+              flush=True)
+
+        eng_s = serve.Engine(model, params, num_slots=b, max_len=max_len,
+                             prefill_chunk=4, tick_steps=4, registry=reg,
+                             temperature=0.8, top_p=0.9,
+                             rng=jax.random.PRNGKey(1))
+        timed_engine("engine sampled (T=0.8)", eng_s, list(prompt),
+                     b * new)
+
+        # ragged: the short prompt is just a shorter REQUEST — submit the
+        # unpadded rows the lock-step path had to left-pad
+        ragged_rows = [ragged_prompt[0, plen // 2:]] + list(prompt[1:])
+        timed_engine("engine ragged", eng, ragged_rows, b * new)
 
     draft = GPT(dataclasses.replace(config, num_layers=2))
     d_params = dict(params)

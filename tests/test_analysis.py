@@ -1131,6 +1131,17 @@ def test_walk_covers_obs_package():
         assert f"distributed_tensorflow_tpu/{mod}" in rel
 
 
+def test_walk_covers_serve_package():
+    """Same guard for the serving tier (serve/): the continuous-batching
+    engine is jit-heavy scheduler code — exactly what DT1xx/DT2xx exist
+    to check — and must stay inside the lint walk."""
+    files = analysis.collect_files(["distributed_tensorflow_tpu"])
+    rel = {os.path.relpath(f, REPO).replace(os.sep, "/") for f in files}
+    for mod in ("serve/__init__.py", "serve/slots.py",
+                "serve/scheduler.py", "serve/engine.py"):
+        assert f"distributed_tensorflow_tpu/{mod}" in rel
+
+
 def test_self_check_package_lints_clean_modulo_baseline():
     """The committed gate: the package + examples + scripts produce no
     findings beyond .dtlint-baseline.json (exactly what CI runs)."""

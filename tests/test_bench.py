@@ -499,3 +499,28 @@ class TestGptLong:
         assert r["metric"].startswith("gpt_moe_lm_train_tokens_per_sec")
         assert r["moe_experts"] == 8
         assert r["value"] > 0
+
+    def test_gpt_serve_smoke_schema(self):
+        """Continuous-batching row: the seeded mixed-length arrival
+        trace runs on the CPU mesh and the JSON carries the serving
+        schema — engine tokens/s, TTFT percentiles, and a vs_lockstep
+        ratio measured against the in-process lock-step baseline.
+        Admission/retirement must never recompile the hot executables:
+        after warmup the sanitizer sees zero violations, so
+        retrace_warnings must be absent."""
+        proc = _run(["--config=gpt_serve", "--device=cpu"],
+                    _env(DTTPU_BENCH_SEQ=128))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1
+        r = json.loads(lines[0])
+        assert r["metric"].startswith("gpt_serve_tokens_per_sec")
+        assert r["tokens_per_sec"] > 0
+        assert r["lockstep_tokens_per_sec"] > 0
+        assert r["vs_lockstep"] == r["vs_baseline"]
+        assert 0 < r["ttft_p50_ms"] <= r["ttft_p95_ms"]
+        assert r["requests"] > 0 and r["num_slots"] > 0
+        assert r.get("retrace_warnings", 0) == 0
+        # the acceptance bar: strictly better than lock-step batching
+        # on the mixed-length trace (CPU smoke margin is ~1.2-1.4x)
+        assert r["vs_lockstep"] > 1.0

@@ -54,6 +54,84 @@ def test_prefetch_to_device():
     np.testing.assert_array_equal(np.asarray(out[0][0]), x[:2])
 
 
+def _prefetch_threads():
+    import threading
+    return [t for t in threading.enumerate() if t.name == "dttpu-prefetch"]
+
+
+def _wait_for_no_prefetch_threads(timeout=5.0):
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _prefetch_threads():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_prefetch_consumer_abandonment_terminates_producer():
+    """A caller that drops the generator early (break out of an epoch)
+    must not leave the producer thread parked on the capacity semaphore
+    forever, pinning ``size`` device batches — the seed's leak."""
+    x = np.arange(400).reshape(100, 4).astype(np.float32)
+    ds = data.Dataset([x], 2, shuffle=False)
+    gen = data.prefetch_to_device(iter(ds), size=2)
+    next(gen)
+    next(gen)          # producer now parked on the capacity semaphore
+    gen.close()        # GeneratorExit -> unblock + join the producer
+    assert _wait_for_no_prefetch_threads(), "producer thread leaked"
+
+
+def test_prefetch_break_out_of_loop_terminates_producer():
+    """The natural spelling of the leak: ``break`` inside a for-loop
+    then dropping the generator (refcount close via gc)."""
+    x = np.arange(400).reshape(100, 4).astype(np.float32)
+    ds = data.Dataset([x], 2, shuffle=False)
+    for i, _batch in enumerate(data.prefetch_to_device(iter(ds), size=3)):
+        if i == 1:
+            break      # the for-loop's generator is closed on gc
+    import gc
+    gc.collect()
+    assert _wait_for_no_prefetch_threads(), "producer thread leaked"
+
+
+def test_prefetch_producer_error_still_raises_and_joins():
+    def bad_iter():
+        yield (np.zeros((2, 2), np.float32),)
+        raise RuntimeError("upstream boom")
+
+    gen = data.prefetch_to_device(bad_iter(), size=2)
+    next(gen)
+    with np.testing.assert_raises_regex(RuntimeError, "upstream boom"):
+        for _ in gen:
+            pass
+    assert _wait_for_no_prefetch_threads()
+
+
+def test_prefetch_caps_resident_batches():
+    """The capacity contract survives the rewrite: at most ``size``
+    batches are uploaded ahead of the consumer (the ticket is taken
+    BEFORE device_put)."""
+    import time
+    uploaded = []
+
+    def tracking_iter():
+        for i in range(10):
+            uploaded.append(i)
+            yield (np.full((2, 2), i, np.float32),)
+
+    gen = data.prefetch_to_device(tracking_iter(), size=2)
+    first = next(gen)
+    time.sleep(0.3)    # give the producer every chance to overrun
+    # consumed 1 + at most `size` in flight ahead of it
+    assert len(uploaded) <= 3, uploaded
+    np.testing.assert_array_equal(np.asarray(first[0]),
+                                  np.zeros((2, 2)))
+    rest = list(gen)
+    assert len(rest) == 9
+    assert _wait_for_no_prefetch_threads()
+
+
 def test_synthetic_datasets_shapes_and_learnability():
     (xt, yt), (xe, ye) = data.mnist()
     assert xt.shape == (60000, 28, 28, 1) and xt.dtype == np.float32

@@ -1,0 +1,355 @@
+"""Continuous-batching engine tests: slot admission exactness, stale-KV
+safety, retrace-free scheduling, metrics.
+
+The contracts pinned here (docs/SERVING.md):
+  * single request through the engine == greedy ``GPT.generate``
+    token-for-token (chunked prefill included),
+  * admitting a request mid-decode leaves other slots' logits
+    BIT-identical (same executable, row-independent math),
+  * int8 ``kv_cache_dtype`` slot splices round-trip values AND scales,
+  * a reused slot never reads the previous occupant's K/V (left-padded
+    ragged splices included),
+  * admission/retirement never recompile anything (RetraceGuard).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu import serve
+from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.ops import decoding as dec
+
+
+def _model_params(seed=0, **kw):
+    model = gpt_tiny(dropout_rate=0.0, **kw)
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+def _prompt(plen, seed=1, vocab=512):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (plen,), 0, vocab), np.int32)
+
+
+def _generate_tokens(model, params, prompt, new, max_len, **kw):
+    out = model.generate(params, jnp.asarray(prompt[None]),
+                         max_new_tokens=new, max_len=max_len, **kw)
+    return np.asarray(out)[0, prompt.size:].tolist()
+
+
+# ---------------------------------------------------------------------------
+# exactness: engine vs generate
+
+
+def test_single_request_matches_generate():
+    """One request in flight: streamed tokens == generate() greedy,
+    token-for-token — with a single-window AND a chunked (multi-window)
+    prefill."""
+    model, params = _model_params()
+    prompt = _prompt(7)
+    want = _generate_tokens(model, params, prompt, 9, 32)
+    for chunk in (8, 3):           # one window; 3 windows (ragged last)
+        eng = serve.Engine(model, params, num_slots=3, max_len=32,
+                           prefill_chunk=chunk, tick_steps=2)
+        h = eng.submit(prompt, max_new_tokens=9)
+        eng.drain()
+        assert h.done and h.tokens == want, (chunk, h.tokens, want)
+        assert h.ttft_s is not None and h.ttft_s > 0
+
+
+def test_single_request_eos_matches_generate():
+    """EOS retirement: the engine stops at the token where generate()
+    starts padding, and delivers the EOS itself."""
+    model, params = _model_params()
+    prompt = _prompt(6, seed=3)
+    plain = _generate_tokens(model, params, prompt, 10, 32)
+    eos = plain[2]                  # force an early stop on a real token
+    want = plain[:plain.index(eos) + 1]
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=8, tick_steps=3, eos_id=eos)
+    h = eng.submit(prompt, max_new_tokens=10)
+    eng.drain()
+    assert h.tokens == want
+    gen = _generate_tokens(model, params, prompt, 10, 32, eos_id=eos)
+    assert gen[:len(want)] == want          # same prefix, then pad
+    assert all(t == eos for t in gen[len(want):])
+
+
+def test_concurrent_unequal_requests_match_solo():
+    """Unequal-length requests decoding CONCURRENTLY in slots each equal
+    their own solo generate — ragged batching without any padding."""
+    model, params = _model_params()
+    prompts = [_prompt(7, seed=1), _prompt(5, seed=2), _prompt(3, seed=4)]
+    budgets = [9, 12, 6]
+    wants = [_generate_tokens(model, params, p, n, 32)
+             for p, n in zip(prompts, budgets)]
+    eng = serve.Engine(model, params, num_slots=3, max_len=32,
+                       prefill_chunk=4, tick_steps=3)
+    handles = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+    eng.drain()
+    for h, want in zip(handles, wants):
+        assert h.tokens == want
+
+
+def test_rope_gqa_engine_matches_generate():
+    """The slot step's per-row positions drive RoPE too (Llama-shaped
+    recipe: rotary positions + grouped-query cache)."""
+    model, params = _model_params(position_embedding="rope", num_heads=4,
+                                  hidden_size=128, num_kv_heads=2)
+    prompt = _prompt(6, seed=5)
+    want = _generate_tokens(model, params, prompt, 8, 32)
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=4, tick_steps=2)
+    h = eng.submit(prompt, 8)
+    eng.drain()
+    assert h.tokens == want
+
+
+def test_decode_slots_step_matches_decode_step_logits():
+    """Numeric oracle below the engine: one slot holding a prefilled
+    request produces decode_step's logits (same cache contents, per-row
+    state vs scalar pos)."""
+    model, params = _model_params()
+    ids = np.asarray(_prompt(6, seed=7))[None, :]
+    ref_cache = model.init_cache(1, 16)
+    _, ref_cache = model.decode_block(params, ref_cache,
+                                      jnp.asarray(ids))
+    cache = serve.init_slot_cache(model, num_slots=3, max_len=16)
+    cache = serve.insert_slot(cache, 0, serve.strip_pos(ref_cache), 6)
+    tok = jnp.asarray([ids[0, -1], 0, 0], jnp.int32)
+    live = jnp.asarray([True, False, False])
+    # feed the same token through both paths (the value fed does not
+    # matter for the comparison as long as both sides see it)
+    ref_logits, _ = model.decode_step(params, ref_cache, tok[:1])
+    slot_logits, cache = serve.decode_slots_step(model, params, cache,
+                                                 tok, live)
+    np.testing.assert_allclose(np.asarray(slot_logits[0]),
+                               np.asarray(ref_logits[0]), atol=2e-4)
+    assert int(cache["write_col"][0]) == 7      # live row advanced
+    assert int(cache["write_col"][1]) == 0      # dead rows frozen
+
+
+# ---------------------------------------------------------------------------
+# isolation: admission / stale KV
+
+
+def test_mid_decode_insertion_keeps_other_slots_bit_identical():
+    """Splicing a request into slot 1 mid-decode must not change slot
+    0's logits by even one bit: same executable, row-independent math."""
+    model, params = _model_params()
+    p0, p1 = _prompt(6, seed=1), _prompt(4, seed=2)
+    pf0 = model.init_cache(1, 16)
+    _, pf0 = model.decode_block(params, pf0, jnp.asarray(p0[None]))
+    pf1 = model.init_cache(1, 16)
+    _, pf1 = model.decode_block(params, pf1, jnp.asarray(p1[None]))
+    feed = np.asarray(_prompt(6, seed=9))       # fixed row-0 token feed
+
+    def run(insert_at):
+        cache = serve.init_slot_cache(model, 2, 16)
+        cache = serve.insert_slot(cache, 0, serve.strip_pos(pf0), 6)
+        live = jnp.asarray([True, False])
+        out = []
+        for t in range(6):
+            if t == insert_at:
+                cache = serve.insert_slot(cache, 1,
+                                          serve.strip_pos(pf1), 4)
+                live = jnp.asarray([True, True])
+            tokens = jnp.asarray([feed[t], 0], jnp.int32)
+            logits, cache = serve.decode_slots_step(model, params,
+                                                    cache, tokens, live)
+            out.append(np.asarray(logits[0]))
+        return out
+
+    alone = run(insert_at=None)
+    with_insert = run(insert_at=3)
+    for a, b in zip(alone, with_insert):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_retire_then_reuse_never_reads_stale_kv():
+    """Three requests through ONE slot: each newcomer's tokens equal its
+    solo generate even though the slot's cache still holds the previous
+    occupant's K/V beyond the new validity window — including a reuse
+    where the new request is SHORTER than the leftovers."""
+    model, params = _model_params()
+    long_p, short_p = _prompt(12, seed=11), _prompt(3, seed=12)
+    eng = serve.Engine(model, params, num_slots=1, max_len=40,
+                       prefill_chunk=4, tick_steps=4)
+    h1 = eng.submit(long_p, 20)     # fills columns 0..31
+    h2 = eng.submit(short_p, 5)     # reuse: much shorter
+    h3 = eng.submit(long_p, 20)     # reuse again with the long one
+    eng.drain()
+    assert h1.tokens == _generate_tokens(model, params, long_p, 20, 40)
+    assert h2.tokens == _generate_tokens(model, params, short_p, 5, 40)
+    assert h3.tokens == h1.tokens
+
+
+def test_left_padded_ragged_splice_matches_solo():
+    """insert_slot(pad_len=...) accepts a LEFT-padded ragged prefill row
+    (decode_block kv_valid/positions) and the slot then decodes exactly
+    the solo ragged generate — pads masked, positions shifted."""
+    model, params = _model_params()
+    plen, pad = 6, 2
+    real = _prompt(plen - pad, seed=13)
+    padded = np.zeros((plen,), np.int32)
+    padded[pad:] = real
+    valid = np.zeros((plen,), np.int32)
+    valid[pad:] = 1
+    max_len = 24
+    pad_len, kv_valid = dec.ragged_prompt_masks(
+        jnp.asarray(valid[None]), (1, plen), max_len)
+    pf = model.init_cache(1, max_len)
+    logits, pf = model.decode_block(
+        params, pf, jnp.asarray(padded[None]),
+        kv_valid=kv_valid[:, :plen],
+        positions=jnp.maximum(jnp.arange(plen)[None, :]
+                              - pad_len[:, None], 0))
+    want = _generate_tokens(model, params, real, 7, max_len)
+
+    cache = serve.init_slot_cache(model, 2, max_len)
+    cache = serve.insert_slot(cache, 0, serve.strip_pos(pf),
+                              plen - pad, pad_len=pad)
+    kvv = np.asarray(serve.slot_kv_valid(cache))
+    assert not kvv[0, :pad].any() and kvv[0, pad:plen].all() \
+        and not kvv[0, plen:].any()
+    tok = int(jnp.argmax(logits[0]))
+    got = [tok]
+    live = jnp.asarray([True, False])
+    for _ in range(6):
+        logits, cache = serve.decode_slots_step(
+            model, params, cache, jnp.asarray([tok, 0], jnp.int32), live)
+        tok = int(jnp.argmax(logits[0]))
+        got.append(tok)
+    assert got == want
+
+
+def test_int8_slot_splice_roundtrips_scales():
+    """kv_cache_dtype='int8': the slot splice carries int8 planes AND
+    f32 scales bit-for-bit, and the engine's greedy output equals the
+    int8 generate()'s."""
+    model, params = _model_params(kv_cache_dtype="int8")
+    prompt = _prompt(6, seed=1)
+    pf = model.init_cache(1, 16)
+    _, pf = model.decode_block(params, pf, jnp.asarray(prompt[None]))
+    cache = serve.init_slot_cache(model, 3, 16)
+    assert cache["kv"]["k"].dtype == jnp.int8
+    assert cache["kv"]["k_scale"].dtype == jnp.float32
+    cache = serve.insert_slot(cache, 1, serve.strip_pos(pf), 6)
+    for name in ("k", "v", "k_scale", "v_scale"):
+        np.testing.assert_array_equal(
+            np.asarray(cache["kv"][name][:, 1]),
+            np.asarray(pf[name][:, 0]))
+
+    want = _generate_tokens(model, params, prompt, 8, 32)
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=8, tick_steps=3)
+    h = eng.submit(prompt, 8)
+    eng.drain()
+    assert h.tokens == want
+
+
+# ---------------------------------------------------------------------------
+# scheduling behavior
+
+
+@pytest.mark.retrace_guard(budget=1, enforce_donation=True)
+def test_admission_and_retirement_never_recompile():
+    """Every engine executable traces ONCE across a mixed workload of
+    admissions, chunked prefills, EOS/budget retirements, and slot
+    reuse (budget=1: the second trace of anything fails the test).
+    Donation enforcement doubles as a use-after-donate check on the
+    scheduler's buffer management."""
+    model, params = _model_params()
+    rng = np.random.default_rng(0)
+    eng = serve.Engine(model, params, num_slots=2, max_len=64,
+                       prefill_chunk=4, tick_steps=3, eos_id=7)
+    handles = []
+    for i in range(7):
+        plen = int(rng.integers(2, 11))
+        prompt = rng.integers(0, 512, plen).astype(np.int32)
+        handles.append(eng.submit(prompt, int(rng.integers(1, 12))))
+        eng.step()
+    eng.drain()
+    assert all(h.done for h in handles)
+    assert all(len(h.tokens) >= 1 for h in handles)
+
+
+def test_streaming_callbacks_deliver_everything_in_order():
+    model, params = _model_params()
+    prompt = _prompt(5, seed=2)
+    got = []
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=8, tick_steps=2)
+    h = eng.submit(prompt, 9, on_token=got.extend)
+    eng.drain()
+    assert got == h.tokens
+    assert h.result() == h.tokens        # result() on a done handle
+
+
+def test_sampled_mode_runs_and_stays_in_vocab():
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=8, tick_steps=2, temperature=0.9,
+                       top_p=0.95, rng=jax.random.PRNGKey(5))
+    h1 = eng.submit(_prompt(4, seed=1), 8)
+    h2 = eng.submit(_prompt(6, seed=2), 8)
+    eng.drain()
+    for h in (h1, h2):
+        assert len(h.tokens) == 8
+        assert all(0 <= t < 512 for t in h.tokens)
+
+
+def test_submit_validation():
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=2, max_len=16,
+                       prefill_chunk=4)
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit(np.zeros((0,), np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(_prompt(4), 0)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        eng.submit(_prompt(4), 13)           # 4 + 13 > 16
+    eng.submit(_prompt(15), 1)               # chunk-padded 16 fits
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        eng.submit(_prompt(17), 1)           # chunk-padded 20 > 16
+    with pytest.raises(ValueError, match="num_slots"):
+        serve.Engine(model, params, num_slots=0, max_len=16)
+
+
+def test_engine_metrics_land_in_registry():
+    """The obs wiring: queue/active gauges move, TTFT and per-request
+    histograms observe once per request, token/request counters add up —
+    all scrapable through the standard exposition path."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=8, tick_steps=2, registry=reg)
+    n_tok = [6, 4, 9]
+    handles = [eng.submit(_prompt(4 + i, seed=i), n)
+               for i, n in enumerate(n_tok)]
+    eng.drain()
+    assert all(h.done for h in handles)
+    assert reg.get("dttpu_serve_requests_total").value == 3
+    assert reg.get("dttpu_serve_tokens_total").value == sum(n_tok)
+    assert reg.get("dttpu_serve_ttft_seconds").count == 3
+    assert reg.get("dttpu_serve_request_decode_seconds").count == 3
+    assert reg.get("dttpu_serve_queue_depth").value == 0
+    assert reg.get("dttpu_serve_active_slots").value == 0
+    doc = metrics_lib.parse_exposition(reg.expose())
+    assert doc["dttpu_serve_ttft_seconds"]["type"] == "histogram"
+    assert doc["dttpu_serve_tokens_total"]["type"] == "counter"
+
+
+def test_generate_batch_convenience_and_queueing():
+    """More requests than slots: the queue drains through slot reuse and
+    every output matches its solo generate."""
+    model, params = _model_params()
+    prompts = [_prompt(3 + i, seed=20 + i) for i in range(6)]
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=4, tick_steps=3,
+                       default_max_new_tokens=5)
+    outs = eng.generate_batch(prompts)
+    for p, got in zip(prompts, outs):
+        assert got == _generate_tokens(model, params, p, 5, 32)
