@@ -21,6 +21,8 @@ from typing import Iterable, Iterator, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..resilience import faults as faults_lib
+
 __all__ = ["Dataset", "prefetch_to_device"]
 
 
@@ -171,6 +173,12 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
     def producer():
         try:
             for item in iterator:
+                plan = faults_lib.active()
+                if plan is not None:
+                    # chaos harness: may poison this batch or kill this
+                    # producer (the raise lands in err[] below and the
+                    # consumer re-raises — the real dead-producer path)
+                    item = plan.on_batch(item)
                 sem.acquire()
                 # checked after acquire: an abandoning consumer releases
                 # the semaphore once to unblock exactly this wait

@@ -1,0 +1,38 @@
+"""resilience — fault injection, verified-checkpoint fallback, auto-resume.
+
+The survival layer above checkpointing (docs/RESILIENCE.md).  The
+reference delegates its entire failure story to
+``MonitoredTrainingSession`` restore-on-restart (reference
+example.py:189-192); this package supplies the three pieces that story
+silently assumes:
+
+* ``resilience.faults`` — a seeded, deterministic fault-injection
+  harness (``FaultPlan``: corrupt/truncate a checkpoint post-write,
+  transient save ``OSError``, NaN-poisoned batches/steps, a killed
+  prefetch producer, a failed serve decode), activated via
+  ``DTTPU_FAULTS`` or ``faults.activated(plan)``, every injection
+  audited through obs (``dttpu_faults_injected_total`` + trace
+  instants).  Recovery paths are *proven* under these faults, not
+  assumed from the happy path.
+* verified checkpoints — ``train.checkpoint`` now records per-leaf
+  masked CRC32C in the manifest and ``restore_latest_good`` walks
+  newest→oldest, quarantining corrupt dirs (``corrupt-ckpt-*`` + reason
+  file) and falling back to the previous good step
+  (``TrainSession(restore=True)`` uses it).
+* ``resilience.supervisor`` — ``Supervisor.run(build_session, train)``:
+  transient-vs-fatal exception classification, bounded restarts with
+  exponential backoff + jitter, ``dttpu_restarts_total`` /
+  ``dttpu_recovery_seconds``; plus ``NonfiniteGuardHook``, the
+  consecutive-non-finite tripwire over the ``device_health`` metrics
+  (pair with the step builders' in-graph ``skip_nonfinite=True``).
+
+Serve-side graceful degradation (queue-depth admission control,
+per-request deadlines, failure isolation) lives in ``serve.engine`` /
+``serve.scheduler`` and is cataloged in the same doc.
+"""
+from . import faults, supervisor
+from .faults import Fault, FaultPlan, InjectedFault
+from .supervisor import NonfiniteGuardHook, Supervisor
+
+__all__ = ["faults", "supervisor", "Fault", "FaultPlan", "InjectedFault",
+           "NonfiniteGuardHook", "Supervisor"]

@@ -1,0 +1,283 @@
+"""Seeded, deterministic fault injection — the chaos half of resilience.
+
+A ``FaultPlan`` is a list of scheduled faults, each named by *kind* and
+armed at a deterministic trigger index (the Nth checkpoint save, the Nth
+prefetched batch, global step N, request id K).  The plan is activated
+process-wide (``activate``/``activated`` — the same module-active idiom
+as ``obs.trace``) or via the ``DTTPU_FAULTS`` env var (a JSON list, so
+chaos runs work through subprocess boundaries, e.g. ``bench.py
+--config=recovery``); instrumented sites in checkpoint/session/pipeline/
+serve call the plan's ``on_*`` hooks, which no-op unless a fault of the
+matching kind is armed at that exact index.
+
+Fault catalog (docs/RESILIENCE.md):
+
+==================  =========================================================
+kind                effect (trigger field ``at``)
+==================  =========================================================
+corrupt_checkpoint  after the ``at``-th successful ``checkpoint.save``,
+                    truncate (``mode="truncate"``, default) or bit-flip
+                    (``mode="flip"``) ``file`` (default ``arrays.npz``)
+                    inside the just-written checkpoint dir
+save_oserror        raise a transient ``OSError`` at entry of the ``at``-th
+                    ``checkpoint.save`` call
+poison_batch        replace every float leaf of the ``at``-th batch flowing
+                    through ``data.prefetch_to_device`` with NaN
+nan_grads           NaN-poison the batch of the training step whose
+                    pre-step global step equals ``at`` (the gradients of
+                    that step become non-finite in-graph)
+kill_prefetch       raise ``OSError`` inside the ``dttpu-prefetch``
+                    producer thread at the ``at``-th batch (the consumer
+                    sees the producer die and re-raises)
+fail_decode         raise ``InjectedFault`` when the serve scheduler
+                    delivers tokens for request id ``at`` (fails exactly
+                    that handle; scheduler isolation keeps the tick loop
+                    and every other slot alive)
+==================  =========================================================
+
+Every injection is auditable: it lands in ``plan.log``, increments the
+``dttpu_faults_injected_total`` counter on the plan's registry, and emits
+a ``fault`` instant on the active obs tracer (when one is active), so a
+chaos run's timeline shows exactly where reality was bent.
+
+Determinism: triggers are index-equality, each fault fires at most
+``times`` times (default 1), and the only randomness (the flip offset of
+``corrupt_checkpoint``) comes from the plan's seeded generator — the
+same plan against the same run injects the same faults.
+
+NOTE: an ACTIVE plan makes ``TrainSession.run_step`` read the device
+step counter every step (a host sync) to evaluate ``nan_grads``
+triggers.  That cost exists only during chaos runs; with no plan active
+every hook site is a single module-global ``None`` check.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..obs import metrics as metrics_lib
+from ..obs import trace as trace_lib
+
+__all__ = ["Fault", "FaultPlan", "InjectedFault", "KINDS", "activate",
+           "activated", "active", "deactivate", "plan_from_env"]
+
+KINDS = ("corrupt_checkpoint", "save_oserror", "poison_batch",
+         "nan_grads", "kill_prefetch", "fail_decode")
+
+
+class InjectedFault(RuntimeError):
+    """An injected failure with no realistic stdlib exception type.
+
+    Used where the real-world analogue is a component-internal error
+    (a poisoned request's decode); sites injecting faults that DO have a
+    realistic type raise that type instead (``OSError`` for save/IO).
+    """
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault.  ``at`` is the trigger index — what it
+    indexes depends on ``kind`` (see the module catalog)."""
+    kind: str
+    at: int
+    mode: str = "truncate"          # corrupt_checkpoint: truncate | flip
+    file: str = "arrays.npz"        # corrupt_checkpoint target file
+    times: int = 1                  # max fires
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"choices: {KINDS}")
+
+
+class FaultPlan:
+    """A deterministic schedule of faults plus its audit trail."""
+
+    def __init__(self, faults, seed: int = 0,
+                 registry: Optional[metrics_lib.Registry] = None):
+        import numpy as np
+        self.faults: List[Fault] = [
+            f if isinstance(f, Fault) else Fault(**f) for f in faults]
+        self.seed = int(seed)
+        self._rng = np.random.default_rng(self.seed)
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self.log: List[Dict[str, Any]] = []
+        reg = registry if registry is not None else metrics_lib.REGISTRY
+        self._injected = reg.counter(
+            "dttpu_faults_injected_total",
+            "Faults injected by the resilience chaos harness.")
+
+    # ----------------------------------------------------------- matching
+
+    def _tick(self, site: str) -> int:
+        """0-based per-site call counter (thread-safe; the prefetch
+        producer calls ``on_batch`` off the main thread)."""
+        with self._lock:
+            i = self._counters.get(site, 0)
+            self._counters[site] = i + 1
+            return i
+
+    def _match(self, kind: str, index: int) -> Optional[Fault]:
+        with self._lock:
+            for f in self.faults:
+                if f.kind == kind and f.at == index and f.fired < f.times:
+                    f.fired += 1
+                    return f
+        return None
+
+    def _record(self, fault: Fault, **ctx: Any) -> None:
+        entry = {"kind": fault.kind, "at": fault.at, **ctx}
+        with self._lock:
+            self.log.append(entry)
+        self._injected.inc()
+        trace_lib.instant("fault", kind=fault.kind,
+                          **{k: str(v) for k, v in ctx.items()})
+
+    # ------------------------------------------------------ site hooks
+    # Each is called by exactly one instrumented site; all are no-ops
+    # (beyond a counter tick) unless a fault matches.
+
+    def on_save(self) -> int:
+        """Entry of ``checkpoint.save``: returns this call's save index;
+        raises a transient ``OSError`` when a save_oserror is armed."""
+        i = self._tick("save")
+        f = self._match("save_oserror", i)
+        if f is not None:
+            self._record(f, save=i)
+            raise OSError(f"injected fault: checkpoint save #{i} failed")
+        return i
+
+    def on_saved(self, ckpt_path: str, save_index: int) -> None:
+        """After the atomic rename: corrupt the just-written checkpoint
+        when a corrupt_checkpoint is armed at this save index."""
+        f = self._match("corrupt_checkpoint", save_index)
+        if f is not None:
+            self._corrupt(ckpt_path, f)
+            self._record(f, path=ckpt_path, mode=f.mode)
+
+    def _corrupt(self, ckpt_path: str, fault: Fault) -> None:
+        target = os.path.join(ckpt_path, fault.file)
+        size = os.path.getsize(target)
+        if fault.mode == "flip":
+            off = int(self._rng.integers(0, max(1, size)))
+            with open(target, "r+b") as fh:
+                fh.seek(off)
+                b = fh.read(1) or b"\x00"
+                fh.seek(off)
+                fh.write(bytes([b[0] ^ 0xFF]))
+        else:                                   # truncate
+            with open(target, "r+b") as fh:
+                fh.truncate(size // 2)
+
+    def on_batch(self, item: Any) -> Any:
+        """One batch through the prefetch producer: kill the producer or
+        poison the batch when armed; otherwise pass ``item`` through."""
+        i = self._tick("batch")
+        f = self._match("kill_prefetch", i)
+        if f is not None:
+            self._record(f, batch=i)
+            raise OSError(
+                f"injected fault: dttpu-prefetch producer killed at "
+                f"batch #{i}")
+        f = self._match("poison_batch", i)
+        if f is not None:
+            self._record(f, batch=i)
+            return _poison(item)
+        return item
+
+    def on_step(self, step: int, args: tuple) -> tuple:
+        """``TrainSession.run_step`` with pre-step global step ``step``:
+        NaN-poison the step's args when a nan_grads fault is armed."""
+        f = self._match("nan_grads", int(step))
+        if f is not None:
+            self._record(f, step=int(step))
+            return _poison(args)
+        return args
+
+    def on_decode(self, rid: int) -> None:
+        """Serve token delivery for request ``rid``: fail exactly that
+        request when a fail_decode fault is armed."""
+        f = self._match("fail_decode", int(rid))
+        if f is not None:
+            self._record(f, rid=int(rid))
+            raise InjectedFault(
+                f"injected fault: decode failed for request {rid}")
+
+
+def _poison(tree: Any) -> Any:
+    """Replace every float array leaf with NaN (jax arrays stay jax
+    arrays — already-uploaded prefetch batches poison in place)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def bad(leaf):
+        dt = getattr(leaf, "dtype", None)
+        if dt is None or not jnp.issubdtype(jnp.dtype(dt), jnp.floating):
+            return leaf
+        if isinstance(leaf, jax.Array):
+            return jnp.full_like(leaf, jnp.nan)
+        return np.full_like(np.asarray(leaf), np.nan)
+
+    return jax.tree.map(bad, tree)
+
+
+# ---------------------------------------------------------------------------
+# Active plan: process-wide activation (the obs.trace idiom) + env spec.
+
+_ACTIVE: Optional[FaultPlan] = None
+_ENV_CACHE = (None, None)   # (env string, parsed plan)
+
+
+def activate(plan: FaultPlan) -> FaultPlan:
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def deactivate(plan: Optional[FaultPlan] = None) -> None:
+    """Clear the active plan (only if it is ``plan``, when given)."""
+    global _ACTIVE
+    if plan is None or _ACTIVE is plan:
+        _ACTIVE = None
+
+
+@contextlib.contextmanager
+def activated(plan: FaultPlan):
+    """Scoped activation — the pytest-facing entry (the ``activate_faults``
+    fixture in tests/conftest.py wraps this)."""
+    activate(plan)
+    try:
+        yield plan
+    finally:
+        deactivate(plan)
+
+
+def active() -> Optional[FaultPlan]:
+    """The plan injection sites consult: an explicitly activated plan
+    wins; otherwise ``DTTPU_FAULTS`` (JSON) is parsed once per distinct
+    value and cached — counters must persist across calls."""
+    global _ENV_CACHE
+    if _ACTIVE is not None:
+        return _ACTIVE
+    spec = os.environ.get("DTTPU_FAULTS")
+    if not spec:
+        return None
+    if _ENV_CACHE[0] != spec:
+        _ENV_CACHE = (spec, plan_from_env(spec))
+    return _ENV_CACHE[1]
+
+
+def plan_from_env(spec: str) -> FaultPlan:
+    """Parse a ``DTTPU_FAULTS`` value: either a JSON list of fault dicts
+    or ``{"seed": S, "faults": [...]}``."""
+    doc = json.loads(spec)
+    if isinstance(doc, dict):
+        return FaultPlan(doc.get("faults", []), seed=doc.get("seed", 0))
+    return FaultPlan(doc)

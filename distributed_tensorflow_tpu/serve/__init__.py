@@ -23,12 +23,12 @@ baseline in the same process; exactness (single request == greedy
 tests/test_serve.py.
 """
 from . import engine, scheduler, slots
-from .engine import Engine, RequestHandle, ServeMetrics
+from .engine import Engine, QueueFullError, RequestHandle, ServeMetrics
 from .scheduler import Request, SlotScheduler
 from .slots import (decode_slots_step, init_slot_cache, insert_slot,
                     slot_kv_valid, strip_pos)
 
-__all__ = ["Engine", "RequestHandle", "ServeMetrics", "Request",
-           "SlotScheduler", "decode_slots_step", "init_slot_cache",
-           "insert_slot", "slot_kv_valid", "strip_pos", "engine",
-           "scheduler", "slots"]
+__all__ = ["Engine", "QueueFullError", "RequestHandle", "ServeMetrics",
+           "Request", "SlotScheduler", "decode_slots_step",
+           "init_slot_cache", "insert_slot", "slot_kv_valid", "strip_pos",
+           "engine", "scheduler", "slots"]

@@ -16,6 +16,15 @@ The engine is synchronous — the caller pumps ``step()``/``drain()``
 are the reference drivers); a thread wrapping ``drain()`` gives a
 background server loop when needed.
 
+Graceful degradation (docs/RESILIENCE.md): ``max_queue_depth`` bounds
+admission — a full queue rejects with ``QueueFullError`` instead of
+buffering unbounded work; per-request ``deadline_s`` retires requests
+that would otherwise decode forever (status ``deadline_exceeded``);
+``drain(timeout_s=...)`` bounds shutdown; and a poisoned request (a
+raising ``on_token`` callback, an injected decode fault) fails ONLY its
+own handle — the scheduler tick loop and every other slot's bit-exact
+stream survive.
+
 Metrics (``registry=`` — defaults to the process registry served at the
 existing ``/metrics`` endpoint, docs/OBSERVABILITY.md):
 
@@ -23,16 +32,24 @@ existing ``/metrics`` endpoint, docs/OBSERVABILITY.md):
 * ``dttpu_serve_ttft_seconds`` histogram (submit -> first token on host),
 * ``dttpu_serve_request_decode_seconds`` histogram (first -> last token),
 * ``dttpu_serve_tokens_total`` / ``dttpu_serve_requests_total`` counters
-  (rates are the scraper's job, e.g. ``rate(...[1m])``).
+  (rates are the scraper's job, e.g. ``rate(...[1m])``),
+* ``dttpu_serve_rejected_total`` / ``dttpu_serve_deadline_expired_total``
+  / ``dttpu_serve_failed_total`` counters — the degradation triad.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional
 
 from ..obs import metrics as metrics_lib
 from .scheduler import Request, SlotScheduler
 
-__all__ = ["Engine", "RequestHandle", "ServeMetrics"]
+__all__ = ["Engine", "QueueFullError", "RequestHandle", "ServeMetrics"]
+
+
+class QueueFullError(RuntimeError):
+    """``submit`` rejected: the engine's queue is at ``max_queue_depth``.
+    Backpressure, not failure — retry after in-flight work retires."""
 
 
 class ServeMetrics:
@@ -64,6 +81,16 @@ class ServeMetrics:
         self.requests = reg.counter(
             "dttpu_serve_requests_total",
             "Requests submitted to the engine.")
+        self.rejected = reg.counter(
+            "dttpu_serve_rejected_total",
+            "Requests rejected at submit (queue at max_queue_depth).")
+        self.deadline_expired = reg.counter(
+            "dttpu_serve_deadline_expired_total",
+            "Requests retired past their deadline_s budget.")
+        self.failed = reg.counter(
+            "dttpu_serve_failed_total",
+            "Requests failed individually (callback/decode error) "
+            "without killing the scheduler.")
 
     # -- scheduler hooks --------------------------------------------------
 
@@ -83,6 +110,12 @@ class ServeMetrics:
         if req.first_token_time is not None and req.finish_time is not None:
             self.request_decode.observe(
                 req.finish_time - req.first_token_time)
+
+    def aborted(self, req: Request, status: str) -> None:
+        if status == "deadline_exceeded":
+            self.deadline_expired.inc()
+        elif status == "failed":
+            self.failed.inc()
 
     def depth(self, queued: int, active: int) -> None:
         self.queue_depth.set(queued)
@@ -108,6 +141,18 @@ class RequestHandle:
     @property
     def done(self) -> bool:
         return self._req.done.is_set()
+
+    @property
+    def status(self) -> str:
+        """``pending`` while in flight; terminal: ``ok`` |
+        ``deadline_exceeded`` | ``failed`` | ``cancelled``.  Non-ok
+        handles keep whatever tokens were delivered before the abort."""
+        return self._req.status
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """The isolating failure for status ``failed``; None otherwise."""
+        return self._req.error
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -139,13 +184,27 @@ class Engine:
         process registry ``obs.metrics.REGISTRY`` — served by any
         ``MetricsServer``/``Telemetry`` endpoint already running).
       default_max_new_tokens: ``submit()`` budget when none is given.
+      max_queue_depth: admission bound — ``submit`` raises
+        ``QueueFullError`` (and bumps ``dttpu_serve_rejected_total``)
+        when this many requests are already queued ahead of prefill.
+        ``None`` (default) keeps the old accept-everything behavior.
+      default_deadline_s: ``submit()`` deadline when none is given
+        (``None`` = no deadline).
     """
 
     def __init__(self, model, params, *,
                  registry: Optional[metrics_lib.Registry] = None,
-                 default_max_new_tokens: int = 64, **scheduler_kwargs):
+                 default_max_new_tokens: int = 64,
+                 max_queue_depth: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 **scheduler_kwargs):
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1; got {max_queue_depth}")
         self.metrics = ServeMetrics(registry)
         self.default_max_new_tokens = default_max_new_tokens
+        self.max_queue_depth = max_queue_depth
+        self.default_deadline_s = default_deadline_s
         self.scheduler = SlotScheduler(model, params,
                                        metrics=self.metrics,
                                        **scheduler_kwargs)
@@ -153,13 +212,23 @@ class Engine:
     # ----------------------------------------------------------- intake
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               on_token: Optional[Callable[[List[int]], None]] = None
-               ) -> RequestHandle:
+               on_token: Optional[Callable[[List[int]], None]] = None,
+               deadline_s: Optional[float] = None) -> RequestHandle:
         """Queue one prompt ([plen] ids, any length per request) ->
-        handle.  ``on_token`` streams each delivered token batch."""
+        handle.  ``on_token`` streams each delivered token batch.
+        Raises ``QueueFullError`` at ``max_queue_depth`` — shed load at
+        the door instead of queueing work that will miss every SLO."""
+        if self.max_queue_depth is not None \
+                and self.scheduler.queued >= self.max_queue_depth:
+            self.metrics.rejected.inc()
+            raise QueueFullError(
+                f"queue at max_queue_depth={self.max_queue_depth}; "
+                "retry after in-flight requests retire")
         req = self.scheduler.submit(
             prompt, max_new_tokens or self.default_max_new_tokens,
-            on_token=on_token)
+            on_token=on_token,
+            deadline_s=(deadline_s if deadline_s is not None
+                        else self.default_deadline_s))
         return RequestHandle(req, self)
 
     # ------------------------------------------------------------ drive
@@ -172,15 +241,43 @@ class Engine:
         """One scheduler tick; False when fully idle."""
         return self.scheduler.step()
 
-    def drain(self) -> None:
-        """Run until every submitted request has finished."""
-        self.scheduler.drain()
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Run until every submitted request has finished; with
+        ``timeout_s``, stop pumping at the budget and return False
+        (in-flight requests stay resumable by further ``step`` calls —
+        or cancel them for a hard shutdown)."""
+        if timeout_s is None:
+            self.scheduler.drain()
+            return True
+        deadline = time.perf_counter() + timeout_s
+        while self.scheduler.busy:
+            if time.perf_counter() >= deadline:
+                return False
+            self.scheduler.step()
+        return True
+
+    def cancel(self, handle: RequestHandle) -> bool:
+        """Abort one request (status ``cancelled``); False if it already
+        finished."""
+        return self.scheduler.cancel(handle._req)
 
     def generate_batch(self, prompts,
                        max_new_tokens: Optional[int] = None
                        ) -> List[List[int]]:
         """Convenience: submit a list of prompts, drain, return each
-        request's generated tokens (in submission order)."""
-        handles = [self.submit(p, max_new_tokens) for p in prompts]
+        request's generated tokens (in submission order).
+
+        If a mid-list ``submit`` raises (validation, queue full), the
+        already-submitted handles are cancelled before the error
+        propagates — the seed version drained anyway and left them
+        permanently pending."""
+        handles = []
+        try:
+            for p in prompts:
+                handles.append(self.submit(p, max_new_tokens))
+        except BaseException:
+            for h in handles:
+                self.scheduler.cancel(h._req)
+            raise
         self.drain()
         return [h.tokens for h in handles]

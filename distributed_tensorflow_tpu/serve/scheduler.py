@@ -53,6 +53,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..resilience import faults as faults_lib
 from ..ops import decoding as dec
 from . import slots as slots_lib
 
@@ -61,13 +62,23 @@ __all__ = ["Request", "SlotScheduler"]
 
 @dataclasses.dataclass
 class Request:
-    """One in-flight generation request (host-side bookkeeping)."""
+    """One in-flight generation request (host-side bookkeeping).
+
+    ``status`` is the terminal disposition: ``"pending"`` while in
+    flight, then ``"ok"`` | ``"deadline_exceeded"`` | ``"failed"`` |
+    ``"cancelled"`` (docs/RESILIENCE.md).  ``deadline`` is an absolute
+    ``perf_counter`` instant; expiry is checked once per tick, so a
+    retirement can lag the deadline by at most one tick.
+    """
     rid: int
     prompt: np.ndarray                       # [plen] int32
     max_new_tokens: int
     on_token: Optional[Callable[[List[int]], None]] = None
     tokens: List[int] = dataclasses.field(default_factory=list)
     submit_time: float = 0.0
+    deadline: Optional[float] = None
+    status: str = "pending"
+    error: Optional[BaseException] = None
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     done: threading.Event = dataclasses.field(
@@ -93,6 +104,9 @@ class _NullMetrics:
         pass
 
     def finished(self, req):
+        pass
+
+    def aborted(self, req, status):
         pass
 
     def depth(self, queued, active):
@@ -218,12 +232,17 @@ class SlotScheduler:
     # ------------------------------------------------------------- intake
 
     def submit(self, prompt, max_new_tokens: int,
-               on_token: Optional[Callable[[List[int]], None]] = None
-               ) -> Request:
+               on_token: Optional[Callable[[List[int]], None]] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Queue one request.  ``prompt``: [plen] int token ids (no
         padding — slots are per-request, unequal lengths batch freely).
         Enforces generate()'s length rule: prompt + max_new_tokens must
-        fit ``max_len``, and the chunk-padded prompt must too."""
+        fit ``max_len``, and the chunk-padded prompt must too.
+
+        ``deadline_s``: total wall-clock budget from submit; a request
+        still queued/decoding past it is retired with status
+        ``deadline_exceeded`` at the next tick instead of decoding
+        forever."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = prompt.size
         if plen < 1:
@@ -231,14 +250,19 @@ class SlotScheduler:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1; got {max_new_tokens}")
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError(f"deadline_s must be >= 0; got {deadline_s}")
         padded = -(-plen // self.prefill_chunk) * self.prefill_chunk
         if plen + max_new_tokens > self.max_len or padded > self.max_len:
             raise ValueError(
                 f"prompt ({plen}, chunk-padded {padded}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_len {self.max_len}")
+        now = time.perf_counter()
         req = Request(rid=self._next_rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
-                      on_token=on_token, submit_time=time.perf_counter())
+                      on_token=on_token, submit_time=now,
+                      deadline=None if deadline_s is None
+                      else now + deadline_s)
         self._next_rid += 1
         self._queue.append(req)
         self.metrics.submitted(req)
@@ -252,11 +276,19 @@ class SlotScheduler:
         return bool(self._queue) or bool(self._prefills) \
             or any(r is not None for r in self._slots)
 
+    @property
+    def queued(self) -> int:
+        """Requests accepted but not yet prefilling (the engine's
+        ``max_queue_depth`` admission-control signal)."""
+        return len(self._queue)
+
     def step(self) -> bool:
-        """One tick: advance every in-flight prefill by one window
-        (starting new prefills for free slots first), then one decode
-        dispatch over the slots.  Returns False when fully idle."""
+        """One tick: retire expired deadlines, advance every in-flight
+        prefill by one window (starting new prefills for free slots
+        first), then one decode dispatch over the slots.  Returns False
+        when fully idle."""
         did = False
+        self._expire_deadlines()
         free = sum(r is None for r in self._slots)
         while self._queue and len(self._prefills) < free:
             self._prefills.append(self._begin_prefill(
@@ -312,7 +344,16 @@ class SlotScheduler:
         # the pool entry was not donated — reusable for the next request
         self._pf_pool.append(slots_lib.strip_pos(cache))
         self.metrics.admitted(req)
-        self._deliver(req, [first])
+        try:
+            self._deliver(req, [first])
+        except Exception as e:
+            # failure isolation: the newcomer dies alone — freeze its
+            # freshly spliced row (frozen rows never perturb the others:
+            # the decode math is row-independent) and keep ticking
+            self._finished = self._finished.at[slot].set(True)
+            self._abort(req, "failed", error=e)
+            self._report_depth()
+            return True
         if req.max_new_tokens <= 1 or (self.eos_id is not None
                                        and first == self.eos_id):
             self._finish(req)      # spliced but already finished: the
@@ -337,23 +378,99 @@ class SlotScheduler:
                 continue
             toks = em[:, r][mask[:, r]]
             if toks.size:
-                self._deliver(req, [int(t) for t in toks])
+                try:
+                    self._deliver(req, [int(t) for t in toks])
+                except Exception as e:
+                    # failure isolation: a poisoned request (callback
+                    # raise, injected decode fault) fails its own handle;
+                    # its row freezes and every other slot keeps its
+                    # bit-exact stream — the tick loop never dies
+                    self._slots[r] = None
+                    self._finished = self._finished.at[r].set(True)
+                    self._abort(req, "failed", error=e)
+                    continue
             if fin[r]:
                 self._slots[r] = None
                 self._finish(req)
         self._report_depth()
 
+    # --------------------------------------------- degradation paths
+
+    def _expire_deadlines(self) -> None:
+        """Retire every request past its deadline, wherever it is —
+        queued (never admitted), mid-prefill (cache back to the pool),
+        or active (row frozen).  Runs once per tick."""
+        now = time.perf_counter()
+
+        def expired(req):
+            return req is not None and req.deadline is not None \
+                and now > req.deadline
+
+        if any(expired(r) for r in self._queue):
+            keep: collections.deque = collections.deque()
+            for req in self._queue:
+                if expired(req):
+                    self._abort(req, "deadline_exceeded")
+                else:
+                    keep.append(req)
+            self._queue = keep
+        still = []
+        for st in self._prefills:
+            if expired(st[0]):
+                self._pf_pool.append(slots_lib.strip_pos(st[3]))
+                self._abort(st[0], "deadline_exceeded")
+            else:
+                still.append(st)
+        self._prefills = still
+        for r, req in enumerate(self._slots):
+            if expired(req):
+                self._slots[r] = None
+                self._finished = self._finished.at[r].set(True)
+                self._abort(req, "deadline_exceeded")
+
+    def cancel(self, req: Request, status: str = "cancelled") -> bool:
+        """Abort one request wherever it is; False if already finished.
+        (The engine's ``generate_batch`` error path uses this so a
+        failed submit never strands earlier handles pending forever.)"""
+        if req.done.is_set():
+            return False
+        if req in self._queue:
+            self._queue.remove(req)
+        for st in list(self._prefills):
+            if st[0] is req:
+                self._prefills.remove(st)
+                self._pf_pool.append(slots_lib.strip_pos(st[3]))
+        for r, other in enumerate(self._slots):
+            if other is req:
+                self._slots[r] = None
+                self._finished = self._finished.at[r].set(True)
+        self._abort(req, status)
+        self._report_depth()
+        return True
+
     # ------------------------------------------------------ bookkeeping
 
     def _deliver(self, req: Request, toks: List[int]) -> None:
+        plan = faults_lib.active()
+        if plan is not None:
+            plan.on_decode(req.rid)   # chaos: may fail THIS request only
         req.tokens.extend(toks)
         self.metrics.emitted(req, len(toks))
         if req.on_token is not None:
             req.on_token(toks)
 
     def _finish(self, req: Request) -> None:
+        req.status = "ok"
         req.finish_time = time.perf_counter()
         self.metrics.finished(req)
+        req.done.set()
+
+    def _abort(self, req: Request, status: str,
+               error: Optional[BaseException] = None) -> None:
+        req.status = status
+        req.error = error
+        req.finish_time = time.perf_counter()
+        self.metrics.aborted(req, status)
         req.done.set()
 
     def _report_depth(self) -> None:

@@ -12,17 +12,29 @@ Design:
     paths, shapes, dtypes — human-auditable and a structure check on
     restore).
   * Writes are atomic: temp dir + ``os.replace``; a ``checkpoint`` index
-    file names the latest (TF-convention) and ``max_to_keep`` prunes old
-    steps.  Chief-only writing is enforced by the caller (TrainSession),
-    matching the reference's chief semantics (example.py:74-76,190).
+    file names the latest (TF-convention, itself written tmp +
+    ``os.replace`` so a crash can never leave it torn) and
+    ``max_to_keep`` prunes old steps.  Chief-only writing is enforced by
+    the caller (TrainSession), matching the reference's chief semantics
+    (example.py:74-76,190).
   * Restore is *into* a target pytree (same treedef), so restored leaves come
     back with the target's structure; callers re-apply shardings by donating
     the result to their jitted step (single-controller scale; the multi-host
     per-shard writer is ``train/sharded_checkpoint.py``).
+  * **Verified restore** (docs/RESILIENCE.md): every manifest leaf row
+    carries a masked CRC32C of the stored bytes; ``verify`` checks
+    structure + checksums without touching the target, and
+    ``restore_latest_good`` walks newest→oldest, quarantining any
+    checkpoint that fails verification or restore (dir renamed to
+    ``corrupt-<name>`` with a ``QUARANTINE_REASON`` file) and falling
+    back to the previous good step — ``TrainSession(restore=True)``
+    restores through it, so one corrupt dir costs a save interval of
+    progress instead of the run.
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import shutil
@@ -32,10 +44,20 @@ from typing import Any, List, Optional, Tuple
 import jax
 import numpy as np
 
-__all__ = ["save", "restore", "latest_checkpoint", "latest_step",
+from ..obs import metrics as obs_metrics
+from ..resilience import faults as faults_lib
+from ..summary.crc32c import masked_crc32c
+
+log = logging.getLogger(__name__)
+
+__all__ = ["save", "restore", "restore_latest_good", "verify",
+           "quarantine", "latest_checkpoint", "latest_step",
            "all_checkpoints", "AsyncCheckpointer", "ckpt_path"]
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+_QUARANTINE_PREFIX = "corrupt-"
+_REASON_FILE = "QUARANTINE_REASON"
+CHECKSUM_FORMAT = "masked-crc32c"
 
 # npy cannot faithfully serialize extension dtypes (bfloat16, float8_*):
 # their descr degrades to raw void bytes that cannot be cast on load.  Store
@@ -80,19 +102,24 @@ def _leaf_paths(tree) -> Tuple[List[str], Any]:
 
 def save(ckpt_dir: str, step: int, tree: Any, max_to_keep: int = 5) -> str:
     """Atomically write one checkpoint; returns its directory path."""
+    plan = faults_lib.active()
+    save_index = plan.on_save() if plan is not None else None
     os.makedirs(ckpt_dir, exist_ok=True)
     paths, (flat, _) = _leaf_paths(tree)
     leaves = [np.asarray(jax.device_get(leaf)) for _, leaf in flat]
+    stored = [_storage_view(leaf) for leaf in leaves]
 
     tmp = tempfile.mkdtemp(prefix=".ckpt-tmp-", dir=ckpt_dir)
     try:
         np.savez(os.path.join(tmp, "arrays.npz"),
-                 **{f"leaf_{i}": _storage_view(leaf)
-                    for i, leaf in enumerate(leaves)})
+                 **{f"leaf_{i}": sv for i, sv in enumerate(stored)})
         manifest = {
             "step": int(step),
-            "leaves": [{"path": p, "shape": list(l.shape), "dtype": str(l.dtype)}
-                       for p, l in zip(paths, leaves)],
+            "checksum": CHECKSUM_FORMAT,
+            "leaves": [{"path": p, "shape": list(l.shape),
+                        "dtype": str(l.dtype),
+                        "crc32c": masked_crc32c(_leaf_bytes(sv))}
+                       for p, l, sv in zip(paths, leaves, stored)],
         }
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
@@ -104,13 +131,35 @@ def save(ckpt_dir: str, step: int, tree: Any, max_to_keep: int = 5) -> str:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
 
-    with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
-        f.write(os.path.basename(final) + "\n")
+    if plan is not None:
+        plan.on_saved(final, save_index)
+    write_index(ckpt_dir, os.path.basename(final))
 
     if max_to_keep and max_to_keep > 0:
         for old in all_checkpoints(ckpt_dir)[:-max_to_keep]:
             shutil.rmtree(old, ignore_errors=True)
     return final
+
+
+def _leaf_bytes(stored: np.ndarray) -> bytes:
+    """The exact byte string whose CRC the manifest records: the
+    C-contiguous storage view (what npz round-trips)."""
+    return np.ascontiguousarray(stored).tobytes()
+
+
+def write_index(ckpt_dir: str, name: str) -> None:
+    """Atomically (re)write the TF-convention ``checkpoint`` index file.
+    The seed version used a bare truncating ``open("w")`` — a crash
+    mid-write left a torn index; tmp + ``os.replace`` cannot."""
+    fd, tmp = tempfile.mkstemp(prefix=".checkpoint-tmp-", dir=ckpt_dir)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(name + "\n")
+        os.replace(tmp, os.path.join(ckpt_dir, "checkpoint"))
+    except Exception:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
 
 
 def all_checkpoints(ckpt_dir: str) -> List[str]:
@@ -125,7 +174,33 @@ def all_checkpoints(ckpt_dir: str) -> List[str]:
     return [p for _, p in sorted(found)]
 
 
+def _index_entry(ckpt_dir: str) -> Optional[str]:
+    """The checkpoint dir the index file names, if it is valid: parses,
+    matches the ``ckpt-*`` convention, and still exists with its arrays
+    file (a quarantined or pruned target invalidates the entry)."""
+    try:
+        with open(os.path.join(ckpt_dir, "checkpoint")) as f:
+            name = f.readline().strip()
+    except OSError:
+        return None
+    if not name or _CKPT_RE.match(name) is None:
+        return None
+    path = os.path.join(ckpt_dir, name)
+    if not os.path.exists(os.path.join(path, "arrays.npz")):
+        return None
+    return path
+
+
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Prefer a valid ``checkpoint`` index entry (TF semantics: the index
+    is authoritative for "latest"); fall back to the directory scan when
+    the index is missing, torn, or points at a gone/quarantined dir.
+    The index is written after the atomic dir rename, so at worst it
+    lags one save behind the scan — which ``restore_latest_good``'s
+    newest→oldest walk does not depend on."""
+    path = _index_entry(ckpt_dir)
+    if path is not None:
+        return path
     ckpts = all_checkpoints(ckpt_dir)
     return ckpts[-1] if ckpts else None
 
@@ -234,3 +309,111 @@ def restore(target: Any, ckpt_path: str) -> Any:
                     f"{np.shape(leaf)}")
             leaves.append(stored.astype(np.asarray(leaf).dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Verified restore: checksum verification, quarantine, newest-good fallback.
+
+
+def verify(path: str, target: Any = None) -> Tuple[bool, str]:
+    """Integrity-check one checkpoint dir WITHOUT building the result tree.
+
+    Checks: manifest parses; the npz opens and holds exactly the
+    manifest's leaves; per-leaf shapes match; per-leaf masked CRC32C
+    matches when the manifest records one (pre-checksum checkpoints pass
+    on structure alone); and, when ``target`` is given, leaf count /
+    paths / shapes match the target pytree.  Returns ``(ok, reason)`` —
+    every failure mode (truncated npz, flipped bytes, torn manifest,
+    leaf-count mismatch) comes back as a reason string, never an
+    exception.
+    """
+    try:
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        metas = manifest["leaves"]
+    except Exception as e:
+        return False, f"unreadable manifest.json: {e!r}"
+    try:
+        with np.load(os.path.join(path, "arrays.npz")) as z:
+            names = set(z.files)
+            if names != {f"leaf_{i}" for i in range(len(metas))}:
+                return False, (
+                    f"manifest/leaf-count mismatch: manifest has "
+                    f"{len(metas)} leaves, npz has {len(names)}")
+            for i, meta in enumerate(metas):
+                arr = z[f"leaf_{i}"]
+                if list(arr.shape) != list(meta["shape"]):
+                    return False, (
+                        f"leaf {i} shape {list(arr.shape)} != manifest "
+                        f"{meta['shape']}")
+                want_crc = meta.get("crc32c")
+                if want_crc is not None \
+                        and masked_crc32c(_leaf_bytes(arr)) != want_crc:
+                    return False, f"leaf {i} ({meta['path']}) CRC mismatch"
+    except Exception as e:
+        return False, f"unreadable arrays.npz: {e!r}"
+    if target is not None:
+        flat, _ = jax.tree_util.tree_flatten_with_path(target)
+        if len(flat) != len(metas):
+            return False, (
+                f"checkpoint has {len(metas)} leaves but target has "
+                f"{len(flat)}")
+        for i, ((kp, leaf), meta) in enumerate(zip(flat, metas)):
+            want = jax.tree_util.keystr(kp)
+            if meta["path"] != want:
+                return False, (f"leaf {i} path {meta['path']!r} != target "
+                               f"{want!r}")
+            if list(meta["shape"]) != list(np.shape(leaf)):
+                return False, (f"leaf {want}: shape {meta['shape']} != "
+                               f"target {list(np.shape(leaf))}")
+    return True, ""
+
+
+def quarantine(path: str, reason: str) -> str:
+    """Move a bad checkpoint out of the restore path: rename the dir to
+    ``corrupt-<name>`` (uniquified) and drop a ``QUARANTINE_REASON``
+    file inside.  ``all_checkpoints`` never matches the new name, so a
+    quarantined dir can never be restored, pruned as a "checkpoint", or
+    re-quarantined — but stays on disk for the postmortem."""
+    parent, base = os.path.split(os.path.normpath(path))
+    dst = os.path.join(parent, _QUARANTINE_PREFIX + base)
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = os.path.join(parent, f"{_QUARANTINE_PREFIX}{base}.{n}")
+    os.rename(path, dst)
+    try:
+        with open(os.path.join(dst, _REASON_FILE), "w") as f:
+            f.write(reason + "\n")
+    except OSError:  # the rename is the load-bearing part
+        log.exception("could not write %s in %s", _REASON_FILE, dst)
+    obs_metrics.REGISTRY.counter(
+        "dttpu_checkpoints_quarantined_total",
+        "Checkpoint dirs quarantined by verified restore.").inc()
+    from ..obs import trace as obs_trace
+    obs_trace.instant("checkpoint_quarantine", path=dst, reason=reason)
+    log.warning("quarantined checkpoint %s -> %s (%s)", path, dst, reason)
+    return dst
+
+
+def restore_latest_good(target: Any, ckpt_dir: str
+                        ) -> Tuple[Optional[Any], Optional[str]]:
+    """Restore the newest checkpoint that verifies AND restores cleanly.
+
+    Walks ``all_checkpoints`` newest→oldest; every dir that fails
+    ``verify`` (against the manifest and ``target``'s structure) or
+    whose ``restore`` raises is quarantined with its reason, and the
+    walk falls back to the next older step.  Returns ``(tree, path)``,
+    or ``(None, None)`` when no checkpoint survives — the caller starts
+    fresh (loudly), exactly what an operator wants from an auto-resume
+    loop at 3am.
+    """
+    for path in reversed(all_checkpoints(ckpt_dir)):
+        ok, reason = verify(path, target=target)
+        if ok:
+            try:
+                return restore(target, path), path
+            except Exception as e:
+                reason = f"restore failed: {e!r}"
+        quarantine(path, reason)
+    return None, None

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel import cluster
+from ..resilience import faults as faults_lib
 from . import checkpoint as ckpt_lib
 from . import sharded_checkpoint as sharded_lib
 from .hooks import Hook
@@ -112,23 +113,21 @@ class TrainSession:
                                 else ckpt_lib.AsyncCheckpointer())
 
         if restore and checkpoint_dir:
+            # Verified restore (docs/RESILIENCE.md): walk newest->oldest,
+            # quarantine anything that fails checksums/structure, fall
+            # back to the previous good step.  A corrupt newest
+            # checkpoint costs one save interval, not the run.
             if sharded_checkpoint:
-                ckpts = sharded_lib.all_sharded_checkpoints(checkpoint_dir)
-                latest = ckpts[-1] if ckpts else None
-                if latest is not None:
-                    self.state = sharded_lib.restore_sharded(self.state,
-                                                             latest)
-                    self.last_saved_step = self.step
-                    log.info("restored sharded checkpoint %s (step %d)",
-                             latest, self.step)
-                    print(f"Restored checkpoint {os.path.basename(latest)} "
-                          f"at step {self.step}", flush=True)
-                return
-            latest = ckpt_lib.latest_checkpoint(checkpoint_dir)
-            if latest is not None:
-                self.state = ckpt_lib.restore(self.state, latest)
+                restored, latest = sharded_lib.restore_latest_good_sharded(
+                    self.state, checkpoint_dir)
+            else:
+                restored, latest = ckpt_lib.restore_latest_good(
+                    self.state, checkpoint_dir)
+            if restored is not None:
+                self.state = restored
                 self.last_saved_step = self.step  # disk already has this step
-                log.info("restored checkpoint %s (step %d)", latest, self.step)
+                log.info("restored checkpoint %s (step %d)", latest,
+                         self.step)
                 print(f"Restored checkpoint {os.path.basename(latest)} at "
                       f"step {self.step}", flush=True)
 
@@ -145,6 +144,12 @@ class TrainSession:
 
     def run_step(self, *args, **kwargs) -> Dict[str, Any]:
         """One training step: hooks, compiled step fn, cursor advance."""
+        plan = faults_lib.active()
+        if plan is not None:
+            # chaos runs only: evaluating a step-indexed fault trigger
+            # reads the device step scalar (a host sync); with no plan
+            # active this is one module-global None check.
+            args = plan.on_step(self.step, args)
         for hook in self.hooks:
             hook.before_step(self)
         if self.telemetry is not None:

@@ -40,6 +40,7 @@ from . import checkpoint as _plain
 
 __all__ = ["save_sharded", "restore_sharded", "is_sharded_checkpoint",
            "is_complete_sharded_checkpoint", "all_sharded_checkpoints",
+           "verify_sharded", "restore_latest_good_sharded",
            "AsyncShardedCheckpointer"]
 
 _SHARD_FILE = "shards-{pid:05d}.npz"
@@ -86,9 +87,12 @@ def _snapshot_local(tree, pid: int) -> Tuple[Dict[str, np.ndarray],
                     continue
                 seen.add(start)
                 data = np.asarray(jax.device_get(shard.data))
-                chunks[_chunk_key(i, start)] = _plain._storage_view(data)
+                sv = _plain._storage_view(data)
+                chunks[_chunk_key(i, start)] = sv
                 my_chunks.append({"leaf": i, "start": list(start),
-                                  "shape": list(data.shape), "pid": pid})
+                                  "shape": list(data.shape), "pid": pid,
+                                  "crc32c": _plain.masked_crc32c(
+                                      _plain._leaf_bytes(sv))})
             leaves_meta.append({"path": paths[i], "shape": list(gshape),
                                 "dtype": dtype, "kind": "sharded"})
         else:
@@ -96,9 +100,12 @@ def _snapshot_local(tree, pid: int) -> Tuple[Dict[str, np.ndarray],
             data = np.asarray(leaf)
             if chief:
                 start = tuple([0] * data.ndim)
-                chunks[_chunk_key(i, start)] = _plain._storage_view(data)
+                sv = _plain._storage_view(data)
+                chunks[_chunk_key(i, start)] = sv
                 my_chunks.append({"leaf": i, "start": list(start),
-                                  "shape": list(data.shape), "pid": pid})
+                                  "shape": list(data.shape), "pid": pid,
+                                  "crc32c": _plain.masked_crc32c(
+                                      _plain._leaf_bytes(sv))})
             leaves_meta.append({"path": paths[i], "shape": list(data.shape),
                                 "dtype": str(data.dtype), "kind": "host"})
     return chunks, my_chunks, leaves_meta
@@ -140,8 +147,7 @@ def _write_local(ckpt_dir: str, step: int, pid: int, nproc: int,
             with open(mtmp, "w") as f:
                 json.dump(manifest, f, indent=1)
             os.replace(mtmp, os.path.join(final, "manifest.json"))
-            with open(os.path.join(ckpt_dir, "checkpoint"), "w") as f:
-                f.write(os.path.basename(final) + "\n")
+            _plain.write_index(ckpt_dir, os.path.basename(final))
             if max_to_keep and max_to_keep > 0:
                 _prune(ckpt_dir, max_to_keep)
     except Exception:
@@ -389,3 +395,119 @@ def restore_sharded(target: Any, ckpt_path: str,
     finally:
         reader.close()
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Verified restore (sharded): chunk checksums + coverage, newest-good walk.
+
+
+def verify_sharded(ckpt_path: str) -> Tuple[bool, str]:
+    """Integrity-check one sharded checkpoint: structural completeness
+    (manifest + every process's shard/chunk-index files), every indexed
+    chunk present in its shard npz with the recorded shape and masked
+    CRC32C (when recorded — pre-checksum checkpoints pass on structure),
+    chunks inside their leaf's bounds, and full coverage: per leaf, the
+    chunk volumes must sum to the leaf volume (chunks never overlap —
+    replica_id 0 owners are disjoint — so equal volume means every
+    element is covered without materializing a filled-mask the size of
+    the global array).  Returns ``(ok, reason)``; never raises."""
+    try:
+        with open(os.path.join(ckpt_path, "manifest.json")) as f:
+            manifest = json.load(f)
+    except Exception as e:
+        return False, f"unreadable manifest.json: {e!r}"
+    if manifest.get("format") != "sharded-v1":
+        return False, f"not a sharded-v1 checkpoint: {manifest.get('format')!r}"
+    if not is_complete_sharded_checkpoint(ckpt_path):
+        return False, ("structurally incomplete: a process's shard/"
+                       "chunk-index files are missing")
+    metas = manifest["leaves"]
+    try:
+        if "chunks" in manifest:                     # legacy embedded index
+            chunk_rows = manifest["chunks"]
+        else:
+            chunk_rows = []
+            for p in range(int(manifest.get("process_count", 1))):
+                with open(os.path.join(ckpt_path,
+                                       f"chunks-{p:05d}.json")) as f:
+                    chunk_rows.extend(json.load(f))
+    except Exception as e:
+        return False, f"unreadable chunk index: {e!r}"
+    covered = [0] * len(metas)
+    files: Dict[int, Any] = {}
+    try:
+        for row in chunk_rows:
+            leaf_i, start = int(row["leaf"]), tuple(row["start"])
+            shape = tuple(row["shape"])
+            if leaf_i >= len(metas):
+                return False, f"chunk names leaf {leaf_i} beyond manifest"
+            gshape = tuple(metas[leaf_i]["shape"])
+            if len(start) != len(gshape) or any(
+                    s + c > g for s, c, g in zip(start, shape, gshape)):
+                return False, (f"leaf {leaf_i} chunk @{start} shape {shape} "
+                               f"outside global shape {gshape}")
+            pid = int(row["pid"])
+            if pid not in files:
+                files[pid] = np.load(os.path.join(
+                    ckpt_path, _SHARD_FILE.format(pid=pid)))
+            key = _chunk_key(leaf_i, start)
+            if key not in files[pid].files:
+                return False, (f"chunk {key} indexed but missing from "
+                               f"shard file of process {pid}")
+            arr = files[pid][key]
+            if tuple(arr.shape) != shape:
+                return False, (f"chunk {key} shape {tuple(arr.shape)} != "
+                               f"indexed {shape}")
+            want_crc = row.get("crc32c")
+            if want_crc is not None and _plain.masked_crc32c(
+                    _plain._leaf_bytes(arr)) != want_crc:
+                return False, f"chunk {key} CRC mismatch"
+            covered[leaf_i] += int(np.prod(shape, dtype=np.int64)) or 1
+    except Exception as e:
+        return False, f"unreadable shard file: {e!r}"
+    finally:
+        for f in files.values():
+            f.close()
+    for i, meta in enumerate(metas):
+        want = int(np.prod(meta["shape"], dtype=np.int64)) or 1
+        if covered[i] != want:
+            return False, (f"leaf {i} ({meta['path']}) chunks cover "
+                           f"{covered[i]} of {want} elements")
+    return True, ""
+
+
+def restore_latest_good_sharded(target: Any, ckpt_dir: str,
+                                shardings: Any = None
+                                ) -> Tuple[Optional[Any], Optional[str]]:
+    """Sharded analogue of ``checkpoint.restore_latest_good``: walk every
+    ``ckpt-*`` dir newest→oldest, restore the first that verifies,
+    quarantine the rest (``corrupt-ckpt-*`` + reason file).
+
+    Incomplete dirs ARE quarantined here: restore time is job start, when
+    no writer can still be in flight, so "manifest present but a chunk
+    file missing" is a torn save, not a pending one.  (The rename may
+    race other restoring processes of the same job — first one wins,
+    the rest tolerate the miss.)  Returns ``(tree, path)`` or
+    ``(None, None)``."""
+    if not os.path.isdir(ckpt_dir):
+        return None, None
+    found = []
+    for name in os.listdir(ckpt_dir):
+        m = _plain._CKPT_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    for _, path in sorted(found, reverse=True):
+        ok, reason = verify_sharded(path)
+        if ok:
+            try:
+                return restore_sharded(target, path,
+                                       shardings=shardings), path
+            except Exception as e:
+                reason = f"restore failed: {e!r}"
+        elif reason.startswith("not a sharded-v1"):
+            continue   # a plain checkpoint sharing the dir is not corrupt
+        try:
+            _plain.quarantine(path, reason)
+        except OSError:   # another process of this job quarantined it first
+            pass
+    return None, None

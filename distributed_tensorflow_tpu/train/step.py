@@ -135,7 +135,8 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                     accum_steps: int = 1,
                     policy: Any = None,
                     loss_scale: bool = False,
-                    device_health: bool = False) -> Callable:
+                    device_health: bool = False,
+                    skip_nonfinite: bool = False) -> Callable:
     """Build ``step(state, (x, y)) -> (new_state, metrics)``.
 
     Thin adapter over ``make_custom_train_step``: wraps the (model, loss,
@@ -169,7 +170,8 @@ def make_train_step(model, loss, optimizer: opt_lib.Optimizer,
                                   grad_clip_norm=grad_clip_norm,
                                   accum_steps=accum_steps, policy=policy,
                                   loss_scale=loss_scale,
-                                  device_health=device_health)
+                                  device_health=device_health,
+                                  skip_nonfinite=skip_nonfinite)
 
 
 def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
@@ -182,7 +184,8 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
                            accum_steps: int = 1,
                            policy: Any = None,
                            loss_scale: bool = False,
-                           device_health: bool = False) -> Callable:
+                           device_health: bool = False,
+                           skip_nonfinite: bool = False) -> Callable:
     """Generalized step builder for model families with structured batches.
 
     ``loss_fn(params, model_state, batch, rng, train) ->
@@ -221,7 +224,23 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
     them only when they fire, and the hot loop gains no device->host
     syncs.  (``grad_clip_norm`` already reports ``grad_norm``; the health
     key defers to it.)
+
+    ``skip_nonfinite=True``: when any gradient element is non-finite the
+    whole update is dropped IN-GRAPH — params, optimizer state (bias
+    correction must not see skipped steps), and model_state keep their
+    pre-step values; only the step cursor advances.  The rollback must
+    live inside the compiled step because the state is donated: by the
+    time a hook could react on the host, the pre-step buffers are gone.
+    The returned state therefore already IS the rolled-back one, and
+    ``metrics['grads_finite']`` reports what happened — pair with
+    ``resilience.NonfiniteGuardHook`` to abort (for a supervisor
+    restart) after K consecutive skips.  ``loss_scale=True`` includes
+    this skip already (plus scale adjustment); combining both is
+    rejected.
     """
+    if skip_nonfinite and loss_scale:
+        raise ValueError("loss_scale=True already skips non-finite "
+                         "updates; drop skip_nonfinite")
     base_key = jax.random.PRNGKey(seed)
     pol = prec_lib.policy(policy) if policy is not None else None
 
@@ -320,12 +339,24 @@ def make_custom_train_step(loss_fn, optimizer: opt_lib.Optimizer,
             from ..obs import device as obs_device
             for k, v in obs_device.grad_health(grads).items():
                 metrics.setdefault(k, v)
+        sn_finite = prec_lib.all_finite(grads) if skip_nonfinite else None
         if grad_clip_norm is not None:
             grads, gnorm = opt_lib.clip_by_global_norm(grads, grad_clip_norm)
             metrics["grad_norm"] = gnorm
         updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                                   state.params)
         new_params = opt_lib.apply_updates(state.params, updates)
+        if sn_finite is not None:
+            # In-graph rollback: the NaN-contaminated candidates are
+            # computed then discarded by the select — where() never
+            # propagates the unselected branch's NaNs.  Same keep shape
+            # as the loss-scale skip below.
+            keep = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(sn_finite, n, o), new, old)
+            new_params = keep(new_params, state.params)
+            new_opt_state = keep(new_opt_state, state.opt_state)
+            new_model_state = keep(new_model_state, model_state_in)
+            metrics["grads_finite"] = sn_finite
         if ls is not None:
             # Non-finite grads: drop the whole update (params, optimizer
             # state including its step count — bias correction must not see

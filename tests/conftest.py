@@ -183,3 +183,25 @@ def _retrace_guard_marker(request):
     from distributed_tensorflow_tpu.analysis.sanitizer import RetraceGuard
     with RetraceGuard(*marker.args, **marker.kwargs):
         yield
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (resilience/faults.py, docs/RESILIENCE.md): chaos tests
+# activate a deterministic FaultPlan for their extent via
+#
+#   plan = activate_faults({"kind": "kill_prefetch", "at": 3}, ...)
+#
+# The fixture guarantees deactivation even when the test dies mid-chaos —
+# a leaked plan would inject faults into every later test's saves/batches.
+
+@pytest.fixture
+def activate_faults():
+    from distributed_tensorflow_tpu.resilience import faults
+
+    def _activate(*fault_dicts, seed=0, registry=None):
+        plan = faults.FaultPlan(list(fault_dicts), seed=seed,
+                                registry=registry)
+        return faults.activate(plan)
+
+    yield _activate
+    faults.deactivate()

@@ -1142,6 +1142,17 @@ def test_walk_covers_serve_package():
         assert f"distributed_tensorflow_tpu/{mod}" in rel
 
 
+def test_walk_covers_resilience_package():
+    """Same guard for the resilience tier (resilience/): the fault
+    harness and supervisor touch checkpoint/session/serve internals and
+    must stay inside the DT101-107 + DT2xx lint walk."""
+    files = analysis.collect_files(["distributed_tensorflow_tpu"])
+    rel = {os.path.relpath(f, REPO).replace(os.sep, "/") for f in files}
+    for mod in ("resilience/__init__.py", "resilience/faults.py",
+                "resilience/supervisor.py"):
+        assert f"distributed_tensorflow_tpu/{mod}" in rel
+
+
 def test_self_check_package_lints_clean_modulo_baseline():
     """The committed gate: the package + examples + scripts produce no
     findings beyond .dtlint-baseline.json (exactly what CI runs)."""

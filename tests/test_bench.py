@@ -307,6 +307,25 @@ class TestPromotedDefaults:
         bench._load_promoted_defaults()          # warns, no raise
 
 
+class TestRecovery:
+    """bench.py --config=recovery: the resilience smoke's JSON contract
+    (docs/RESILIENCE.md).  Run in-process — the row is tiny by design
+    (XOR MLP) and a subprocess would mostly measure jax import time."""
+
+    def test_recovery_schema_and_one_injected_kill(self):
+        result = bench.bench_recovery()
+        assert result["metric"] == "recovery_restore_ms"
+        assert result["unit"] == "ms"
+        assert result["value"] > 0
+        assert result["restore_ms"] == result["value"]
+        # the kill lands between two save intervals: 0 < lost <= interval
+        assert 0 <= result["recovery_steps_lost"] <= 5
+        assert result["restarts"] >= 1
+        assert result["faults_injected"] == 1
+        assert result["final_step"] == 24
+        json.dumps(result)                      # one-line-JSON safe
+
+
 class TestHelpers:
     def test_parse_last_json(self):
         text = "noise\n{\"a\": 1}\nnot json {broken\n"

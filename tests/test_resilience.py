@@ -1,0 +1,633 @@
+"""Resilience layer tests: fault injection, verified-checkpoint fallback,
+auto-resume supervisor, non-finite guard (docs/RESILIENCE.md).
+
+Every recovery path here is exercised UNDER an injected fault (the
+``FaultPlan`` harness), not just asserted from the happy path — the
+chaos acceptance test at the bottom drives corrupt-checkpoint fallback,
+a NaN-poisoned step, and a killed prefetch producer through one
+supervised training run.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu import data, ops, optim, train
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.obs import trace as trace_lib
+from distributed_tensorflow_tpu.resilience import (Fault, FaultPlan,
+                                                   InjectedFault,
+                                                   NonfiniteGuardHook,
+                                                   Supervisor, faults)
+from distributed_tensorflow_tpu.train import checkpoint as ckpt_lib
+from distributed_tensorflow_tpu.train import sharded_checkpoint as sh_lib
+
+
+def make_bits(device_health=False, skip_nonfinite=False):
+    model = ops.serial(ops.Dense(16, "relu"), ops.Dense(32, "sigmoid"))
+    opt = optim.adam()
+    state = train.init_train_state(model, opt, jax.random.PRNGKey(0), (64,))
+    step = train.make_train_step(model, "mse", opt,
+                                 device_health=device_health,
+                                 skip_nonfinite=skip_nonfinite)
+    (xt, yt), _ = data.xor_data(500, val_size=10, seed=0)
+    ds = data.Dataset([xt, yt], 50, seed=0)
+    return state, step, ds
+
+
+def tree() -> dict:
+    return {"w": jnp.arange(12.0).reshape(3, 4), "b": jnp.zeros((4,)),
+            "step": np.int32(0)}
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan mechanics
+
+
+class TestFaultPlan:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultPlan([{"kind": "set_cpu_on_fire", "at": 0}])
+
+    def test_env_spec_parses_both_shapes(self):
+        p = faults.plan_from_env('[{"kind": "nan_grads", "at": 3}]')
+        assert p.faults[0].kind == "nan_grads" and p.faults[0].at == 3
+        p = faults.plan_from_env(
+            '{"seed": 7, "faults": [{"kind": "kill_prefetch", "at": 1}]}')
+        assert p.seed == 7 and p.faults[0].kind == "kill_prefetch"
+
+    def test_env_activation_and_counter_persistence(self, monkeypatch):
+        monkeypatch.setenv("DTTPU_FAULTS",
+                           '[{"kind": "save_oserror", "at": 1}]')
+        plan = faults.active()
+        assert plan is not None
+        # same env value -> same cached plan (counters must persist)
+        assert faults.active() is plan
+        monkeypatch.delenv("DTTPU_FAULTS")
+        assert faults.active() is None
+
+    def test_fires_once_by_default_and_times_n(self, activate_faults):
+        reg = metrics_lib.Registry()
+        plan = activate_faults({"kind": "fail_decode", "at": 5},
+                               {"kind": "fail_decode", "at": 6, "times": 2},
+                               registry=reg)
+        with pytest.raises(InjectedFault):
+            plan.on_decode(5)
+        plan.on_decode(5)                       # exhausted: no raise
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                plan.on_decode(6)
+        plan.on_decode(6)
+        assert reg.get("dttpu_faults_injected_total").value == 3
+        assert [e["kind"] for e in plan.log] == ["fail_decode"] * 3
+
+    def test_injections_emit_trace_instants(self, activate_faults):
+        tracer = trace_lib.Tracer(enabled=True)
+        trace_lib.activate(tracer)
+        try:
+            plan = activate_faults({"kind": "nan_grads", "at": 0},
+                                   registry=metrics_lib.Registry())
+            plan.on_step(0, (np.ones((2, 2), np.float32),))
+            assert tracer.instant_counts.get("fault") == 1
+        finally:
+            trace_lib.deactivate(tracer)
+
+    def test_poison_hits_float_leaves_only(self, activate_faults):
+        plan = activate_faults({"kind": "poison_batch", "at": 0},
+                               registry=metrics_lib.Registry())
+        x = np.ones((3,), np.float32)
+        ids = np.ones((3,), np.int32)
+        px, pids = plan.on_batch((x, ids))
+        assert np.isnan(px).all() and (pids == 1).all()
+
+    def test_flip_corruption_is_seeded_deterministic(self, tmp_path):
+        files = []
+        for seed in (3, 3):
+            d = tmp_path / f"s{seed}-{len(files)}"
+            d.mkdir()
+            f = d / "arrays.npz"
+            f.write_bytes(bytes(range(256)) * 4)
+            plan = FaultPlan([{"kind": "corrupt_checkpoint", "at": 0,
+                               "mode": "flip"}], seed=seed,
+                             registry=metrics_lib.Registry())
+            plan.on_saved(str(d), plan.on_save())
+            files.append(f.read_bytes())
+        assert files[0] == files[1] != bytes(range(256)) * 4
+
+
+# ---------------------------------------------------------------------------
+# Verified checkpoints: CRC manifest, quarantine, newest-good fallback
+
+
+class TestVerifiedCheckpoint:
+    def test_manifest_records_crc_and_verify_passes(self, tmp_path):
+        d = str(tmp_path)
+        p = ckpt_lib.save(d, 1, tree())
+        with open(os.path.join(p, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["checksum"] == ckpt_lib.CHECKSUM_FORMAT
+        assert all(isinstance(m["crc32c"], int) for m in manifest["leaves"])
+        ok, reason = ckpt_lib.verify(p, target=tree())
+        assert ok, reason
+
+    def test_truncated_npz_quarantined_and_falls_back(self, tmp_path):
+        d = str(tmp_path)
+        t = tree()
+        ckpt_lib.save(d, 1, t)
+        good = ckpt_lib.save(d, 2, jax.tree.map(
+            lambda a: np.asarray(a) * 0 + 7, t))
+        bad = ckpt_lib.save(d, 3, t)
+        npz = os.path.join(bad, "arrays.npz")
+        with open(npz, "r+b") as f:
+            f.truncate(os.path.getsize(npz) // 2)
+        ok, reason = ckpt_lib.verify(bad)
+        assert not ok and "arrays.npz" in reason
+        restored, path = ckpt_lib.restore_latest_good(t, d)
+        assert path == good and restored is not None
+        assert float(np.asarray(restored["b"])[0]) == 7.0
+        # the bad dir moved out of the restore namespace, with a reason
+        q = os.path.join(d, "corrupt-ckpt-0000000003")
+        assert os.path.isdir(q)
+        with open(os.path.join(q, "QUARANTINE_REASON")) as f:
+            assert "arrays.npz" in f.read()
+        assert bad not in ckpt_lib.all_checkpoints(d)
+
+    def test_content_swap_caught_by_leaf_crc(self, tmp_path):
+        """A structurally VALID npz whose array content no longer matches
+        the manifest (silent bitrot 'repair', a leaf swapped between
+        checkpoints): the zip layer's own CRC passes — only the
+        manifest's per-leaf CRC can catch it."""
+        d = str(tmp_path)
+        p = ckpt_lib.save(d, 1, tree())
+        npz = os.path.join(p, "arrays.npz")
+        with np.load(npz) as z:
+            arrs = {k: z[k].copy() for k in z.files}
+        arrs["leaf_0"][0] += 1.0               # same shape/dtype, new value
+        np.savez(npz, **arrs)
+        ok, reason = ckpt_lib.verify(p)
+        assert not ok and "CRC mismatch" in reason
+
+    def test_leaf_count_mismatch_quarantined(self, tmp_path):
+        d = str(tmp_path)
+        t = tree()
+        good = ckpt_lib.save(d, 1, t)
+        bad = ckpt_lib.save(d, 2, t)
+        mpath = os.path.join(bad, "manifest.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest["leaves"] = manifest["leaves"][:-1]
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        ok, reason = ckpt_lib.verify(bad)
+        assert not ok and "mismatch" in reason
+        restored, path = ckpt_lib.restore_latest_good(t, d)
+        assert path == good
+        assert ckpt_lib.all_checkpoints(d) == [good]
+
+    def test_all_bad_returns_none(self, tmp_path):
+        d = str(tmp_path)
+        t = tree()
+        for step in (1, 2):
+            p = ckpt_lib.save(d, step, t)
+            with open(os.path.join(p, "arrays.npz"), "r+b") as f:
+                f.truncate(10)
+        restored, path = ckpt_lib.restore_latest_good(t, d)
+        assert restored is None and path is None
+        assert ckpt_lib.all_checkpoints(d) == []
+
+    def test_quarantine_names_uniquify(self, tmp_path):
+        d = str(tmp_path)
+        t = tree()
+        for _ in range(2):
+            p = ckpt_lib.save(d, 5, t)
+            ckpt_lib.quarantine(p, "test reason")
+        names = sorted(os.listdir(d))
+        assert "corrupt-ckpt-0000000005" in names
+        assert "corrupt-ckpt-0000000005.1" in names
+
+    def test_session_restores_through_fallback(self, tmp_path):
+        """TrainSession(restore=True) lands on the previous good step when
+        the newest checkpoint is corrupt — the MTS auto-restore contract
+        surviving corruption."""
+        d = str(tmp_path)
+        state, step, ds = make_bits()
+        with train.TrainSession(state, step, checkpoint_dir=d,
+                                hooks=[train.CheckpointHook(
+                                    every_steps=2, every_secs=None),
+                                    train.StopAtStepHook(last_step=5)]
+                                ) as sess:
+            for batch in ds.epochs(10):
+                if sess.should_stop():
+                    break
+                sess.run_step(batch)
+        newest = ckpt_lib.latest_checkpoint(d)
+        assert newest.endswith("ckpt-0000000005")
+        with open(os.path.join(newest, "arrays.npz"), "r+b") as f:
+            f.truncate(20)
+        state2, step2, _ = make_bits()
+        with train.TrainSession(state2, step2, checkpoint_dir=d,
+                                hooks=[train.StopAtStepHook(last_step=9)]
+                                ) as s2:
+            assert s2.step == 4           # fell back one save interval
+        assert os.path.isdir(os.path.join(d, "corrupt-ckpt-0000000005"))
+
+    def test_save_oserror_fault_is_transient_shaped(self, tmp_path,
+                                                    activate_faults):
+        activate_faults({"kind": "save_oserror", "at": 0},
+                        registry=metrics_lib.Registry())
+        with pytest.raises(OSError, match="injected fault"):
+            ckpt_lib.save(str(tmp_path), 1, tree())
+        # next save (index 1) succeeds and verifies
+        p = ckpt_lib.save(str(tmp_path), 2, tree())
+        assert ckpt_lib.verify(p)[0]
+
+
+class TestCheckpointIndex:
+    def test_index_written_atomically_and_preferred(self, tmp_path):
+        d = str(tmp_path)
+        ckpt_lib.save(d, 1, tree())
+        p2 = ckpt_lib.save(d, 2, tree())
+        with open(os.path.join(d, "checkpoint")) as f:
+            assert f.read().strip() == "ckpt-0000000002"
+        assert ckpt_lib.latest_checkpoint(d) == p2
+        # no stray tmp files from the tmp+replace dance
+        assert not [n for n in os.listdir(d) if n.startswith(".checkpoint")]
+
+    def test_torn_index_falls_back_to_scan(self, tmp_path):
+        d = str(tmp_path)
+        p = ckpt_lib.save(d, 3, tree())
+        with open(os.path.join(d, "checkpoint"), "w") as f:
+            f.write("ckpt-00000")          # torn mid-write
+        assert ckpt_lib.latest_checkpoint(d) == p
+        with open(os.path.join(d, "checkpoint"), "w") as f:
+            f.write("not-a-checkpoint\n")
+        assert ckpt_lib.latest_checkpoint(d) == p
+        assert ckpt_lib.latest_step(d) == 3
+
+    def test_index_pointing_at_quarantined_dir_falls_back(self, tmp_path):
+        d = str(tmp_path)
+        p3 = ckpt_lib.save(d, 3, tree())
+        p5 = ckpt_lib.save(d, 5, tree())
+        ckpt_lib.quarantine(p5, "poof")     # index still names ckpt-5
+        assert ckpt_lib.latest_checkpoint(d) == p3
+
+    def test_missing_index_still_scans(self, tmp_path):
+        d = str(tmp_path)
+        p = ckpt_lib.save(d, 1, tree())
+        os.unlink(os.path.join(d, "checkpoint"))
+        assert ckpt_lib.latest_checkpoint(d) == p
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints: chunk CRCs, coverage, quarantine walk
+
+
+def sharded_tree():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]).reshape(4), "data")
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data"))
+    return {"w": jax.device_put(jnp.arange(16.0).reshape(8, 2), sh),
+            "step": np.int32(7)}
+
+
+class TestShardedVerify:
+    def test_chunk_rows_carry_crc_and_verify_passes(self, tmp_path):
+        d = str(tmp_path)
+        p = sh_lib.save_sharded(d, 1, sharded_tree())
+        with open(os.path.join(p, "chunks-00000.json")) as f:
+            rows = json.load(f)
+        assert rows and all(isinstance(r["crc32c"], int) for r in rows)
+        ok, reason = sh_lib.verify_sharded(p)
+        assert ok, reason
+
+    def test_missing_chunk_file_quarantined_with_fallback(self, tmp_path):
+        """Manifest present but a shard npz gone: structurally incomplete
+        → quarantined by the restore walk, restore falls back."""
+        d = str(tmp_path)
+        t = sharded_tree()
+        good = sh_lib.save_sharded(d, 1, t)
+        bad = sh_lib.save_sharded(d, 2, t)
+        os.unlink(os.path.join(bad, "shards-00000.npz"))
+        assert os.path.exists(os.path.join(bad, "manifest.json"))
+        ok, reason = sh_lib.verify_sharded(bad)
+        assert not ok and "incomplete" in reason
+        assert sh_lib.all_sharded_checkpoints(d) == [good]
+        restored, path = sh_lib.restore_latest_good_sharded(t, d)
+        assert path == good and restored is not None
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(t["w"]))
+        assert os.path.isdir(os.path.join(d, "corrupt-ckpt-0000000002"))
+        assert sh_lib.all_sharded_checkpoints(d) == [good]
+
+    def test_chunk_content_swap_fails_chunk_crc(self, tmp_path):
+        """Valid shard npz, wrong chunk content: only the chunk-index
+        CRC catches it (the zip layer re-checksums the new bytes)."""
+        d = str(tmp_path)
+        t = sharded_tree()
+        p = sh_lib.save_sharded(d, 1, t)
+        npz = os.path.join(p, "shards-00000.npz")
+        with np.load(npz) as z:
+            arrs = {k: z[k].copy() for k in z.files}
+        key = next(k for k in arrs if arrs[k].size > 0
+                   and arrs[k].dtype == np.float32)
+        arrs[key] = arrs[key] + 1.0
+        np.savez(npz, **arrs)
+        ok, reason = sh_lib.verify_sharded(p)
+        assert not ok and "CRC mismatch" in reason
+
+    def test_dropped_chunk_row_fails_coverage(self, tmp_path):
+        d = str(tmp_path)
+        p = sh_lib.save_sharded(d, 1, sharded_tree())
+        cpath = os.path.join(p, "chunks-00000.json")
+        with open(cpath) as f:
+            rows = json.load(f)
+        with open(cpath, "w") as f:
+            json.dump(rows[1:], f)
+        ok, reason = sh_lib.verify_sharded(p)
+        assert not ok and "cover" in reason
+
+    def test_session_sharded_restore_falls_back(self, tmp_path):
+        d = str(tmp_path)
+        state, step, ds = make_bits()
+        with train.TrainSession(state, step, checkpoint_dir=d,
+                                sharded_checkpoint=True,
+                                hooks=[train.StopAtStepHook(last_step=3)]
+                                ) as sess:
+            for batch in ds.epochs(10):
+                if sess.should_stop():
+                    break
+                sess.run_step(batch)
+        # corrupt the (only) checkpoint -> next session starts fresh at 0
+        newest = sh_lib.all_sharded_checkpoints(d)[-1]
+        os.unlink(os.path.join(newest, "shards-00000.npz"))
+        state2, step2, _ = make_bits()
+        sess2 = train.TrainSession(state2, step2, checkpoint_dir=d,
+                                   sharded_checkpoint=True)
+        assert sess2.step == 0
+        assert any(n.startswith("corrupt-") for n in os.listdir(d))
+
+
+# ---------------------------------------------------------------------------
+# skip_nonfinite step option + NonfiniteGuardHook
+
+
+class TestSkipNonfinite:
+    def test_bad_step_rolls_back_in_graph(self):
+        state, step, ds = make_bits(device_health=True, skip_nonfinite=True)
+        batch = next(iter(ds))
+        state, m = step(state, batch)
+        params_before = jax.tree.map(np.asarray, state.params)
+        opt_before = jax.tree.map(np.asarray, state.opt_state)
+        poisoned = tuple(np.full_like(a, np.nan) for a in batch)
+        state, m = step(state, poisoned)
+        assert not bool(m["grads_finite"])
+        assert float(m["nonfinite_grads"]) > 0
+        for a, b in zip(jax.tree.leaves(params_before),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        for a, b in zip(jax.tree.leaves(opt_before),
+                        jax.tree.leaves(state.opt_state)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        assert int(state.step) == 2            # cursor still advances
+        # and a following clean step updates params again
+        state2, m2 = step(state, batch)
+        assert bool(m2["grads_finite"])
+        assert any(not np.array_equal(a, np.asarray(b))
+                   for a, b in zip(jax.tree.leaves(params_before),
+                                   jax.tree.leaves(state2.params)))
+
+    def test_rejected_with_loss_scale(self):
+        model = ops.serial(ops.Dense(4))
+        with pytest.raises(ValueError, match="loss_scale"):
+            train.make_train_step(model, "mse", optim.sgd(0.1),
+                                  loss_scale=True, skip_nonfinite=True)
+
+
+class TestNonfiniteGuard:
+    def test_aborts_after_k_consecutive(self):
+        state, step, ds = make_bits(device_health=True, skip_nonfinite=True)
+        batch = next(iter(ds))
+        poisoned = tuple(np.full_like(a, np.nan) for a in batch)
+        guard = NonfiniteGuardHook(max_consecutive=3)
+        with pytest.raises(FloatingPointError, match="3 consecutive"):
+            with train.TrainSession(state, step, hooks=[guard]) as sess:
+                for _ in range(5):
+                    sess.run_step(poisoned)
+        assert guard.total_nonfinite == 3
+
+    def test_isolated_bad_steps_survive(self):
+        state, step, ds = make_bits(device_health=True, skip_nonfinite=True)
+        it = iter(ds.epochs(10))
+        guard = NonfiniteGuardHook(max_consecutive=2)
+        with train.TrainSession(state, step, hooks=[guard]) as sess:
+            for i in range(6):
+                batch = next(it)
+                if i % 2 == 0:     # never two bad in a row
+                    batch = tuple(np.full_like(a, np.nan) for a in batch)
+                sess.run_step(batch)
+        assert guard.total_nonfinite == 3 and guard.consecutive <= 1
+
+    def test_no_health_metrics_is_a_noop(self):
+        state, step, ds = make_bits()      # no device_health
+        guard = NonfiniteGuardHook(max_consecutive=1)
+        with train.TrainSession(state, step, hooks=[guard]) as sess:
+            sess.run_step(next(iter(ds)))
+        assert guard.consecutive == 0
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+
+
+class TestSupervisor:
+    def _sup(self, **kw):
+        sleeps = []
+        reg = metrics_lib.Registry()
+        sup = Supervisor(registry=reg, sleep=sleeps.append,
+                         backoff_base=0.5, jitter=0.0, **kw)
+        return sup, sleeps, reg
+
+    def test_transient_retries_with_exponential_backoff(self):
+        sup, sleeps, reg = self._sup(max_restarts=3)
+        calls = []
+
+        class Sess:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *e):
+                return False
+
+        def build():
+            calls.append("build")
+            return Sess()
+
+        def train_fn(sess):
+            if len(calls) < 3:
+                raise OSError("flaky storage")
+            return "done"
+
+        assert sup.run(build, train_fn) == "done"
+        assert calls == ["build"] * 3
+        assert sleeps == [0.5, 1.0]
+        assert reg.get("dttpu_restarts_total").value == 2
+        assert reg.get("dttpu_recovery_seconds").count == 2
+        assert len(sup.restart_log) == 2
+
+    def test_fatal_raises_immediately(self):
+        sup, sleeps, reg = self._sup(max_restarts=5)
+
+        def build():
+            raise ValueError("shape mismatch: a code bug")
+
+        with pytest.raises(ValueError):
+            sup.run(build, lambda s: None)
+        assert sleeps == []
+        assert reg.get("dttpu_restarts_total").value == 0
+
+    def test_budget_exhaustion_reraises_last_transient(self):
+        sup, sleeps, reg = self._sup(max_restarts=2)
+
+        def build():
+            raise OSError("down hard")
+
+        with pytest.raises(OSError, match="down hard"):
+            sup.run(build, lambda s: None)
+        assert len(sleeps) == 2
+        assert reg.get("dttpu_restarts_total").value == 2
+
+    def test_classify_override(self):
+        sup, sleeps, _ = self._sup(
+            max_restarts=3,
+            classify=lambda e: "transient"
+            if isinstance(e, KeyError) else "fatal")
+        n = []
+
+        class Sess:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *e):
+                return False
+
+        def train_fn(sess):
+            n.append(1)
+            if len(n) == 1:
+                raise KeyError("custom-transient")
+            return len(n)
+
+        assert sup.run(Sess, train_fn) == 2
+        # and the default-transient OSError is now fatal under override
+        with pytest.raises(OSError):
+            sup.run(Sess, lambda s: (_ for _ in ()).throw(OSError("x")))
+
+    def test_backoff_caps_and_jitters(self):
+        sup, _, _ = self._sup(max_restarts=1)
+        sup.backoff_max = 2.0
+        sup.jitter = 0.5
+        delays = {sup._delay(10) for _ in range(8)}
+        assert all(2.0 <= d <= 3.0 for d in delays)
+        assert len(delays) > 1                  # jitter actually jitters
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: the whole layer under one FaultPlan
+
+
+@pytest.mark.chaos
+def test_chaos_training_run_survives_three_faults(tmp_path,
+                                                  activate_faults):
+    """THE acceptance scenario (ISSUE 5): corrupt the newest checkpoint,
+    NaN-poison one step, kill the prefetch producer — the supervised run
+    still reaches the target step via quarantine-fallback + restart,
+    with >= 1 restart recorded and finite final params."""
+    reg = metrics_lib.Registry()
+    d = str(tmp_path)
+    TARGET = 12
+    activate_faults({"kind": "corrupt_checkpoint", "at": 1},
+                    {"kind": "nan_grads", "at": 4},
+                    {"kind": "kill_prefetch", "at": 8},
+                    registry=reg)
+
+    def build_session():
+        state, step, ds = make_bits(device_health=True, skip_nonfinite=True)
+        sess = train.TrainSession(
+            state, step, checkpoint_dir=d,
+            hooks=[train.CheckpointHook(every_steps=3, every_secs=None),
+                   NonfiniteGuardHook(max_consecutive=3),
+                   train.StopAtStepHook(last_step=TARGET)])
+        sess._chaos_ds = ds
+        return sess
+
+    def train_fn(sess):
+        it = data.prefetch_to_device(iter(sess._chaos_ds.epochs(100)),
+                                     size=2)
+        for batch in it:
+            if sess.should_stop():
+                break
+            sess.run_step(batch)
+        return sess.state
+
+    sup = Supervisor(max_restarts=3, backoff_base=0.01, registry=reg)
+    final_state = sup.run(build_session, train_fn)
+
+    assert int(final_state.step) == TARGET
+    assert reg.get("dttpu_restarts_total").value >= 1
+    assert reg.get("dttpu_faults_injected_total").value == 3
+    plan = faults.active()
+    assert {e["kind"] for e in plan.log} == {
+        "corrupt_checkpoint", "nan_grads", "kill_prefetch"}
+    # final params finite despite the poisoned step
+    assert all(bool(jnp.all(jnp.isfinite(leaf)))
+               for leaf in jax.tree.leaves(final_state.params))
+    # the corrupted newest checkpoint (step 6, save #1) was quarantined
+    # with its reason, and fallback resumed from step 3
+    assert os.path.isdir(os.path.join(d, "corrupt-ckpt-0000000006"))
+    # training then re-saved past the quarantined step
+    assert train.checkpoint.latest_step(d) == TARGET
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_storm_long_run(tmp_path, activate_faults):
+    """Storm tier: several faults of every train-side kind across a
+    longer supervised run — the restart budget absorbs all of them."""
+    reg = metrics_lib.Registry()
+    d = str(tmp_path)
+    TARGET = 40
+    activate_faults({"kind": "save_oserror", "at": 2},
+                    {"kind": "corrupt_checkpoint", "at": 4},
+                    {"kind": "nan_grads", "at": 11},
+                    {"kind": "poison_batch", "at": 17},
+                    {"kind": "kill_prefetch", "at": 7},
+                    {"kind": "kill_prefetch", "at": 26},
+                    registry=reg)
+
+    def build_session():
+        state, step, ds = make_bits(device_health=True, skip_nonfinite=True)
+        sess = train.TrainSession(
+            state, step, checkpoint_dir=d,
+            hooks=[train.CheckpointHook(every_steps=4, every_secs=None),
+                   NonfiniteGuardHook(max_consecutive=3),
+                   train.StopAtStepHook(last_step=TARGET)])
+        sess._chaos_ds = ds
+        return sess
+
+    def train_fn(sess):
+        it = data.prefetch_to_device(iter(sess._chaos_ds.epochs(1000)),
+                                     size=2)
+        for batch in it:
+            if sess.should_stop():
+                break
+            sess.run_step(batch)
+        return sess.state
+
+    sup = Supervisor(max_restarts=6, backoff_base=0.01, registry=reg)
+    final_state = sup.run(build_session, train_fn)
+    assert int(final_state.step) == TARGET
+    assert reg.get("dttpu_restarts_total").value >= 2   # 2 kills + OSError
+    assert all(bool(jnp.all(jnp.isfinite(leaf)))
+               for leaf in jax.tree.leaves(final_state.params))

@@ -353,3 +353,165 @@ def test_generate_batch_convenience_and_queueing():
     outs = eng.generate_batch(prompts)
     for p, got in zip(prompts, outs):
         assert got == _generate_tokens(model, params, p, 5, 32)
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: backpressure, deadlines, failure isolation
+# (docs/RESILIENCE.md)
+
+
+def test_queue_full_rejects_with_metric():
+    """Admission control: the queue holds max_queue_depth requests, the
+    next submit is rejected loudly (and counted), and a later submit is
+    accepted again once the queue drains."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    eng = serve.Engine(model, params, num_slots=1, max_len=32,
+                       prefill_chunk=8, tick_steps=2, registry=reg,
+                       max_queue_depth=2)
+    handles = [eng.submit(_prompt(4, seed=i), 4) for i in range(2)]
+    with pytest.raises(serve.QueueFullError):
+        eng.submit(_prompt(4, seed=9), 4)
+    assert reg.get("dttpu_serve_rejected_total").value == 1
+    assert reg.get("dttpu_serve_requests_total").value == 2
+    eng.drain()
+    assert all(h.status == "ok" for h in handles)
+    h = eng.submit(_prompt(4, seed=9), 4)      # accepted after drain
+    eng.drain()
+    assert h.status == "ok"
+
+
+def test_deadline_expires_queued_and_active_requests():
+    """A queued request past its deadline never prefills; an ACTIVE one
+    is retired mid-decode with partial tokens — both carry status
+    deadline_exceeded + the metric, and neither decodes forever."""
+    import time as time_mod
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    eng = serve.Engine(model, params, num_slots=1, max_len=64,
+                       prefill_chunk=4, tick_steps=1, registry=reg)
+    # queued expiry: one slot is busy, the second request's deadline
+    # passes while it waits
+    h_busy = eng.submit(_prompt(4, seed=1), 8)
+    h_q = eng.submit(_prompt(4, seed=2), 8, deadline_s=0.0)
+    time_mod.sleep(0.005)
+    eng.drain()
+    assert h_busy.status == "ok" and len(h_busy.tokens) == 8
+    assert h_q.status == "deadline_exceeded" and h_q.tokens == []
+    # active expiry: admit, decode a few ticks, then let the deadline hit
+    h_a = eng.submit(_prompt(4, seed=3), 60, deadline_s=0.05)
+    while not h_a.tokens:
+        eng.step()
+    deadline = time_mod.perf_counter() + 2.0
+    while not h_a.done and time_mod.perf_counter() < deadline:
+        eng.step()
+        time_mod.sleep(0.005)
+    assert h_a.status == "deadline_exceeded"
+    assert 0 < len(h_a.tokens) < 60
+    assert reg.get("dttpu_serve_deadline_expired_total").value == 2
+    assert not eng.busy
+
+
+def test_poisoned_request_fails_alone_survivors_bit_exact():
+    """THE serve acceptance contract: one request whose callback raises
+    mid-decode fails ONLY its own handle; the scheduler keeps ticking
+    and every surviving request's greedy output stays token-identical
+    to generate()."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    eng = serve.Engine(model, params, num_slots=3, max_len=32,
+                       prefill_chunk=4, tick_steps=2, registry=reg)
+    prompts = [_prompt(5, seed=1), _prompt(4, seed=2), _prompt(6, seed=3)]
+    wants = [_generate_tokens(model, params, p, 8, 32) for p in prompts]
+
+    poison_after = [3]
+
+    def bad_callback(toks):
+        poison_after[0] -= len(toks)
+        if poison_after[0] <= 0:
+            raise RuntimeError("poisoned request payload")
+
+    h0 = eng.submit(prompts[0], 8)
+    h1 = eng.submit(prompts[1], 8, on_token=bad_callback)
+    h2 = eng.submit(prompts[2], 8)
+    eng.drain()
+    assert h1.status == "failed"
+    assert isinstance(h1.error, RuntimeError)
+    assert h0.status == "ok" and h0.tokens == wants[0]
+    assert h2.status == "ok" and h2.tokens == wants[2]
+    assert reg.get("dttpu_serve_failed_total").value == 1
+    # the freed slot is reusable and still exact
+    h3 = eng.submit(prompts[1], 8)
+    eng.drain()
+    assert h3.tokens == wants[1]
+
+
+def test_injected_decode_fault_fails_exact_request():
+    """resilience.faults fail_decode: rid-targeted injection fails that
+    handle with InjectedFault; everyone else matches generate()."""
+    from distributed_tensorflow_tpu.resilience import InjectedFault, faults
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=4, tick_steps=2,
+                       registry=metrics_lib.Registry())
+    prompts = [_prompt(5, seed=1), _prompt(4, seed=2)]
+    wants = [_generate_tokens(model, params, p, 6, 32) for p in prompts]
+    plan = faults.FaultPlan([{"kind": "fail_decode", "at": 1}],
+                            registry=metrics_lib.Registry())
+    with faults.activated(plan):
+        h0 = eng.submit(prompts[0], 6)
+        h1 = eng.submit(prompts[1], 6)
+        eng.drain()
+    assert h0.status == "ok" and h0.tokens == wants[0]
+    assert h1.status == "failed" and isinstance(h1.error, InjectedFault)
+    assert plan.log == [{"kind": "fail_decode", "at": 1, "rid": 1}]
+
+
+def test_generate_batch_failed_submit_cancels_earlier_handles():
+    """Satellite regression: a mid-list submit failure must not leave
+    the already-submitted handles permanently pending — they are
+    cancelled before the error propagates."""
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=2, max_len=16,
+                       prefill_chunk=4, tick_steps=2,
+                       registry=metrics_lib.Registry())
+    prompts = [_prompt(4, seed=1), _prompt(4, seed=2),
+               _prompt(17, seed=3)]          # third fails validation
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        eng.generate_batch(prompts, max_new_tokens=4)
+    # nothing left in flight, nothing pending forever
+    assert not eng.busy
+    assert eng.scheduler.queued == 0
+    # the engine still works afterwards
+    outs = eng.generate_batch(prompts[:2], max_new_tokens=4)
+    assert outs == [_generate_tokens(model, params, p, 4, 16)
+                    for p in prompts[:2]]
+
+
+def test_drain_timeout_returns_false_then_resumable():
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=1, max_len=64,
+                       prefill_chunk=4, tick_steps=1,
+                       registry=metrics_lib.Registry())
+    h = eng.submit(_prompt(4, seed=1), 40)
+    assert eng.drain(timeout_s=0.0) is False    # budget hit immediately
+    assert not h.done
+    assert eng.drain() is True                  # resumable afterwards
+    assert h.status == "ok" and len(h.tokens) == 40
+
+
+def test_cancel_frees_slot_and_marks_status():
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=1, max_len=64,
+                       prefill_chunk=4, tick_steps=1,
+                       registry=metrics_lib.Registry())
+    want = _generate_tokens(model, params, _prompt(4, seed=2), 6, 64)
+    h = eng.submit(_prompt(4, seed=1), 40)
+    while not h.tokens:
+        eng.step()
+    assert eng.cancel(h) is True
+    assert h.status == "cancelled" and h.done
+    assert eng.cancel(h) is False               # already finished
+    h2 = eng.submit(_prompt(4, seed=2), 6)      # slot reuse stays exact
+    eng.drain()
+    assert h2.tokens == want
