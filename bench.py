@@ -1309,6 +1309,12 @@ def bench_gpt_serve():
     # short arrival stagger (in ticks): the queue builds while the
     # first admissions are still prefilling, as live traffic would
     arrivals = np.sort(rng.integers(0, slots + 1, n_req))
+    # seeded tenant ids ride the trace (drawn AFTER the arrays above so
+    # the prompts/budgets/arrivals stay byte-identical to earlier
+    # rounds); this engine enforces no tenancy policy — the ids feed
+    # the per-tenant serve metrics and keep the trace shared with
+    # --config=fleet, which does enforce fair-share
+    tenants = rng.choice(["free", "pro", "batch"], n_req)
 
     eng = serve.Engine(model, params, num_slots=slots, max_len=seq,
                        prefill_chunk=chunk, tick_steps=tick_steps)
@@ -1325,7 +1331,8 @@ def bench_gpt_serve():
         t0 = time.perf_counter()
         while i < n_req or eng.busy:
             while i < n_req and arrivals[i] <= tick:
-                handles.append(eng.submit(prompts[i], int(budgets[i])))
+                handles.append(eng.submit(prompts[i], int(budgets[i]),
+                                          tenant=str(tenants[i])))
                 i += 1
             eng.step()
             tick += 1
@@ -1389,6 +1396,149 @@ def bench_gpt_serve():
                 ttft_p50_ms=round(ttft_p50 * 1e3, 3),
                 ttft_p95_ms=round(ttft_p95 * 1e3, 3),
                 requests=n_req, num_slots=slots, prefill_chunk=chunk,
+                tick_steps=tick_steps, total_new_tokens=total_tokens,
+                seq_len=seq)
+
+
+def bench_fleet():
+    """Multi-replica fleet serving (fleet/): an ADVERSARIAL three-tenant
+    burst routed over N Engine replicas by the least-loaded Router, with
+    a deficit-weighted fair-share tenancy policy and a LoRA adapter
+    hot-swapped per request on one tenant's traffic.  The tenants carry
+    EQUAL total token demand in skewed request shapes — ``free`` many
+    short requests, ``pro`` medium (under a LoRA adapter), ``batch``
+    few long — submitted as whole per-tenant blocks in that order, the
+    worst case for FIFO admission (the last tenant would wait for both
+    blocks ahead of it).  The JSON reports fleet tokens/s, per-tenant
+    TTFT p50/p95, and ``fairness_ratio``: over the contended window
+    (up to the admission that exhausts the first tenant's backlog), the
+    min/max ratio of weight-normalized cumulative ADMITTED token
+    budgets per tenant — the deficit scheduler's own decision variable,
+    so 1.0 is perfect token-weighted fair-share and plain FIFO on this
+    trace measures 0.0 (the last block admits nothing inside the
+    window).  CPU mesh, single process, zero retrace_warnings
+    (admission, retirement, failover, and adapter swaps never
+    recompile)."""
+    import jax
+    import numpy as np
+    from distributed_tensorflow_tpu import fleet, serve
+    from distributed_tensorflow_tpu.models.gpt import GPT
+    from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+
+    seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
+    config = _gpt_bench_config(seq)
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    n_replicas = int(os.environ.get("DTTPU_BENCH_FLEET_REPLICAS", "2"))
+    slots = int(os.environ.get("DTTPU_BENCH_SERVE_SLOTS",
+                               4 if SMOKE else 8))
+    chunk = 16 if SMOKE else 32
+    tick_steps = int(os.environ.get("DTTPU_BENCH_SERVE_TICK",
+                                    "4" if SMOKE else "8"))
+    # equal per-tenant token demand, skewed request shapes
+    demand = 60 if SMOKE else 240
+    profiles = {"free": (2, 5), "pro": (5, 9), "batch": (10, 16)}
+    tenants = tuple(profiles)
+    rng = np.random.default_rng(0)
+
+    reqs = []                  # (tenant, prompt, budget, adapter_id)
+    for tenant, (lo, hi) in profiles.items():
+        left = demand
+        while left > 0:
+            budget = min(int(rng.integers(lo, hi)), left)
+            plen = int(rng.integers(3, 2 * chunk + 1))
+            prompt = rng.integers(0, config.vocab_size,
+                                  plen).astype(np.int32)
+            # tenant "pro" serves a fine-tuned LoRA variant: the
+            # adapter swap rides the measured path
+            adapter = "pro-tuned" if tenant == "pro" else None
+            reqs.append((tenant, prompt, budget, adapter))
+            left -= budget
+    # per-tenant blocks in profile order — the FIFO worst case the
+    # fair-share queue has to undo (arrival order is part of the trace)
+    n_req = len(reqs)
+
+    policy = fleet.TenantPolicy(quantum=8)
+    reg = metrics_lib.Registry()
+    engines = [serve.Engine(model, params, num_slots=slots, max_len=seq,
+                            prefill_chunk=chunk, tick_steps=tick_steps,
+                            registry=reg, tenancy=policy,
+                            adapter_capacity=2, adapter_rank=4)
+               for _ in range(n_replicas)]
+    router = fleet.Router(engines, registry=reg)
+    adapter = model.init_lora(jax.random.PRNGKey(7), rank=4)
+    router.load_adapter("pro-tuned", adapter)
+
+    # Warmup covers every executable on EVERY replica (round-robin by
+    # load): two requests per replica — one multi-window prefill, one
+    # short — plus one adapter-carrying request per replica.
+    for _ in range(n_replicas):
+        router.submit(rng.integers(0, config.vocab_size,
+                                   chunk + 2).astype(np.int32), 4)
+        router.submit(reqs[0][1], 2)
+        router.submit(reqs[0][1], 2, adapter_id="pro-tuned")
+    router.drain()
+
+    def replay():
+        # the whole adversarially-ordered trace arrives as one burst,
+        # then the fleet drains it
+        handles = [(tenant, budget,
+                    router.submit(prompt, budget, tenant=tenant,
+                                  adapter_id=ad))
+                   for tenant, prompt, budget, ad in reqs]
+        t0 = time.perf_counter()
+        while router.busy:
+            router.step()
+        wall = time.perf_counter() - t0
+        return wall, handles
+
+    (wall, handles) = min((replay() for _ in range(2)),
+                          key=lambda r: r[0])
+    assert all(h.status == "ok" for _, _, h in handles)
+    total_tokens = sum(len(h.tokens) for _, _, h in handles)
+    tps = total_tokens / wall
+
+    # fairness over the contended window: walk admissions in TTFT order
+    # (burst submit => admission order), accumulating each tenant's
+    # admitted token budget, and stop at the admission that exhausts the
+    # first tenant's backlog — beyond it the comparison is meaningless.
+    remaining = {t: sum(1 for tt, _, _ in handles if tt == t)
+                 for t in tenants}
+    admitted = {t: 0 for t in tenants}
+    for tenant, budget, _ in sorted(handles,
+                                    key=lambda r: r[2].ttft_s):
+        admitted[tenant] += budget
+        remaining[tenant] -= 1
+        if remaining[tenant] == 0:
+            break
+    norm = [admitted[t] / policy.quota(t).weight for t in tenants]
+    fairness = (min(norm) / max(norm)) if max(norm) > 0 else 0.0
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return vals[int(q * (len(vals) - 1))]
+
+    ttft_all = [h.ttft_s for _, _, h in handles]
+    tenant_p50, tenant_p95 = {}, {}
+    for tenant in tenants:
+        ts = [h.ttft_s for t, _, h in handles if t == tenant]
+        tenant_p50[tenant] = round(pct(ts, 0.50) * 1e3, 3)
+        tenant_p95[tenant] = round(pct(ts, 0.95) * 1e3, 3)
+
+    log(f"fleet: {n_replicas} replicas {tps:,.0f} tok/s, admission "
+        f"fairness {fairness:.3f} (FIFO on this trace: 0.0), per-tenant "
+        "ttft p95 "
+        + ", ".join(f"{t} {tenant_p95[t]:.1f} ms" for t in tenants))
+    return dict(metric="fleet_tokens_per_sec",
+                value=round(tps, 1), unit="tokens/sec",
+                tokens_per_sec=round(tps, 1),
+                fairness_ratio=round(fairness, 4),
+                ttft_p50_ms=round(pct(ttft_all, 0.50) * 1e3, 3),
+                ttft_p95_ms=round(pct(ttft_all, 0.95) * 1e3, 3),
+                tenant_ttft_p50_ms=tenant_p50,
+                tenant_ttft_p95_ms=tenant_p95,
+                replicas=n_replicas, requests=n_req,
+                num_slots=slots, prefill_chunk=chunk,
                 tick_steps=tick_steps, total_new_tokens=total_tokens,
                 seq_len=seq)
 
@@ -1520,6 +1670,7 @@ CONFIGS = {
     "gpt_decode_int8": bench_gpt_decode_int8,
     "gpt_decode_spec": bench_gpt_decode_spec,
     "gpt_serve": bench_gpt_serve,
+    "fleet": bench_fleet,
     "recovery": bench_recovery,
 }
 

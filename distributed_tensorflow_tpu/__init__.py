@@ -14,8 +14,8 @@ Public surface (two tiers, mirroring the reference's two scripts):
     — the analogue of reference example2.py's Keras path.
 """
 
-from . import (data, models, obs, ops, optim, parallel, resilience, serve,
-               summary, train, utils)
+from . import (data, fleet, models, obs, ops, optim, parallel, resilience,
+               serve, summary, train, utils)
 from .utils import flags
 from .utils.flags import FLAGS
 

@@ -1,0 +1,28 @@
+"""fleet — multi-replica serving: router, tenancy, zero-downtime ops.
+
+The tier above ``serve``: one ``Engine`` is one mesh, a fleet is N of
+them behind one façade (docs/SERVING.md §Fleet):
+
+* ``fleet.router`` — ``Router``: least-loaded placement fed by the
+  ``Engine.stats()`` snapshot, in-deadline retry of rejected/failed
+  requests on a surviving replica, ``drain_replica``/``remove_replica``
+  /``add_replica`` rolling restarts, ``dttpu_router_*`` metrics.
+* ``fleet.tenancy`` — per-tenant admission policy: ``TenantQuota``
+  ceilings (max in-flight, token budgets) rejected loudly at submit,
+  and a deficit-weighted fair-share queue (`DeficitFairQueue`) that
+  drops into the scheduler so one tenant's burst cannot starve others.
+
+LoRA adapter hot-swap rides the serve/model layers
+(``serve.AdapterTable``, ``GPT.init_lora``); ``Router.load_adapter``
+broadcasts an adapter to every replica.  Chaos coverage: the
+``kill_replica`` fault (resilience.faults) drops a replica mid-traffic
+and the router reroutes — measured by ``bench.py --config=fleet``.
+"""
+from . import router, tenancy
+from .router import FleetHandle, NoReplicaError, Router
+from .tenancy import (DeficitFairQueue, QuotaExceededError, TenantPolicy,
+                      TenantQuota)
+
+__all__ = ["DeficitFairQueue", "FleetHandle", "NoReplicaError",
+           "QuotaExceededError", "Router", "TenantPolicy", "TenantQuota",
+           "router", "tenancy"]

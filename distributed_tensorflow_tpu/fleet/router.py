@@ -1,0 +1,399 @@
+"""Fleet router: spread requests over N Engine replicas, survive losses.
+
+One ``serve.Engine`` is one mesh; a fleet is N of them behind a
+``Router`` façade with the same ``submit() -> handle`` surface
+(docs/SERVING.md §Fleet):
+
+* **Least-loaded placement** — each submit reads every live replica's
+  ``Engine.stats()`` snapshot (queued + prefilling + active; a cheap
+  host-side read, never a ``/metrics`` text scrape) and places on the
+  least-loaded replica, ties broken by replica id — so a replayed trace
+  reproduces its placement decisions exactly (``router.placements``,
+  pinned by tests/test_fleet.py).
+* **Retry within the deadline** — a submit REJECTED by one replica
+  (queue full, tenant quota) tries the others in load order before the
+  rejection reaches the caller; a request whose replica dies or whose
+  engine handle fails is resubmitted to a surviving replica as long as
+  its deadline allows (generation restarts from the prompt — delivery
+  is at-least-once, so ``on_token`` may replay from the start after a
+  failover; the terminal ``tokens`` are exactly one clean run's).
+* **Rolling restarts** — ``drain_replica`` stops routing new traffic to
+  a replica and pumps the fleet until it empties;
+  ``remove_replica`` / ``add_replica`` swap replicas in and out with
+  in-flight work rerouted, turning the PR 5 backpressure/deadline/drain
+  primitives into zero-downtime deploys.
+* **Chaos** — a ``kill_replica`` fault (resilience.faults) raises at
+  the router's pump site for the targeted replica; the router marks it
+  dead and reroutes, and the acceptance test pins that every
+  non-expired request completes on a survivor with survivor streams
+  bit-exact (tests/test_fleet.py).
+
+The router is synchronous like the engine: the caller pumps ``step()``
+(one tick of every live replica + the retry sweep) or ``drain()``.
+
+Metrics (``registry=``): ``dttpu_router_replicas`` gauge,
+``dttpu_router_requests_total`` / ``dttpu_router_retries_total`` /
+``dttpu_router_replica_down_total`` / ``dttpu_router_rejected_total``
+counters, and per-replica ``dttpu_router_placed_total{replica=...}``.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..obs import metrics as metrics_lib
+from ..resilience import faults as faults_lib
+from ..serve.engine import Engine, QueueFullError, RequestHandle
+from .tenancy import QuotaExceededError
+
+__all__ = ["FleetHandle", "NoReplicaError", "Router"]
+
+# submit errors that mean "THIS replica won't take it right now" — safe
+# to retry on another replica.  Anything else (validation, unknown
+# adapter) is wrong everywhere and propagates to the caller.
+_REJECTIONS = (QueueFullError, QuotaExceededError)
+
+
+class NoReplicaError(RuntimeError):
+    """No live replica can take this request (all dead or draining)."""
+
+
+class FleetHandle:
+    """Caller-facing view of one fleet request across retries.
+
+    Mirrors ``RequestHandle`` (tokens / done / status / error / ttft_s)
+    but survives replica failures: after a failover the handle simply
+    tracks the replacement attempt.  ``replica_id`` is the current (or
+    final) placement; ``attempts`` counts placements."""
+
+    def __init__(self, rid: int, spec: dict,
+                 deadline: Optional[float], retries_left: int,
+                 router: "Router"):
+        self.rid = rid
+        self.spec = spec
+        self.deadline = deadline            # absolute perf_counter or None
+        self.retries_left = retries_left
+        self.attempts = 0
+        self.replica_id: Optional[int] = None
+        self._router = router
+        self._handle: Optional[RequestHandle] = None
+        self._status = "pending"
+        self.error: Optional[BaseException] = None
+
+    @property
+    def tokens(self) -> List[int]:
+        return self._handle.tokens if self._handle is not None else []
+
+    @property
+    def status(self) -> str:
+        return self._status
+
+    @property
+    def done(self) -> bool:
+        return self._status != "pending"
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return self._handle.ttft_s if self._handle is not None else None
+
+    @property
+    def tenant(self) -> str:
+        return self.spec["tenant"]
+
+    def result(self) -> List[int]:
+        """Pump the fleet until this request finishes; return its
+        tokens (synchronous router: waiting IS driving)."""
+        while not self.done:
+            if not self._router.step():
+                break
+        return self.tokens
+
+    def _finalize(self, status: str,
+                  error: Optional[BaseException] = None) -> None:
+        self._status = status
+        self.error = error
+
+
+class Router:
+    """Spread ``submit()`` traffic over N ``serve.Engine`` replicas.
+
+    Args:
+      replicas: engines to start with (``add_replica`` adds more; each
+        gets the next integer replica id).
+      registry: obs registry for the router metrics (default: the
+        process registry).
+      max_retries: placements a request may consume AFTER its first
+        (failover budget; rejected-at-submit probing of other replicas
+        does not count).
+    """
+
+    def __init__(self, replicas=(), *,
+                 registry: Optional[metrics_lib.Registry] = None,
+                 max_retries: int = 2):
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0; got {max_retries}")
+        reg = registry if registry is not None else metrics_lib.REGISTRY
+        self.registry = reg
+        self.max_retries = int(max_retries)
+        self._replicas: Dict[int, Engine] = {}
+        self._draining: set = set()
+        self._next_replica = 0
+        self._next_rid = 0
+        self._inflight: List[FleetHandle] = []
+        self.placements: List[tuple] = []      # (fleet rid, replica id)
+        self._m_replicas = reg.gauge(
+            "dttpu_router_replicas", "Live engine replicas behind the "
+            "router (draining replicas still count until empty).")
+        self._m_requests = reg.counter(
+            "dttpu_router_requests_total",
+            "Requests accepted by the router.")
+        self._m_retries = reg.counter(
+            "dttpu_router_retries_total",
+            "Failover resubmissions (replica death or failed handle).")
+        self._m_down = reg.counter(
+            "dttpu_router_replica_down_total",
+            "Replicas removed after a pump failure.")
+        self._m_rejected = reg.counter(
+            "dttpu_router_rejected_total",
+            "Submits rejected by EVERY live replica (fleet-wide "
+            "backpressure surfaced to the caller).")
+        self._m_placed: Dict[int, metrics_lib.Counter] = {}
+        for engine in replicas:
+            self.add_replica(engine)
+
+    # -------------------------------------------------------- replicas
+
+    def add_replica(self, engine: Engine) -> int:
+        rid = self._next_replica
+        self._next_replica += 1
+        self._replicas[rid] = engine
+        self._m_placed[rid] = self.registry.counter(
+            "dttpu_router_placed_total",
+            "Requests placed, by replica.",
+            labels={"replica": str(rid)})
+        self._m_replicas.set(len(self._replicas))
+        return rid
+
+    @property
+    def replica_ids(self):
+        return tuple(self._replicas)
+
+    def replica(self, replica_id: int) -> Engine:
+        return self._replicas[replica_id]
+
+    def stats(self) -> Dict[int, object]:
+        """{replica_id: EngineStats} for every live replica."""
+        return {rid: eng.stats() for rid, eng in self._replicas.items()}
+
+    def load_adapter(self, adapter_id: str, adapter) -> None:
+        """Register a LoRA adapter on EVERY live replica (each holds its
+        own device table) so placement stays adapter-agnostic."""
+        for eng in self._replicas.values():
+            eng.load_adapter(adapter_id, adapter)
+
+    # ---------------------------------------------------------- intake
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               on_token: Optional[Callable[[List[int]], None]] = None,
+               deadline_s: Optional[float] = None,
+               tenant: str = "default",
+               adapter_id: Optional[str] = None) -> FleetHandle:
+        """Place one request on the least-loaded live replica -> handle.
+        Replicas that reject (queue full, tenant quota) are skipped for
+        the next-loaded one; if EVERY live replica rejects, the last
+        rejection propagates (fleet-wide backpressure).  ``deadline_s``
+        is a FLEET deadline: retries submit with the remaining budget."""
+        deadline = (None if deadline_s is None
+                    else time.perf_counter() + deadline_s)
+        fh = FleetHandle(
+            rid=self._next_rid,
+            spec=dict(prompt=prompt, max_new_tokens=max_new_tokens,
+                      on_token=on_token, tenant=tenant,
+                      adapter_id=adapter_id),
+            deadline=deadline, retries_left=self.max_retries,
+            router=self)
+        self._next_rid += 1
+        self._place(fh, raise_rejection=True)
+        self._m_requests.inc()
+        self._inflight.append(fh)
+        return fh
+
+    def _candidates(self) -> List[int]:
+        """Live, non-draining replica ids, least-loaded first (stats
+        snapshot inflight; ties by id — deterministic placement)."""
+        return sorted(
+            (rid for rid in self._replicas if rid not in self._draining),
+            key=lambda rid: (self._replicas[rid].stats().inflight, rid))
+
+    def _place(self, fh: FleetHandle, raise_rejection: bool) -> bool:
+        """Try to submit ``fh`` on each candidate replica in load order.
+        True on placement; False when every candidate rejected (or none
+        exists) and ``raise_rejection`` is off."""
+        remaining = None
+        if fh.deadline is not None:
+            remaining = fh.deadline - time.perf_counter()
+            if remaining <= 0:
+                fh._finalize("deadline_exceeded")
+                return False
+        candidates = self._candidates()
+        if not candidates:
+            err = NoReplicaError("no live replica available")
+            if raise_rejection:
+                raise err
+            fh._finalize("failed", error=fh.error or err)
+            return False
+        last: Optional[BaseException] = None
+        for rid in candidates:
+            try:
+                h = self._replicas[rid].submit(
+                    fh.spec["prompt"], fh.spec["max_new_tokens"],
+                    on_token=fh.spec["on_token"],
+                    deadline_s=remaining,
+                    tenant=fh.spec["tenant"],
+                    adapter_id=fh.spec["adapter_id"])
+            except _REJECTIONS as e:
+                last = e
+                continue
+            fh._handle = h
+            fh.replica_id = rid
+            fh.attempts += 1
+            self.placements.append((fh.rid, rid))
+            self._m_placed[rid].inc()
+            return True
+        if raise_rejection:
+            self._m_rejected.inc()
+            raise last
+        return False                    # stays pending; retried next step
+
+    # ----------------------------------------------------------- drive
+
+    @property
+    def busy(self) -> bool:
+        return (any(eng.busy for eng in self._replicas.values())
+                or any(not fh.done for fh in self._inflight))
+
+    def step(self) -> bool:
+        """One fleet tick: pump every live replica (a replica whose pump
+        RAISES is declared dead and its in-flight requests rerouted),
+        then sweep handles — finalize finished ones, resubmit failed or
+        orphaned ones that still have deadline and retry budget."""
+        did = False
+        plan = faults_lib.active()
+        for rid in list(self._replicas):
+            eng = self._replicas[rid]
+            try:
+                if plan is not None:
+                    plan.on_replica_step(rid)
+                did = eng.step() or did
+            except Exception as e:
+                self._replica_down(rid, e)
+                did = True
+        did = self._sweep() or did
+        return did
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Pump until every request reached a terminal status; with
+        ``timeout_s``, stop at the budget and return False."""
+        deadline = (None if timeout_s is None
+                    else time.perf_counter() + timeout_s)
+        while self.busy:
+            if deadline is not None and time.perf_counter() >= deadline:
+                return False
+            self.step()
+        return True
+
+    def cancel(self, fh: FleetHandle) -> bool:
+        """Abort one fleet request; False if already terminal."""
+        if fh.done:
+            return False
+        if fh._handle is not None and fh.replica_id in self._replicas:
+            self._replicas[fh.replica_id].cancel(fh._handle)
+        fh._finalize("cancelled")
+        return True
+
+    # ----------------------------------------------- rolling restarts
+
+    def drain_replica(self, replica_id: int,
+                      timeout_s: Optional[float] = None) -> bool:
+        """Stop routing NEW traffic to ``replica_id`` and pump the whole
+        fleet until it is empty (other replicas keep serving).  Returns
+        False on timeout (the replica stays draining — call again or
+        ``remove_replica`` to force reroute)."""
+        if replica_id not in self._replicas:
+            raise KeyError(f"unknown replica {replica_id}")
+        self._draining.add(replica_id)
+        eng = self._replicas[replica_id]
+        deadline = (None if timeout_s is None
+                    else time.perf_counter() + timeout_s)
+        while eng.busy or any(fh.replica_id == replica_id
+                              for fh in self._inflight if not fh.done):
+            if deadline is not None and time.perf_counter() >= deadline:
+                return False
+            if not self.step():
+                break
+        return not eng.busy
+
+    def remove_replica(self, replica_id: int) -> Engine:
+        """Take ``replica_id`` out of the fleet.  In-flight requests on
+        it are cancelled engine-side and rerouted to the survivors
+        (deadline/retry budget permitting) — drain first for a clean
+        handoff.  Returns the detached engine (restart it, then
+        ``add_replica`` it back)."""
+        eng = self._replicas.pop(replica_id)
+        self._draining.discard(replica_id)
+        self._m_replicas.set(len(self._replicas))
+        for fh in self._inflight:
+            if fh.replica_id == replica_id and not fh.done \
+                    and fh._handle is not None:
+                eng.cancel(fh._handle)
+                fh._handle = None       # orphaned: the sweep reroutes
+                fh.replica_id = None
+                self._m_retries.inc()
+        self._sweep()
+        return eng
+
+    # ------------------------------------------------------- internals
+
+    def _replica_down(self, replica_id: int, error: BaseException) -> None:
+        self._replicas.pop(replica_id, None)
+        self._draining.discard(replica_id)
+        self._m_down.inc()
+        self._m_replicas.set(len(self._replicas))
+        for fh in self._inflight:
+            if fh.replica_id == replica_id and not fh.done:
+                fh.error = error
+                fh._handle = None       # orphaned: the sweep reroutes
+                fh.replica_id = None
+                self._m_retries.inc()
+
+    def _sweep(self) -> bool:
+        did = False
+        still: List[FleetHandle] = []
+        for fh in self._inflight:
+            if fh.done:
+                continue
+            h = fh._handle
+            if h is None:               # orphaned (death/removal/retry)
+                did = True
+                self._place(fh, raise_rejection=False)
+            elif h.done:
+                did = True
+                if h.status == "failed" and fh.retries_left > 0 \
+                        and self._deadline_ok(fh):
+                    fh.retries_left -= 1
+                    fh._handle = None
+                    fh.replica_id = None
+                    self._m_retries.inc()
+                    self._place(fh, raise_rejection=False)
+                elif h.status == "failed":
+                    fh._finalize("failed", error=h.error)
+                else:                   # ok | deadline_exceeded | cancelled
+                    fh._finalize(h.status, error=h.error)
+            if not fh.done:
+                still.append(fh)
+        self._inflight = still
+        return did
+
+    @staticmethod
+    def _deadline_ok(fh: FleetHandle) -> bool:
+        return fh.deadline is None or time.perf_counter() < fh.deadline

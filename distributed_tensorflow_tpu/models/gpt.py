@@ -712,6 +712,134 @@ class GPT:
             grads["lm_head"] = aux_grads["word"]
         return loss, grads
 
+    # -- LoRA adapters ----------------------------------------------------
+    # Low-rank per-request adapters for the serving tier (serve/ +
+    # fleet/): many fine-tuned variants of one base serve from ONE set of
+    # base weights.  An adapter adds rank-r deltas to the four attention
+    # projections — q/k/v get x @ a @ b added to the projection output
+    # BEFORE RoPE (both are linear, so this equals projecting with the
+    # merged kernel W + a@b, pinned by ``merge_lora`` parity tests); the
+    # out projection gets attn @ a @ b.  Adapters live in a fixed-
+    # capacity STACKED table ([T, L, ...] leaves) indexed by a traced
+    # per-row slot -> table-row vector, so loading, evicting, and
+    # swapping adapters never changes any compiled executable
+    # (serve.adapters.AdapterTable is the host-side manager).  Row 0 is
+    # reserved all-zero: ``adapter_id=None`` requests resolve to it and
+    # their delta is an exact zero — output tokens identical to an
+    # adapter-free engine.
+
+    _LORA_TARGETS = ("query", "key", "value", "out")
+
+    def lora_shapes(self, rank: int) -> Dict[str, Any]:
+        """{target: (a_shape, b_shape)} for ONE layer of a rank-``rank``
+        adapter (the per-adapter leaves prepend [num_layers], the table
+        leaves [capacity, num_layers])."""
+        c = self.config
+        h, hd, d = c.num_heads, c.head_dim, c.hidden_size
+        kv = c.kv_heads
+        return {
+            "query": ((d, rank), (rank, h, hd)),
+            "key": ((d, rank), (rank, kv, hd)),
+            "value": ((d, rank), (rank, kv, hd)),
+            "out": ((h, hd, rank), (rank, d)),
+        }
+
+    def init_lora(self, key, rank: int, scale: float = 1.0):
+        """One adapter: {target: {a, b}} with [L, ...] leaves.  Standard
+        LoRA init — ``a`` ~ N(0, 0.02) truncated, ``b`` zeros, so a fresh
+        adapter is a no-op until trained/loaded; bake any alpha/r scaling
+        into ``b`` (``scale`` multiplies ``a`` for synthetic tests)."""
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1; got {rank}")
+        c = self.config
+        trunc = init_lib.truncated_normal(0.02)
+        keys = jax.random.split(key, len(self._LORA_TARGETS))
+        adapter = {}
+        for k_t, (name, (a_shape, b_shape)) in zip(
+                keys, self.lora_shapes(rank).items()):
+            adapter[name] = {
+                "a": trunc(k_t, (c.num_layers,) + a_shape) * scale,
+                "b": jnp.zeros((c.num_layers,) + b_shape, jnp.float32),
+            }
+        return adapter
+
+    def init_lora_table(self, capacity: int, rank: int):
+        """All-zero stacked adapter table: {target: {a, b}} with
+        [capacity, L, ...] leaves.  Row 0 is the reserved zero adapter
+        (``adapter_id=None``) — never write it."""
+        if capacity < 2:
+            raise ValueError(f"capacity must be >= 2 (row 0 is the "
+                             f"reserved zero adapter); got {capacity}")
+        c = self.config
+        return {name: {"a": jnp.zeros((capacity, c.num_layers) + a_shape,
+                                      jnp.float32),
+                       "b": jnp.zeros((capacity, c.num_layers) + b_shape,
+                                      jnp.float32)}
+                for name, (a_shape, b_shape)
+                in self.lora_shapes(rank).items()}
+
+    @staticmethod
+    def lora_insert_row(table, row, adapter):
+        """Splice one adapter into table row ``row`` (traced index —
+        ONE executable loads every row; jit with the table donated)."""
+        def splice(buf, leaf):
+            starts = (jnp.asarray(row, jnp.int32),) \
+                + (jnp.int32(0),) * leaf.ndim
+            return lax.dynamic_update_slice(
+                buf, leaf[None].astype(buf.dtype), starts)
+        return jax.tree.map(splice, table, adapter)
+
+    def merge_lora(self, params, adapter):
+        """Base params with the adapter's deltas MERGED into the four
+        attention projection kernels — the exactness oracle: running the
+        merged params adapter-free must match running the base params
+        with the adapter applied per-request."""
+        merged = jax.tree.map(lambda x: x, params)   # shallow-ish copy
+        dec_p = dict(merged["decoder"])
+        for name in self._LORA_TARGETS:
+            a, b = adapter[name]["a"], adapter[name]["b"]
+            if name == "out":
+                delta = jnp.einsum("lhkr,lrd->lhkd", a, b)
+            else:
+                delta = jnp.einsum("ldr,lrhk->ldhk", a, b)
+            attn = dict(dec_p["attention"])
+            attn[name] = dict(attn[name],
+                              kernel=attn[name]["kernel"] + delta)
+            dec_p["attention"] = attn
+        merged["decoder"] = dec_p
+        return merged
+
+    def _lora_deltas(self, adapters, adapter_rows, i, dtype):
+        """Per-row rank-r projection deltas for layer ``i``:
+        {target: fn(x) -> delta}.  ``adapters``: stacked [T, L, ...]
+        table leaves; ``adapter_rows`` [b]: each batch row's table row.
+        The gathers are [b, ...] slices of a tiny table — the einsum
+        chain is O(b·s·d·r), negligible beside the dense projection."""
+        def gathered(name):
+            a = lax.dynamic_index_in_dim(adapters[name]["a"], i, 1,
+                                         keepdims=False)     # [T, ...]
+            b = lax.dynamic_index_in_dim(adapters[name]["b"], i, 1,
+                                         keepdims=False)
+            return (jnp.take(a, adapter_rows, axis=0).astype(dtype),
+                    jnp.take(b, adapter_rows, axis=0).astype(dtype))
+
+        def qkv_delta(name):
+            a, b = gathered(name)                 # [b,d,r], [b,r,h,hd]
+            def fn(x):                            # x: [b, s, d]
+                t = jnp.einsum("bsd,bdr->bsr", x, a)
+                return jnp.einsum("bsr,brhk->bshk", t, b)
+            return fn
+
+        def out_delta():
+            a, b = gathered("out")                # [b,h,hd,r], [b,r,d]
+            def fn(attn):                         # attn: [b, s, h, hd]
+                t = jnp.einsum("bshk,bhkr->bsr", attn, a)
+                return jnp.einsum("bsr,brd->bsd", t, b)
+            return fn
+
+        return {"query": qkv_delta("query"), "key": qkv_delta("key"),
+                "value": qkv_delta("value"), "out": out_delta()}
+
     # -- KV-cache decode --------------------------------------------------
     def init_cache(self, batch_size: int, max_len: Optional[int] = None):
         c = self.config
@@ -820,7 +948,8 @@ class GPT:
         return logits, dict(new_kv, pos=pos + 1)
 
     def decode_step_slots(self, params, kv, token_ids, write_col,
-                          kv_valid, positions):
+                          kv_valid, positions, adapters=None,
+                          adapter_rows=None):
         """One token per row against a SLOT cache (continuous batching).
 
         The serving tier's hot step (serve/): ``kv`` is a position-free
@@ -842,6 +971,12 @@ class GPT:
         marking the written column valid, bumping write_col/positions —
         is the caller's job (serve.slots.decode_slots_step), because
         only the scheduler knows which rows are live.
+
+        ``adapters`` / ``adapter_rows`` [b]: per-row LoRA deltas from a
+        stacked adapter table (see the LoRA section above) — row r runs
+        table row ``adapter_rows[r]``'s adapter; row 0 of the table is
+        the zero adapter, so mixing adapter and non-adapter requests in
+        one tick costs one gather, never a recompile.
         """
         c = self.config
         emb = params["embeddings"]
@@ -872,7 +1007,9 @@ class GPT:
             p, i = inputs
             return self._cache_layer(p, x, kv, i,
                                      write_pos=write_col, rope_cs=rope_cs,
-                                     attention=attention), None
+                                     attention=attention,
+                                     adapters=adapters,
+                                     adapter_rows=adapter_rows), None
 
         (x, new_kv), _ = lax.scan(
             body, (x, dict(kv)),
@@ -881,7 +1018,7 @@ class GPT:
         return self.logits(params, x)[:, 0, :], new_kv
 
     def _cache_layer(self, p, x, kv, i, *, write_pos, rope_cs,
-                     attention):
+                     attention, adapters=None, adapter_rows=None):
         """ONE decoder layer of the KV-cache path — shared by decode_step
         (s=1 against the cache) and decode_block (whole-prompt prefill)
         so the layer math can never diverge between them.  The cache
@@ -897,6 +1034,10 @@ class GPT:
         specific attention read; ``rope_cs``: (cos, sin) tables hoisted
         out of the layer scan.
 
+        ``adapters``/``adapter_rows``: per-row LoRA projection deltas
+        (see the LoRA section) — q/k/v deltas add BEFORE RoPE so the
+        result equals projecting with the merged kernel.
+
         ``write_pos`` may be a scalar (one column for the whole batch —
         the generate/beam path) or a [b] vector (per-row columns — the
         slot-serving path, ``decode_step_slots``): vector positions
@@ -906,15 +1047,20 @@ class GPT:
         h = self._norm(p["ln_1"], x)
         a = p["attention"]
         dtype = h.dtype
+        lora = (self._lora_deltas(adapters, adapter_rows, i, dtype)
+                if adapters is not None else None)
 
-        def proj(pp):
+        def proj(name):
+            pp = a[name]
             y = jnp.einsum("bsd,dhk->bshk", h,
                            pp["kernel"].astype(dtype))
+            if lora is not None:
+                y = y + lora[name](h)
             if "bias" in pp:
                 y = y + pp["bias"].astype(dtype)
             return y
 
-        q, k, v = proj(a["query"]), proj(a["key"]), proj(a["value"])
+        q, k, v = proj("query"), proj("key"), proj("value")
         if rope_cs is not None:
             q = attn_lib.apply_rope(q, *rope_cs)
             k = attn_lib.apply_rope(k, *rope_cs)
@@ -979,6 +1125,8 @@ class GPT:
         attn = attention(q, k, v, kv, i)
         attn_out = jnp.einsum("bshk,hkd->bsd", attn,
                               a["out"]["kernel"].astype(dtype))
+        if lora is not None:
+            attn_out = attn_out + lora["out"](attn)
         if "bias" in a["out"]:
             attn_out = attn_out + a["out"]["bias"].astype(dtype)
         x = x + attn_out
@@ -1057,7 +1205,8 @@ class GPT:
         logits = self.logits(params, x)[:, 0, :]
         return logits, dict(new_kv, pos=cache["pos"] + s)
 
-    def decode_window(self, params, cache, token_ids, head: str = "all"):
+    def decode_window(self, params, cache, token_ids, head: str = "all",
+                      adapters=None, adapter_rows=None):
         """``s`` tokens against a NON-empty cache in one forward.
 
         The generalization of ``decode_block`` to ``cache['pos'] > 0``:
@@ -1074,6 +1223,10 @@ class GPT:
         next-token shape), ``"none"`` (logits is None — intermediate
         chunked-prefill windows only feed the cache, and the [b, s,
         vocab] tensor must not materialize for them).
+
+        ``adapters``/``adapter_rows`` [b]: per-row LoRA deltas (see the
+        LoRA section) — the serve tier prefills each request under its
+        own adapter through this path.
         """
         if head not in ("all", "last", "none"):
             raise ValueError(f"head must be all|last|none; got {head!r}")
@@ -1109,7 +1262,9 @@ class GPT:
             p, i = inputs
             return self._cache_layer(p, x, kv, i,
                                      write_pos=pos, rope_cs=rope_cs,
-                                     attention=window_attn), None
+                                     attention=window_attn,
+                                     adapters=adapters,
+                                     adapter_rows=adapter_rows), None
 
         (x, new_kv), _ = lax.scan(
             body, (x, self._cache_kv(cache)),

@@ -33,6 +33,11 @@ fail_decode         raise ``InjectedFault`` when the serve scheduler
                     delivers tokens for request id ``at`` (fails exactly
                     that handle; scheduler isolation keeps the tick loop
                     and every other slot alive)
+kill_replica        raise ``ConnectionError`` at the fleet Router's pump
+                    site for replica id ``replica`` on its ``at``-th
+                    pump — the router sees the replica die mid-traffic,
+                    removes it, and reroutes its in-flight requests to
+                    the survivors (fleet/router.py)
 ==================  =========================================================
 
 Every injection is auditable: it lands in ``plan.log``, increments the
@@ -66,7 +71,7 @@ __all__ = ["Fault", "FaultPlan", "InjectedFault", "KINDS", "activate",
            "activated", "active", "deactivate", "plan_from_env"]
 
 KINDS = ("corrupt_checkpoint", "save_oserror", "poison_batch",
-         "nan_grads", "kill_prefetch", "fail_decode")
+         "nan_grads", "kill_prefetch", "fail_decode", "kill_replica")
 
 
 class InjectedFault(RuntimeError):
@@ -86,6 +91,7 @@ class Fault:
     at: int
     mode: str = "truncate"          # corrupt_checkpoint: truncate | flip
     file: str = "arrays.npz"        # corrupt_checkpoint target file
+    replica: int = 0                # kill_replica: target replica id
     times: int = 1                  # max fires
     fired: int = 0
 
@@ -123,10 +129,12 @@ class FaultPlan:
             self._counters[site] = i + 1
             return i
 
-    def _match(self, kind: str, index: int) -> Optional[Fault]:
+    def _match(self, kind: str, index: int,
+               replica: Optional[int] = None) -> Optional[Fault]:
         with self._lock:
             for f in self.faults:
-                if f.kind == kind and f.at == index and f.fired < f.times:
+                if f.kind == kind and f.at == index and f.fired < f.times \
+                        and (replica is None or f.replica == replica):
                     f.fired += 1
                     return f
         return None
@@ -208,6 +216,18 @@ class FaultPlan:
             self._record(f, rid=int(rid))
             raise InjectedFault(
                 f"injected fault: decode failed for request {rid}")
+
+    def on_replica_step(self, replica: int) -> None:
+        """The fleet Router's pump of replica ``replica``: kill that
+        replica (a ``ConnectionError`` — the realistic router-to-replica
+        failure type) on its ``at``-th pump when a kill_replica fault
+        targeting it is armed."""
+        i = self._tick(f"replica:{replica}")
+        f = self._match("kill_replica", i, replica=int(replica))
+        if f is not None:
+            self._record(f, replica=int(replica), step=i)
+            raise ConnectionError(
+                f"injected fault: replica {replica} killed at pump #{i}")
 
 
 def _poison(tree: Any) -> Any:

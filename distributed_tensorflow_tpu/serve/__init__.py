@@ -22,13 +22,15 @@ baseline in the same process; exactness (single request == greedy
 ``GPT.generate``, admission never perturbs other slots) is pinned by
 tests/test_serve.py.
 """
-from . import engine, scheduler, slots
+from . import adapters, engine, scheduler, slots
+from .adapters import AdapterTable, AdapterTableFull
 from .engine import Engine, QueueFullError, RequestHandle, ServeMetrics
-from .scheduler import Request, SlotScheduler
+from .scheduler import EngineStats, Request, SlotScheduler
 from .slots import (decode_slots_step, init_slot_cache, insert_slot,
                     slot_kv_valid, strip_pos)
 
-__all__ = ["Engine", "QueueFullError", "RequestHandle", "ServeMetrics",
+__all__ = ["AdapterTable", "AdapterTableFull", "Engine", "EngineStats",
+           "QueueFullError", "RequestHandle", "ServeMetrics",
            "Request", "SlotScheduler", "decode_slots_step",
            "init_slot_cache", "insert_slot", "slot_kv_valid", "strip_pos",
-           "engine", "scheduler", "slots"]
+           "adapters", "engine", "scheduler", "slots"]

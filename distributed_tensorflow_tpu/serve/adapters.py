@@ -1,0 +1,167 @@
+"""Fixed-capacity LoRA adapter table: hot-swap without recompiles.
+
+The host-side manager for the stacked adapter arrays the hot executables
+consume (``GPT.init_lora_table`` layout — ``[capacity+1, L, ...]``
+leaves, row 0 permanently the ZERO adapter so ``adapter_id=None``
+requests cost one gather of zeros and stay token-identical to an
+adapter-free engine).
+
+Lifecycle::
+
+    table = AdapterTable(model, capacity=4, rank=8)
+    table.register("customer-a", model.init_lora(key, rank=8))  # host copy
+    row = table.acquire("customer-a")     # splice into a device row
+    ...                                   # decode under row
+    table.release("customer-a")           # unpin (stays resident)
+
+``acquire`` is what the scheduler calls at prefill begin: a resident
+adapter is a dict hit; a non-resident one is spliced into a free row —
+or into the least-recently-used UNPINNED row (eviction) — by ONE jitted
+``dynamic_update_slice`` at a traced row index, so loading and evicting
+adapters never changes any compiled executable.  When every row is
+pinned by an in-flight request, ``acquire`` raises ``AdapterTableFull``
+and the scheduler leaves the request queued until a row unpins
+(requests release their pin at retirement, so this always drains).
+
+Metrics (registry= — default the process registry):
+``dttpu_adapter_loads_total`` / ``dttpu_adapter_evictions_total``
+counters and the ``dttpu_adapter_resident`` gauge.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..obs import metrics as metrics_lib
+
+__all__ = ["AdapterTable", "AdapterTableFull"]
+
+
+class AdapterTableFull(RuntimeError):
+    """``acquire`` found no free or evictable row: every row is pinned
+    by an in-flight request.  Transient — retry after a retirement."""
+
+
+class AdapterTable:
+    """Host-side manager of one device-resident stacked adapter table.
+
+    ``capacity`` counts LOADABLE adapters (the device table has
+    ``capacity + 1`` rows; row 0 is the reserved zero adapter).
+    ``arrays`` is the stacked pytree the scheduler feeds the hot
+    executables each call — replaced (donated splice) on every load, so
+    it must be re-read per dispatch, never cached.
+    """
+
+    def __init__(self, model, capacity: int, rank: int,
+                 registry: Optional[metrics_lib.Registry] = None):
+        import jax
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        self.model = model
+        self.capacity = int(capacity)
+        self.rank = int(rank)
+        self.arrays = model.init_lora_table(capacity + 1, rank)
+        self._splice = jax.jit(model.lora_insert_row,
+                               donate_argnums=(0,))
+        self._store: Dict[str, dict] = {}     # id -> host adapter tree
+        self._rows: Dict[str, int] = {}       # id -> resident row
+        self._refs: Dict[str, int] = {}       # id -> in-flight pins
+        self._used: Dict[str, int] = {}       # id -> LRU clock tick
+        self._clock = 0
+        reg = registry if registry is not None else metrics_lib.REGISTRY
+        self._loads = reg.counter(
+            "dttpu_adapter_loads_total",
+            "LoRA adapters spliced into a device table row.")
+        self._evictions = reg.counter(
+            "dttpu_adapter_evictions_total",
+            "LoRA adapters evicted from the table (LRU, unpinned only).")
+        self._resident = reg.gauge(
+            "dttpu_adapter_resident",
+            "LoRA adapters currently resident in the device table.")
+
+    # ------------------------------------------------------------ intake
+
+    def register(self, adapter_id: str, adapter) -> None:
+        """Make ``adapter_id`` loadable (host-side copy; device splice
+        happens lazily at ``acquire``).  Re-registering a RESIDENT id
+        re-splices its row in place — the hot-update path."""
+        if not adapter_id:
+            raise ValueError("adapter_id must be a non-empty string")
+        self._check_shapes(adapter)
+        self._store[adapter_id] = adapter
+        row = self._rows.get(adapter_id)
+        if row is not None:
+            self.arrays = self._splice(self.arrays, row, adapter)
+            self._loads.inc()
+
+    def _check_shapes(self, adapter) -> None:
+        want = self.model.lora_shapes(self.rank)
+        L = self.model.config.num_layers
+        for name, (a_shape, b_shape) in want.items():
+            got_a = tuple(adapter[name]["a"].shape)
+            got_b = tuple(adapter[name]["b"].shape)
+            if got_a != (L,) + a_shape or got_b != (L,) + b_shape:
+                raise ValueError(
+                    f"adapter[{name!r}] shapes {got_a}/{got_b} do not "
+                    f"match rank-{self.rank} layout "
+                    f"{(L,) + a_shape}/{(L,) + b_shape}")
+
+    def known(self, adapter_id: str) -> bool:
+        return adapter_id in self._store
+
+    @property
+    def resident_ids(self):
+        return tuple(self._rows)
+
+    # ----------------------------------------------------------- pinning
+
+    def acquire(self, adapter_id: Optional[str]) -> int:
+        """Pin ``adapter_id`` and return its table row (0 for None).
+        Splices a non-resident adapter into a free row, evicting the
+        least-recently-used unpinned resident when the table is full;
+        raises ``AdapterTableFull`` when every row is pinned."""
+        if adapter_id is None:
+            return 0
+        if adapter_id not in self._store:
+            raise KeyError(f"unknown adapter_id {adapter_id!r}; "
+                           f"register() it first")
+        self._clock += 1
+        self._used[adapter_id] = self._clock
+        row = self._rows.get(adapter_id)
+        if row is None:
+            row = self._free_row()
+            self.arrays = self._splice(self.arrays, row, self._store[adapter_id])
+            self._rows[adapter_id] = row
+            self._loads.inc()
+            self._resident.set(len(self._rows))
+        self._refs[adapter_id] = self._refs.get(adapter_id, 0) + 1
+        return row
+
+    def release(self, adapter_id: Optional[str]) -> None:
+        """Unpin one ``acquire`` (the adapter stays resident for reuse
+        until evicted by a later load)."""
+        if adapter_id is None:
+            return
+        n = self._refs.get(adapter_id, 0)
+        if n <= 1:
+            self._refs.pop(adapter_id, None)
+        else:
+            self._refs[adapter_id] = n - 1
+
+    def _free_row(self) -> int:
+        used = set(self._rows.values())
+        for row in range(1, self.capacity + 1):
+            if row not in used:
+                return row
+        victims = [aid for aid in self._rows
+                   if self._refs.get(aid, 0) == 0]
+        if not victims:
+            raise AdapterTableFull(
+                f"all {self.capacity} adapter rows are pinned by "
+                "in-flight requests")
+        victim = min(victims, key=lambda aid: self._used.get(aid, 0))
+        row = self._rows.pop(victim)
+        self._evictions.inc()
+        self._resident.set(len(self._rows))
+        # no scrub needed: the row is fully overwritten by the splice
+        # the caller performs next
+        return row

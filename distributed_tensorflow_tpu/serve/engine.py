@@ -42,9 +42,11 @@ import time
 from typing import Callable, List, Optional
 
 from ..obs import metrics as metrics_lib
-from .scheduler import Request, SlotScheduler
+from .adapters import AdapterTable
+from .scheduler import EngineStats, Request, SlotScheduler
 
-__all__ = ["Engine", "QueueFullError", "RequestHandle", "ServeMetrics"]
+__all__ = ["Engine", "EngineStats", "QueueFullError", "RequestHandle",
+           "ServeMetrics"]
 
 
 class QueueFullError(RuntimeError):
@@ -91,6 +93,29 @@ class ServeMetrics:
             "dttpu_serve_failed_total",
             "Requests failed individually (callback/decode error) "
             "without killing the scheduler.")
+        # per-tenant series, created lazily at first sight of a tenant
+        # (cardinality = the tenant set, which admission policy bounds)
+        self._tenant_tokens: dict = {}
+        self._tenant_inflight: dict = {}
+        self._tenant_rejected: dict = {}
+
+    def tenant_rejected(self, tenant: str):
+        c = self._tenant_rejected.get(tenant)
+        if c is None:
+            c = self._tenant_rejected[tenant] = self.registry.counter(
+                "dttpu_tenant_rejected_total",
+                "Requests rejected by per-tenant quota at admission.",
+                labels={"tenant": tenant})
+        return c
+
+    def _tenant_gauge(self, tenant: str):
+        g = self._tenant_inflight.get(tenant)
+        if g is None:
+            g = self._tenant_inflight[tenant] = self.registry.gauge(
+                "dttpu_tenant_inflight",
+                "In-flight requests (queued+prefilling+active), "
+                "by tenant.", labels={"tenant": tenant})
+        return g
 
     # -- scheduler hooks --------------------------------------------------
 
@@ -103,6 +128,13 @@ class ServeMetrics:
 
     def emitted(self, req: Request, n: int) -> None:
         self.tokens.inc(n)
+        c = self._tenant_tokens.get(req.tenant)
+        if c is None:
+            c = self._tenant_tokens[req.tenant] = self.registry.counter(
+                "dttpu_tenant_tokens_total",
+                "Generated tokens delivered, by tenant.",
+                labels={"tenant": req.tenant})
+        c.inc(n)
 
     def finished(self, req: Request) -> None:
         if req.ttft_s is None:
@@ -117,9 +149,16 @@ class ServeMetrics:
         elif status == "failed":
             self.failed.inc()
 
-    def depth(self, queued: int, active: int) -> None:
-        self.queue_depth.set(queued)
-        self.active_slots.set(active)
+    def depth(self, stats: EngineStats) -> None:
+        """Render the gauges from the scheduler's ``stats()`` snapshot —
+        the one bookkeeping source (no separate counters here)."""
+        self.queue_depth.set(stats.queued)
+        self.active_slots.set(stats.active)
+        for tenant, n in stats.inflight_per_tenant.items():
+            self._tenant_gauge(tenant).set(n)
+        for tenant, g in self._tenant_inflight.items():
+            if tenant not in stats.inflight_per_tenant:
+                g.set(0)
 
 
 class RequestHandle:
@@ -141,6 +180,14 @@ class RequestHandle:
     @property
     def done(self) -> bool:
         return self._req.done.is_set()
+
+    @property
+    def tenant(self) -> str:
+        return self._req.tenant
+
+    @property
+    def adapter_id(self) -> Optional[str]:
+        return self._req.adapter_id
 
     @property
     def status(self) -> str:
@@ -190,6 +237,17 @@ class Engine:
         ``None`` (default) keeps the old accept-everything behavior.
       default_deadline_s: ``submit()`` deadline when none is given
         (``None`` = no deadline).
+      tenancy: a per-tenant admission policy (``fleet.tenancy.
+        TenantPolicy``): quota checks run at ``submit`` (raising the
+        policy's quota error + ``dttpu_tenant_rejected_total``) and the
+        admission queue becomes the policy's deficit-weighted fair
+        queue, so one tenant's burst cannot starve others.
+      adapter_capacity / adapter_rank: > 0 builds a fixed-capacity LoRA
+        ``AdapterTable`` (serve/adapters) — ``load_adapter()`` +
+        ``submit(adapter_id=...)`` then hot-swap per-request adapters
+        with zero recompiles; ``adapter_id=None`` requests ride the
+        reserved zero row and stay token-identical to an adapter-free
+        engine.
     """
 
     def __init__(self, model, params, *,
@@ -197,6 +255,9 @@ class Engine:
                  default_max_new_tokens: int = 64,
                  max_queue_depth: Optional[int] = None,
                  default_deadline_s: Optional[float] = None,
+                 tenancy=None,
+                 adapter_capacity: int = 0,
+                 adapter_rank: int = 8,
                  **scheduler_kwargs):
         if max_queue_depth is not None and max_queue_depth < 1:
             raise ValueError(
@@ -205,30 +266,71 @@ class Engine:
         self.default_max_new_tokens = default_max_new_tokens
         self.max_queue_depth = max_queue_depth
         self.default_deadline_s = default_deadline_s
+        self.tenancy = tenancy
+        self.adapters = (AdapterTable(model, adapter_capacity,
+                                      adapter_rank,
+                                      registry=self.metrics.registry)
+                         if adapter_capacity else None)
+        queue = tenancy.make_queue() if tenancy is not None else None
         self.scheduler = SlotScheduler(model, params,
                                        metrics=self.metrics,
+                                       queue=queue,
+                                       adapters=self.adapters,
                                        **scheduler_kwargs)
 
     # ----------------------------------------------------------- intake
 
+    def stats(self) -> EngineStats:
+        """Lock-cheap load snapshot (queue depth, prefilling, active
+        slots, per-tenant in-flight) — the router's placement signal and
+        the source the serve gauges render from."""
+        return self.scheduler.stats()
+
+    def load_adapter(self, adapter_id: str, adapter) -> None:
+        """Register a LoRA adapter (``GPT.init_lora`` layout) for
+        ``submit(adapter_id=...)``.  Host-side copy now; the device
+        splice happens lazily at first use (and re-splices in place if
+        the id is already resident — the hot-update path)."""
+        if self.adapters is None:
+            raise ValueError("engine built without adapters "
+                             "(adapter_capacity=0)")
+        self.adapters.register(adapter_id, adapter)
+
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                on_token: Optional[Callable[[List[int]], None]] = None,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default",
+               adapter_id: Optional[str] = None) -> RequestHandle:
         """Queue one prompt ([plen] ids, any length per request) ->
         handle.  ``on_token`` streams each delivered token batch.
         Raises ``QueueFullError`` at ``max_queue_depth`` — shed load at
-        the door instead of queueing work that will miss every SLO."""
+        the door instead of queueing work that will miss every SLO.
+        With a ``tenancy`` policy, ``tenant`` is checked against its
+        quotas here too (the policy's quota error propagates);
+        ``adapter_id`` selects a loaded LoRA adapter."""
+        new_tokens = max_new_tokens or self.default_max_new_tokens
         if self.max_queue_depth is not None \
                 and self.scheduler.queued >= self.max_queue_depth:
             self.metrics.rejected.inc()
             raise QueueFullError(
                 f"queue at max_queue_depth={self.max_queue_depth}; "
                 "retry after in-flight requests retire")
+        if self.tenancy is not None:
+            try:
+                self.tenancy.check_admission(
+                    tenant, new_tokens,
+                    inflight=self.scheduler.tenant_inflight(tenant),
+                    tokens_inflight=self.scheduler
+                        .tenant_tokens_inflight(tenant))
+            except Exception:
+                self.metrics.tenant_rejected(tenant).inc()
+                raise
         req = self.scheduler.submit(
-            prompt, max_new_tokens or self.default_max_new_tokens,
+            prompt, new_tokens,
             on_token=on_token,
             deadline_s=(deadline_s if deadline_s is not None
-                        else self.default_deadline_s))
+                        else self.default_deadline_s),
+            tenant=tenant, adapter_id=adapter_id)
         return RequestHandle(req, self)
 
     # ------------------------------------------------------------ drive

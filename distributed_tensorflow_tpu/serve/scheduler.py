@@ -49,15 +49,16 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from ..resilience import faults as faults_lib
 from ..ops import decoding as dec
 from . import slots as slots_lib
+from .adapters import AdapterTableFull
 
-__all__ = ["Request", "SlotScheduler"]
+__all__ = ["EngineStats", "Request", "SlotScheduler"]
 
 
 @dataclasses.dataclass
@@ -69,6 +70,12 @@ class Request:
     ``"cancelled"`` (docs/RESILIENCE.md).  ``deadline`` is an absolute
     ``perf_counter`` instant; expiry is checked once per tick, so a
     retirement can lag the deadline by at most one tick.
+
+    ``tenant`` attributes the request for quotas/fair-share (fleet/
+    tenancy — the scheduler only accounts, the policy decides);
+    ``adapter_id`` names the LoRA adapter it decodes under
+    (serve/adapters), resolved to table row ``adapter_row`` while the
+    request holds a pin (prefill begin -> retirement).
     """
     rid: int
     prompt: np.ndarray                       # [plen] int32
@@ -77,6 +84,9 @@ class Request:
     tokens: List[int] = dataclasses.field(default_factory=list)
     submit_time: float = 0.0
     deadline: Optional[float] = None
+    tenant: str = "default"
+    adapter_id: Optional[str] = None
+    adapter_row: Optional[int] = None
     status: str = "pending"
     error: Optional[BaseException] = None
     first_token_time: Optional[float] = None
@@ -89,6 +99,28 @@ class Request:
         if self.first_token_time is None:
             return None
         return self.first_token_time - self.submit_time
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineStats:
+    """Lock-cheap snapshot of one engine's load — what the fleet router
+    spreads traffic by (``Router`` least-loaded placement) and what the
+    serve gauges render.  Plain ints + small dict copies: reading it
+    never touches the device or takes a lock."""
+    queued: int                              # accepted, not yet prefilling
+    prefilling: int                          # in a chunked-prefill window
+    active: int                              # slots holding a request
+    num_slots: int
+    inflight_per_tenant: Dict[str, int]      # queued+prefilling+active
+    tokens_inflight_per_tenant: Dict[str, int]   # sum of max_new_tokens
+
+    @property
+    def inflight(self) -> int:
+        return self.queued + self.prefilling + self.active
+
+    @property
+    def free_slots(self) -> int:
+        return self.num_slots - self.active
 
 
 class _NullMetrics:
@@ -109,7 +141,7 @@ class _NullMetrics:
     def aborted(self, req, status):
         pass
 
-    def depth(self, queued, active):
+    def depth(self, stats):
         pass
 
 
@@ -127,7 +159,7 @@ class SlotScheduler:
                  tick_steps: int = 4, temperature: float = 0.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  eos_id: Optional[int] = None, pad_id: Optional[int] = None,
-                 rng=None, metrics=None):
+                 rng=None, metrics=None, queue=None, adapters=None):
         import jax
         import jax.numpy as jnp
 
@@ -152,14 +184,23 @@ class SlotScheduler:
         self.eos_id = eos_id
         self.pad_id = dec.resolve_pad(eos_id, pad_id)
         self.metrics = metrics if metrics is not None else _NullMetrics()
+        self.adapters = adapters
         self._next_rid = 0
-        self._queue: collections.deque = collections.deque()
+        # admission queue: a deque by default; any object with append/
+        # popleft/remove/__len__/__iter__ (e.g. fleet.tenancy's deficit-
+        # weighted fair queue) plugs in — the scheduler only asks "next
+        # admissible request", the policy decides whose turn it is
+        self._queue = queue if queue is not None else collections.deque()
         self._slots: List[Optional[Request]] = [None] * num_slots
         # in-flight prefills: [req, windows [n, 1, W], next index, cache]
         self._prefills: List[list] = []
         # spare batch-1 prefill caches, reused across requests (stale
         # columns are masked by the slot validity window, never read)
         self._pf_pool: List[dict] = []
+        # per-tenant in-flight accounting (the ONE bookkeeping source:
+        # quotas, fair-share, gauges, and Engine.stats() all read it)
+        self._tenant_inflight: Dict[str, int] = {}
+        self._tenant_tokens: Dict[str, int] = {}
 
         # -- device state -------------------------------------------------
         self._cache = slots_lib.init_slot_cache(model, num_slots, max_len)
@@ -167,23 +208,32 @@ class SlotScheduler:
         self._finished = jnp.ones((num_slots,), bool)   # empty = finished
         self._remaining = jnp.zeros((num_slots,), jnp.int32)
         self._key = rng if rng is not None else jax.random.PRNGKey(0)
+        # per-slot adapter table row (host np: only admission writes it).
+        # With no adapter table the executables are passed None for both
+        # adapter args (empty pytrees) — the compiled graphs are the
+        # SAME programs as an adapter-free build.
+        self._adapter_rows = (np.zeros((num_slots,), np.int32)
+                              if adapters is not None else None)
 
         # -- the three hot executables (built ONCE; static shapes) --------
         pad = self.pad_id if self.pad_id is not None else 0
 
-        def win_mid(params, cache, window):
+        def win_mid(params, cache, window, ad, ad_row):
             return model.decode_window(params, cache, window,
-                                       head="none")[1]
+                                       head="none", adapters=ad,
+                                       adapter_rows=ad_row)[1]
 
         def last_admit(params, pf_cache, window, last_idx, key,
                        cache, tokens, finished, remaining,
-                       slot_idx, length, budget):
+                       slot_idx, length, budget, ad, ad_row):
             """Last prefill window + first-token sample + slot splice in
             ONE dispatch.  ``pf_cache`` is NOT donated: the pool entry
             stays host-valid for the next request (its columns become
             stale, which the slot validity window masks)."""
             logits, pf_cache = model.decode_window(params, pf_cache,
-                                                   window, head="all")
+                                                   window, head="all",
+                                                   adapters=ad,
+                                                   adapter_rows=ad_row)
             row = jax.lax.dynamic_index_in_dim(logits[0], last_idx,
                                                keepdims=False)
             key, sub = jax.random.split(key)
@@ -200,12 +250,14 @@ class SlotScheduler:
             remaining = remaining.at[slot_idx].set(budget - 1)
             return tok, cache, tokens, finished, remaining, key
 
-        def tick(params, cache, tokens, finished, remaining, key):
+        def tick(params, cache, tokens, finished, remaining, key,
+                 ad, ad_rows):
             def one(carry, _):
                 cache, tokens, finished, remaining, key = carry
                 live = ~finished
                 logits, cache = slots_lib.decode_slots_step(
-                    model, params, cache, tokens, live)
+                    model, params, cache, tokens, live,
+                    adapters=ad, adapter_rows=ad_rows)
                 key, sub = jax.random.split(key)
                 nxt = dec.sample_logits(sub, logits, temperature,
                                         top_k=top_k, top_p=top_p)
@@ -233,7 +285,9 @@ class SlotScheduler:
 
     def submit(self, prompt, max_new_tokens: int,
                on_token: Optional[Callable[[List[int]], None]] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default",
+               adapter_id: Optional[str] = None) -> Request:
         """Queue one request.  ``prompt``: [plen] int token ids (no
         padding — slots are per-request, unequal lengths batch freely).
         Enforces generate()'s length rule: prompt + max_new_tokens must
@@ -242,7 +296,12 @@ class SlotScheduler:
         ``deadline_s``: total wall-clock budget from submit; a request
         still queued/decoding past it is retired with status
         ``deadline_exceeded`` at the next tick instead of decoding
-        forever."""
+        forever.
+
+        ``tenant`` attributes the request for accounting/fair-share;
+        ``adapter_id`` selects a registered LoRA adapter (requires the
+        scheduler's ``adapters`` table; the id must be registered —
+        unknown ids fail HERE, not mid-flight)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = prompt.size
         if plen < 1:
@@ -252,6 +311,14 @@ class SlotScheduler:
                 f"max_new_tokens must be >= 1; got {max_new_tokens}")
         if deadline_s is not None and deadline_s < 0:
             raise ValueError(f"deadline_s must be >= 0; got {deadline_s}")
+        if adapter_id is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    "adapter_id requires an engine built with an adapter "
+                    "table (adapter_capacity > 0)")
+            if not self.adapters.known(adapter_id):
+                raise KeyError(f"unknown adapter_id {adapter_id!r}; "
+                               "load_adapter() it first")
         padded = -(-plen // self.prefill_chunk) * self.prefill_chunk
         if plen + max_new_tokens > self.max_len or padded > self.max_len:
             raise ValueError(
@@ -262,9 +329,14 @@ class SlotScheduler:
                       max_new_tokens=int(max_new_tokens),
                       on_token=on_token, submit_time=now,
                       deadline=None if deadline_s is None
-                      else now + deadline_s)
+                      else now + deadline_s,
+                      tenant=str(tenant), adapter_id=adapter_id)
         self._next_rid += 1
         self._queue.append(req)
+        self._tenant_inflight[req.tenant] = \
+            self._tenant_inflight.get(req.tenant, 0) + 1
+        self._tenant_tokens[req.tenant] = \
+            self._tenant_tokens.get(req.tenant, 0) + req.max_new_tokens
         self.metrics.submitted(req)
         self._report_depth()
         return req
@@ -282,6 +354,25 @@ class SlotScheduler:
         ``max_queue_depth`` admission-control signal)."""
         return len(self._queue)
 
+    def stats(self) -> EngineStats:
+        """The load snapshot (``EngineStats``): queue depth, prefill and
+        slot occupancy, per-tenant in-flight counts.  Cheap host-side
+        reads — the router polls this per placement and the serve gauges
+        render from it, so there is exactly ONE bookkeeping source."""
+        return EngineStats(
+            queued=len(self._queue),
+            prefilling=len(self._prefills),
+            active=sum(r is not None for r in self._slots),
+            num_slots=self.num_slots,
+            inflight_per_tenant=dict(self._tenant_inflight),
+            tokens_inflight_per_tenant=dict(self._tenant_tokens))
+
+    def tenant_inflight(self, tenant: str) -> int:
+        return self._tenant_inflight.get(tenant, 0)
+
+    def tenant_tokens_inflight(self, tenant: str) -> int:
+        return self._tenant_tokens.get(tenant, 0)
+
     def step(self) -> bool:
         """One tick: retire expired deadlines, advance every in-flight
         prefill by one window (starting new prefills for free slots
@@ -290,9 +381,17 @@ class SlotScheduler:
         did = False
         self._expire_deadlines()
         free = sum(r is None for r in self._slots)
-        while self._queue and len(self._prefills) < free:
-            self._prefills.append(self._begin_prefill(
-                self._queue.popleft()))
+        while len(self._queue) and len(self._prefills) < free:
+            req = self._queue.popleft()
+            try:
+                st = self._begin_prefill(req)
+            except AdapterTableFull:
+                # every adapter row is pinned by an in-flight request:
+                # leave the request queued (a retirement frees a pin,
+                # so this always drains) and stop admitting this tick
+                self._requeue(req)
+                break
+            self._prefills.append(st)
         if self._prefills:
             did = True
             self._prefills = [st for st in self._prefills
@@ -301,6 +400,14 @@ class SlotScheduler:
             did = True
             self._decode_tick()
         return did
+
+    def _requeue(self, req: Request) -> None:
+        """Put a popped-but-unstartable request back at the FRONT of its
+        queue position (fair-share queues refund the deficit charge)."""
+        if hasattr(self._queue, "requeue"):
+            self._queue.requeue(req)
+        else:
+            self._queue.appendleft(req)
 
     def drain(self) -> None:
         """Pump until every queued/in-flight request has finished."""
@@ -316,29 +423,49 @@ class SlotScheduler:
         padded = np.zeros((n_win * w,), np.int32)
         padded[:plen] = req.prompt
         windows = padded.reshape(n_win, 1, w)
+        if self.adapters is not None:
+            # pin the adapter BEFORE touching the cache pool: acquire
+            # may raise AdapterTableFull and the request must requeue
+            # with nothing to unwind
+            req.adapter_row = self.adapters.acquire(req.adapter_id)
         kv = (self._pf_pool.pop() if self._pf_pool
               else slots_lib.strip_pos(self.model.init_cache(
                   1, self.max_len)))
         return [req, windows, 0, dict(kv, pos=np.int32(0))]
 
+    def _adapter_args(self, req: Optional[Request] = None):
+        """(table arrays, rows) for the executables — (None, None) when
+        adapters are off, so the compiled programs are identical to an
+        adapter-free build."""
+        if self.adapters is None:
+            return None, None
+        if req is not None:   # batch-1 prefill window for one request
+            return self.adapters.arrays, np.asarray([req.adapter_row],
+                                                    np.int32)
+        return self.adapters.arrays, self._adapter_rows
+
     def _advance_prefill(self, st: list) -> bool:
         """One window for one in-flight prefill; True when the request
         left the prefill phase (admitted or finished)."""
         req, windows, i, cache = st
+        ad, ad_row = self._adapter_args(req)
         if i < len(windows) - 1:
-            st[3] = self._win_mid(self.params, cache, windows[i])
+            st[3] = self._win_mid(self.params, cache, windows[i],
+                                  ad, ad_row)
             st[2] = i + 1
             return False
         plen = req.prompt.size
         last_idx = np.int32(plen - 1 - (len(windows) - 1)
                             * self.prefill_chunk)
         slot = self._slots.index(None)
+        if self._adapter_rows is not None:
+            self._adapter_rows[slot] = req.adapter_row
         tok, self._cache, self._tokens, self._finished, \
             self._remaining, self._key = self._last_admit(
                 self.params, cache, windows[-1], last_idx, self._key,
                 self._cache, self._tokens, self._finished,
                 self._remaining, np.int32(slot), np.int32(plen),
-                np.int32(req.max_new_tokens))
+                np.int32(req.max_new_tokens), ad, ad_row)
         first = int(tok)          # host fetch: the TTFT barrier
         req.first_token_time = time.perf_counter()
         # the pool entry was not donated — reusable for the next request
@@ -366,10 +493,11 @@ class SlotScheduler:
     # ----------------------------------------------------------- decode
 
     def _decode_tick(self) -> None:
+        ad, ad_rows = self._adapter_args()
         (self._cache, self._tokens, self._finished, self._remaining,
          self._key), em, mask = self._tick(
             self.params, self._cache, self._tokens, self._finished,
-            self._remaining, self._key)
+            self._remaining, self._key, ad, ad_rows)
         em = np.asarray(em)                      # [K, S]
         mask = np.asarray(mask)
         fin = np.asarray(self._finished)
@@ -406,14 +534,9 @@ class SlotScheduler:
             return req is not None and req.deadline is not None \
                 and now > req.deadline
 
-        if any(expired(r) for r in self._queue):
-            keep: collections.deque = collections.deque()
-            for req in self._queue:
-                if expired(req):
-                    self._abort(req, "deadline_exceeded")
-                else:
-                    keep.append(req)
-            self._queue = keep
+        for req in [r for r in self._queue if expired(r)]:
+            self._queue.remove(req)
+            self._abort(req, "deadline_exceeded")
         still = []
         for st in self._prefills:
             if expired(st[0]):
@@ -459,9 +582,32 @@ class SlotScheduler:
         if req.on_token is not None:
             req.on_token(toks)
 
+    def _retire_accounting(self, req: Request) -> None:
+        """Shared terminal bookkeeping: per-tenant in-flight counters
+        come down, the adapter pin (if any) is released, and a fair-
+        share queue is told the request left the system."""
+        t = req.tenant
+        n = self._tenant_inflight.get(t, 0) - 1
+        if n > 0:
+            self._tenant_inflight[t] = n
+        else:
+            self._tenant_inflight.pop(t, None)
+        k = self._tenant_tokens.get(t, 0) - req.max_new_tokens
+        if k > 0:
+            self._tenant_tokens[t] = k
+        else:
+            self._tenant_tokens.pop(t, None)
+        if req.adapter_row is not None and self.adapters is not None:
+            self.adapters.release(req.adapter_id)
+            req.adapter_row = None
+        release = getattr(self._queue, "release", None)
+        if release is not None:
+            release(req)
+
     def _finish(self, req: Request) -> None:
         req.status = "ok"
         req.finish_time = time.perf_counter()
+        self._retire_accounting(req)
         self.metrics.finished(req)
         req.done.set()
 
@@ -470,9 +616,9 @@ class SlotScheduler:
         req.status = status
         req.error = error
         req.finish_time = time.perf_counter()
+        self._retire_accounting(req)
         self.metrics.aborted(req, status)
         req.done.set()
 
     def _report_depth(self) -> None:
-        self.metrics.depth(len(self._queue),
-                           sum(r is not None for r in self._slots))
+        self.metrics.depth(self.stats())

@@ -105,7 +105,8 @@ def insert_slot(cache, slot_idx, prefilled, length, pad_len=0):
     }
 
 
-def decode_slots_step(model, params, cache, tokens, live):
+def decode_slots_step(model, params, cache, tokens, live,
+                      adapters=None, adapter_rows=None):
     """One decode step for every slot -> (logits [S, vocab], new cache).
 
     ``tokens`` [S]: each live slot's input token (its previously emitted
@@ -115,10 +116,15 @@ def decode_slots_step(model, params, cache, tokens, live):
     outside every validity window and is fully overwritten by the next
     ``insert_slot``.  Row independence makes live rows' logits
     bit-identical whatever the dead rows hold.
+
+    ``adapters``/``adapter_rows`` [S]: per-slot LoRA deltas from a
+    stacked adapter table (``GPT.decode_step_slots``) — None keeps the
+    compiled program identical to an adapter-free build.
     """
     logits, kv = model.decode_step_slots(
         params, cache["kv"], tokens, cache["write_col"],
-        slot_kv_valid(cache), cache["positions"])
+        slot_kv_valid(cache), cache["positions"],
+        adapters=adapters, adapter_rows=adapter_rows)
     live = live.astype(jnp.int32)
     return logits, {
         "kv": kv,

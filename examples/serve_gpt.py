@@ -47,6 +47,13 @@ flags_lib.DEFINE_bool("engine", False,
                       "the continuous-batching engine (serve/) — same "
                       "tokens/s lines, lock-step paths stay as the "
                       "baseline; serve metrics land on /metrics")
+flags_lib.DEFINE_integer("replicas", 1,
+                         ">= 2: also run a FLEET demo — that many "
+                         "engine replicas behind the fleet Router "
+                         "(least-loaded placement, per-tenant "
+                         "fair-share, a hot-swapped LoRA adapter), "
+                         "with the dttpu_router_*/dttpu_tenant_* "
+                         "gauges live on /metrics")
 FLAGS = flags_lib.FLAGS
 
 
@@ -206,6 +213,52 @@ def main() -> int:
         # unpadded rows the lock-step path had to left-pad
         ragged_rows = [ragged_prompt[0, plen // 2:]] + list(prompt[1:])
         timed_engine("engine ragged", eng, ragged_rows, b * new)
+
+    if FLAGS.replicas >= 2:
+        # Fleet demo (fleet/): N engine replicas behind one Router —
+        # least-loaded placement off Engine.stats(), two tenants under
+        # a deficit-weighted fair-share policy, and tenant "pro"
+        # decoding under a hot-swapped LoRA adapter.  Greedy traffic
+        # with adapter_id=None must still match the lock-step greedy
+        # output (the fleet inherits the engine exactness contract).
+        from distributed_tensorflow_tpu import fleet, serve
+
+        reg = telemetry.registry if telemetry is not None else None
+        policy = fleet.TenantPolicy(quantum=8)
+        router = fleet.Router(
+            [serve.Engine(model, params, num_slots=b, max_len=max_len,
+                          prefill_chunk=4, tick_steps=4, registry=reg,
+                          tenancy=policy, adapter_capacity=2,
+                          adapter_rank=4)
+             for _ in range(FLAGS.replicas)],
+            registry=reg)
+        router.load_adapter(
+            "pro-tuned", model.init_lora(jax.random.PRNGKey(11), rank=4))
+
+        def fleet_round():
+            handles = []
+            for i, p in enumerate(prompt):
+                tenant = "pro" if i % 2 else "free"
+                handles.append(router.submit(
+                    p, new, tenant=tenant,
+                    adapter_id="pro-tuned" if tenant == "pro" else None))
+            router.drain()
+            return handles
+
+        fleet_round()                          # warmup: compiles all
+        t0 = time.perf_counter()
+        hs = fleet_round()
+        dt = time.perf_counter() - t0
+        print(f"{'fleet (%d replicas)' % FLAGS.replicas:<28} "
+              f"{b * new / dt:10,.0f} tok/s", flush=True)
+        base_rows = [i for i in range(b) if i % 2 == 0]
+        agree_fleet = float(np.mean([
+            hs[i].tokens == np.asarray(greedy)[i, plen:].tolist()
+            for i in base_rows]))
+        spread = {r: sum(1 for _, rid in router.placements if rid == r)
+                  for r in router.replica_ids}
+        print(f"{'':<28} fleet==lock-step greedy {agree_fleet:.3f} "
+              f"(base-model rows), placements {spread}", flush=True)
 
     draft = GPT(dataclasses.replace(config, num_layers=2))
     d_params = dict(params)

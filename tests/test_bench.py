@@ -543,3 +543,32 @@ class TestGptLong:
         # the acceptance bar: strictly better than lock-step batching
         # on the mixed-length trace (CPU smoke margin is ~1.2-1.4x)
         assert r["vs_lockstep"] > 1.0
+
+    def test_fleet_smoke_schema(self):
+        """Fleet row: the adversarial three-tenant block burst routed
+        over 2 CPU replicas under the deficit fair-share policy with a
+        LoRA adapter on one tenant's traffic.  The JSON carries fleet
+        tokens/s, per-tenant TTFT p50/p95, and fairness_ratio — the
+        weight-normalized admitted-token min/max over the contended
+        window, where plain FIFO on this trace measures 0.0.  Placement,
+        failover, and adapter swaps must never recompile: zero
+        retrace_warnings."""
+        proc = _run(["--config=fleet", "--device=cpu"],
+                    _env(DTTPU_BENCH_SEQ=128))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1
+        r = json.loads(lines[0])
+        assert r["metric"] == "fleet_tokens_per_sec"
+        assert r["tokens_per_sec"] > 0
+        assert r["replicas"] == 2
+        for tenant in ("free", "pro", "batch"):
+            p50 = r["tenant_ttft_p50_ms"][tenant]
+            p95 = r["tenant_ttft_p95_ms"][tenant]
+            assert 0 < p50 <= p95
+        assert 0 < r["ttft_p50_ms"] <= r["ttft_p95_ms"]
+        assert r.get("retrace_warnings", 0) == 0
+        # the fair-share bar: the deficit queue must interleave the
+        # per-tenant blocks FIFO would serialize (FIFO scores 0.0; the
+        # CPU smoke converges well above half)
+        assert r["fairness_ratio"] > 0.5

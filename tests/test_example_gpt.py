@@ -66,6 +66,28 @@ def test_serve_gpt_demo_smoke():
     assert match and float(match[0].split()[-1]) > 0.9
 
 
+def test_serve_gpt_fleet_demo_smoke():
+    """--engine --replicas=2 adds the fleet demo: two engine replicas
+    behind the Router, tenant fair-share, a hot-swapped LoRA adapter on
+    the "pro" tenant — the fleet line must print with base-model rows
+    token-identical to lock-step greedy and placements spread over both
+    replicas."""
+    proc = _run(["examples/serve_gpt.py", "--device=cpu",
+                 "--new_tokens=8", "--batch=2", "--engine",
+                 "--replicas=2"])
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    assert "fleet (2 replicas)" in proc.stdout, proc.stdout
+    eng = [l for l in proc.stdout.splitlines()
+           if "engine==lock-step greedy" in l]
+    assert eng and float(eng[0].split()[-1]) == 1.0
+    fl = [l for l in proc.stdout.splitlines()
+          if "fleet==lock-step greedy" in l]
+    assert fl and float(fl[0].split()[2]) == 1.0
+    # placement spread printed as {0: n, 1: m}: both replicas used
+    assert "placements {0:" in fl[0] and "1:" in fl[0]
+
+
 def test_finetune_bert_mlm_gather_smoke():
     """MLM warm-up with the masked-position gather + fused-LN/remat flags
     through examples/finetune_bert.py (the fit-level lever surface)."""

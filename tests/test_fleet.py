@@ -1,0 +1,511 @@
+"""Fleet serving tests: router placement/failover, tenancy quotas +
+fair-share, LoRA adapter hot-swap exactness.
+
+The contracts pinned here (docs/SERVING.md §Fleet):
+  * router placement is least-loaded AND deterministic — a replayed
+    trace reproduces ``router.placements`` exactly,
+  * quota rejection is EXACT (the (N - quota) overflow submits raise,
+    nothing else), and rejected tenants recover after their backlog
+    drains,
+  * deficit-weighted fair-share interleaves an adversarial per-tenant
+    block burst so the last block is not starved (plain FIFO admits it
+    dead last),
+  * ``kill_replica`` chaos: every non-expired request completes on a
+    survivor, and every completed stream is token-identical to solo
+    ``generate`` (survivors bit-exact, reroutes restart cleanly),
+  * per-request LoRA adapters match ``generate`` on the MERGED weights
+    token-for-token while a base-model request shares the same tick,
+    ``adapter_id=None`` stays token-identical to an adapter-free
+    engine, and adapter load/evict/swap never recompiles
+    (retrace_guard budget=1).
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu import fleet, serve
+from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.resilience import faults
+
+
+def _model_params(seed=0, **kw):
+    model = gpt_tiny(dropout_rate=0.0, **kw)
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+def _prompt(plen, seed=1, vocab=512):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (plen,), 0, vocab), np.int32)
+
+
+def _generate_tokens(model, params, prompt, new, max_len, **kw):
+    out = model.generate(params, jnp.asarray(prompt[None]),
+                         max_new_tokens=new, max_len=max_len, **kw)
+    return np.asarray(out)[0, prompt.size:].tolist()
+
+
+def _engine(model, params, reg=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("tick_steps", 2)
+    return serve.Engine(model, params,
+                        registry=reg or metrics_lib.Registry(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# engine stats (the router's placement signal)
+
+
+def test_engine_stats_snapshot_tracks_lifecycle():
+    model, params = _model_params()
+    eng = _engine(model, params, num_slots=2)
+    s = eng.stats()
+    assert (s.queued, s.prefilling, s.active, s.inflight) == (0, 0, 0, 0)
+    assert s.num_slots == 2 and s.free_slots == 2
+    # multi-window prompts (plen 10, chunk 4 -> 3 windows) so one step
+    # leaves the started prefills observable mid-phase
+    handles = [eng.submit(_prompt(10, seed=i), 6, tenant="t")
+               for i in range(3)]
+    s = eng.stats()
+    assert s.inflight == 3 and s.queued == 3
+    assert s.inflight_per_tenant == {"t": 3}
+    assert s.tokens_inflight_per_tenant == {"t": 18}
+    eng.step()                          # prefills started
+    s = eng.stats()
+    assert s.prefilling == 2 and s.queued == 1 and s.inflight == 3
+    eng.drain()
+    s = eng.stats()
+    assert s.inflight == 0 and s.inflight_per_tenant == {}
+    assert all(h.status == "ok" for h in handles)
+
+
+# ---------------------------------------------------------------------------
+# tenancy: quotas
+
+
+def test_quota_rejection_exactness():
+    """max_inflight=2: of 5 submits exactly the 3 overflow ones raise,
+    the tenant recovers after its backlog drains, and other tenants are
+    never touched."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    policy = fleet.TenantPolicy(
+        {"a": fleet.TenantQuota(max_inflight=2)})
+    eng = _engine(model, params, reg=reg, tenancy=policy)
+    ok, rejected = [], 0
+    for i in range(5):
+        try:
+            ok.append(eng.submit(_prompt(4, seed=i), 4, tenant="a"))
+        except fleet.QuotaExceededError:
+            rejected += 1
+    assert len(ok) == 2 and rejected == 3
+    # an unlisted tenant gets the (uncapped) default quota
+    other = eng.submit(_prompt(4, seed=9), 4, tenant="b")
+    assert reg.get("dttpu_tenant_rejected_total",
+                   labels={"tenant": "a"}).value == 3
+    eng.drain()
+    assert all(h.status == "ok" for h in ok) and other.status == "ok"
+    h = eng.submit(_prompt(4, seed=7), 4, tenant="a")   # recovered
+    eng.drain()
+    assert h.status == "ok"
+
+
+def test_token_budget_quota_boundary_exact():
+    model, params = _model_params()
+    policy = fleet.TenantPolicy(
+        {"a": fleet.TenantQuota(max_tokens_inflight=10)})
+    eng = _engine(model, params, tenancy=policy)
+    eng.submit(_prompt(4, seed=1), 6, tenant="a")       # 6 in flight
+    with pytest.raises(fleet.QuotaExceededError):
+        eng.submit(_prompt(4, seed=2), 5, tenant="a")   # 11 > 10
+    eng.submit(_prompt(4, seed=3), 4, tenant="a")       # exactly 10
+    eng.drain()
+
+
+# ---------------------------------------------------------------------------
+# tenancy: deficit-weighted fair-share
+
+
+@dataclasses.dataclass
+class _Req:
+    tenant: str
+    max_new_tokens: int
+
+
+def test_deficit_fair_queue_token_weighted_interleave():
+    """Unit-level DRR: tenant A's many cheap requests cannot monopolize
+    the ring — over any admission prefix the cumulative TOKEN budgets
+    stay within one quantum + max cost of each other."""
+    policy = fleet.TenantPolicy(quantum=4)
+    q = policy.make_queue()
+    for _ in range(12):
+        q.append(_Req("a", 2))          # block of cheap requests first
+    for _ in range(6):
+        q.append(_Req("b", 4))
+    served = {"a": 0, "b": 0}
+    bound = policy.quantum + 4          # quantum + max request cost
+    while len(q):
+        r = q.popleft()
+        served[r.tenant] += r.max_new_tokens
+        if min(served.values()) < 24 - bound:   # both still backlogged
+            assert abs(served["a"] - served["b"]) <= bound, served
+    assert served == {"a": 24, "b": 24}
+
+
+def test_deficit_fair_queue_weights_shift_share():
+    """weight=2 sustains twice the token share of weight=1 while both
+    are backlogged."""
+    policy = fleet.TenantPolicy(
+        {"heavy": fleet.TenantQuota(weight=2.0)}, quantum=3)
+    q = policy.make_queue()
+    for _ in range(40):
+        q.append(_Req("heavy", 3))
+    for _ in range(40):
+        q.append(_Req("light", 3))
+    heavy = light = 0
+    for _ in range(30):                 # both deeply backlogged
+        r = q.popleft()
+        if r.tenant == "heavy":
+            heavy += r.max_new_tokens
+        else:
+            light += r.max_new_tokens
+    assert heavy / light == pytest.approx(2.0, rel=0.35)
+
+
+def test_fair_share_convergence_on_skewed_block_trace():
+    """End-to-end: an adversarial per-tenant block burst (all of A, then
+    all of B) through one engine.  FIFO would admit every A before any
+    B; the fair queue interleaves them — B's first admission lands
+    within the first few, and the admitted token budgets at the end of
+    the contended window are within one quantum+cost of equal."""
+    model, params = _model_params()
+    policy = fleet.TenantPolicy(quantum=4)
+    eng = _engine(model, params, num_slots=2, max_len=64,
+                  tenancy=policy)
+    handles = []
+    for i in range(10):                             # A: 10 x 2 tokens
+        handles.append(("a", 2, eng.submit(_prompt(3, seed=i), 2,
+                                           tenant="a")))
+    for i in range(5):                              # B: 5 x 4 tokens
+        handles.append(("b", 4, eng.submit(_prompt(3, seed=20 + i), 4,
+                                           tenant="b")))
+    eng.drain()
+    assert all(h.status == "ok" for _, _, h in handles)
+    order = sorted(handles, key=lambda r: r[2].ttft_s)
+    # B is not starved behind A's block: it appears among the first 3
+    assert "b" in [t for t, _, _ in order[:3]]
+    admitted = {"a": 0, "b": 0}
+    remaining = {"a": 10, "b": 5}
+    for tenant, budget, _ in order:
+        admitted[tenant] += budget
+        remaining[tenant] -= 1
+        if remaining[tenant] == 0:
+            break
+    assert abs(admitted["a"] - admitted["b"]) <= policy.quantum + 4, \
+        admitted
+
+
+# ---------------------------------------------------------------------------
+# router: placement, retry, rolling restarts
+
+
+def _fleet(model, params, n=2, reg=None, **eng_kw):
+    reg = reg or metrics_lib.Registry()
+    router = fleet.Router(
+        [_engine(model, params, reg=reg, **eng_kw) for _ in range(n)],
+        registry=reg)
+    return router, reg
+
+
+def test_router_least_loaded_and_deterministic_replay():
+    """Placement spreads by load (ties by replica id) and an identical
+    replayed trace reproduces the placements list exactly."""
+    model, params = _model_params()
+
+    def run():
+        router, _ = _fleet(model, params, n=2)
+        hs = []
+        for i in range(6):
+            hs.append(router.submit(_prompt(4 + i % 3, seed=i), 5))
+            if i % 2:
+                router.step()
+        router.drain()
+        assert all(h.status == "ok" for h in hs)
+        return router.placements
+
+    first = run()
+    assert first[:2] == [(0, 0), (1, 1)]        # idle tie -> id order
+    assert first == run()                       # deterministic replay
+
+
+def test_router_outputs_match_solo_generate():
+    model, params = _model_params()
+    router, _ = _fleet(model, params, n=2)
+    prompts = [_prompt(3 + i % 4, seed=i) for i in range(8)]
+    hs = [router.submit(p, 6) for p in prompts]
+    router.drain()
+    for p, h in zip(prompts, hs):
+        assert h.status == "ok"
+        assert h.tokens == _generate_tokens(model, params, p, 6, 32)
+
+
+def test_router_retries_rejected_submit_on_other_replica():
+    """The least-loaded replica's queue is full -> the submit probes the
+    next one and lands there; with EVERY queue full the rejection
+    reaches the caller."""
+    model, params = _model_params()
+    router, _ = _fleet(model, params, n=2, num_slots=1,
+                       max_queue_depth=1)
+    hs = [router.submit(_prompt(4, seed=i), 4) for i in range(2)]
+    # admit replica 1's request into its slot: r0 queued=1 (queue FULL),
+    # r1 active=1 (queue empty) — equal inflight, so the tie sends the
+    # next submit to r0 first, which must reject toward r1
+    router.replica(1).step()
+    hs.append(router.submit(_prompt(4, seed=2), 4))
+    assert hs[-1].replica_id == 1
+    assert {rid for _, rid in router.placements} == {0, 1}
+    with pytest.raises(serve.QueueFullError):   # now BOTH queues full
+        router.submit(_prompt(4, seed=9), 4)
+    router.drain()
+    assert all(h.status == "ok" for h in hs)
+
+
+def test_router_retries_failed_request():
+    """A request whose callback poisons its FIRST attempt is retried on
+    a live replica and completes; the terminal tokens are one clean
+    run's."""
+    model, params = _model_params()
+    router, reg = _fleet(model, params, n=2)
+    prompt = _prompt(5, seed=3)
+    want = _generate_tokens(model, params, prompt, 6, 32)
+    fails = [1]
+
+    def flaky(toks):
+        if fails[0]:
+            fails[0] -= 1
+            raise RuntimeError("transient consumer failure")
+
+    h = router.submit(prompt, 6, on_token=flaky)
+    router.drain()
+    assert h.status == "ok" and h.attempts == 2
+    assert h.tokens == want
+    assert reg.get("dttpu_router_retries_total").value == 1
+
+
+def test_drain_replica_stops_new_traffic_then_empties():
+    model, params = _model_params()
+    router, _ = _fleet(model, params, n=2)
+    hs = [router.submit(_prompt(4, seed=i), 8) for i in range(4)]
+    assert router.drain_replica(0, timeout_s=60) is True
+    # new traffic only lands on replica 1
+    h = router.submit(_prompt(4, seed=9), 4)
+    assert h.replica_id == 1
+    router.drain()
+    assert all(x.status == "ok" for x in hs + [h])
+
+
+def test_remove_replica_reroutes_in_flight():
+    model, params = _model_params()
+    router, _ = _fleet(model, params, n=2)
+    prompts = [_prompt(4, seed=i) for i in range(4)]
+    hs = [router.submit(p, 10) for p in prompts]
+    router.step()                               # some work in flight
+    removed = router.remove_replica(1)
+    assert removed is not None and router.replica_ids == (0,)
+    router.drain()
+    for p, h in zip(prompts, hs):
+        assert h.status == "ok"
+        assert h.tokens == _generate_tokens(model, params, p, 10, 32)
+    rid = router.add_replica(removed)            # rolling restart: back in
+    h2 = router.submit(_prompt(4, seed=9), 4)
+    router.drain()
+    assert h2.status == "ok" and rid in router.replica_ids
+
+
+def test_submit_with_no_replicas_raises():
+    router = fleet.Router(registry=metrics_lib.Registry())
+    with pytest.raises(fleet.NoReplicaError):
+        router.submit(_prompt(4), 4)
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill a replica mid-traffic
+
+
+@pytest.mark.chaos
+def test_kill_replica_survivors_absorb_load():
+    """THE fleet chaos acceptance (ROADMAP item 2): kill one replica
+    mid-traffic; every non-expired request completes on a survivor and
+    every completed stream is token-identical to solo generate —
+    survivors bit-exact (their engine never saw the failure), rerouted
+    requests restarted cleanly."""
+    model, params = _model_params()
+    router, reg = _fleet(model, params, n=2, num_slots=2, max_len=64)
+    prompts = [_prompt(3 + i % 4, seed=i) for i in range(8)]
+    wants = [_generate_tokens(model, params, p, 8, 64) for p in prompts]
+    plan = faults.FaultPlan([{"kind": "kill_replica", "at": 2,
+                              "replica": 1}],
+                            registry=metrics_lib.Registry())
+    with faults.activated(plan):
+        hs = [router.submit(p, 8, deadline_s=120.0) for p in prompts]
+        router.step()                       # traffic in flight on both
+        assert router.drain(timeout_s=120)
+    assert plan.log == [{"kind": "kill_replica", "at": 2, "replica": 1,
+                         "step": 2}]
+    assert router.replica_ids == (0,)
+    assert reg.get("dttpu_router_replica_down_total").value == 1
+    assert reg.get("dttpu_router_retries_total").value >= 1
+    for h, want in zip(hs, wants):
+        assert h.status == "ok", (h.status, h.error)
+        assert h.tokens == want
+
+
+@pytest.mark.chaos
+def test_kill_last_replica_fails_loudly():
+    """With no survivor left, in-flight requests fail with the replica
+    error instead of hanging forever."""
+    model, params = _model_params()
+    router, _ = _fleet(model, params, n=1)
+    plan = faults.FaultPlan([{"kind": "kill_replica", "at": 0,
+                              "replica": 0}],
+                            registry=metrics_lib.Registry())
+    with faults.activated(plan):
+        h = router.submit(_prompt(4, seed=1), 6)
+        router.drain(timeout_s=30)
+    assert h.status == "failed"
+    assert isinstance(h.error, ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# LoRA adapter hot-swap
+
+
+def _nonzero_adapter(model, seed, rank=4, scale=0.3):
+    ad = model.init_lora(jax.random.PRNGKey(seed), rank=rank)
+    for t in model._LORA_TARGETS:
+        ad[t]["b"] = scale * jax.random.normal(
+            jax.random.PRNGKey(seed + 1), ad[t]["b"].shape)
+    return ad
+
+
+def test_lora_request_matches_merged_generate():
+    """A request under an adapter equals greedy generate on the MERGED
+    weights token-for-token, while a base request (adapter_id=None)
+    sharing the same ticks equals the plain generate — one executable,
+    two effective models."""
+    model, params = _model_params()
+    ad = _nonzero_adapter(model, seed=5)
+    merged = model.merge_lora(params, ad)
+    p_a, p_b = _prompt(6, seed=1), _prompt(5, seed=2)
+    want_adapter = _generate_tokens(model, merged, p_a, 8, 32)
+    want_base = _generate_tokens(model, params, p_b, 8, 32)
+    assert want_adapter != _generate_tokens(model, params, p_a, 8, 32), \
+        "adapter too weak to distinguish outputs — test is vacuous"
+    eng = _engine(model, params, num_slots=2, adapter_capacity=2,
+                  adapter_rank=4)
+    eng.load_adapter("tuned", ad)
+    h_a = eng.submit(p_a, 8, adapter_id="tuned")
+    h_b = eng.submit(p_b, 8)                      # base model, same ticks
+    eng.drain()
+    assert h_a.tokens == want_adapter
+    assert h_b.tokens == want_base
+
+
+def test_lora_none_token_identical_to_adapter_free_engine():
+    """adapter_id=None through an adapter-ENABLED engine must be
+    token-identical to an engine built with no adapter table at all."""
+    model, params = _model_params()
+    prompts = [_prompt(4 + i, seed=i) for i in range(3)]
+    plain = _engine(model, params)
+    with_table = _engine(model, params, adapter_capacity=2,
+                         adapter_rank=4)
+    a = [plain.submit(p, 7) for p in prompts]
+    b = [with_table.submit(p, 7) for p in prompts]
+    plain.drain()
+    with_table.drain()
+    for ha, hb in zip(a, b):
+        assert ha.tokens == hb.tokens
+
+
+@pytest.mark.retrace_guard(budget=1, enforce_donation=True)
+def test_adapter_swap_never_recompiles():
+    """Hot-swapping adapters — load, use, evict, reload, mixed with
+    base traffic — never retraces any engine executable (budget=1: the
+    second trace of anything fails)."""
+    model, params = _model_params()
+    eng = _engine(model, params, num_slots=2, max_len=64,
+                  adapter_capacity=2, adapter_rank=4)
+    for i, name in enumerate(("a", "b", "c")):      # 3 ids, 2 rows
+        eng.load_adapter(name, _nonzero_adapter(model, seed=10 + i))
+    rng = np.random.default_rng(0)
+    handles = []
+    for i, ad in enumerate([None, "a", "b", "a", "c", None, "b", "c"]):
+        plen = int(rng.integers(2, 9))
+        prompt = rng.integers(0, 512, plen).astype(np.int32)
+        handles.append(eng.submit(prompt, int(rng.integers(2, 8)),
+                                  adapter_id=ad))
+        eng.step()
+    eng.drain()
+    assert all(h.status == "ok" for h in handles)
+
+
+def test_adapter_validation_and_capacity():
+    model, params = _model_params()
+    eng = _engine(model, params)
+    with pytest.raises(ValueError, match="adapter"):
+        eng.submit(_prompt(4), 4, adapter_id="nope")   # no table at all
+    eng2 = _engine(model, params, adapter_capacity=1, adapter_rank=4)
+    with pytest.raises(KeyError, match="unknown adapter"):
+        eng2.submit(_prompt(4), 4, adapter_id="nope")  # not registered
+    with pytest.raises(ValueError, match="shapes"):
+        eng2.load_adapter("bad", _nonzero_adapter(model, seed=1,
+                                                  rank=2))
+
+
+def test_adapter_capacity_pressure_requeues_and_drains():
+    """capacity=1 with TWO distinct adapters wanted concurrently: the
+    second waits queued (AdapterTableFull is transient) and both
+    complete exactly once a pin frees."""
+    model, params = _model_params()
+    eng = _engine(model, params, num_slots=2, adapter_capacity=1,
+                  adapter_rank=4)
+    ad1 = _nonzero_adapter(model, seed=3)
+    ad2 = _nonzero_adapter(model, seed=7)
+    eng.load_adapter("one", ad1)
+    eng.load_adapter("two", ad2)
+    p1, p2 = _prompt(4, seed=1), _prompt(5, seed=2)
+    want1 = _generate_tokens(model, model.merge_lora(params, ad1),
+                             p1, 6, 32)
+    want2 = _generate_tokens(model, model.merge_lora(params, ad2),
+                             p2, 6, 32)
+    h1 = eng.submit(p1, 6, adapter_id="one")
+    h2 = eng.submit(p2, 6, adapter_id="two")
+    eng.drain()
+    assert h1.tokens == want1
+    assert h2.tokens == want2
+    table = eng.adapters
+    assert table.resident_ids == ("two",)       # "one" evicted for "two"
+
+
+def test_router_broadcasts_adapters_to_all_replicas():
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    router = fleet.Router(
+        [_engine(model, params, reg=reg, adapter_capacity=2,
+                 adapter_rank=4) for _ in range(2)],
+        registry=reg)
+    ad = _nonzero_adapter(model, seed=4)
+    router.load_adapter("tuned", ad)
+    merged = model.merge_lora(params, ad)
+    prompts = [_prompt(4 + i, seed=i) for i in range(4)]
+    hs = [router.submit(p, 6, adapter_id="tuned") for p in prompts]
+    router.drain()
+    assert {rid for _, rid in router.placements} == {0, 1}
+    for p, h in zip(prompts, hs):
+        assert h.tokens == _generate_tokens(model, merged, p, 6, 32)
