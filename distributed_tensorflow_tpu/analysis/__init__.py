@@ -43,9 +43,13 @@ from .baseline import load_baseline, partition, write_baseline
 from .callgraph import FunctionInfo, Project, module_name_for
 from .cli import (analyze_file, analyze_paths, collect_files,
                   full_rule_catalog, main)
+from .concurrency import (CONCURRENCY_RULES, ConcurrencyModel,
+                          concurrency_rule_catalog,
+                          run_concurrency_rules)
 from .dataflow import ProjectDataflow
 from .project_rules import (PROJECT_RULES, project_rule_catalog,
                             run_project_rules)
+from .race_harness import RaceHarness
 from .report import (Finding, Severity, render_github, render_json,
                      render_text)
 from .rules import RULES, run_rules
@@ -55,12 +59,14 @@ from .walker import Source, SourceError
 rule_catalog = full_rule_catalog
 
 __all__ = [
-    "Finding", "FunctionInfo", "PROJECT_RULES", "Project",
-    "ProjectDataflow", "RULES", "RetraceBudgetExceeded", "RetraceGuard",
+    "CONCURRENCY_RULES", "ConcurrencyModel", "Finding", "FunctionInfo",
+    "PROJECT_RULES", "Project", "ProjectDataflow", "RULES",
+    "RaceHarness", "RetraceBudgetExceeded", "RetraceGuard",
     "Severity", "Source", "SourceError",
-    "analyze_file", "analyze_paths", "collect_files", "full_rule_catalog",
+    "analyze_file", "analyze_paths", "collect_files",
+    "concurrency_rule_catalog", "full_rule_catalog",
     "load_baseline", "main", "module_name_for", "partition",
     "project_rule_catalog", "render_github", "render_json", "render_text",
-    "retrace_guard", "rule_catalog", "run_project_rules", "run_rules",
-    "write_baseline",
+    "retrace_guard", "rule_catalog", "run_concurrency_rules",
+    "run_project_rules", "run_rules", "write_baseline",
 ]

@@ -9,11 +9,15 @@
       --ignore DT105           skip these rules
       --jobs N                 parallel per-file pass (0 = cpu count)
       --no-project             skip the interprocedural DT2xx pass
+      --no-concurrency         skip the host-concurrency DT3xx pass
+      --timings                print the per-tier timing breakdown to
+                               stderr (what scripts/lint.sh shows CI)
       --list-rules             print the rule catalog
 
-Two passes share one file walk: the per-module tier (DT1xx) runs file by
-file (parallelizable with ``--jobs``), then the interprocedural tier
-(DT2xx) runs once over the whole parsed project.
+Three passes share one file walk: the per-module tier (DT1xx) runs file
+by file (parallelizable with ``--jobs``), then the interprocedural tier
+(DT2xx) and the host-concurrency tier (DT3xx) each run once over the
+same parsed project.
 
 Exit status: 0 when no non-baselined findings, 1 when new findings exist,
 2 on usage/parse errors.
@@ -24,10 +28,12 @@ import argparse
 import functools
 import os
 import sys
+import time
 from typing import Dict, Iterable, List, Optional, Set
 
 from . import baseline as baseline_lib
 from .callgraph import Project, module_name_for
+from .concurrency import concurrency_rule_catalog, run_concurrency_rules
 from .context import mesh_axes_for
 from .project_rules import project_rule_catalog, run_project_rules
 from .report import Finding, render_github, render_json, render_text
@@ -58,7 +64,8 @@ def collect_files(paths: Iterable[str]) -> List[str]:
 
 
 def full_rule_catalog():
-    return _file_rule_catalog() + project_rule_catalog()
+    return (_file_rule_catalog() + project_rule_catalog()
+            + concurrency_rule_catalog())
 
 
 def _load_source(path: str) -> Source:
@@ -87,11 +94,19 @@ def _project_module(path: str) -> str:
 
 def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
                   ignore: Optional[Set[str]] = None, jobs: int = 1,
-                  project_pass: bool = True) -> List[Finding]:
+                  project_pass: bool = True,
+                  concurrency_pass: bool = True,
+                  timings: Optional[Dict[str, float]] = None
+                  ) -> List[Finding]:
+    """Run every enabled tier over one shared file walk.  ``timings``,
+    when given, is filled with per-tier wall-clock seconds (the
+    breakdown ``--timings``/scripts/lint.sh print for CI logs)."""
     files = collect_files(paths)
     findings: List[Finding] = []
     sources: Dict[str, Source] = {}
     packages: Set[str] = set()
+    t0 = time.perf_counter()
+    need_project = project_pass or concurrency_pass
 
     if jobs == 0:
         jobs = os.cpu_count() or 1
@@ -102,7 +117,7 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
         with cf.ProcessPoolExecutor(max_workers=jobs) as ex:
             for per_file in ex.map(worker, files):
                 findings.extend(per_file)
-        if project_pass:
+        if need_project:
             for path in files:
                 try:
                     src = _load_source(path)
@@ -123,12 +138,23 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
                 sources[mod] = src
                 if os.path.basename(path) == "__init__.py":
                     packages.add(mod)
+    t1 = time.perf_counter()
 
-    if project_pass and sources:
-        project = Project.from_sources(sources, packages)
+    project = (Project.from_sources(sources, packages)
+               if need_project and sources else None)
+    if project_pass and project is not None:
         axes = mesh_axes_for(files[0]) if files else ()
         findings.extend(run_project_rules(project, axes, select=select,
                                           ignore=ignore))
+    t2 = time.perf_counter()
+    if concurrency_pass and project is not None:
+        findings.extend(run_concurrency_rules(project, select=select,
+                                              ignore=ignore))
+    t3 = time.perf_counter()
+    if timings is not None:
+        timings.update({"files": len(files), "per_file_s": t1 - t0,
+                        "project_s": t2 - t1, "concurrency_s": t3 - t2,
+                        "total_s": t3 - t0})
     return findings
 
 
@@ -155,6 +181,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(0 = cpu count; the project pass stays serial)")
     ap.add_argument("--no-project", action="store_true",
                     help="skip the interprocedural DT2xx pass")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the host-concurrency DT3xx pass")
+    ap.add_argument("--timings", action="store_true",
+                    help="print the per-tier timing breakdown to stderr")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -164,14 +194,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     paths = args.paths or ["."]
+    timings: Dict[str, float] = {}
     try:
         findings = analyze_paths(paths, select=_rule_set(args.select),
                                  ignore=_rule_set(args.ignore),
                                  jobs=args.jobs,
-                                 project_pass=not args.no_project)
+                                 project_pass=not args.no_project,
+                                 concurrency_pass=not args.no_concurrency,
+                                 timings=timings)
     except (FileNotFoundError, SourceError) as e:
         print(f"dtlint: error: {e}", file=sys.stderr)
         return 2
+    if args.timings and timings:
+        print("dtlint: timings: "
+              f"{int(timings['files'])} files | "
+              f"per-file (DT1xx) {timings['per_file_s']:.2f}s | "
+              f"project (DT2xx) {timings['project_s']:.2f}s | "
+              f"concurrency (DT3xx) {timings['concurrency_s']:.2f}s | "
+              f"total {timings['total_s']:.2f}s", file=sys.stderr)
 
     if args.write_baseline:
         n = baseline_lib.write_baseline(args.write_baseline, findings)

@@ -1,0 +1,1090 @@
+"""dtlint DT3xx rules — host-concurrency hazards over a whole Project.
+
+The serving/fleet/obs layers made the host program genuinely concurrent
+(scheduler pumps, router sweeps, HTTP scrape threads, prefetch
+producers), and two of the last three PRs shipped fixes for real
+threading bugs.  This tier makes that class of bug analyzable the same
+way DT2xx made cross-module JAX hazards analyzable:
+
+  DT301  error    attribute written on >=2 thread roots with
+                  inconsistent lock sets (data race), or read without
+                  the lock that guards its writes (torn read)
+  DT302  error    lock-order cycle across the project lock graph
+                  (potential deadlock)
+  DT303  error    user callback / arbitrary callable invoked while
+                  holding a lock (the _deliver/on_token re-entrancy +
+                  deadlock class)
+  DT304  warning  blocking call (queue.get / thread.join / event.wait /
+                  time.sleep / device sync) while holding a lock
+  DT305  error    thread started without a join/close path reachable
+                  from its owner (the prefetch-leak class)
+  DT306  warning  threading.Thread(...) without daemon= or name=
+                  (observability contract: every thread accountable and
+                  identifiable in stack dumps)
+
+**Model.**  ``ConcurrencyModel`` scans every function (including nested
+defs, as pseudo-functions) for: lock acquisitions (``with self._lock:``
+and friends), attribute writes/reads on ``self`` and module globals,
+call events, thread constructions, and joins.  Lock sets are lexical
+``with`` nesting plus an interprocedural entry lock set — the
+intersection of the locks held at every resolved call site — iterated
+to a fixpoint, so a helper only ever called under the lock inherits it.
+
+**Thread roots** are where a function can run: ``threading.Thread(
+target=...)`` sinks (and everything reachable from them through the
+call graph), ``do_*`` methods of HTTP handler classes, and — for a
+class that OWNS a lock (concurrency declared by construction) — each
+public method, since a lock in the class means callers may arrive on
+any thread.  Everything else is the main thread.
+
+**Known limits** (silence, never noise — the family contract): lock
+sets are flow-insensitive within a ``with`` body; entry lock sets are
+an intersection over call sites (a callback invoked under a lock from
+only SOME callers is not flagged); attributes of objects other than
+``self`` are not tracked; unlocked write/read pairs in classes without
+a lock are invisible (no lock, no declared discipline — that is the
+race harness's job, ``analysis/race_harness.py``).  See
+docs/ANALYSIS.md for the catalog with examples.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, \
+    Set, Tuple
+
+from .callgraph import FunctionInfo, Project, enclosing_class_of
+from .report import Finding, Severity
+from .walker import Source, call_name
+
+__all__ = ["CONCURRENCY_RULES", "ConcurrencyModel",
+           "concurrency_rule_catalog", "run_concurrency_rules"]
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "multiprocessing.Lock", "multiprocessing.RLock"}
+_EVENT_CTORS = {"threading.Event"}
+_SEM_CTORS = {"threading.Semaphore", "threading.BoundedSemaphore"}
+_QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                "queue.SimpleQueue"}
+_THREAD_CTORS = {"threading.Thread"}
+
+# method calls that mutate their receiver — a write to the attribute
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "remove", "clear", "add", "discard", "update", "setdefault",
+             "sort", "reverse", "requeue"}
+
+# names that look like a lock when the constructor is out of reach
+_LOCKISH_RE = re.compile(r"(^|_)(lock|mutex)s?$", re.IGNORECASE)
+
+# attribute/variable names that mean "user-supplied callable"
+_CALLBACK_ATTR_RE = re.compile(
+    r"^on_[a-z0-9_]+$|_(callback|cb|fn|hook)s?$|^(callback|hook)$")
+
+_HTTP_HANDLER_BASES = ("BaseHTTPRequestHandler",
+                       "SimpleHTTPRequestHandler")
+
+_MAIN_ROOT = "<main>"
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class AccessEvent:
+    """One read/write of ``self.attr`` (or a module global) with the
+    lexical lock set held at the site."""
+    attr: str                    # lock-key-style attribute identity
+    kind: str                    # "write" | "read"
+    locks: FrozenSet[str]
+    node: ast.AST
+    fn_key: str
+
+
+@dataclasses.dataclass
+class CallEvent:
+    node: ast.Call
+    locks: FrozenSet[str]
+    fn_key: str
+
+
+@dataclasses.dataclass
+class AcquireEvent:
+    lock: str
+    held: FrozenSet[str]         # locks already held when acquiring
+    node: ast.AST
+    fn_key: str
+
+
+@dataclasses.dataclass
+class ThreadSite:
+    """One ``threading.Thread(...)`` construction."""
+    node: ast.Call
+    fn_key: str
+    module: str
+    target: Optional[ast.AST]    # the target= expression
+    has_daemon: bool
+    has_name: bool
+    started: bool = False
+    binding: Optional[str] = None      # "self.x" | local name | None
+    escapes: bool = False              # passed/returned/unresolvable bind
+
+
+@dataclasses.dataclass
+class FunctionFacts:
+    key: str
+    module: str
+    qualname: str
+    node: ast.AST
+    src: Source
+    cls: Optional[str]
+    accesses: List[AccessEvent] = dataclasses.field(default_factory=list)
+    calls: List[CallEvent] = dataclasses.field(default_factory=list)
+    acquires: List[AcquireEvent] = dataclasses.field(default_factory=list)
+    threads: List[ThreadSite] = dataclasses.field(default_factory=list)
+    joins: Set[str] = dataclasses.field(default_factory=set)
+    nested: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+    params: Set[str] = dataclasses.field(default_factory=set)
+    local_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class ConcurrencyModel:
+    """Locks, thread roots, and access events for one Project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.facts: Dict[str, FunctionFacts] = {}
+        self._resolve_cache: Dict[int, Optional[str]] = {}
+        # (module, class) -> {attr: ctor canonical} for threading/queue
+        # typed attributes (assignment- and annotation-derived)
+        self.attr_types: Dict[Tuple[str, str], Dict[str, str]] = {}
+        # lock identities: "mod::Class.attr" / "mod::NAME" / local keys
+        self.class_locks: Dict[Tuple[str, str], Set[str]] = {}
+        self.module_locks: Dict[str, Set[str]] = {}
+        self.class_bases: Dict[Tuple[str, str], List[str]] = {}
+        self._build()
+        self._merge_inherited_types()
+        self._propagate_entry_locks()
+        self.roots: Dict[str, Set[str]] = self._thread_roots()
+        self._ctor_only: Set[str] = self._ctor_only_functions()
+
+    # ------------------------------------------------------------ build
+
+    def _build(self) -> None:
+        for mod, src in self.project.sources.items():
+            self._scan_types(mod, src)
+        for mod, src in self.project.sources.items():
+            # module-level statements form a pseudo-function
+            self._scan_function(mod, src, src.tree, f"{mod}::<module>",
+                                "<module>", None)
+        for info in self.project.iter_functions():
+            cls = info.qualname.split(".")[0] if "." in info.qualname \
+                else None
+            self._scan_function(info.module, info.src, info.node,
+                                info.key, info.qualname, cls)
+
+    def _scan_types(self, mod: str, src: Source) -> None:
+        """Collect lock/thread/event/queue-typed attributes per class
+        (``self.x = threading.Lock()`` and ``x: threading.Event``
+        annotations) and module-level lock constants."""
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                types: Dict[str, str] = {}
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                        tgt = sub.targets[0]
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self" \
+                                and isinstance(sub.value, ast.Call):
+                            ctor = src.call_canonical(sub.value)
+                            if ctor in (_LOCK_CTORS | _EVENT_CTORS
+                                        | _SEM_CTORS | _QUEUE_CTORS
+                                        | _THREAD_CTORS):
+                                types[tgt.attr] = ctor
+                    elif isinstance(sub, ast.AnnAssign) \
+                            and isinstance(sub.target, ast.Name) \
+                            and getattr(sub, "parent", None) is node:
+                        ann = src.canonical(_dotted(sub.annotation)) \
+                            if sub.annotation is not None else None
+                        if ann in (_LOCK_CTORS | _EVENT_CTORS
+                                   | _QUEUE_CTORS | _THREAD_CTORS):
+                            types[sub.target.id] = ann
+                self.attr_types[(mod, node.name)] = types
+                self.class_bases[(mod, node.name)] = [
+                    d for d in (_dotted(b) for b in node.bases)
+                    if d is not None]
+                self.class_locks[(mod, node.name)] = {
+                    f"{mod}::{node.name}.{a}" for a, c in types.items()
+                    if c in _LOCK_CTORS}
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and getattr(node, "parent", None) is src.tree:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) \
+                        and isinstance(node.value, ast.Call) \
+                        and src.call_canonical(node.value) in _LOCK_CTORS:
+                    self.module_locks.setdefault(mod, set()).add(
+                        f"{mod}::{tgt.id}")
+
+    def _merge_inherited_types(self) -> None:
+        """A subclass inherits its bases' typed attributes (the
+        ``_Metric._lock`` pattern: the base constructs the lock, the
+        subclasses guard their state with it) — so lock ownership and
+        receiver typing follow the class hierarchy."""
+        for _ in range(3):              # bounded: hierarchies are shallow
+            changed = False
+            for (mod, cls), bases in self.class_bases.items():
+                mine = self.attr_types[(mod, cls)]
+                for base in bases:
+                    cinfo = self.project.resolve_class(mod, base)
+                    if cinfo is None:
+                        continue
+                    for attr, ctor in self.attr_types.get(
+                            (cinfo.module, cinfo.name), {}).items():
+                        if attr not in mine:
+                            mine[attr] = ctor
+                            changed = True
+            if not changed:
+                break
+        for (mod, cls), types in self.attr_types.items():
+            self.class_locks[(mod, cls)] = {
+                f"{mod}::{cls}.{a}" for a, c in types.items()
+                if c in _LOCK_CTORS}
+
+    # ------------------------------------------------ per-function scan
+
+    def _scan_function(self, mod: str, src: Source, fn: ast.AST,
+                       key: str, qualname: str,
+                       cls: Optional[str]) -> None:
+        if key in self.facts:
+            return
+        facts = FunctionFacts(key=key, module=mod, qualname=qualname,
+                              node=fn, src=src, cls=cls)
+        self.facts[key] = facts
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            facts.params = {p.arg for p in a.posonlyargs + a.args
+                            + a.kwonlyargs if p.arg not in ("self", "cls")}
+
+        body = fn.body if not isinstance(fn, ast.Module) else [
+            n for n in fn.body
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef))]
+        for stmt in body:
+            self._visit(facts, stmt, frozenset())
+        # nested defs run later (thread targets, local helpers): scan
+        # each as its own pseudo-function with an empty lexical lock set
+        for name, node in list(facts.nested.items()):
+            self._scan_function(mod, src, node,
+                                f"{key}.<locals>.{name}",
+                                f"{qualname}.<locals>.{name}", cls)
+
+    def _lock_key(self, facts: FunctionFacts,
+                  expr: ast.AST) -> Optional[str]:
+        """Lock identity for a ``with`` context expression, or None."""
+        mod, cls = facts.module, facts.cls
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and cls is not None:
+            types = self.attr_types.get((mod, cls), {})
+            if types.get(expr.attr) in _LOCK_CTORS \
+                    or (expr.attr not in types
+                        and _LOCKISH_RE.search(expr.attr)):
+                return f"{mod}::{cls}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if f"{mod}::{expr.id}" in self.module_locks.get(mod, set()):
+                return f"{mod}::{expr.id}"
+            if facts.local_types.get(expr.id) in _LOCK_CTORS \
+                    or _LOCKISH_RE.search(expr.id):
+                return f"{mod}::<local>.{expr.id}"
+        return None
+
+    def _visit(self, facts: FunctionFacts, node: ast.AST,
+               locks: FrozenSet[str]) -> None:
+        src = facts.src
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            facts.nested[node.name] = node
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(locks)
+            for item in node.items:
+                lk = self._lock_key(facts, item.context_expr)
+                if lk is not None:
+                    facts.acquires.append(AcquireEvent(
+                        lk, frozenset(inner), item.context_expr,
+                        facts.key))
+                    inner.add(lk)
+                else:
+                    self._visit(facts, item.context_expr, locks)
+            for child in node.body:
+                self._visit(facts, child, frozenset(inner))
+            return
+
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                self._record_store(facts, tgt, locks)
+            if node.value is not None:
+                self._note_local_type(facts, node)
+                self._visit(facts, node.value, locks)
+            # AugAssign also reads its target
+            if isinstance(node, ast.AugAssign):
+                self._record_access(facts, node.target, "read", locks)
+            return
+
+        if isinstance(node, ast.Call):
+            self._record_call(facts, node, locks)
+            for child in ast.iter_child_nodes(node):
+                self._visit(facts, child, locks)
+            return
+
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx,
+                                                          ast.Load):
+            self._record_access(facts, node, "read", locks)
+            self._visit(facts, node.value, locks)
+            return
+
+        for child in ast.iter_child_nodes(node):
+            self._visit(facts, child, locks)
+
+    def _note_local_type(self, facts: FunctionFacts,
+                         node: ast.AST) -> None:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            return
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name) and isinstance(node.value, ast.Call):
+            ctor = facts.src.call_canonical(node.value)
+            if ctor in (_LOCK_CTORS | _EVENT_CTORS | _SEM_CTORS
+                        | _QUEUE_CTORS | _THREAD_CTORS):
+                facts.local_types[tgt.id] = ctor
+
+    def _attr_key(self, facts: FunctionFacts,
+                  node: ast.AST) -> Optional[str]:
+        """Identity of a trackable attribute: ``self.x`` in a class, or
+        a module-level global name rebound inside a function."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" and facts.cls is not None:
+            return f"{facts.module}::{facts.cls}.{node.attr}"
+        return None
+
+    def _record_store(self, facts: FunctionFacts, tgt: ast.AST,
+                      locks: FrozenSet[str]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._record_store(facts, elt, locks)
+            return
+        base = tgt
+        if isinstance(tgt, ast.Subscript):
+            base = tgt.value            # self.x[k] = v writes x
+            self._visit(facts, tgt.slice, locks)
+        key = self._attr_key(facts, base)
+        if key is not None:
+            facts.accesses.append(AccessEvent(key, "write", locks, tgt,
+                                              facts.key))
+        elif isinstance(base, ast.Name) and isinstance(
+                facts.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # ``global X`` rebinding of a module-level name
+            for n in ast.walk(facts.node):
+                if isinstance(n, ast.Global) and base.id in n.names:
+                    facts.accesses.append(AccessEvent(
+                        f"{facts.module}::{base.id}", "write", locks,
+                        tgt, facts.key))
+                    break
+
+    def _record_access(self, facts: FunctionFacts, node: ast.AST,
+                       kind: str, locks: FrozenSet[str]) -> None:
+        key = self._attr_key(facts, node)
+        if key is not None:
+            facts.accesses.append(AccessEvent(key, kind, locks, node,
+                                              facts.key))
+
+    def _record_call(self, facts: FunctionFacts, call: ast.Call,
+                     locks: FrozenSet[str]) -> None:
+        facts.calls.append(CallEvent(call, locks, facts.key))
+        src = facts.src
+        func = call.func
+        # receiver mutation counts as a write (self._queue.append(...))
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            key = self._attr_key(facts, func.value)
+            if key is not None:
+                facts.accesses.append(AccessEvent(key, "write", locks,
+                                                  call, facts.key))
+        # joins (DT305 bookkeeping): self._t.join() / t.join()
+        if isinstance(func, ast.Attribute) and func.attr == "join":
+            recv = _dotted(func.value)
+            if recv is not None:
+                facts.joins.add(recv)
+        # thread constructions
+        if src.call_canonical(call) in _THREAD_CTORS:
+            kwargs = {k.arg for k in call.keywords if k.arg}
+            site = ThreadSite(
+                node=call, fn_key=facts.key, module=facts.module,
+                target=next((k.value for k in call.keywords
+                             if k.arg == "target"), None),
+                has_daemon="daemon" in kwargs, has_name="name" in kwargs)
+            self._bind_thread(facts, call, site)
+            facts.threads.append(site)
+
+    @staticmethod
+    def _bind_thread(facts: FunctionFacts, call: ast.Call,
+                     site: ThreadSite) -> None:
+        """Work out what the new Thread is bound to, and whether
+        ``.start()`` is ever called on that binding."""
+        parent = getattr(call, "parent", None)
+        if isinstance(parent, ast.Attribute) and parent.attr == "start":
+            site.started = True          # Thread(...).start(): no handle
+            return
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            tgt = parent.targets[0]
+            name = _dotted(tgt)
+            if name is not None and (isinstance(tgt, ast.Name)
+                                     or (isinstance(tgt, ast.Attribute)
+                                         and name.startswith("self."))):
+                site.binding = name
+                scope = facts.node
+                for n in ast.walk(scope):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "start" \
+                            and _dotted(n.func.value) == name:
+                        site.started = True
+                return
+        site.escapes = True              # passed/returned: out of reach
+
+    # ------------------------------------- interprocedural propagation
+
+    def resolve_call(self, facts: FunctionFacts,
+                     call: ast.Call) -> Optional[str]:
+        """Callee fact-key for a call, or None.  Resolves local nested
+        defs, self/cls methods, and project functions."""
+        cached = self._resolve_cache.get(id(call), "-miss-")
+        if cached != "-miss-":
+            return cached
+        out = self._resolve_call_uncached(facts, call)
+        self._resolve_cache[id(call)] = out
+        return out
+
+    def _resolve_call_uncached(self, facts: FunctionFacts,
+                               call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in facts.nested:
+            return f"{facts.key}.<locals>.{func.id}"
+        owner = self._owner_facts(facts)
+        if isinstance(func, ast.Name) and owner is not facts \
+                and func.id in owner.nested:
+            return f"{owner.key}.<locals>.{func.id}"
+        scope = facts.node if isinstance(
+            facts.node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            else facts.src.tree
+        types = self.project.instance_types(facts.module, scope)
+        info = self.project.resolve_call(facts.module, call, facts.cls,
+                                         types)
+        return info.key if info is not None else None
+
+    def _owner_facts(self, facts: FunctionFacts) -> FunctionFacts:
+        """The outermost enclosing function's facts (for nested keys)."""
+        key = facts.key.split(".<locals>.")[0]
+        return self.facts.get(key, facts)
+
+    def _propagate_entry_locks(self) -> None:
+        """entry(f) = intersection over resolved call sites of the locks
+        held there (callers' entry set included); a function with an
+        unknown caller keeps an empty entry set.  Event lock sets become
+        ``lexical | entry``."""
+        entry: Dict[str, Optional[FrozenSet[str]]] = {
+            k: None for k in self.facts}
+        for _ in range(4):
+            changed = False
+            for facts in self.facts.values():
+                base = entry.get(facts.key) or frozenset()
+                for ce in facts.calls:
+                    callee = self.resolve_call(facts, ce.node)
+                    if callee is None or callee not in entry:
+                        continue
+                    held = ce.locks | base
+                    cur = entry[callee]
+                    new = held if cur is None else (cur & held)
+                    if new != cur:
+                        entry[callee] = new
+                        changed = True
+            if not changed:
+                break
+        self.entry_locks: Dict[str, FrozenSet[str]] = {
+            k: (v or frozenset()) for k, v in entry.items()}
+
+    def effective_locks(self, ev) -> FrozenSet[str]:
+        return ev.locks | self.entry_locks.get(ev.fn_key, frozenset())
+
+    # ------------------------------------------------------ thread roots
+
+    def _thread_roots(self) -> Dict[str, Set[str]]:
+        """root label -> set of fact keys reachable on that root."""
+        roots: Dict[str, Set[str]] = {}
+        for facts in self.facts.values():
+            for site in facts.threads:
+                tkey = self._resolve_target(facts, site.target)
+                if tkey is not None:
+                    label = (f"thread '{tkey.split('::')[-1]}' "
+                             f"({facts.module}:{site.node.lineno})")
+                    roots[label] = self._reach(tkey)
+        for mod, src in self.project.sources.items():
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = {b.attr if isinstance(b, ast.Attribute) else
+                         getattr(b, "id", "") for b in node.bases}
+                if bases & set(_HTTP_HANDLER_BASES):
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef) \
+                                and item.name.startswith("do_"):
+                            key = f"{mod}::{node.name}.{item.name}"
+                            roots[f"HTTP handler {node.name}."
+                                  f"{item.name}"] = self._reach(key)
+                if self.class_locks.get((mod, node.name)):
+                    # a lock in the class declares concurrent callers:
+                    # each public method is its own potential thread
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)) \
+                                and not item.name.startswith("_"):
+                            key = f"{mod}::{node.name}.{item.name}"
+                            roots[f"caller of {node.name}."
+                                  f"{item.name}"] = self._reach(key)
+        return roots
+
+    def _resolve_target(self, facts: FunctionFacts,
+                        target: Optional[ast.AST]) -> Optional[str]:
+        if target is None:
+            return None
+        if isinstance(target, ast.Name):
+            if target.id in facts.nested:
+                return f"{facts.key}.<locals>.{target.id}"
+            owner = self._owner_facts(facts)
+            if owner is not facts and target.id in owner.nested:
+                return f"{owner.key}.<locals>.{target.id}"
+            info = self.project.resolve_name(facts.module, target.id,
+                                             facts.cls)
+            return info.key if info is not None else None
+        if isinstance(target, ast.Attribute):
+            dotted = _dotted(target)
+            if dotted is None:
+                return None
+            head, _, rest = dotted.partition(".")
+            if head == "self" and facts.cls is not None and rest \
+                    and "." not in rest:
+                info = self.project.function(facts.module,
+                                             f"{facts.cls}.{rest}")
+                return info.key if info is not None else None
+            info = self.project.resolve_name(facts.module, dotted,
+                                             facts.cls)
+            return info.key if info is not None else None
+        return None
+
+    def _reach(self, key: str) -> Set[str]:
+        out: Set[str] = set()
+        work = [key]
+        while work:
+            cur = work.pop()
+            if cur in out or cur not in self.facts:
+                continue
+            out.add(cur)
+            facts = self.facts[cur]
+            for ce in facts.calls:
+                callee = self.resolve_call(facts, ce.node)
+                if callee is not None and callee not in out:
+                    work.append(callee)
+        return out
+
+    def roots_of(self, fn_key: str) -> Set[str]:
+        hit = {label for label, reach in self.roots.items()
+               if fn_key in reach}
+        return hit or {_MAIN_ROOT}
+
+    def _ctor_only_functions(self) -> Set[str]:
+        """Private helpers whose every resolved call site lives in an
+        ``__init__`` (or another such helper) run during construction,
+        before the object is shared — their accesses are as single-
+        threaded as ``__init__``'s own."""
+        callers: Dict[str, Set[str]] = {}
+        for facts in self.facts.values():
+            for ce in facts.calls:
+                callee = self.resolve_call(facts, ce.node)
+                if callee is not None:
+                    callers.setdefault(callee, set()).add(facts.key)
+        rooted = set()
+        for reach in self.roots.values():
+            rooted |= reach
+
+        def is_init(key: str) -> bool:
+            tail = key.split("::")[-1].split(".<locals>.")[0]
+            return tail.split(".")[-1] == "__init__"
+
+        ctor_only: Set[str] = set()
+        for _ in range(4):
+            changed = False
+            for key, facts in self.facts.items():
+                if key in ctor_only or key in rooted or is_init(key):
+                    continue
+                if not facts.qualname.split(".")[-1].startswith("_"):
+                    continue
+                callset = callers.get(key)
+                if callset and all(is_init(c) or c in ctor_only
+                                   for c in callset):
+                    ctor_only.add(key)
+                    changed = True
+            if not changed:
+                break
+        return ctor_only
+
+    # ------------------------------------------------------ conveniences
+
+    def iter_accesses(self) -> Iterator[Tuple[FunctionFacts, AccessEvent]]:
+        for facts in self.facts.values():
+            if facts.qualname.split(".")[-1] == "__init__" \
+                    or ".__init__.<locals>." in facts.key \
+                    or facts.key in self._ctor_only:
+                continue             # construction is single-threaded
+            for ev in facts.accesses:
+                yield facts, ev
+
+    def attr_ctor(self, module: str, attr_key: str) -> Optional[str]:
+        """Canonical ctor for ``mod::Class.attr`` keys, if typed."""
+        tail = attr_key.split("::")[-1]
+        if "." not in tail:
+            return None
+        cls, attr = tail.split(".", 1)
+        return self.attr_types.get((module, cls), {}).get(attr)
+
+
+# ------------------------------------------------------------------ rules
+
+class ConcurrencyContext:
+    def __init__(self, project: Project):
+        self.project = project
+        self.model = ConcurrencyModel(project)
+
+    def finding(self, rule: str, severity: str, src: Source,
+                node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule=rule, severity=severity, path=src.path,
+                       line=line, col=col, message=message,
+                       source_line=src.line_text(line))
+
+
+class ConcurrencyRule:
+    id: str = "DT300"
+    severity: str = Severity.ERROR
+    summary: str = ""
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _short_lock(lock: str) -> str:
+    return lock.split("::")[-1].replace("<local>.", "")
+
+
+def _locks_str(locks: FrozenSet[str]) -> str:
+    if not locks:
+        return "no lock"
+    return "{" + ", ".join(sorted(_short_lock(lk) for lk in locks)) + "}"
+
+
+# --------------------------------------------------------------- DT301
+
+class InconsistentLockset(ConcurrencyRule):
+    id = "DT301"
+    severity = Severity.ERROR
+    summary = ("an attribute is written on >=2 thread roots with no "
+               "common lock (data race), or read without the lock that "
+               "guards every write (torn read)")
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        model = cctx.model
+        by_attr: Dict[str, List[Tuple[FunctionFacts, AccessEvent,
+                                      FrozenSet[str], Set[str]]]] = {}
+        for facts, ev in model.iter_accesses():
+            ctor = model.attr_ctor(facts.module, ev.attr)
+            if ctor in _LOCK_CTORS or ctor in _EVENT_CTORS:
+                continue             # the sync primitives themselves
+            by_attr.setdefault(ev.attr, []).append(
+                (facts, ev, model.effective_locks(ev),
+                 model.roots_of(ev.fn_key)))
+        for attr, events in sorted(by_attr.items()):
+            writes = [e for e in events if e[1].kind == "write"]
+            if not writes:
+                continue
+            write_roots = set()
+            for _, _, _, roots in writes:
+                write_roots |= roots
+            common: Optional[FrozenSet[str]] = None
+            for _, _, locks, _ in writes:
+                common = locks if common is None else (common & locks)
+            common = common or frozenset()
+            if len(write_roots) >= 2 and not common:
+                # report at the least-protected write site
+                facts, ev, locks, roots = min(
+                    writes, key=lambda e: (len(e[2]), e[1].node.lineno))
+                yield cctx.finding(
+                    self.id, self.severity, facts.src, ev.node,
+                    f"'{_short_lock(attr)}' is written on "
+                    f"{len(write_roots)} thread roots "
+                    f"({', '.join(sorted(write_roots))}) with no common "
+                    f"lock — this write holds {_locks_str(locks)}; "
+                    "guard every write with one lock or confine the "
+                    "attribute to a single thread")
+                continue
+            if not common:
+                continue             # single root: confined, fine
+            for facts, ev, locks, roots in events:
+                if ev.kind != "read" or locks & common:
+                    continue
+                if roots == {_MAIN_ROOT} and write_roots == {_MAIN_ROOT}:
+                    continue
+                yield cctx.finding(
+                    self.id, self.severity, facts.src, ev.node,
+                    f"'{_short_lock(attr)}' is read here without "
+                    f"{_locks_str(common)}, the lock every write holds "
+                    "— a concurrent write can tear this read; take the "
+                    "lock (or snapshot under it)")
+
+
+# --------------------------------------------------------------- DT302
+
+class LockOrderCycle(ConcurrencyRule):
+    id = "DT302"
+    severity = Severity.ERROR
+    summary = ("two locks are acquired in opposite orders on different "
+               "paths (lock-order cycle) — concurrent callers can "
+               "deadlock; impose one global acquisition order")
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        model = cctx.model
+        edges: Dict[Tuple[str, str],
+                    Tuple[FunctionFacts, ast.AST]] = {}
+        for facts in model.facts.values():
+            entry = model.entry_locks.get(facts.key, frozenset())
+            for acq in facts.acquires:
+                for held in acq.held | entry:
+                    if held != acq.lock:
+                        edges.setdefault((held, acq.lock),
+                                         (facts, acq.node))
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+        seen_cycles: Set[FrozenSet[str]] = set()
+        for start in sorted(graph):
+            cycle = self._find_cycle(graph, start)
+            if cycle is None:
+                continue
+            sig = frozenset(cycle)
+            if sig in seen_cycles:
+                continue
+            seen_cycles.add(sig)
+            facts, node = edges[(cycle[0], cycle[1 % len(cycle)])]
+            order = " -> ".join(_short_lock(lk)
+                                for lk in cycle + [cycle[0]])
+            yield cctx.finding(
+                self.id, self.severity, facts.src, node,
+                f"lock-order cycle {order}: another path acquires these "
+                "locks in the opposite order, so two threads can each "
+                "hold one and wait forever on the other; pick one "
+                "global order (or merge the locks)")
+
+    @staticmethod
+    def _find_cycle(graph: Dict[str, Set[str]],
+                    start: str) -> Optional[List[str]]:
+        path: List[str] = []
+        on_path: Set[str] = set()
+        done: Set[str] = set()
+
+        def dfs(node: str) -> Optional[List[str]]:
+            if node in on_path:
+                return path[path.index(node):]
+            if node in done:
+                return None
+            on_path.add(node)
+            path.append(node)
+            for nxt in sorted(graph.get(node, ())):
+                hit = dfs(nxt)
+                if hit is not None:
+                    return hit
+            on_path.discard(node)
+            path.pop()
+            done.add(node)
+            return None
+
+        return dfs(start)
+
+
+# --------------------------------------------------------------- DT303
+
+class CallbackUnderLock(ConcurrencyRule):
+    id = "DT303"
+    severity = Severity.ERROR
+    summary = ("a user callback / arbitrary callable is invoked while a "
+               "lock is held — the callee can block forever or re-enter "
+               "the lock (the _deliver/on_token bug class); snapshot "
+               "under the lock, call outside it")
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        model = cctx.model
+        for facts in model.facts.values():
+            for ce in facts.calls:
+                locks = model.effective_locks(ce)
+                if not locks:
+                    continue
+                what = self._arbitrary(facts, ce.node)
+                if what is None:
+                    continue
+                yield cctx.finding(
+                    self.id, self.severity, facts.src, ce.node,
+                    f"{what} is called while holding "
+                    f"{_locks_str(locks)} — arbitrary code under a lock "
+                    "can block every other thread or deadlock by "
+                    "re-entering; release the lock first")
+
+    @staticmethod
+    def _arbitrary(facts: FunctionFacts,
+                   call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if _CALLBACK_ATTR_RE.search(func.attr):
+                return f"callback '{_dotted(func) or func.attr}'"
+            return None
+        if isinstance(func, ast.Name):
+            if func.id in facts.params:
+                return f"caller-supplied callable '{func.id}'"
+            if _CALLBACK_ATTR_RE.search(func.id):
+                return f"callback '{func.id}'"
+        return None
+
+
+# --------------------------------------------------------------- DT304
+
+_BLOCKING_CANONICAL = {"time.sleep", "jax.device_get",
+                       "subprocess.run", "subprocess.check_call",
+                       "subprocess.check_output", "subprocess.call"}
+_BLOCKING_METHODS = {
+    "get": _QUEUE_CTORS,                       # queue.Queue().get()
+    "join": _THREAD_CTORS | _QUEUE_CTORS,      # thread/queue join
+    "wait": _EVENT_CTORS | _LOCK_CTORS,        # Event/Condition wait
+    "acquire": _SEM_CTORS,                     # semaphore park
+}
+
+
+class BlockingUnderLock(ConcurrencyRule):
+    id = "DT304"
+    severity = Severity.WARNING
+    summary = ("a blocking call (queue.get / thread.join / event.wait / "
+               "sleep / device sync) runs while a lock is held — every "
+               "thread needing that lock stalls behind it")
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        model = cctx.model
+        for facts in model.facts.values():
+            for ce in facts.calls:
+                locks = model.effective_locks(ce)
+                if not locks:
+                    continue
+                what = self._blocking(model, facts, ce.node, locks)
+                if what is None:
+                    continue
+                yield cctx.finding(
+                    self.id, self.severity, facts.src, ce.node,
+                    f"blocking call {what} while holding "
+                    f"{_locks_str(locks)} — the lock is pinned for the "
+                    "full wait; move the blocking call outside the "
+                    "critical section")
+
+    def _blocking(self, model: ConcurrencyModel, facts: FunctionFacts,
+                  call: ast.Call,
+                  locks: FrozenSet[str]) -> Optional[str]:
+        name = facts.src.call_canonical(call)
+        if name in _BLOCKING_CANONICAL:
+            return f"'{name}'"
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        if func.attr == "block_until_ready":
+            return "'.block_until_ready()' (device sync)"
+        ctors = _BLOCKING_METHODS.get(func.attr)
+        if ctors is None:
+            return None
+        recv_type = self._receiver_type(model, facts, func.value)
+        if recv_type in ctors:
+            return (f"'.{func.attr}()' on a "
+                    f"{recv_type.rsplit('.', 1)[-1]}")
+        return None
+
+    @staticmethod
+    def _receiver_type(model: ConcurrencyModel, facts: FunctionFacts,
+                       recv: ast.AST) -> Optional[str]:
+        if isinstance(recv, ast.Name):
+            t = facts.local_types.get(recv.id)
+            if t is not None:
+                return t
+            owner = model._owner_facts(facts)
+            return owner.local_types.get(recv.id)
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name):
+            base = recv.value.id
+            if base == "self" and facts.cls is not None:
+                return model.attr_types.get(
+                    (facts.module, facts.cls), {}).get(recv.attr)
+            # req.done-style: typed attr of a resolvable local instance
+            scope = facts.node if isinstance(
+                facts.node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                else facts.src.tree
+            types = model.project.instance_types(facts.module, scope)
+            ckey = types.get(base)
+            if ckey is not None:
+                cmod, _, cname = ckey.partition("::")
+                return model.attr_types.get((cmod, cname),
+                                            {}).get(recv.attr)
+        return None
+
+
+# --------------------------------------------------------------- DT305
+
+class UnjoinedThread(ConcurrencyRule):
+    id = "DT305"
+    severity = Severity.ERROR
+    summary = ("a thread is started but no join() on it is reachable "
+               "from its owner — shutdown leaks the thread and whatever "
+               "it pins (the prefetch-producer leak class)")
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        model = cctx.model
+        for facts in model.facts.values():
+            for site in facts.threads:
+                if not site.started:
+                    continue          # never started, or escaped unstarted
+                if site.binding is None and not site.escapes:
+                    pass              # started inline: definitely no join
+                elif site.escapes:
+                    continue          # handed elsewhere: out of reach
+                elif self._joined(model, facts, site):
+                    continue
+                yield cctx.finding(
+                    self.id, self.severity, facts.src, site.node,
+                    self._message(site))
+
+    @staticmethod
+    def _joined(model: ConcurrencyModel, facts: FunctionFacts,
+                site: ThreadSite) -> bool:
+        binding = site.binding
+        if binding is None:
+            return False
+        if binding.startswith("self."):
+            # any method of the owning class may hold the shutdown path
+            if facts.cls is None:
+                return False
+            prefix = f"{facts.module}::{facts.cls}."
+            for other in model.facts.values():
+                if other.key.startswith(prefix) \
+                        and binding in other.joins:
+                    return True
+            return False
+        # local binding: join must be reachable in this function (or its
+        # nested defs — a finally handler counts, ast.walk covers it)
+        if binding in facts.joins:
+            return True
+        for nkey in [k for k in model.facts
+                     if k.startswith(facts.key + ".<locals>.")]:
+            if binding in model.facts[nkey].joins:
+                return True
+        # escape hatch: a thread returned to the caller or handed to
+        # another callable has its shutdown path elsewhere — silence,
+        # never noise
+        for n in ast.walk(facts.node):
+            if isinstance(n, ast.Return) and n.value is not None \
+                    and binding in {x.id for x in ast.walk(n.value)
+                                    if isinstance(x, ast.Name)}:
+                return True
+            if isinstance(n, ast.Call):
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    if isinstance(a, ast.Name) and a.id == binding:
+                        return True
+        return False
+
+    @staticmethod
+    def _message(site: ThreadSite) -> str:
+        where = (f"'{site.binding}'" if site.binding
+                 else "an anonymous thread (started inline)")
+        return (f"{where} is started but never joined — no shutdown "
+                "path reaches it, so exit leaks the thread and every "
+                "buffer it pins; join it from the owner's close/stop "
+                "(a daemon flag hides the leak, it does not fix it)")
+
+
+# --------------------------------------------------------------- DT306
+
+class UnnamedThread(ConcurrencyRule):
+    id = "DT306"
+    severity = Severity.WARNING
+    summary = ("threading.Thread(...) without an explicit daemon= or "
+               "name= — unnamed/undeclared threads are unaccountable in "
+               "stack dumps and shutdown audits (observability "
+               "contract)")
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        for facts in cctx.model.facts.values():
+            for site in facts.threads:
+                missing = [k for k, have in (("name", site.has_name),
+                                             ("daemon", site.has_daemon))
+                           if not have]
+                if not missing:
+                    continue
+                yield cctx.finding(
+                    self.id, self.severity, facts.src, site.node,
+                    f"threading.Thread without {' or '.join(missing)}: "
+                    "give every thread a dttpu-prefixed name (stack "
+                    "dumps, /healthz audits) and an explicit daemon "
+                    "decision (implicit non-daemon blocks interpreter "
+                    "exit)")
+
+
+CONCURRENCY_RULES: List[ConcurrencyRule] = [
+    InconsistentLockset(), LockOrderCycle(), CallbackUnderLock(),
+    BlockingUnderLock(), UnjoinedThread(), UnnamedThread()]
+
+
+def concurrency_rule_catalog() -> List[Tuple[str, str, str]]:
+    return [(r.id, r.severity, r.summary) for r in CONCURRENCY_RULES]
+
+
+def run_concurrency_rules(project: Project,
+                          select: Optional[Set[str]] = None,
+                          ignore: Optional[Set[str]] = None
+                          ) -> List[Finding]:
+    wanted = [r for r in CONCURRENCY_RULES
+              if (not select or r.id in select)
+              and not (ignore and r.id in ignore)]
+    if not wanted:
+        return []
+    cctx = ConcurrencyContext(project)
+    by_path = {src.path: src for src in project.sources.values()}
+    out: List[Finding] = []
+    for rule in wanted:
+        for f in rule.check(cctx):
+            src = by_path.get(f.path)
+            if src is not None and src.suppressed(f.rule, f.line):
+                continue
+            out.append(f)
+    return out

@@ -1,0 +1,181 @@
+"""Runtime sibling of the DT3xx tier: a seeded cooperative preemption
+fuzzer that makes host-side races reproduce on demand.
+
+Static lock-set inference (``analysis/concurrency.py``) only sees
+discipline that is DECLARED — a class with no lock at all is invisible
+to it.  ``RaceHarness`` attacks the same bug class from the runtime
+side, the way ``RetraceGuard`` attacks retraces: run the real code, but
+force the scheduler to interleave threads at exactly the sites where
+races live, under a fixed seed, so
+
+* a racy critical section loses updates (or tears a read) on EVERY run
+  instead of once a fortnight in CI, and
+* the fixed code passes the same schedule — a regression test that
+  means something.
+
+Mechanism: ``sys.settrace``/``threading.settrace`` install a tracer for
+frames whose file path matches ``scope`` (substring match; default the
+package).  In-scope frames run with ``f_trace_opcodes`` enabled, and at
+each opcode a per-thread ``random.Random`` — seeded from ``(seed,
+thread-arrival-index)`` — decides whether to yield the GIL with a short
+``time.sleep``.  Attribute loads/stores, subscript stores, and calls
+(the lock acquire/release + shared-write sites) yield with a much
+higher probability than other opcodes, so a read-modify-write like
+``self.n += 1`` is split between its LOAD and STORE essentially every
+time two threads contend.  ``sys.setswitchinterval`` is dropped for the
+harness's extent so every sleep really is a context switch.
+
+Usage::
+
+    with RaceHarness(seed=7, scope=("tests/test_thread_safety.py",)):
+        ... start threads, hammer the shared object ...
+    # pytest (tests/conftest.py wires the marker):
+    @pytest.mark.race_harness(seed=7, scope=("serve/", "fleet/"))
+    def test_router_under_preemption(...): ...
+
+Scope/limits: only threads STARTED inside the harness are traced
+(``threading.settrace`` applies to new threads; the calling thread is
+traced via ``sys.settrace``); frames outside ``scope`` (jax, numpy,
+stdlib) run untraced at full speed.  Determinism is per-site, not
+per-schedule: the same seed forces yields at the same code sites with
+the same per-thread decision streams, which reliably *manifests* a
+planted race and reliably *passes* fixed code, but the exact OS-level
+interleaving still belongs to the OS.  Keep harnessed sections small —
+opcode tracing is ~100x interpreter slowdown inside scope.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import sys
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["RaceHarness"]
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# opcodes where shared-state races live: attribute/subscript traffic and
+# calls (lock acquire/release, queue ops, callback entry)
+_HOT_OPNAMES = {"LOAD_ATTR", "STORE_ATTR", "DELETE_ATTR",
+                "STORE_SUBSCR", "BINARY_SUBSCR", "DELETE_SUBSCR",
+                "CALL_FUNCTION", "CALL_METHOD", "CALL",
+                "CALL_FUNCTION_KW", "CALL_FUNCTION_EX"}
+
+
+def _hot_opcodes() -> frozenset:
+    import opcode
+    return frozenset(opcode.opmap[n] for n in _HOT_OPNAMES
+                     if n in opcode.opmap)
+
+
+class RaceHarness:
+    """Force seeded context switches at racy sites for a ``with`` block.
+
+    Args:
+      seed: base seed; thread ``i`` (in arrival order) draws its yield
+        decisions from ``random.Random((seed, i))``.
+      scope: path substrings selecting the frames to preempt (match
+        against ``co_filename``).  Default: this package's source tree.
+      hot_every / cold_every: yield one opcode in N at hot sites
+        (attribute/subscript/call opcodes) and elsewhere.
+      sleep_s: how long a forced yield parks the thread; with the
+        switch interval floored this always hands the GIL over.
+    """
+
+    def __init__(self, seed: int = 0,
+                 scope: Optional[Sequence[str]] = None,
+                 hot_every: int = 3, cold_every: int = 19,
+                 sleep_s: float = 2e-5):
+        if hot_every < 1 or cold_every < 1:
+            raise ValueError("hot_every/cold_every must be >= 1")
+        self.seed = int(seed)
+        self.scope = tuple(os.path.normpath(s).replace(os.sep, "/")
+                           for s in (scope or (_PKG_ROOT,)))
+        self.hot_every = int(hot_every)
+        self.cold_every = int(cold_every)
+        self.sleep_s = float(sleep_s)
+        self.preemptions = 0
+        self.threads_seen = 0
+        self._rngs: Dict[int, random.Random] = {}
+        self._arrival = itertools.count()
+        self._rng_lock = threading.Lock()
+        self._scope_cache: Dict[int, bool] = {}
+        self._hot = _hot_opcodes()
+        self._old_interval: Optional[float] = None
+        self._old_threading_trace = None
+        self._old_sys_trace = None
+        self._active = False
+
+    # ------------------------------------------------------------ enter
+
+    def __enter__(self) -> "RaceHarness":
+        self._old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+        self._old_threading_trace = getattr(threading, "gettrace",
+                                            lambda: None)()
+        self._old_sys_trace = sys.gettrace()
+        self._active = True
+        threading.settrace(self._trace)
+        sys.settrace(self._trace)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._active = False
+        sys.settrace(self._old_sys_trace)
+        threading.settrace(self._old_threading_trace)
+        if self._old_interval is not None:
+            sys.setswitchinterval(self._old_interval)
+
+    # ------------------------------------------------------------ trace
+
+    def _in_scope(self, code) -> bool:
+        hit = self._scope_cache.get(id(code))
+        if hit is None:
+            path = code.co_filename.replace(os.sep, "/")
+            hit = any(s in path for s in self.scope)
+            self._scope_cache[id(code)] = hit
+        return hit
+
+    def _rng(self) -> random.Random:
+        tid = threading.get_ident()
+        rng = self._rngs.get(tid)
+        if rng is None:
+            with self._rng_lock:
+                rng = self._rngs.get(tid)
+                if rng is None:
+                    idx = next(self._arrival)
+                    # int mix, not a (seed, idx) tuple: tuple seeding
+                    # hashes, which is deprecated AND PYTHONHASHSEED-
+                    # dependent — the opposite of reproducible
+                    rng = self._rngs[tid] = random.Random(
+                        self.seed * 0x9E3779B97F4A7C15 + idx)
+                    self.threads_seen = idx + 1
+        return rng
+
+    def _trace(self, frame, event, arg):
+        if not self._active:
+            return None
+        if event == "call":
+            if not self._in_scope(frame.f_code):
+                return None          # out of scope: run untraced
+            frame.f_trace_opcodes = True
+            return self._trace
+        if event == "opcode":
+            op = frame.f_code.co_code[frame.f_lasti]
+            every = self.hot_every if op in self._hot else self.cold_every
+            if self._rng().randrange(every) == 0:
+                self.preemptions += 1
+                time.sleep(self.sleep_s)
+        return self._trace
+
+    # ----------------------------------------------------------- report
+
+    def report(self) -> str:
+        with self._rng_lock:
+            seen = self.threads_seen
+        return (f"RaceHarness(seed={self.seed}): "
+                f"{self.preemptions} forced preemption(s) across "
+                f"{seen} thread(s)")

@@ -37,6 +37,13 @@ covers positional ``donate_argnums`` (not ``donate_argnames``).  The
 module imports JAX lazily — importing it (e.g. via the analysis package)
 stays pure-stdlib.
 
+The global patch is **refcounted and thread-safe**: concurrent guards
+(one per engine thread in the multi-replica fleet tests) share one
+installed patch — the first guard in installs, the last one out
+restores, and a jit constructed while several guards are active counts
+toward EVERY one of them.  Entering the same guard object twice is an
+error; nest distinct guards.
+
 Telemetry: when an ``obs.trace`` tracer is active (``obs.Telemetry`` in
 a TrainSession, or bench's trace file), every trace of a guarded
 function lands on the host timeline as an instant event —
@@ -48,11 +55,57 @@ from __future__ import annotations
 
 import functools
 import sys
+import threading
 from typing import Any, Dict, List, Tuple
 
 __all__ = ["RetraceGuard", "RetraceBudgetExceeded", "retrace_guard"]
 
 _MAX_STATIC_REPR = 80
+
+# The jax.jit/pjit patch is PROCESS-GLOBAL state: concurrent guards
+# (multi-replica fleet tests enter one per engine thread) must not
+# install over each other's patch or restore the original out from
+# under a still-active guard.  Install is refcounted under this lock —
+# the first guard in patches, the last one out restores — and every
+# jit constructed while ANY guard is active is instrumented for ALL
+# guards active at construction time.
+_PATCH_LOCK = threading.RLock()
+_ACTIVE_GUARDS: List["RetraceGuard"] = []
+_SAVED: List[Tuple[Any, str, Any]] = []
+
+
+def _install_patch() -> None:
+    """Called under _PATCH_LOCK with the first guard already active."""
+    import jax
+    for name in ("jit", "pjit"):
+        orig = getattr(jax, name, None)
+        if orig is None:
+            continue
+
+        def make(orig):
+            @functools.wraps(orig)
+            def guarded(fun, *args, **kwargs):
+                with _PATCH_LOCK:
+                    guards = list(_ACTIVE_GUARDS)
+                wrapped = fun
+                for g in guards:
+                    wrapped = g._counting(wrapped)
+                jitted = orig(wrapped, *args, **kwargs)
+                donate = _donate_argnums(kwargs)
+                if donate and any(g.enforce_donation for g in guards):
+                    return _DonationEnforcer(jitted, donate)
+                return jitted
+            return guarded
+
+        _SAVED.append((jax, name, orig))
+        setattr(jax, name, make(orig))
+
+
+def _uninstall_patch() -> None:
+    """Called under _PATCH_LOCK after the last guard exits."""
+    for owner, name, orig in reversed(_SAVED):
+        setattr(owner, name, orig)
+    _SAVED.clear()
 
 
 class RetraceBudgetExceeded(RuntimeError):
@@ -206,36 +259,25 @@ class RetraceGuard:
         self.stream = stream
         self.violations: List[str] = []
         self.traces: Dict[int, _FnTraces] = {}
-        self._saved: List[Tuple[Any, str, Any]] = []
 
     # ------------------------------------------------------------ patch
 
     def __enter__(self) -> "RetraceGuard":
-        import jax
-        self._patch(jax, "jit", jax.jit)
-        if hasattr(jax, "pjit"):
-            self._patch(jax, "pjit", jax.pjit)
+        with _PATCH_LOCK:
+            if self in _ACTIVE_GUARDS:
+                raise RuntimeError("RetraceGuard is not re-entrant with "
+                                   "itself; nest distinct guards instead")
+            _ACTIVE_GUARDS.append(self)
+            if len(_ACTIVE_GUARDS) == 1:
+                _install_patch()
         return self
 
     def __exit__(self, *exc) -> None:
-        for owner, name, orig in reversed(self._saved):
-            setattr(owner, name, orig)
-        self._saved.clear()
-
-    def _patch(self, owner: Any, name: str, orig: Any) -> None:
-        guard = self
-
-        @functools.wraps(orig)
-        def guarded(fun, *args, **kwargs):
-            wrapped = guard._counting(fun)
-            jitted = orig(wrapped, *args, **kwargs)
-            donate = _donate_argnums(kwargs)
-            if donate and guard.enforce_donation:
-                return _DonationEnforcer(jitted, donate)
-            return jitted
-
-        self._saved.append((owner, name, orig))
-        setattr(owner, name, guarded)
+        with _PATCH_LOCK:
+            if self in _ACTIVE_GUARDS:
+                _ACTIVE_GUARDS.remove(self)
+            if not _ACTIVE_GUARDS:
+                _uninstall_patch()
 
     def _counting(self, fun: Any):
         name = getattr(fun, "__qualname__",

@@ -31,6 +31,14 @@ One ``serve.Engine`` is one mesh; a fleet is N of them behind a
 The router is synchronous like the engine: the caller pumps ``step()``
 (one tick of every live replica + the retry sweep) or ``drain()``.
 
+Thread-safety: ``submit``/``cancel``/``stats``/replica management may
+run on any thread concurrently with the pump.  One state lock guards
+the replica table, the in-flight list, and the placement log; engines
+are pumped OUTSIDE it (each engine serializes its own ticks), so a
+slow tick never blocks a concurrent submit.  Lock order is strictly
+router -> engine (scheduler/adapter locks) — no path takes them the
+other way around.
+
 Metrics (``registry=``): ``dttpu_router_replicas`` gauge,
 ``dttpu_router_requests_total`` / ``dttpu_router_retries_total`` /
 ``dttpu_router_replica_down_total`` / ``dttpu_router_rejected_total``
@@ -38,6 +46,7 @@ counters, and per-replica ``dttpu_router_placed_total{replica=...}``.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -135,6 +144,9 @@ class Router:
         reg = registry if registry is not None else metrics_lib.REGISTRY
         self.registry = reg
         self.max_retries = int(max_retries)
+        # guards the replica table, draining set, in-flight list, and
+        # placement log; never held while pumping an engine tick
+        self._lock = threading.Lock()
         self._replicas: Dict[int, Engine] = {}
         self._draining: set = set()
         self._next_replica = 0
@@ -164,31 +176,38 @@ class Router:
     # -------------------------------------------------------- replicas
 
     def add_replica(self, engine: Engine) -> int:
-        rid = self._next_replica
-        self._next_replica += 1
-        self._replicas[rid] = engine
-        self._m_placed[rid] = self.registry.counter(
-            "dttpu_router_placed_total",
-            "Requests placed, by replica.",
-            labels={"replica": str(rid)})
-        self._m_replicas.set(len(self._replicas))
+        with self._lock:
+            rid = self._next_replica
+            self._next_replica += 1
+            self._replicas[rid] = engine
+            self._m_placed[rid] = self.registry.counter(
+                "dttpu_router_placed_total",
+                "Requests placed, by replica.",
+                labels={"replica": str(rid)})
+            self._m_replicas.set(len(self._replicas))
         return rid
 
     @property
     def replica_ids(self):
-        return tuple(self._replicas)
+        with self._lock:
+            return tuple(self._replicas)
 
     def replica(self, replica_id: int) -> Engine:
-        return self._replicas[replica_id]
+        with self._lock:
+            return self._replicas[replica_id]
 
     def stats(self) -> Dict[int, object]:
         """{replica_id: EngineStats} for every live replica."""
-        return {rid: eng.stats() for rid, eng in self._replicas.items()}
+        with self._lock:
+            live = list(self._replicas.items())
+        return {rid: eng.stats() for rid, eng in live}
 
     def load_adapter(self, adapter_id: str, adapter) -> None:
         """Register a LoRA adapter on EVERY live replica (each holds its
         own device table) so placement stays adapter-agnostic."""
-        for eng in self._replicas.values():
+        with self._lock:
+            live = list(self._replicas.values())
+        for eng in live:
             eng.load_adapter(adapter_id, adapter)
 
     # ---------------------------------------------------------- intake
@@ -205,22 +224,24 @@ class Router:
         is a FLEET deadline: retries submit with the remaining budget."""
         deadline = (None if deadline_s is None
                     else time.perf_counter() + deadline_s)
-        fh = FleetHandle(
-            rid=self._next_rid,
-            spec=dict(prompt=prompt, max_new_tokens=max_new_tokens,
-                      on_token=on_token, tenant=tenant,
-                      adapter_id=adapter_id),
-            deadline=deadline, retries_left=self.max_retries,
-            router=self)
-        self._next_rid += 1
-        self._place(fh, raise_rejection=True)
-        self._m_requests.inc()
-        self._inflight.append(fh)
+        with self._lock:
+            fh = FleetHandle(
+                rid=self._next_rid,
+                spec=dict(prompt=prompt, max_new_tokens=max_new_tokens,
+                          on_token=on_token, tenant=tenant,
+                          adapter_id=adapter_id),
+                deadline=deadline, retries_left=self.max_retries,
+                router=self)
+            self._next_rid += 1
+            self._place(fh, raise_rejection=True)
+            self._m_requests.inc()
+            self._inflight.append(fh)
         return fh
 
     def _candidates(self) -> List[int]:
         """Live, non-draining replica ids, least-loaded first (stats
-        snapshot inflight; ties by id — deterministic placement)."""
+        snapshot inflight; ties by id — deterministic placement).
+        Called with the router lock held."""
         return sorted(
             (rid for rid in self._replicas if rid not in self._draining),
             key=lambda rid: (self._replicas[rid].stats().inflight, rid))
@@ -228,7 +249,9 @@ class Router:
     def _place(self, fh: FleetHandle, raise_rejection: bool) -> bool:
         """Try to submit ``fh`` on each candidate replica in load order.
         True on placement; False when every candidate rejected (or none
-        exists) and ``raise_rejection`` is off."""
+        exists) and ``raise_rejection`` is off.  Called with the router
+        lock held (engine submits take the engine's own state lock —
+        lock order router -> engine, never reversed)."""
         remaining = None
         if fh.deadline is not None:
             remaining = fh.deadline - time.perf_counter()
@@ -269,18 +292,25 @@ class Router:
 
     @property
     def busy(self) -> bool:
-        return (any(eng.busy for eng in self._replicas.values())
-                or any(not fh.done for fh in self._inflight))
+        with self._lock:
+            live = list(self._replicas.values())
+            pending = any(not fh.done for fh in self._inflight)
+        return pending or any(eng.busy for eng in live)
 
     def step(self) -> bool:
         """One fleet tick: pump every live replica (a replica whose pump
         RAISES is declared dead and its in-flight requests rerouted),
         then sweep handles — finalize finished ones, resubmit failed or
-        orphaned ones that still have deadline and retry budget."""
+        orphaned ones that still have deadline and retry budget.
+
+        Engines are pumped WITHOUT the router lock (each engine's pump
+        mutex serializes its ticks), so submit/cancel/stats on other
+        threads never stall behind a device dispatch."""
         did = False
         plan = faults_lib.active()
-        for rid in list(self._replicas):
-            eng = self._replicas[rid]
+        with self._lock:
+            live = list(self._replicas.items())
+        for rid, eng in live:
             try:
                 if plan is not None:
                     plan.on_replica_step(rid)
@@ -288,7 +318,8 @@ class Router:
             except Exception as e:
                 self._replica_down(rid, e)
                 did = True
-        did = self._sweep() or did
+        with self._lock:
+            did = self._sweep() or did
         return did
 
     def drain(self, timeout_s: Optional[float] = None) -> bool:
@@ -304,11 +335,13 @@ class Router:
 
     def cancel(self, fh: FleetHandle) -> bool:
         """Abort one fleet request; False if already terminal."""
-        if fh.done:
-            return False
-        if fh._handle is not None and fh.replica_id in self._replicas:
-            self._replicas[fh.replica_id].cancel(fh._handle)
-        fh._finalize("cancelled")
+        with self._lock:
+            if fh.done:
+                return False
+            handle, eng = fh._handle, self._replicas.get(fh.replica_id)
+            fh._finalize("cancelled")
+        if handle is not None and eng is not None:
+            eng.cancel(handle)
         return True
 
     # ----------------------------------------------- rolling restarts
@@ -319,14 +352,19 @@ class Router:
         fleet until it is empty (other replicas keep serving).  Returns
         False on timeout (the replica stays draining — call again or
         ``remove_replica`` to force reroute)."""
-        if replica_id not in self._replicas:
-            raise KeyError(f"unknown replica {replica_id}")
-        self._draining.add(replica_id)
-        eng = self._replicas[replica_id]
+        with self._lock:
+            if replica_id not in self._replicas:
+                raise KeyError(f"unknown replica {replica_id}")
+            self._draining.add(replica_id)
+            eng = self._replicas[replica_id]
         deadline = (None if timeout_s is None
                     else time.perf_counter() + timeout_s)
-        while eng.busy or any(fh.replica_id == replica_id
-                              for fh in self._inflight if not fh.done):
+        while True:
+            with self._lock:
+                waiting = any(fh.replica_id == replica_id
+                              for fh in self._inflight if not fh.done)
+            if not (eng.busy or waiting):
+                break
             if deadline is not None and time.perf_counter() >= deadline:
                 return False
             if not self.step():
@@ -339,34 +377,41 @@ class Router:
         (deadline/retry budget permitting) — drain first for a clean
         handoff.  Returns the detached engine (restart it, then
         ``add_replica`` it back)."""
-        eng = self._replicas.pop(replica_id)
-        self._draining.discard(replica_id)
-        self._m_replicas.set(len(self._replicas))
-        for fh in self._inflight:
-            if fh.replica_id == replica_id and not fh.done \
-                    and fh._handle is not None:
-                eng.cancel(fh._handle)
-                fh._handle = None       # orphaned: the sweep reroutes
-                fh.replica_id = None
-                self._m_retries.inc()
-        self._sweep()
+        with self._lock:
+            eng = self._replicas.pop(replica_id)
+            self._draining.discard(replica_id)
+            self._m_replicas.set(len(self._replicas))
+            orphaned: List[RequestHandle] = []
+            for fh in self._inflight:
+                if fh.replica_id == replica_id and not fh.done \
+                        and fh._handle is not None:
+                    orphaned.append(fh._handle)
+                    fh._handle = None   # orphaned: the sweep reroutes
+                    fh.replica_id = None
+                    self._m_retries.inc()
+        for handle in orphaned:
+            eng.cancel(handle)
+        with self._lock:
+            self._sweep()
         return eng
 
     # ------------------------------------------------------- internals
 
     def _replica_down(self, replica_id: int, error: BaseException) -> None:
-        self._replicas.pop(replica_id, None)
-        self._draining.discard(replica_id)
-        self._m_down.inc()
-        self._m_replicas.set(len(self._replicas))
-        for fh in self._inflight:
-            if fh.replica_id == replica_id and not fh.done:
-                fh.error = error
-                fh._handle = None       # orphaned: the sweep reroutes
-                fh.replica_id = None
-                self._m_retries.inc()
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            self._draining.discard(replica_id)
+            self._m_down.inc()
+            self._m_replicas.set(len(self._replicas))
+            for fh in self._inflight:
+                if fh.replica_id == replica_id and not fh.done:
+                    fh.error = error
+                    fh._handle = None   # orphaned: the sweep reroutes
+                    fh.replica_id = None
+                    self._m_retries.inc()
 
     def _sweep(self) -> bool:
+        """Called with the router lock held."""
         did = False
         still: List[FleetHandle] = []
         for fh in self._inflight:

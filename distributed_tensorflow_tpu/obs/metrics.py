@@ -90,10 +90,12 @@ class Counter(_Metric):
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def samples(self):
-        return [(self.name, self.labels, self._value)]
+        with self._lock:
+            return [(self.name, self.labels, self._value)]
 
 
 class Gauge(_Metric):
@@ -113,10 +115,12 @@ class Gauge(_Metric):
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def samples(self):
-        return [(self.name, self.labels, self._value)]
+        with self._lock:
+            return [(self.name, self.labels, self._value)]
 
 
 class Histogram(_Metric):
@@ -143,11 +147,13 @@ class Histogram(_Metric):
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def quantile(self, q: float) -> float:
         """Upper bucket bound holding the q-quantile (the same estimate a
@@ -169,18 +175,24 @@ class Histogram(_Metric):
         return float("inf")
 
     def samples(self):
+        # snapshot under the lock: a concurrent observe() between the
+        # bucket walk and the _count read would render an exposition
+        # where the +Inf bucket and _count disagree (torn scrape)
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, total_count = self._sum, self._count
         out = []
         cum = 0
         for i, bound in enumerate(self.buckets):
-            cum += self._counts[i]
+            cum += counts[i]
             out.append((self.name + "_bucket",
                         self.labels + (("le", _format_value(bound)),),
                         float(cum)))
-        cum += self._counts[-1]
+        cum += counts[-1]
         out.append((self.name + "_bucket", self.labels + (("le", "+Inf"),),
                     float(cum)))
-        out.append((self.name + "_sum", self.labels, self._sum))
-        out.append((self.name + "_count", self.labels, float(self._count)))
+        out.append((self.name + "_sum", self.labels, total_sum))
+        out.append((self.name + "_count", self.labels, float(total_count)))
         return out
 
 
@@ -237,7 +249,8 @@ class Registry:
                                    buckets=buckets)
 
     def get(self, name: str, labels=None) -> Optional[_Metric]:
-        return self._metrics.get((name, _freeze_labels(labels)))
+        with self._lock:
+            return self._metrics.get((name, _freeze_labels(labels)))
 
     def expose(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -245,10 +258,10 @@ class Registry:
             families: Dict[str, List[_Metric]] = {}
             for (name, _), metric in sorted(self._metrics.items()):
                 families.setdefault(name, []).append(metric)
-            order = list(families)
+            meta = {name: self._families[name] for name in families}
         lines: List[str] = []
-        for name in order:
-            cls, help_text = self._families[name]
+        for name in families:
+            cls, help_text = meta[name]
             if help_text:
                 lines.append(f"# HELP {name} " +
                              help_text.replace("\\", "\\\\")

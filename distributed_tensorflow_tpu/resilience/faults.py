@@ -253,6 +253,7 @@ def _poison(tree: Any) -> Any:
 
 _ACTIVE: Optional[FaultPlan] = None
 _ENV_CACHE = (None, None)   # (env string, parsed plan)
+_ENV_LOCK = threading.Lock()  # rebuilds race across pump/producer threads
 
 
 def activate(plan: FaultPlan) -> FaultPlan:
@@ -282,16 +283,22 @@ def activated(plan: FaultPlan):
 def active() -> Optional[FaultPlan]:
     """The plan injection sites consult: an explicitly activated plan
     wins; otherwise ``DTTPU_FAULTS`` (JSON) is parsed once per distinct
-    value and cached — counters must persist across calls."""
+    value and cached — counters must persist across calls.
+
+    Injection sites run on scheduler pumps, router sweeps, and prefetch
+    producers concurrently; the rebuild is locked so one spec value maps
+    to ONE plan instance (two racing rebuilds would split the per-site
+    at-most-``times`` counters across two plans and over-fire faults)."""
     global _ENV_CACHE
     if _ACTIVE is not None:
         return _ACTIVE
     spec = os.environ.get("DTTPU_FAULTS")
     if not spec:
         return None
-    if _ENV_CACHE[0] != spec:
-        _ENV_CACHE = (spec, plan_from_env(spec))
-    return _ENV_CACHE[1]
+    with _ENV_LOCK:
+        if _ENV_CACHE[0] != spec:
+            _ENV_CACHE = (spec, plan_from_env(spec))
+        return _ENV_CACHE[1]
 
 
 def plan_from_env(spec: str) -> FaultPlan:

@@ -29,6 +29,7 @@ counters and the ``dttpu_adapter_resident`` gauge.
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 from ..obs import metrics as metrics_lib
@@ -62,6 +63,11 @@ class AdapterTable:
         self.arrays = model.init_lora_table(capacity + 1, rank)
         self._splice = jax.jit(model.lora_insert_row,
                                donate_argnums=(0,))
+        # register() runs on controller threads (Engine/Router
+        # load_adapter hot-swap) while acquire()/release() run on the
+        # scheduler pump — one lock keeps the row/pin/LRU maps and the
+        # device-table splices coherent
+        self._lock = threading.Lock()
         self._store: Dict[str, dict] = {}     # id -> host adapter tree
         self._rows: Dict[str, int] = {}       # id -> resident row
         self._refs: Dict[str, int] = {}       # id -> in-flight pins
@@ -87,11 +93,12 @@ class AdapterTable:
         if not adapter_id:
             raise ValueError("adapter_id must be a non-empty string")
         self._check_shapes(adapter)
-        self._store[adapter_id] = adapter
-        row = self._rows.get(adapter_id)
-        if row is not None:
-            self.arrays = self._splice(self.arrays, row, adapter)
-            self._loads.inc()
+        with self._lock:
+            self._store[adapter_id] = adapter
+            row = self._rows.get(adapter_id)
+            if row is not None:
+                self.arrays = self._splice(self.arrays, row, adapter)
+                self._loads.inc()
 
     def _check_shapes(self, adapter) -> None:
         want = self.model.lora_shapes(self.rank)
@@ -106,11 +113,13 @@ class AdapterTable:
                     f"{(L,) + a_shape}/{(L,) + b_shape}")
 
     def known(self, adapter_id: str) -> bool:
-        return adapter_id in self._store
+        with self._lock:
+            return adapter_id in self._store
 
     @property
     def resident_ids(self):
-        return tuple(self._rows)
+        with self._lock:
+            return tuple(self._rows)
 
     # ----------------------------------------------------------- pinning
 
@@ -121,31 +130,34 @@ class AdapterTable:
         raises ``AdapterTableFull`` when every row is pinned."""
         if adapter_id is None:
             return 0
-        if adapter_id not in self._store:
-            raise KeyError(f"unknown adapter_id {adapter_id!r}; "
-                           f"register() it first")
-        self._clock += 1
-        self._used[adapter_id] = self._clock
-        row = self._rows.get(adapter_id)
-        if row is None:
-            row = self._free_row()
-            self.arrays = self._splice(self.arrays, row, self._store[adapter_id])
-            self._rows[adapter_id] = row
-            self._loads.inc()
-            self._resident.set(len(self._rows))
-        self._refs[adapter_id] = self._refs.get(adapter_id, 0) + 1
-        return row
+        with self._lock:
+            if adapter_id not in self._store:
+                raise KeyError(f"unknown adapter_id {adapter_id!r}; "
+                               f"register() it first")
+            self._clock += 1
+            self._used[adapter_id] = self._clock
+            row = self._rows.get(adapter_id)
+            if row is None:
+                row = self._free_row()
+                self.arrays = self._splice(self.arrays, row,
+                                           self._store[adapter_id])
+                self._rows[adapter_id] = row
+                self._loads.inc()
+                self._resident.set(len(self._rows))
+            self._refs[adapter_id] = self._refs.get(adapter_id, 0) + 1
+            return row
 
     def release(self, adapter_id: Optional[str]) -> None:
         """Unpin one ``acquire`` (the adapter stays resident for reuse
         until evicted by a later load)."""
         if adapter_id is None:
             return
-        n = self._refs.get(adapter_id, 0)
-        if n <= 1:
-            self._refs.pop(adapter_id, None)
-        else:
-            self._refs[adapter_id] = n - 1
+        with self._lock:
+            n = self._refs.get(adapter_id, 0)
+            if n <= 1:
+                self._refs.pop(adapter_id, None)
+            else:
+                self._refs[adapter_id] = n - 1
 
     def _free_row(self) -> int:
         used = set(self._rows.values())

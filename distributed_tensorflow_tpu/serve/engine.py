@@ -43,15 +43,11 @@ from typing import Callable, List, Optional
 
 from ..obs import metrics as metrics_lib
 from .adapters import AdapterTable
-from .scheduler import EngineStats, Request, SlotScheduler
+from .scheduler import (EngineStats, QueueFullError, Request,
+                        SlotScheduler)
 
 __all__ = ["Engine", "EngineStats", "QueueFullError", "RequestHandle",
            "ServeMetrics"]
-
-
-class QueueFullError(RuntimeError):
-    """``submit`` rejected: the engine's queue is at ``max_queue_depth``.
-    Backpressure, not failure — retry after in-flight work retires."""
 
 
 class ServeMetrics:
@@ -272,10 +268,15 @@ class Engine:
                                       registry=self.metrics.registry)
                          if adapter_capacity else None)
         queue = tenancy.make_queue() if tenancy is not None else None
+        # admission (queue depth + tenant quota) lives INSIDE the
+        # scheduler, under its state lock, so concurrent submitters get
+        # one atomic decision instead of check-then-enqueue races
         self.scheduler = SlotScheduler(model, params,
                                        metrics=self.metrics,
                                        queue=queue,
                                        adapters=self.adapters,
+                                       max_queue_depth=max_queue_depth,
+                                       tenancy=tenancy,
                                        **scheduler_kwargs)
 
     # ----------------------------------------------------------- intake
@@ -309,28 +310,22 @@ class Engine:
         quotas here too (the policy's quota error propagates);
         ``adapter_id`` selects a loaded LoRA adapter."""
         new_tokens = max_new_tokens or self.default_max_new_tokens
-        if self.max_queue_depth is not None \
-                and self.scheduler.queued >= self.max_queue_depth:
+        try:
+            req = self.scheduler.submit(
+                prompt, new_tokens,
+                on_token=on_token,
+                deadline_s=(deadline_s if deadline_s is not None
+                            else self.default_deadline_s),
+                tenant=tenant, adapter_id=adapter_id)
+        except QueueFullError:
             self.metrics.rejected.inc()
-            raise QueueFullError(
-                f"queue at max_queue_depth={self.max_queue_depth}; "
-                "retry after in-flight requests retire")
-        if self.tenancy is not None:
-            try:
-                self.tenancy.check_admission(
-                    tenant, new_tokens,
-                    inflight=self.scheduler.tenant_inflight(tenant),
-                    tokens_inflight=self.scheduler
-                        .tenant_tokens_inflight(tenant))
-            except Exception:
+            raise
+        except (ValueError, KeyError):
+            raise                    # validation, not admission policy
+        except Exception:
+            if self.tenancy is not None:
                 self.metrics.tenant_rejected(tenant).inc()
-                raise
-        req = self.scheduler.submit(
-            prompt, new_tokens,
-            on_token=on_token,
-            deadline_s=(deadline_s if deadline_s is not None
-                        else self.default_deadline_s),
-            tenant=tenant, adapter_id=adapter_id)
+            raise
         return RequestHandle(req, self)
 
     # ------------------------------------------------------------ drive

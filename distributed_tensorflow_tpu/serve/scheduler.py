@@ -42,6 +42,27 @@ Exactness contract: with one request in flight the emitted tokens equal
 ``GPT.generate``'s greedy output token-for-token, and admission
 mid-decode leaves other slots' logits bit-identical — see
 ``GPT.decode_step_slots`` and tests/test_serve.py.
+
+Thread-safety contract (dtlint DT3xx + tests/test_thread_safety.py):
+``submit``/``cancel``/``stats`` may run on any thread concurrently with
+the pump.  Two locks, strictly ordered pump -> state:
+
+* ``_pump_lock`` serializes ticks — device state (``_cache``/
+  ``_tokens``/``_finished``/``_remaining``/``_key``) is touched ONLY
+  with the pump mutex held, so donation in the hot executables is
+  race-free and concurrent ``step()`` callers simply queue behind the
+  running tick;
+* ``_lock`` guards host bookkeeping (queue, slots table, prefill list,
+  cache pool, tenant counters) in short critical sections that never
+  span a device dispatch or a user callback.
+
+Cross-thread ``cancel`` never touches device arrays: it marks the row
+in ``_stale_rows`` (the pump freezes it at the next tick) and moves an
+in-flight prefill to the orphan list (the pump pools its cache).  Token
+delivery and terminal transitions are queued in tick order and flushed
+at the END of the tick — holding the pump mutex but NOT the state lock,
+so a slow ``on_token`` callback never blocks a concurrent ``submit``.
+Callbacks run on the pumping thread and must not re-enter ``step()``.
 """
 from __future__ import annotations
 
@@ -58,7 +79,12 @@ from ..ops import decoding as dec
 from . import slots as slots_lib
 from .adapters import AdapterTableFull
 
-__all__ = ["EngineStats", "Request", "SlotScheduler"]
+__all__ = ["EngineStats", "QueueFullError", "Request", "SlotScheduler"]
+
+
+class QueueFullError(RuntimeError):
+    """``submit`` rejected: the queue is at ``max_queue_depth``.
+    Backpressure, not failure — retry after in-flight work retires."""
 
 
 @dataclasses.dataclass
@@ -93,6 +119,9 @@ class Request:
     finish_time: Optional[float] = None
     done: threading.Event = dataclasses.field(
         default_factory=threading.Event)
+    # terminal transitions are claim-once (cancel vs pump races resolve
+    # in _retire_accounting under the scheduler lock)
+    _retired: bool = dataclasses.field(default=False, repr=False)
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -159,7 +188,8 @@ class SlotScheduler:
                  tick_steps: int = 4, temperature: float = 0.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  eos_id: Optional[int] = None, pad_id: Optional[int] = None,
-                 rng=None, metrics=None, queue=None, adapters=None):
+                 rng=None, metrics=None, queue=None, adapters=None,
+                 max_queue_depth: Optional[int] = None, tenancy=None):
         import jax
         import jax.numpy as jnp
 
@@ -185,7 +215,23 @@ class SlotScheduler:
         self.pad_id = dec.resolve_pad(eos_id, pad_id)
         self.metrics = metrics if metrics is not None else _NullMetrics()
         self.adapters = adapters
+        self.max_queue_depth = max_queue_depth
+        # duck-typed admission policy (fleet.tenancy.TenantPolicy):
+        # checked under the state lock so quota decisions are atomic
+        # against concurrent submitters
+        self.tenancy = tenancy
         self._next_rid = 0
+        # host-bookkeeping lock: queue/slots/prefills/pool/tenant
+        # counters — short sections only, never spanning a dispatch or a
+        # callback.  The pump mutex serializes ticks: device state is
+        # touched only with it held (lock order: pump -> state).
+        self._lock = threading.Lock()
+        self._pump_lock = threading.Lock()
+        # cross-thread cancel leaves device work to the pump: rows to
+        # freeze at the next tick, cancelled prefills whose caches the
+        # pump pools back
+        self._stale_rows: set = set()
+        self._orphans: List[list] = []
         # admission queue: a deque by default; any object with append/
         # popleft/remove/__len__/__iter__ (e.g. fleet.tenancy's deficit-
         # weighted fair queue) plugs in — the scheduler only asks "next
@@ -325,18 +371,32 @@ class SlotScheduler:
                 f"prompt ({plen}, chunk-padded {padded}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_len {self.max_len}")
         now = time.perf_counter()
-        req = Request(rid=self._next_rid, prompt=prompt,
-                      max_new_tokens=int(max_new_tokens),
-                      on_token=on_token, submit_time=now,
-                      deadline=None if deadline_s is None
-                      else now + deadline_s,
-                      tenant=str(tenant), adapter_id=adapter_id)
-        self._next_rid += 1
-        self._queue.append(req)
-        self._tenant_inflight[req.tenant] = \
-            self._tenant_inflight.get(req.tenant, 0) + 1
-        self._tenant_tokens[req.tenant] = \
-            self._tenant_tokens.get(req.tenant, 0) + req.max_new_tokens
+        tenant = str(tenant)
+        with self._lock:
+            # depth + quota + enqueue + counter bump are ONE atomic
+            # admission decision, however many threads submit at once
+            if self.max_queue_depth is not None \
+                    and len(self._queue) >= self.max_queue_depth:
+                raise QueueFullError(
+                    f"queue at max_queue_depth={self.max_queue_depth}; "
+                    "retry after in-flight requests retire")
+            if self.tenancy is not None:
+                self.tenancy.check_admission(
+                    tenant, int(max_new_tokens),
+                    inflight=self._tenant_inflight.get(tenant, 0),
+                    tokens_inflight=self._tenant_tokens.get(tenant, 0))
+            req = Request(rid=self._next_rid, prompt=prompt,
+                          max_new_tokens=int(max_new_tokens),
+                          on_token=on_token, submit_time=now,
+                          deadline=None if deadline_s is None
+                          else now + deadline_s,
+                          tenant=tenant, adapter_id=adapter_id)
+            self._next_rid += 1
+            self._queue.append(req)
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + 1
+            self._tenant_tokens[tenant] = \
+                self._tenant_tokens.get(tenant, 0) + req.max_new_tokens
         self.metrics.submitted(req)
         self._report_depth()
         return req
@@ -345,61 +405,114 @@ class SlotScheduler:
 
     @property
     def busy(self) -> bool:
-        return bool(self._queue) or bool(self._prefills) \
-            or any(r is not None for r in self._slots)
+        with self._lock:
+            return bool(self._queue) or bool(self._prefills) \
+                or any(r is not None for r in self._slots)
 
     @property
     def queued(self) -> int:
         """Requests accepted but not yet prefilling (the engine's
         ``max_queue_depth`` admission-control signal)."""
-        return len(self._queue)
+        with self._lock:
+            return len(self._queue)
 
     def stats(self) -> EngineStats:
         """The load snapshot (``EngineStats``): queue depth, prefill and
         slot occupancy, per-tenant in-flight counts.  Cheap host-side
         reads — the router polls this per placement and the serve gauges
         render from it, so there is exactly ONE bookkeeping source."""
-        return EngineStats(
-            queued=len(self._queue),
-            prefilling=len(self._prefills),
-            active=sum(r is not None for r in self._slots),
-            num_slots=self.num_slots,
-            inflight_per_tenant=dict(self._tenant_inflight),
-            tokens_inflight_per_tenant=dict(self._tenant_tokens))
+        with self._lock:
+            return EngineStats(
+                queued=len(self._queue),
+                prefilling=len(self._prefills),
+                active=sum(r is not None for r in self._slots),
+                num_slots=self.num_slots,
+                inflight_per_tenant=dict(self._tenant_inflight),
+                tokens_inflight_per_tenant=dict(self._tenant_tokens))
 
     def tenant_inflight(self, tenant: str) -> int:
-        return self._tenant_inflight.get(tenant, 0)
+        with self._lock:
+            return self._tenant_inflight.get(tenant, 0)
 
     def tenant_tokens_inflight(self, tenant: str) -> int:
-        return self._tenant_tokens.get(tenant, 0)
+        with self._lock:
+            return self._tenant_tokens.get(tenant, 0)
 
     def step(self) -> bool:
         """One tick: retire expired deadlines, advance every in-flight
         prefill by one window (starting new prefills for free slots
         first), then one decode dispatch over the slots.  Returns False
-        when fully idle."""
+        when fully idle.
+
+        Thread-safe: ticks are serialized by the pump mutex (concurrent
+        callers queue behind the running tick); ``submit``/``cancel``/
+        ``stats`` interleave freely.  Callbacks fire on the pumping
+        thread at the end of the tick and must not re-enter ``step``."""
+        with self._pump_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> bool:
         did = False
+        outbox: List[tuple] = []     # tick-ordered deliveries/finishes
+        self._harvest_orphans()
+        self._freeze_stale_rows()
         self._expire_deadlines()
-        free = sum(r is None for r in self._slots)
-        while len(self._queue) and len(self._prefills) < free:
-            req = self._queue.popleft()
+        while True:
+            with self._lock:
+                req = None
+                free = sum(r is None for r in self._slots)
+                if self._queue and len(self._prefills) < free:
+                    req = self._queue.popleft()
+            if req is None:
+                break
             try:
                 st = self._begin_prefill(req)
             except AdapterTableFull:
                 # every adapter row is pinned by an in-flight request:
                 # leave the request queued (a retirement frees a pin,
                 # so this always drains) and stop admitting this tick
-                self._requeue(req)
+                with self._lock:
+                    self._requeue(req)
                 break
-            self._prefills.append(st)
-        if self._prefills:
+            with self._lock:
+                self._prefills.append(st)
+        with self._lock:
+            pending = list(self._prefills)
+        if pending:
             did = True
-            self._prefills = [st for st in self._prefills
-                              if not self._advance_prefill(st)]
-        if any(r is not None for r in self._slots):
+            for st in pending:
+                self._advance_prefill(st, outbox)
+        with self._lock:
+            active = any(r is not None for r in self._slots)
+        if active:
             did = True
-            self._decode_tick()
+            self._decode_tick(outbox)
+        self._flush(outbox)
+        if did:
+            self._report_depth()
         return did
+
+    def _harvest_orphans(self) -> None:
+        """Pool the prefill caches of requests cancelled cross-thread
+        (only the pump owns cache recycling — a cancel mid-window must
+        not hand a buffer back while a dispatch is still writing it)."""
+        with self._lock:
+            orphans, self._orphans = self._orphans, []
+            for st in orphans:
+                self._pf_pool.append(slots_lib.strip_pos(st[3]))
+
+    def _freeze_stale_rows(self) -> None:
+        """Freeze device rows cancelled cross-thread since the last
+        tick.  Runs BEFORE admissions so a newcomer spliced into the
+        freed slot this tick is never frozen by the departed request's
+        leftover mark (reservation also discards its slot from the
+        set — the splice overwrites the whole row anyway)."""
+        with self._lock:
+            stale = sorted(self._stale_rows)
+            self._stale_rows.clear()
+        if stale:
+            self._finished = self._finished.at[np.asarray(stale)].set(
+                True)
 
     def _requeue(self, req: Request) -> None:
         """Put a popped-but-unstartable request back at the FRONT of its
@@ -428,9 +541,11 @@ class SlotScheduler:
             # may raise AdapterTableFull and the request must requeue
             # with nothing to unwind
             req.adapter_row = self.adapters.acquire(req.adapter_id)
-        kv = (self._pf_pool.pop() if self._pf_pool
-              else slots_lib.strip_pos(self.model.init_cache(
-                  1, self.max_len)))
+        with self._lock:
+            kv = self._pf_pool.pop() if self._pf_pool else None
+        if kv is None:
+            kv = slots_lib.strip_pos(self.model.init_cache(
+                1, self.max_len))
         return [req, windows, 0, dict(kv, pos=np.int32(0))]
 
     def _adapter_args(self, req: Optional[Request] = None):
@@ -444,20 +559,36 @@ class SlotScheduler:
                                                     np.int32)
         return self.adapters.arrays, self._adapter_rows
 
-    def _advance_prefill(self, st: list) -> bool:
-        """One window for one in-flight prefill; True when the request
-        left the prefill phase (admitted or finished)."""
+    def _advance_prefill(self, st: list, outbox: List[tuple]) -> None:
+        """One window for one in-flight prefill; admits the request into
+        its slot on the last window.  Pump-only; delivery of the first
+        token is queued on ``outbox`` (flushed at end of tick)."""
         req, windows, i, cache = st
+        with self._lock:
+            if st not in self._prefills:
+                return       # cancelled cross-thread: harvest pools it
         ad, ad_row = self._adapter_args(req)
         if i < len(windows) - 1:
-            st[3] = self._win_mid(self.params, cache, windows[i],
-                                  ad, ad_row)
-            st[2] = i + 1
-            return False
+            new_cache = self._win_mid(self.params, cache, windows[i],
+                                      ad, ad_row)
+            with self._lock:
+                st[3] = new_cache
+                st[2] = i + 1
+            return
         plen = req.prompt.size
         last_idx = np.int32(plen - 1 - (len(windows) - 1)
                             * self.prefill_chunk)
-        slot = self._slots.index(None)
+        with self._lock:
+            if st not in self._prefills or req.done.is_set():
+                return
+            self._prefills.remove(st)
+            slot = self._slots.index(None)
+            # reserve before the splice so the free-slot count stays
+            # consistent for concurrent admissions and stats(); the
+            # splice overwrites the row, so a leftover freeze mark from
+            # the slot's previous (cancelled) occupant must not fire
+            self._slots[slot] = req
+            self._stale_rows.discard(slot)
         if self._adapter_rows is not None:
             self._adapter_rows[slot] = req.adapter_row
         tok, self._cache, self._tokens, self._finished, \
@@ -468,31 +599,36 @@ class SlotScheduler:
                 np.int32(req.max_new_tokens), ad, ad_row)
         first = int(tok)          # host fetch: the TTFT barrier
         req.first_token_time = time.perf_counter()
-        # the pool entry was not donated — reusable for the next request
-        self._pf_pool.append(slots_lib.strip_pos(cache))
-        self.metrics.admitted(req)
-        try:
-            self._deliver(req, [first])
-        except Exception as e:
-            # failure isolation: the newcomer dies alone — freeze its
-            # freshly spliced row (frozen rows never perturb the others:
-            # the decode math is row-independent) and keep ticking
+        with self._lock:
+            # the pool entry was not donated — reusable for the next
+            # request
+            self._pf_pool.append(slots_lib.strip_pos(cache))
+            cancelled = req.done.is_set()
+            if cancelled and self._slots[slot] is req:
+                self._slots[slot] = None
+        if cancelled:
+            # cancel() raced the splice: retire the freshly spliced row
+            # (frozen rows never perturb the others) and deliver nothing
             self._finished = self._finished.at[slot].set(True)
-            self._abort(req, "failed", error=e)
-            self._report_depth()
-            return True
+            return
+        self.metrics.admitted(req)
         if req.max_new_tokens <= 1 or (self.eos_id is not None
                                        and first == self.eos_id):
-            self._finish(req)      # spliced but already finished: the
-            # slot stays free host-side and the splice is dead weight
+            with self._lock:
+                if self._slots[slot] is req:
+                    self._slots[slot] = None
+            # spliced but already finished in-graph: the slot stays free
+            # host-side and the splice is dead weight
+            outbox.append(("deliver", req, [first], None))
+            outbox.append(("finish", req))
         else:
-            self._slots[slot] = req
-        self._report_depth()
-        return True
+            outbox.append(("deliver", req, [first], slot))
 
     # ----------------------------------------------------------- decode
 
-    def _decode_tick(self) -> None:
+    def _decode_tick(self, outbox: List[tuple]) -> None:
+        with self._lock:
+            slots = list(self._slots)
         ad, ad_rows = self._adapter_args()
         (self._cache, self._tokens, self._finished, self._remaining,
          self._key), em, mask = self._tick(
@@ -501,72 +637,109 @@ class SlotScheduler:
         em = np.asarray(em)                      # [K, S]
         mask = np.asarray(mask)
         fin = np.asarray(self._finished)
-        for r, req in enumerate(self._slots):
+        for r, req in enumerate(slots):
             if req is None:
                 continue
+            with self._lock:
+                if self._slots[r] is not req:
+                    continue         # cancelled mid-dispatch: drop tokens
             toks = em[:, r][mask[:, r]]
             if toks.size:
-                try:
-                    self._deliver(req, [int(t) for t in toks])
-                except Exception as e:
-                    # failure isolation: a poisoned request (callback
-                    # raise, injected decode fault) fails its own handle;
-                    # its row freezes and every other slot keeps its
-                    # bit-exact stream — the tick loop never dies
-                    self._slots[r] = None
-                    self._finished = self._finished.at[r].set(True)
-                    self._abort(req, "failed", error=e)
-                    continue
+                outbox.append(("deliver", req, [int(t) for t in toks], r))
             if fin[r]:
-                self._slots[r] = None
+                with self._lock:
+                    if self._slots[r] is req:
+                        self._slots[r] = None
+                outbox.append(("finish", req))
+
+    def _flush(self, outbox: List[tuple]) -> None:
+        """Deliver tokens and terminal transitions in tick order.  Runs
+        at the end of the tick: pump mutex held (so streams stay ordered
+        per request across concurrently pumping threads) but the state
+        lock is NOT — a slow callback never blocks submit/cancel/stats.
+        A raising callback fails only its own request (failure
+        isolation): its row freezes, every other stream is untouched."""
+        poisoned: set = set()
+        for ev in outbox:
+            kind, req = ev[0], ev[1]
+            if id(req) in poisoned or req.done.is_set():
+                continue             # failed earlier this tick/cancelled
+            if kind == "deliver":
+                toks, row = ev[2], ev[3]
+                try:
+                    self._deliver(req, toks)
+                except Exception as e:
+                    poisoned.add(id(req))
+                    if row is not None:
+                        with self._lock:
+                            if self._slots[row] is req:
+                                self._slots[row] = None
+                        self._finished = self._finished.at[row].set(True)
+                    self._abort(req, "failed", error=e)
+            else:                    # "finish"
                 self._finish(req)
-        self._report_depth()
 
     # --------------------------------------------- degradation paths
 
     def _expire_deadlines(self) -> None:
         """Retire every request past its deadline, wherever it is —
         queued (never admitted), mid-prefill (cache back to the pool),
-        or active (row frozen).  Runs once per tick."""
+        or active (row frozen).  Runs once per tick, on the pump."""
         now = time.perf_counter()
 
         def expired(req):
             return req is not None and req.deadline is not None \
-                and now > req.deadline
+                and now > req.deadline and not req.done.is_set()
 
-        for req in [r for r in self._queue if expired(r)]:
-            self._queue.remove(req)
+        aborts: List[Request] = []
+        rows: List[int] = []
+        with self._lock:
+            for req in [r for r in self._queue if expired(r)]:
+                self._queue.remove(req)
+                aborts.append(req)
+            still = []
+            for st in self._prefills:
+                if expired(st[0]):
+                    self._pf_pool.append(slots_lib.strip_pos(st[3]))
+                    aborts.append(st[0])
+                else:
+                    still.append(st)
+            self._prefills = still
+            for r, req in enumerate(self._slots):
+                if expired(req):
+                    self._slots[r] = None
+                    rows.append(r)
+                    aborts.append(req)
+        if rows:
+            self._finished = self._finished.at[np.asarray(rows)].set(True)
+        for req in aborts:
             self._abort(req, "deadline_exceeded")
-        still = []
-        for st in self._prefills:
-            if expired(st[0]):
-                self._pf_pool.append(slots_lib.strip_pos(st[3]))
-                self._abort(st[0], "deadline_exceeded")
-            else:
-                still.append(st)
-        self._prefills = still
-        for r, req in enumerate(self._slots):
-            if expired(req):
-                self._slots[r] = None
-                self._finished = self._finished.at[r].set(True)
-                self._abort(req, "deadline_exceeded")
+        if aborts:
+            self._report_depth()
 
     def cancel(self, req: Request, status: str = "cancelled") -> bool:
         """Abort one request wherever it is; False if already finished.
         (The engine's ``generate_batch`` error path uses this so a
-        failed submit never strands earlier handles pending forever.)"""
+        failed submit never strands earlier handles pending forever.)
+
+        Thread-safe against a concurrently running tick: device work is
+        left to the pump — an active row lands in ``_stale_rows`` (the
+        pump freezes it next tick), a mid-window prefill moves to the
+        orphan list (the pump pools its cache when no dispatch can
+        still be writing it)."""
         if req.done.is_set():
             return False
-        if req in self._queue:
-            self._queue.remove(req)
-        for st in list(self._prefills):
-            if st[0] is req:
-                self._prefills.remove(st)
-                self._pf_pool.append(slots_lib.strip_pos(st[3]))
-        for r, other in enumerate(self._slots):
-            if other is req:
-                self._slots[r] = None
-                self._finished = self._finished.at[r].set(True)
+        with self._lock:
+            if req in self._queue:
+                self._queue.remove(req)
+            for st in list(self._prefills):
+                if st[0] is req:
+                    self._prefills.remove(st)
+                    self._orphans.append(st)
+            for r, other in enumerate(self._slots):
+                if other is req:
+                    self._slots[r] = None
+                    self._stale_rows.add(r)
         self._abort(req, status)
         self._report_depth()
         return True
@@ -576,47 +749,64 @@ class SlotScheduler:
     def _deliver(self, req: Request, toks: List[int]) -> None:
         plan = faults_lib.active()
         if plan is not None:
-            plan.on_decode(req.rid)   # chaos: may fail THIS request only
+            # chaos: may fail THIS request only.  The injection hook is
+            # the test double for this delivery path — it runs exactly
+            # where the real callback does, pump mutex and all
+            plan.on_decode(req.rid)  # dtlint: disable=DT303 -- see above
         req.tokens.extend(toks)
         self.metrics.emitted(req, len(toks))
         if req.on_token is not None:
-            req.on_token(toks)
+            # state lock NOT held here (submit/cancel/stats stay live);
+            # the pump mutex is — delivery is the tick's last phase, and
+            # callbacks are documented to never re-enter step()
+            req.on_token(toks)  # dtlint: disable=DT303 -- see comment
 
-    def _retire_accounting(self, req: Request) -> None:
+    def _retire_accounting(self, req: Request) -> bool:
         """Shared terminal bookkeeping: per-tenant in-flight counters
         come down, the adapter pin (if any) is released, and a fair-
-        share queue is told the request left the system."""
-        t = req.tenant
-        n = self._tenant_inflight.get(t, 0) - 1
-        if n > 0:
-            self._tenant_inflight[t] = n
-        else:
-            self._tenant_inflight.pop(t, None)
-        k = self._tenant_tokens.get(t, 0) - req.max_new_tokens
-        if k > 0:
-            self._tenant_tokens[t] = k
-        else:
-            self._tenant_tokens.pop(t, None)
+        share queue is told the request left the system.  Claim-once:
+        returns False when another thread already retired the request
+        (cancel racing the pump), so status/metrics fire exactly once."""
+        with self._lock:
+            if req._retired:
+                return False
+            req._retired = True
+            t = req.tenant
+            n = self._tenant_inflight.get(t, 0) - 1
+            if n > 0:
+                self._tenant_inflight[t] = n
+            else:
+                self._tenant_inflight.pop(t, None)
+            k = self._tenant_tokens.get(t, 0) - req.max_new_tokens
+            if k > 0:
+                self._tenant_tokens[t] = k
+            else:
+                self._tenant_tokens.pop(t, None)
+            release = getattr(self._queue, "release", None)
+            if release is not None:
+                release(req)
         if req.adapter_row is not None and self.adapters is not None:
+            # outside the state lock: release takes the adapter table's
+            # own lock (lock order stays scheduler-independent)
             self.adapters.release(req.adapter_id)
             req.adapter_row = None
-        release = getattr(self._queue, "release", None)
-        if release is not None:
-            release(req)
+        return True
 
     def _finish(self, req: Request) -> None:
+        if not self._retire_accounting(req):
+            return
         req.status = "ok"
         req.finish_time = time.perf_counter()
-        self._retire_accounting(req)
         self.metrics.finished(req)
         req.done.set()
 
     def _abort(self, req: Request, status: str,
                error: Optional[BaseException] = None) -> None:
+        if not self._retire_accounting(req):
+            return
         req.status = status
         req.error = error
         req.finish_time = time.perf_counter()
-        self._retire_accounting(req)
         self.metrics.aborted(req, status)
         req.done.set()
 

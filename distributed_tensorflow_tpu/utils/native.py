@@ -72,8 +72,12 @@ def _build_locked() -> None:
     with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
         fcntl.flock(lockf, fcntl.LOCK_EX)
         if not os.path.exists(_LIB_PATH):
-            subprocess.run(["make", "-C", _NATIVE_DIR],
-                           check=True, capture_output=True, timeout=120)
+            # intentional blocking-under-lock: the one-time native build
+            # must pin the lock — a thread arriving meanwhile needs the
+            # built artifact and has nothing to do but wait
+            subprocess.run(  # dtlint: disable=DT304 -- see comment above
+                ["make", "-C", _NATIVE_DIR],
+                check=True, capture_output=True, timeout=120)
 
 
 def load_native(build: bool = True) -> Optional[ctypes.CDLL]:

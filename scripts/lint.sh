@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
 # Repo lint gate: ruff (pyflakes + import hygiene, config in
 # pyproject.toml) then dtlint (distributed-JAX hazards, docs/ANALYSIS.md:
-# per-module DT1xx + interprocedural DT2xx) against the committed
-# baseline.  Extra args pass through to dtlint, e.g.
+# per-module DT1xx + interprocedural DT2xx + host-concurrency DT3xx)
+# against the committed baseline.  Extra args pass through to dtlint,
+# e.g.
 #   scripts/lint.sh --format github     # PR-diff annotations in CI
 #   DTLINT_JOBS=4 scripts/lint.sh       # parallel per-file pass
+#   DTLINT_LOG=lint.log scripts/lint.sh # tee findings to a file too
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,7 +16,17 @@ else
   echo "lint.sh: ruff not installed; skipping pyflakes tier" >&2
 fi
 
-exec python -m distributed_tensorflow_tpu.analysis \
+# --timings: per-tier breakdown (DT1xx per-file / DT2xx project /
+# DT3xx concurrency) on stderr so CI logs show where lint time goes.
+# Findings tee into $DTLINT_LOG when set; with `set -o pipefail` the
+# pipeline's status is dtlint's (tee's success must not mask findings),
+# captured via `|| rc=$?` because set -e would otherwise exit before
+# we can report it ourselves.
+rc=0
+python -m distributed_tensorflow_tpu.analysis \
   distributed_tensorflow_tpu examples scripts \
   --jobs "${DTLINT_JOBS:-0}" \
-  --baseline .dtlint-baseline.json "$@"
+  --timings \
+  --baseline .dtlint-baseline.json "$@" \
+  | tee "${DTLINT_LOG:-/dev/null}" || rc=$?
+exit "$rc"

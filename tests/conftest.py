@@ -186,6 +186,29 @@ def _retrace_guard_marker(request):
 
 
 # ---------------------------------------------------------------------------
+# Opt-in race harness (analysis/race_harness.py, docs/ANALYSIS.md):
+#
+#   @pytest.mark.race_harness(seed=7, scope=("serve/", "fleet/"))
+#
+# wraps the test in a RaceHarness: threads started inside it are forced
+# to context-switch at attribute/call sites in the scoped modules under
+# the seed, so host-concurrency races manifest deterministically instead
+# of once a fortnight in CI.  Opt-in by marker — opcode tracing is a
+# ~100x slowdown inside scope and must never leak into other tests.
+
+@pytest.fixture(autouse=True)
+def _race_harness_marker(request):
+    marker = request.node.get_closest_marker("race_harness")
+    if marker is None:
+        yield
+        return
+    from distributed_tensorflow_tpu.analysis.race_harness import RaceHarness
+    with RaceHarness(*marker.args, **marker.kwargs) as harness:
+        request.node.race_harness = harness
+        yield
+
+
+# ---------------------------------------------------------------------------
 # Fault injection (resilience/faults.py, docs/RESILIENCE.md): chaos tests
 # activate a deterministic FaultPlan for their extent via
 #

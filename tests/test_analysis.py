@@ -980,7 +980,8 @@ def test_baseline_partition_roundtrip(tmp_path):
 def test_rule_catalog_covers_all_families():
     ids = [rid for rid, _, _ in analysis.rule_catalog()]
     assert ids == ["DT101", "DT102", "DT103", "DT104", "DT105", "DT106",
-                   "DT107", "DT201", "DT202", "DT203", "DT204"]
+                   "DT107", "DT201", "DT202", "DT203", "DT204",
+                   "DT301", "DT302", "DT303", "DT304", "DT305", "DT306"]
 
 
 def test_cli_json_output_and_exit_codes(tmp_path):

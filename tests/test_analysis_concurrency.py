@@ -1,0 +1,635 @@
+"""dtlint DT3xx (host-concurrency tier): rule-by-rule fixtures.
+
+Same contract as tests/test_analysis.py: every rule gets a planted-bug
+fixture (flags), a fixed-twin fixture (silent), and a suppression
+fixture (honored).  Fixtures are parsed, never imported or run — the
+races are in the AST, not the interpreter.  The runtime sibling
+(``RaceHarness``) is exercised in tests/test_thread_safety.py where the
+code really runs.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from distributed_tensorflow_tpu import analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_conc(files, select=None, packages=()):
+    """Run the DT3xx tier over {module: code} fixtures."""
+    if isinstance(files, str):
+        files = {"pkg.mod": files}
+    sources = {mod: analysis.Source(mod.replace(".", "/") + ".py",
+                                    textwrap.dedent(code))
+               for mod, code in files.items()}
+    project = analysis.Project.from_sources(sources, set(packages))
+    sel = {select} if isinstance(select, str) else select
+    return analysis.run_concurrency_rules(project, select=sel)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------- DT301
+
+RACY_CLASS = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._jobs = []
+
+        def add(self, job):
+            with self._lock:
+                self._jobs.append(job)
+
+        def run_next(self):
+            return self._jobs.pop()      # no lock: races add()
+"""
+
+
+def test_dt301_inconsistent_lockset_across_public_entries():
+    findings = lint_conc(RACY_CLASS, select="DT301")
+    assert rules_of(findings) == ["DT301"]
+    assert "_jobs" in findings[0].message
+    assert "no common lock" in findings[0].message
+
+
+def test_dt301_fixed_twin_is_silent():
+    findings = lint_conc(RACY_CLASS.replace(
+        "return self._jobs.pop()      # no lock: races add()",
+        "with self._lock:\n                return self._jobs.pop()"),
+        select="DT301")
+    assert findings == []
+
+
+def test_dt301_global_written_on_thread_and_main():
+    findings = lint_conc("""
+        import threading
+
+        COUNT = 0
+
+        def worker():
+            global COUNT
+            COUNT += 1
+
+        def main():
+            global COUNT
+            t = threading.Thread(target=worker, name="w", daemon=True)
+            t.start()
+            COUNT += 1
+            t.join()
+    """, select="DT301")
+    assert rules_of(findings) == ["DT301"]
+    assert "COUNT" in findings[0].message
+
+
+def test_dt301_global_guarded_by_module_lock_is_silent():
+    findings = lint_conc("""
+        import threading
+
+        COUNT = 0
+        LOCK = threading.Lock()
+
+        def worker():
+            global COUNT
+            with LOCK:
+                COUNT += 1
+
+        def main():
+            global COUNT
+            t = threading.Thread(target=worker, name="w", daemon=True)
+            t.start()
+            with LOCK:
+                COUNT += 1
+            t.join()
+    """, select="DT301")
+    assert findings == []
+
+
+def test_dt301_torn_read_without_writers_lock():
+    findings = lint_conc("""
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0
+
+            def inc(self):
+                with self._lock:
+                    self._value += 1
+
+            def value(self):
+                return self._value       # torn read
+    """, select="DT301")
+    assert rules_of(findings) == ["DT301"]
+    assert "read here without" in findings[0].message
+
+
+def test_dt301_single_root_confinement_is_silent():
+    # device-state idiom: written only on the pump path, guarded by the
+    # pump mutex — one consistent lock, reads on the same path
+    findings = lint_conc("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pump_lock = threading.Lock()
+                self._state = 0
+
+            def step(self):
+                with self._pump_lock:
+                    self._tick()
+
+            def _tick(self):
+                self._state = self._state + 1
+    """, select="DT301")
+    assert findings == []
+
+
+def test_dt301_ctor_only_helper_is_silent():
+    # a private helper called only from __init__ runs before the object
+    # is shared — no finding (the Tracer._add_metadata idiom)
+    findings = lint_conc("""
+        import threading
+
+        class Tracer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._events = []
+                self._seed_metadata()
+
+            def _seed_metadata(self):
+                self._events.append({"ph": "M"})
+
+            def record(self, ev):
+                with self._lock:
+                    self._events.append(ev)
+
+            def events(self):
+                with self._lock:
+                    return list(self._events)
+    """, select="DT301")
+    assert findings == []
+
+
+def test_dt301_inherited_base_lock_counts():
+    # the obs.metrics idiom: the base class constructs the lock, the
+    # subclass guards writes with it — an unlocked subclass read flags
+    findings = lint_conc("""
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class Counter(Base):
+            def __init__(self):
+                super().__init__()
+                self._value = 0
+
+            def inc(self):
+                with self._lock:
+                    self._value += 1
+
+            def samples(self):
+                return [self._value]
+    """, select="DT301")
+    assert rules_of(findings) == ["DT301"]
+
+
+def test_dt301_suppression():
+    findings = lint_conc(RACY_CLASS.replace(
+        "return self._jobs.pop()      # no lock: races add()",
+        "return self._jobs.pop()  "
+        "# dtlint: disable=DT301 -- single-consumer by contract"),
+        select="DT301")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT302
+
+DEADLOCK_MOD = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def transfer():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def audit():
+        with LOCK_B:
+            with LOCK_A:
+                pass
+"""
+
+
+def test_dt302_lock_order_cycle():
+    findings = lint_conc(DEADLOCK_MOD, select="DT302")
+    assert rules_of(findings) == ["DT302"]
+    assert "opposite order" in findings[0].message or \
+        "lock-order cycle" in findings[0].message
+
+
+def test_dt302_consistent_order_is_silent():
+    findings = lint_conc(DEADLOCK_MOD.replace(
+        "with LOCK_B:\n            with LOCK_A:",
+        "with LOCK_A:\n            with LOCK_B:"), select="DT302")
+    assert findings == []
+
+
+def test_dt302_cycle_through_a_callee():
+    # audit() takes B then calls a helper that takes A: the edge comes
+    # from the entry-lock-set propagation, not lexical nesting
+    findings = lint_conc("""
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def _grab_a():
+            with LOCK_A:
+                pass
+
+        def transfer():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+
+        def audit():
+            with LOCK_B:
+                _grab_a()
+    """, select="DT302")
+    assert rules_of(findings) == ["DT302"]
+
+
+def test_dt302_suppression():
+    # the suppression sits on the acquiring `with` the finding anchors
+    # to — the first edge of the cycle in file order
+    findings = lint_conc(DEADLOCK_MOD.replace(
+        "with LOCK_A:\n            with LOCK_B:",
+        "with LOCK_A:\n            with LOCK_B:  "
+        "# dtlint: disable=DT302 -- audit runs single-threaded at exit"),
+        select="DT302")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT303
+
+CALLBACK_MOD = """
+    import threading
+
+    class Scheduler:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._out = []
+
+        def deliver(self, req, toks):
+            with self._lock:
+                self._out.append(toks)
+                req.on_token(toks)       # user code under the lock
+"""
+
+
+def test_dt303_callback_under_lock():
+    findings = lint_conc(CALLBACK_MOD, select="DT303")
+    assert rules_of(findings) == ["DT303"]
+    assert "on_token" in findings[0].message
+
+
+def test_dt303_fixed_twin_calls_outside_lock():
+    findings = lint_conc("""
+        import threading
+
+        class Scheduler:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._out = []
+
+            def deliver(self, req, toks):
+                with self._lock:
+                    self._out.append(toks)
+                req.on_token(toks)       # lock released first
+    """, select="DT303")
+    assert findings == []
+
+
+def test_dt303_parameter_callable_under_lock():
+    findings = lint_conc("""
+        import threading
+
+        LOCK = threading.Lock()
+
+        def guarded_apply(fn):
+            with LOCK:
+                return fn()
+    """, select="DT303")
+    assert rules_of(findings) == ["DT303"]
+    assert "caller-supplied" in findings[0].message
+
+
+def test_dt303_helper_only_called_under_lock_inherits_it():
+    # the _deliver idiom: the callback site is in a helper whose every
+    # call site holds the lock — entry-lock-set propagation finds it
+    findings = lint_conc("""
+        import threading
+
+        class Scheduler:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def tick(self, req):
+                with self._lock:
+                    self._n += 1
+                    self._deliver(req)
+
+            def _deliver(self, req):
+                req.on_token([1])
+    """, select="DT303")
+    assert rules_of(findings) == ["DT303"]
+
+
+def test_dt303_suppression():
+    findings = lint_conc(CALLBACK_MOD.replace(
+        "req.on_token(toks)       # user code under the lock",
+        "req.on_token(toks)  # dtlint: disable=DT303 -- trusted sink"),
+        select="DT303")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT304
+
+BLOCKING_MOD = """
+    import queue
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue()
+
+        def take(self):
+            with self._lock:
+                return self._q.get()     # blocks with the lock pinned
+"""
+
+
+def test_dt304_queue_get_under_lock():
+    findings = lint_conc(BLOCKING_MOD, select="DT304")
+    assert rules_of(findings) == ["DT304"]
+    assert findings[0].severity == "warning"
+    assert "Queue" in findings[0].message
+
+
+def test_dt304_sleep_and_join_under_lock():
+    findings = lint_conc("""
+        import threading
+        import time
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=print, name="t",
+                                           daemon=True)
+
+            def stop(self):
+                with self._lock:
+                    time.sleep(0.1)
+                    self._t.join()
+    """, select="DT304")
+    assert rules_of(findings) == ["DT304", "DT304"]
+
+
+def test_dt304_negative_dict_get_and_unlocked_queue():
+    findings = lint_conc("""
+        import queue
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+                self._names = {}
+
+            def take(self):
+                return self._q.get()         # no lock held: fine
+
+            def lookup(self, k):
+                with self._lock:
+                    return self._names.get(k)   # dict.get is not blocking
+    """, select="DT304")
+    assert findings == []
+
+
+def test_dt304_suppression():
+    findings = lint_conc(BLOCKING_MOD.replace(
+        "return self._q.get()     # blocks with the lock pinned",
+        "return self._q.get()  # dtlint: disable=DT304 -- bounded by "
+        "producer SLA"), select="DT304")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT305
+
+LEAKY_MOD = """
+    import threading
+
+    class Loader:
+        def start(self):
+            self._t = threading.Thread(target=self._run, name="ldr",
+                                       daemon=True)
+            self._t.start()
+
+        def _run(self):
+            pass
+"""
+
+
+def test_dt305_self_thread_never_joined():
+    findings = lint_conc(LEAKY_MOD, select="DT305")
+    assert rules_of(findings) == ["DT305"]
+    assert "never joined" in findings[0].message
+
+
+def test_dt305_fixed_twin_with_close_join():
+    findings = lint_conc("""
+        import threading
+
+        class Loader:
+            def start(self):
+                self._t = threading.Thread(target=self._run, name="ldr",
+                                           daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._t.join(timeout=5)
+    """, select="DT305")
+    assert findings == []
+
+
+def test_dt305_local_thread_without_join_and_inline_start():
+    findings = lint_conc("""
+        import threading
+
+        def fire_and_forget(work):
+            t = threading.Thread(target=work, name="w", daemon=True)
+            t.start()
+
+        def worse(work):
+            threading.Thread(target=work, name="w2", daemon=True).start()
+    """, select="DT305")
+    assert rules_of(findings) == ["DT305", "DT305"]
+
+
+def test_dt305_negative_joined_in_finally_and_escaping():
+    findings = lint_conc("""
+        import threading
+
+        def pump(work):
+            t = threading.Thread(target=work, name="w", daemon=True)
+            t.start()
+            try:
+                work()
+            finally:
+                t.join(timeout=5)
+
+        def build(work):
+            t = threading.Thread(target=work, name="w", daemon=True)
+            t.start()
+            return t                 # caller owns the shutdown path
+
+        def register(work, pool):
+            t = threading.Thread(target=work, name="w", daemon=True)
+            t.start()
+            pool.adopt(t)            # handed to an owner
+    """, select="DT305")
+    assert findings == []
+
+
+def test_dt305_suppression():
+    findings = lint_conc("""
+        import threading
+
+        def fire_and_forget(work):
+            t = threading.Thread(target=work, name="w", daemon=True)  # dtlint: disable=DT305 -- process-lifetime watcher
+            t.start()
+    """, select="DT305")
+    assert findings == []
+
+
+# ------------------------------------------------------------- DT306
+
+def test_dt306_thread_missing_name_and_daemon():
+    findings = lint_conc("""
+        import threading
+
+        def go(work):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+    """, select="DT306")
+    assert rules_of(findings) == ["DT306"]
+    assert "name" in findings[0].message and "daemon" in findings[0].message
+
+
+def test_dt306_missing_only_daemon():
+    findings = lint_conc("""
+        import threading
+
+        def go(work):
+            t = threading.Thread(target=work, name="dttpu-w")
+            t.start()
+            t.join()
+    """, select="DT306")
+    assert rules_of(findings) == ["DT306"]
+    assert "daemon" in findings[0].message
+
+
+def test_dt306_negative_and_suppression():
+    findings = lint_conc("""
+        import threading
+
+        def good(work):
+            t = threading.Thread(target=work, name="dttpu-w", daemon=False)
+            t.start()
+            t.join()
+
+        def legacy(work):
+            t = threading.Thread(target=work)  # dtlint: disable=DT306 -- stdlib naming kept for strace parity
+            t.start()
+            t.join()
+    """, select="DT306")
+    assert findings == []
+
+
+# ----------------------------------------------------- infrastructure
+
+def test_cli_concurrency_pass_and_opt_out(tmp_path):
+    """DT3xx through the real CLI, and --no-concurrency drops the tier."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import threading
+
+        def fire(work):
+            t = threading.Thread(target=work, name="w", daemon=True)
+            t.start()
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(bad), "--format", "json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["DT305"]
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(bad), "--format", "json", "--no-concurrency"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["count"] == 0
+
+
+def test_cli_timings_breakdown(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tensorflow_tpu.analysis",
+         str(good), "--timings"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0
+    assert "dtlint: timings:" in proc.stderr
+    for tier in ("per-file (DT1xx)", "project (DT2xx)",
+                 "concurrency (DT3xx)"):
+        assert tier in proc.stderr
+
+
+def test_dt3xx_sees_real_package_locks():
+    """The model must see the repo's own concurrent classes — if the
+    scheduler/router/metrics locks ever vanish from its view, the tier
+    is linting air and the self-check means nothing."""
+    files = analysis.collect_files(
+        [os.path.join(REPO, "distributed_tensorflow_tpu")])
+    project = analysis.Project.from_sources({
+        analysis.module_name_for(os.path.relpath(p, REPO)):
+            analysis.Source(p, open(p, encoding="utf-8").read())
+        for p in files})
+    model = analysis.ConcurrencyModel(project)
+    locked_classes = {cls for (_, cls), locks in model.class_locks.items()
+                      if locks}
+    for expect in ("SlotScheduler", "Router", "AdapterTable",
+                   "Registry", "Tracer", "Counter"):
+        assert expect in locked_classes, (expect, sorted(locked_classes))

@@ -1,0 +1,531 @@
+"""Host-concurrency runtime tests: the DT3xx tier's runtime sibling.
+
+What is pinned here (docs/ANALYSIS.md §RaceHarness):
+
+* ``RaceHarness`` makes a planted lost-update race manifest on EVERY
+  run under a fixed seed, and the lock-fixed twin passes the same
+  forced schedule — the harness turns "flaky once a fortnight" into a
+  regression test.
+* ``RetraceGuard``'s global ``jax.jit`` patch is refcounted: concurrent
+  guards (one per engine thread, the multi-replica fleet shape) and
+  nested guards share one installed patch and the LAST exit restores
+  the pristine ``jax.jit``.
+* The PR's concrete fixes, each under its own test: the obs.metrics
+  torn-exposition read, engine submit/cancel racing the scheduler pump,
+  the router stress acceptance (4 submitter threads over 2 engines,
+  terminal tokens bit-identical to solo ``generate``, no handle lost),
+  adapter-table hot-swap under concurrent registration, the
+  ``resilience.faults`` env-plan double-build, and prefetch producer
+  shutdown under forced preemption.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu import fleet, serve
+from distributed_tensorflow_tpu.analysis.race_harness import RaceHarness
+from distributed_tensorflow_tpu.analysis.sanitizer import RetraceGuard
+from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+
+_THIS_FILE = os.path.basename(__file__)
+
+
+def _model_params(seed=0, **kw):
+    model = gpt_tiny(dropout_rate=0.0, **kw)
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+def _prompt(plen, seed=1, vocab=512):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (plen,), 0, vocab), np.int32)
+
+
+def _generate_tokens(model, params, prompt, new, max_len, **kw):
+    out = model.generate(params, jnp.asarray(prompt[None]),
+                         max_new_tokens=new, max_len=max_len, **kw)
+    return np.asarray(out)[0, prompt.size:].tolist()
+
+
+# ---------------------------------------------------------------------------
+# RaceHarness: planted race reproduces, fixed twin passes
+
+
+class _RacyCounter:
+    """Deliberate lost-update window: load, compute, store — three
+    separate lines so the harness can preempt between them."""
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        cur = self.n
+        cur = cur + 1
+        self.n = cur
+
+
+class _LockedCounter:
+    def __init__(self):
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def bump(self):
+        with self._lock:
+            cur = self.n
+            cur = cur + 1
+            self.n = cur
+
+
+def _hammer(counter, threads=2, per_thread=60, seed=7):
+    barrier = threading.Barrier(threads)
+
+    def work():
+        barrier.wait()
+        for _ in range(per_thread):
+            counter.bump()
+
+    with RaceHarness(seed=seed, scope=(_THIS_FILE,)) as harness:
+        ts = [threading.Thread(target=work, name=f"dttpu-race-{i}",
+                               daemon=True) for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+    assert all(not t.is_alive() for t in ts)
+    return harness
+
+
+def test_race_harness_reproduces_planted_race_deterministically():
+    # the same seed forces yields at the same sites on every run: the
+    # unlocked read-modify-write LOSES updates, run after run — not
+    # once a fortnight in CI
+    counter = _RacyCounter()
+    harness = _hammer(counter)
+    assert harness.preemptions > 0
+    assert harness.threads_seen >= 2
+    assert counter.n < 120, (counter.n, harness.report())
+
+
+def test_race_harness_fixed_twin_passes_same_schedule():
+    counter = _LockedCounter()
+    harness = _hammer(counter)
+    assert harness.preemptions > 0
+    assert counter.n == 120, (counter.n, harness.report())
+
+
+def test_race_harness_restores_tracing_state():
+    old_interval = __import__("sys").getswitchinterval()
+    with RaceHarness(seed=0, scope=(_THIS_FILE,)):
+        pass
+    import sys
+    assert sys.gettrace() is None
+    assert sys.getswitchinterval() == pytest.approx(old_interval)
+
+
+@pytest.mark.race_harness(seed=3, scope=(_THIS_FILE,))
+def test_race_harness_pytest_marker_is_wired(request):
+    harness = getattr(request.node, "race_harness", None)
+    assert isinstance(harness, RaceHarness)
+    counter = _RacyCounter()
+    ts = [threading.Thread(target=lambda: [counter.bump()
+                                           for _ in range(40)],
+                           name=f"dttpu-mk-{i}", daemon=True)
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert harness.preemptions > 0
+
+
+# ---------------------------------------------------------------------------
+# RetraceGuard: refcounted global patch (satellite regression)
+
+
+def test_retrace_guard_concurrent_guards_do_not_corrupt_patch():
+    """Two guards entered from concurrent threads (the multi-replica
+    fleet shape): no lost original, no double patch — after both exit,
+    jax.jit is pristine; a retrace inside the window is still caught."""
+    orig_jit = jax.jit
+    barrier = threading.Barrier(2)
+    done = threading.Barrier(2)
+    errors = []
+    guards = {}
+
+    def engine_thread(name, retrace):
+        try:
+            with RetraceGuard(budget=1, mode="warn",
+                              enforce_donation=False) as g:
+                guards[name] = g
+                barrier.wait(timeout=30)   # both guards active at once
+                f = jax.jit(lambda x: x + 1)
+                f(jnp.zeros((2,)))
+                if retrace:
+                    f(jnp.zeros((3,)))     # second shape: retrace
+                done.wait(timeout=30)      # neither exits early
+        except Exception as e:             # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=engine_thread, args=("a", True),
+                           name="dttpu-g-a", daemon=True),
+          threading.Thread(target=engine_thread, args=("b", False),
+                           name="dttpu-g-b", daemon=True)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors
+    assert all(not t.is_alive() for t in ts)
+    assert jax.jit is orig_jit             # last guard out restored it
+    # the retracing thread's guard saw its violation (warn mode records)
+    assert any("retrace budget exceeded" in v
+               for v in guards["a"].violations)
+
+
+def test_retrace_guard_nested_guards_share_one_patch():
+    orig_jit = jax.jit
+    with RetraceGuard(budget=5, mode="warn",
+                      enforce_donation=False) as outer:
+        with RetraceGuard(budget=1, mode="warn",
+                          enforce_donation=False) as inner:
+            f = jax.jit(lambda x: x * 2)
+            f(jnp.zeros((2,)))
+            f(jnp.zeros((3,)))             # inner violates, outer absorbs
+        assert jax.jit is not orig_jit     # outer still active
+        g = jax.jit(lambda x: x - 1)       # constructed after inner exit
+        g(jnp.zeros((2,)))
+    assert jax.jit is orig_jit
+    assert inner.violations and not outer.violations
+
+
+def test_retrace_guard_same_object_reentry_rejected():
+    guard = RetraceGuard(budget=1)
+    with guard:
+        with pytest.raises(RuntimeError, match="not re-entrant"):
+            guard.__enter__()
+    assert jax.jit.__module__.startswith("jax")
+
+
+# ---------------------------------------------------------------------------
+# obs.metrics: torn exposition regression (DT301 fix)
+
+
+def test_metrics_exposition_never_torn_under_preemption():
+    """A /metrics scrape racing observe(): the histogram's +Inf bucket
+    must equal its _count in EVERY exposition (the unlocked samples()
+    rendered mid-observe snapshots where they disagree)."""
+    reg = metrics_lib.Registry()
+    hist = reg.histogram("t_seconds", "t", buckets=(0.1, 1.0))
+    ctr = reg.counter("t_total", "t")
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            hist.observe(0.05 * (i % 40))
+            ctr.inc()
+            i += 1
+
+    with RaceHarness(seed=11, scope=("obs/metrics.py",)) as harness:
+        t = threading.Thread(target=writer, name="dttpu-obs-w",
+                             daemon=True)
+        t.start()
+        try:
+            for _ in range(25):
+                doc = metrics_lib.parse_exposition(reg.expose())
+                fam = doc["t_seconds"]["samples"]
+                inf = fam[("t_seconds_bucket", (("le", "+Inf"),))]
+                cnt = fam[("t_seconds_count", ())]
+                assert inf == cnt, (inf, cnt)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+    assert harness.preemptions > 0
+    assert hist.count == hist.samples()[-1][2]   # locked reads agree
+
+
+# ---------------------------------------------------------------------------
+# engine/scheduler: concurrent submit + cancel vs the pump (DT301 fix)
+
+
+def test_engine_concurrent_submitters_no_loss_and_exact():
+    """4 submitter threads race the pumping main thread: every handle
+    completes ok, every stream is bit-identical to solo generate, and
+    the tenant accounting drains to zero."""
+    model, params = _model_params()
+    prompts = {i: _prompt(4 + (i % 3), seed=20 + i) for i in range(8)}
+    want = {i: _generate_tokens(model, params, prompts[i], 6, 32)
+            for i in range(8)}
+    eng = serve.Engine(model, params, num_slots=3, max_len=32,
+                       prefill_chunk=4, tick_steps=2,
+                       registry=metrics_lib.Registry())
+    handles = {}
+    hlock = threading.Lock()
+    barrier = threading.Barrier(4)
+
+    def submitter(ids):
+        barrier.wait(timeout=30)
+        for i in ids:
+            h = eng.submit(prompts[i], 6, tenant=f"t{i % 2}")
+            with hlock:
+                handles[i] = h
+
+    ts = [threading.Thread(target=submitter, args=([k, k + 4],),
+                           name=f"dttpu-sub-{k}", daemon=True)
+          for k in range(4)]
+    for t in ts:
+        t.start()
+    deadline = time.time() + 120
+    while True:
+        with hlock:
+            got = dict(handles)
+        if len(got) == 8 and all(h.done for h in got.values()):
+            break
+        eng.step()
+        assert time.time() < deadline, "fleet did not drain"
+    for t in ts:
+        t.join(timeout=30)
+    for i, h in handles.items():
+        assert h.status == "ok", (i, h.status, h.error)
+        assert h.tokens == want[i], i
+    st = eng.stats()
+    assert st.inflight == 0
+    assert st.inflight_per_tenant == {}
+    assert st.tokens_inflight_per_tenant == {}
+
+
+def test_engine_cancel_from_other_thread_then_slot_reuse_exact():
+    """Cross-thread cancel against a live pump: the cancelled handle
+    terminates exactly once, and the freed slot's next occupant decodes
+    bit-identically (stale-row freeze + orphaned-cache pooling)."""
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=1, max_len=64,
+                       prefill_chunk=4, tick_steps=1,
+                       registry=metrics_lib.Registry())
+    want = _generate_tokens(model, params, _prompt(4, seed=2), 6, 64)
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            if not eng.step():
+                time.sleep(0.001)
+
+    pt = threading.Thread(target=pump, name="dttpu-pump", daemon=True)
+    pt.start()
+    try:
+        h1 = eng.submit(_prompt(4, seed=1), 40)
+        deadline = time.time() + 60
+        while not h1.tokens:
+            assert time.time() < deadline
+            time.sleep(0.001)
+        assert eng.cancel(h1) is True      # from THIS thread, pump live
+        assert h1.done and h1.status == "cancelled"
+        assert eng.cancel(h1) is False
+        h2 = eng.submit(_prompt(4, seed=2), 6)
+        deadline = time.time() + 60
+        while not h2.done:
+            assert time.time() < deadline
+            time.sleep(0.001)
+        assert h2.status == "ok" and h2.tokens == want
+    finally:
+        stop.set()
+        pt.join(timeout=30)
+    assert not pt.is_alive()
+
+
+def test_engine_queue_depth_is_atomic_across_submitters():
+    """max_queue_depth under 4 racing submitters: exactly depth requests
+    are accepted (check-then-enqueue used to live outside the lock and
+    could overshoot)."""
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=1, max_len=32,
+                       prefill_chunk=4, tick_steps=1,
+                       max_queue_depth=2,
+                       registry=metrics_lib.Registry())
+    accepted, rejected = [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(4)
+
+    def submitter(i):
+        barrier.wait(timeout=30)
+        try:
+            h = eng.submit(_prompt(4, seed=i), 4)
+            with lock:
+                accepted.append(h)
+        except serve.QueueFullError:
+            with lock:
+                rejected.append(i)
+
+    ts = [threading.Thread(target=submitter, args=(i,),
+                           name=f"dttpu-q-{i}", daemon=True)
+          for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert len(accepted) == 2 and len(rejected) == 2
+    eng.drain()
+    assert all(h.status == "ok" for h in accepted)
+
+
+# ---------------------------------------------------------------------------
+# the router stress acceptance (satellite): 4 submitters, 2 engines
+
+
+@pytest.mark.race_harness(
+    seed=17, scope=("distributed_tensorflow_tpu/serve/",
+                    "distributed_tensorflow_tpu/fleet/"))
+def test_router_stress_tokens_exact_and_no_handle_lost(request):
+    """THE stress test: one Router over 2 engines driven by 4 submitter
+    threads under a seeded preemption schedule.  Every handle reaches a
+    terminal status (none lost in a torn in-flight list), and every
+    terminal token stream is bit-identical to solo ``generate`` — the
+    forced context switches land inside the scheduler/router critical
+    sections, exactly where the pre-lock code tore."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    engines = [serve.Engine(model, params, num_slots=2, max_len=32,
+                            prefill_chunk=4, tick_steps=2, registry=reg)
+               for _ in range(2)]
+    router = fleet.Router(engines, registry=reg)
+    prompts = {i: _prompt(4 + (i % 3), seed=40 + i) for i in range(8)}
+    want = {i: _generate_tokens(model, params, prompts[i], 6, 32)
+            for i in range(8)}
+    handles = {}
+    hlock = threading.Lock()
+    barrier = threading.Barrier(4)
+
+    def submitter(ids):
+        barrier.wait(timeout=60)
+        for i in ids:
+            h = router.submit(prompts[i], 6)
+            with hlock:
+                handles[i] = h
+
+    ts = [threading.Thread(target=submitter, args=([k, k + 4],),
+                           name=f"dttpu-fleet-{k}", daemon=True)
+          for k in range(4)]
+    for t in ts:
+        t.start()
+    deadline = time.time() + 300
+    while True:
+        with hlock:
+            got = dict(handles)
+        if len(got) == 8 and all(h.done for h in got.values()):
+            break
+        router.step()
+        assert time.time() < deadline, "router did not drain"
+    for t in ts:
+        t.join(timeout=60)
+
+    harness = request.node.race_harness
+    assert harness.preemptions > 0, "harness never fired"
+    assert len(handles) == 8                      # no handle lost
+    for i, h in handles.items():
+        assert h.status == "ok", (i, h.status, h.error)
+        assert h.tokens == want[i], i             # bit-identical streams
+    assert len(router.placements) >= 8
+    for st in router.stats().values():
+        assert st.inflight == 0
+
+
+# ---------------------------------------------------------------------------
+# adapter table: hot-swap registration racing acquire/release
+
+
+def test_adapter_table_register_races_acquire_release():
+    model, params = _model_params()
+    table = serve.AdapterTable(model, capacity=2, rank=4,
+                               registry=metrics_lib.Registry())
+    ad = model.init_lora(jax.random.PRNGKey(1), rank=4)
+    table.register("hot", ad)
+    errors = []
+    stop = threading.Event()
+
+    def swapper():
+        while not stop.is_set():
+            try:
+                table.register("hot", ad)      # hot-update re-splice
+            except Exception as e:             # pragma: no cover
+                errors.append(e)
+
+    with RaceHarness(seed=5, scope=("serve/adapters.py",)) as harness:
+        t = threading.Thread(target=swapper, name="dttpu-swap",
+                             daemon=True)
+        t.start()
+        try:
+            for _ in range(60):
+                row = table.acquire("hot")
+                assert row == 1                # stable resident row
+                table.release("hot")
+        finally:
+            stop.set()
+            t.join(timeout=30)
+    assert not errors
+    assert harness.preemptions > 0
+    assert table.resident_ids == ("hot",)
+    assert table._refs == {}                   # every pin released
+
+
+# ---------------------------------------------------------------------------
+# resilience.faults: env-plan cache builds exactly one instance
+
+
+def test_env_fault_plan_single_instance_across_threads(monkeypatch):
+    from distributed_tensorflow_tpu.resilience import faults
+
+    monkeypatch.setenv("DTTPU_FAULTS",
+                       '[{"kind": "poison_batch", "at": 999999}]')
+    faults._ENV_CACHE = (None, None)          # force a fresh rebuild
+    plans = []
+    plock = threading.Lock()
+    barrier = threading.Barrier(4)
+
+    def reader():
+        barrier.wait(timeout=30)
+        for _ in range(10):
+            p = faults.active()
+            with plock:
+                plans.append(p)
+
+    with RaceHarness(seed=9, scope=("resilience/faults.py",)):
+        ts = [threading.Thread(target=reader, name=f"dttpu-f-{i}",
+                               daemon=True) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+    faults._ENV_CACHE = (None, None)
+    assert len(plans) == 40
+    # one spec value -> ONE plan instance: racing rebuilds used to split
+    # the per-site at-most-`times` counters across two plans
+    assert len({id(p) for p in plans}) == 1
+
+
+# ---------------------------------------------------------------------------
+# data.pipeline: producer shutdown under forced preemption
+
+
+def test_prefetch_abandonment_joins_producer_under_preemption():
+    """The PR 4 leak fix, re-pinned under the harness: breaking out of
+    an epoch mid-stream (then closing) must unblock and join the
+    dttpu-prefetch producer even when the scheduler interleaves the
+    producer and consumer at every attribute/call site."""
+    from distributed_tensorflow_tpu.data import pipeline
+
+    batches = [np.full((2,), i, np.int32) for i in range(64)]
+    with RaceHarness(seed=13, scope=("data/pipeline.py",)) as harness:
+        it = pipeline.prefetch_to_device(iter(batches), size=2)
+        first = next(it)
+        assert int(np.asarray(first)[0]) == 0
+        it.close()                             # abandon mid-epoch
+    assert harness.preemptions > 0
+    leftover = [t for t in threading.enumerate()
+                if t.name == "dttpu-prefetch" and t.is_alive()]
+    assert leftover == []
