@@ -1262,7 +1262,30 @@ def bench_gpt_serve():
     replaces.  ``vs_lockstep`` > 1.0 is the acceptance bar: short
     requests no longer pay for long batchmates.  Single device (no
     mesh), like the other decode rows; wall clocks close with host
-    value fetches on both sides."""
+    value fetches on both sides.
+
+    BOTH storage layouts replay the mixed trace: ``vs_lockstep`` stays
+    the CONTIGUOUS stripe engine's ratio (the PR 4 comparator, so the
+    metric is comparable across rounds) and ``vs_lockstep_paged`` is
+    the default paged engine's — on this CPU smoke the XLA-emulated
+    page gather costs fusion in the tiny-model tick, which is exactly
+    what the side-by-side number makes visible (docs/SERVING.md).
+
+    Two more measured phases (serve/pages.py):
+
+    * ``shared_prefix``: a seeded arrival trace where requests share
+      one of a few SYSTEM PROMPTS (plus the mixed trace's per-group
+      long-tail stragglers).  Replayed on the paged engine with the
+      radix prefix cache ON and OFF (``prefix_cache=False`` — same
+      engine, same paging, reuse ablated): ``vs_no_reuse`` is the
+      cache's own win, ``prefix_hit_rate``/``prefill_windows_skipped``
+      the mechanism, and the TTFT p50 delta the latency effect.
+    * ``slots_at_fixed_mem``: with the page pool capped at the
+      contiguous layout's HBM budget (``slots`` full stripes), a burst
+      of short requests shows how many slots the paged engine actually
+      runs CONCURRENTLY — strictly more than the stripe layout's
+      ``slots``, because pages are allocated per actual footprint.
+    """
     import jax
     import numpy as np
     from distributed_tensorflow_tpu import serve
@@ -1316,23 +1339,32 @@ def bench_gpt_serve():
     # --config=fleet, which does enforce fair-share
     tenants = rng.choice(["free", "pro", "batch"], n_req)
 
-    eng = serve.Engine(model, params, num_slots=slots, max_len=seq,
-                       prefill_chunk=chunk, tick_steps=tick_steps)
-    # Warmup on the SAME engine (a fresh one would recompile): covers
-    # the mid+last prefill windows, the admit splice, and the tick.
-    eng.submit(rng.integers(0, config.vocab_size,
-                            chunk + 2).astype(np.int32), 4)
-    eng.submit(prompts[0], 2)
-    eng.drain()
+    def make_engine(**kw):
+        """Engine + warmup covering the mid+last prefill windows, the
+        admit splice/arm, and the tick (a cold engine would otherwise
+        compile inside the measured window)."""
+        eng = serve.Engine(model, params, num_slots=kw.pop("num_slots",
+                                                           slots),
+                           max_len=seq, prefill_chunk=chunk,
+                           tick_steps=tick_steps, **kw)
+        eng.submit(rng.integers(0, config.vocab_size,
+                                chunk + 2).astype(np.int32), 4)
+        eng.submit(prompts[0], 2)
+        eng.drain()
+        return eng
 
-    def replay_engine():
+    def replay_engine(eng, trace_prompts, trace_budgets, trace_arrivals,
+                      trace_tenants=None):
         handles = []
         i = tick = 0
+        n = len(trace_prompts)
         t0 = time.perf_counter()
-        while i < n_req or eng.busy:
-            while i < n_req and arrivals[i] <= tick:
-                handles.append(eng.submit(prompts[i], int(budgets[i]),
-                                          tenant=str(tenants[i])))
+        while i < n or eng.busy:
+            while i < n and trace_arrivals[i] <= tick:
+                handles.append(eng.submit(
+                    trace_prompts[i], int(trace_budgets[i]),
+                    tenant=("default" if trace_tenants is None
+                            else str(trace_tenants[i]))))
                 i += 1
             eng.step()
             tick += 1
@@ -1340,16 +1372,28 @@ def bench_gpt_serve():
         wall = time.perf_counter() - t0
         return wall, handles
 
+    def ttft_pcts(handles):
+        ttfts = sorted(h.ttft_s for h in handles)
+        return (ttfts[int(0.50 * (len(ttfts) - 1))],
+                ttfts[int(0.95 * (len(ttfts) - 1))])
+
     # best of 2 windows on BOTH sides (the WINDOWS rationale: a
     # background spike landing in one side's single window flips the
     # ratio); TTFTs are reported from the best engine window
-    wall_engine, handles = min((replay_engine() for _ in range(2)),
-                               key=lambda r: r[0])
+    eng = make_engine()                          # paged (the default)
+    wall_engine, handles = min(
+        (replay_engine(eng, prompts, budgets, arrivals, tenants)
+         for _ in range(2)), key=lambda r: r[0])
     total_tokens = sum(len(h.tokens) for h in handles)
     engine_tps = total_tokens / wall_engine
-    ttfts = sorted(h.ttft_s for h in handles)
-    ttft_p50 = ttfts[int(0.50 * (len(ttfts) - 1))]
-    ttft_p95 = ttfts[int(0.95 * (len(ttfts) - 1))]
+    ttft_p50, ttft_p95 = ttft_pcts(handles)
+    page_size = eng.scheduler.page_size
+
+    eng_c = make_engine(paged=False)             # the PR 4 comparator
+    wall_contig, handles_c = min(
+        (replay_engine(eng_c, prompts, budgets, arrivals, tenants)
+         for _ in range(2)), key=lambda r: r[0])
+    contig_tps = sum(len(h.tokens) for h in handles_c) / wall_contig
 
     # Lock-step comparator: same requests, batches of `slots` in arrival
     # order, LEFT-padded to the global max prompt, each batch running its
@@ -1383,21 +1427,132 @@ def bench_gpt_serve():
         wall_lock = w if wall_lock is None else min(wall_lock, w)
     lock_tps = float(budgets.sum()) / wall_lock
 
-    ratio = engine_tps / lock_tps
-    log(f"gpt_serve: engine {engine_tps:,.0f} tok/s vs lockstep "
-        f"{lock_tps:,.0f} ({ratio:.2f}x), ttft p50 {ttft_p50*1e3:.1f} ms "
-        f"/ p95 {ttft_p95*1e3:.1f} ms over {n_req} requests")
+    ratio_contig = contig_tps / lock_tps
+    ratio_paged = engine_tps / lock_tps
+    log(f"gpt_serve: paged {engine_tps:,.0f} tok/s, contiguous "
+        f"{contig_tps:,.0f}, lockstep {lock_tps:,.0f} "
+        f"(contiguous {ratio_contig:.2f}x / paged {ratio_paged:.2f}x), "
+        f"ttft p50 {ttft_p50*1e3:.1f} ms / p95 {ttft_p95*1e3:.1f} ms "
+        f"over {n_req} requests")
+
+    # ---- shared-prefix trace: the radix cache's own measured win ----
+    # Same long-tail discipline as the mixed trace, but every prompt is
+    # one of a few SYSTEM PROMPTS (3 pages each) plus a short unique
+    # tail — the multi-user serving shape prefix reuse exists for.
+    rng2 = np.random.default_rng(7)
+    n_sp = 24 if SMOKE else 48
+    sys_len = 3 * page_size
+    sys_prompts = [rng2.integers(0, config.vocab_size,
+                                 sys_len).astype(np.int32)
+                   for _ in range(3)]
+    which = rng2.integers(0, 3, n_sp)
+    sp_prompts = [np.concatenate([
+        sys_prompts[w],
+        rng2.integers(0, config.vocab_size,
+                      int(rng2.integers(4, 13))).astype(np.int32)])
+        for w in which]
+    sp_long = np.zeros(n_sp, bool)
+    for lo in range(0, n_sp, slots):
+        sp_long[lo + int(rng2.integers(0, min(slots, n_sp - lo)))] = True
+    sp_budgets = np.where(sp_long, rng2.choice(long_tiers, n_sp),
+                          rng2.integers(2, 9, n_sp))
+    sp_max = max(p.size for p in sp_prompts)
+    sp_budgets = np.clip(sp_budgets, 1, seq - sp_max - 1).astype(int)
+    sp_arrivals = np.sort(rng2.integers(0, slots + 1, n_sp))
+
+    sp_results = {}
+    for label, reuse in (("reuse", True), ("no_reuse", False)):
+        eng_sp = make_engine(prefix_cache=reuse)
+        wall, hs = min(
+            (replay_engine(eng_sp, sp_prompts, sp_budgets, sp_arrivals)
+             for _ in range(2)), key=lambda r: r[0])
+        p50, p95 = ttft_pcts(hs)
+        sp_results[label] = dict(
+            tps=sum(len(h.tokens) for h in hs) / wall,
+            p50=p50, p95=p95, stats=eng_sp.stats())
+
+    sp_args = []
+    for lo in range(0, n_sp, slots):
+        idx = range(lo, min(lo + slots, n_sp))
+        ids = np.zeros((slots, sp_max), np.int32)
+        valid = np.zeros((slots, sp_max), np.int32)
+        for r, j in enumerate(idx):
+            ids[r, sp_max - sp_prompts[j].size:] = sp_prompts[j]
+            valid[r, sp_max - sp_prompts[j].size:] = 1
+        sp_args.append((ids, valid, int(sp_budgets[list(idx)].max())))
+    for ids, valid, mn in sp_args:
+        np.asarray(gen_j(params, ids, valid, mn))
+    sp_lock = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for ids, valid, mn in sp_args:
+            np.asarray(gen_j(params, ids, valid, mn))
+        w = time.perf_counter() - t0
+        sp_lock = w if sp_lock is None else min(sp_lock, w)
+    sp_lock_tps = float(sp_budgets.sum()) / sp_lock
+
+    st = sp_results["reuse"]["stats"]
+    shared_prefix = dict(
+        requests=n_sp,
+        tokens_per_sec=round(sp_results["reuse"]["tps"], 1),
+        no_reuse_tokens_per_sec=round(sp_results["no_reuse"]["tps"], 1),
+        vs_no_reuse=round(sp_results["reuse"]["tps"]
+                          / sp_results["no_reuse"]["tps"], 3),
+        lockstep_tokens_per_sec=round(sp_lock_tps, 1),
+        vs_lockstep=round(sp_results["reuse"]["tps"] / sp_lock_tps, 3),
+        prefix_hit_rate=round(st.prefix_hit_rate, 3),
+        prefill_windows_skipped=st.prefill_windows_skipped_total,
+        prefix_tokens_reused=st.prefix_tokens_reused_total,
+        ttft_p50_ms=round(sp_results["reuse"]["p50"] * 1e3, 3),
+        ttft_p95_ms=round(sp_results["reuse"]["p95"] * 1e3, 3),
+        no_reuse_ttft_p50_ms=round(sp_results["no_reuse"]["p50"] * 1e3,
+                                   3))
+    log(f"gpt_serve shared-prefix: reuse "
+        f"{shared_prefix['tokens_per_sec']:,.0f} tok/s vs no-reuse "
+        f"{shared_prefix['no_reuse_tokens_per_sec']:,.0f} "
+        f"({shared_prefix['vs_no_reuse']:.2f}x), hit rate "
+        f"{shared_prefix['prefix_hit_rate']:.2f}, "
+        f"{shared_prefix['prefill_windows_skipped']} windows skipped, "
+        f"ttft p50 {shared_prefix['ttft_p50_ms']:.1f} ms vs "
+        f"{shared_prefix['no_reuse_ttft_p50_ms']:.1f} ms uncached")
+
+    # ---- slots_at_fixed_mem: concurrency at the contiguous budget ----
+    # Page pool capped at the stripe layout's HBM (slots full stripes);
+    # 2x the slots; a same-tick burst of short requests.  Peak
+    # concurrent ACTIVE slots is the measured claim: pages allocated
+    # per actual footprint, not per worst-case stripe.
+    eng_m = make_engine(num_slots=2 * slots,
+                        num_pages=slots * (seq // page_size) + 1)
+    burst_n = 2 * slots
+    b_prompts = [rng2.integers(0, config.vocab_size,
+                               int(rng2.integers(4, 2 * chunk))
+                               ).astype(np.int32)
+                 for _ in range(burst_n)]
+    b_handles = [eng_m.submit(p, 8) for p in b_prompts]
+    peak_active = 0
+    while eng_m.busy:
+        eng_m.step()
+        peak_active = max(peak_active, eng_m.stats().active)
+    assert all(h.done for h in b_handles)
+    log(f"gpt_serve slots_at_fixed_mem: {peak_active} concurrent slots "
+        f"on a {slots}-stripe budget (contiguous layout: {slots})")
+
     return dict(metric="gpt_serve_tokens_per_sec_per_chip",
                 value=round(engine_tps, 1), unit="tokens/sec/chip",
-                vs_baseline=round(ratio, 3),   # lock-step, same run
+                vs_baseline=round(ratio_contig, 3),  # lock-step, same run
                 tokens_per_sec=round(engine_tps, 1),
+                contiguous_tokens_per_sec=round(contig_tps, 1),
                 lockstep_tokens_per_sec=round(lock_tps, 1),
-                vs_lockstep=round(ratio, 3),
+                vs_lockstep=round(ratio_contig, 3),
+                vs_lockstep_paged=round(ratio_paged, 3),
                 ttft_p50_ms=round(ttft_p50 * 1e3, 3),
                 ttft_p95_ms=round(ttft_p95 * 1e3, 3),
                 requests=n_req, num_slots=slots, prefill_chunk=chunk,
                 tick_steps=tick_steps, total_new_tokens=total_tokens,
-                seq_len=seq)
+                seq_len=seq, page_size=page_size,
+                shared_prefix=shared_prefix,
+                slots_at_fixed_mem=peak_active,
+                slots_at_fixed_mem_contiguous=slots)
 
 
 def bench_fleet():

@@ -197,10 +197,20 @@ class Router:
             return self._replicas[replica_id]
 
     def stats(self) -> Dict[int, object]:
-        """{replica_id: EngineStats} for every live replica."""
+        """{replica_id: EngineStats} for every live replica.  Paged-KV
+        engines carry their page-pool occupancy and radix prefix-cache
+        counters in the same snapshot (``pages_free``,
+        ``prefix_hits_total``, ... — serve/pages.py), so fleet-level
+        capacity dashboards read one surface, not N /metrics scrapes."""
         with self._lock:
             live = list(self._replicas.items())
         return {rid: eng.stats() for rid, eng in live}
+
+    def pages_free(self) -> int:
+        """Fleet-wide free KV pages (sum over live paged replicas) —
+        the admission-headroom signal a capacity autoscaler would act
+        on; 0 when every replica runs the contiguous layout."""
+        return sum(s.pages_free for s in self.stats().values())
 
     def load_adapter(self, adapter_id: str, adapter) -> None:
         """Register a LoRA adapter on EVERY live replica (each holds its

@@ -877,6 +877,35 @@ class GPT:
         return (quant.dequantize_tensor(quant.QTensor(k_all, ks), dtype),
                 quant.dequantize_tensor(quant.QTensor(v_all, vs), dtype))
 
+    def _paged_layer_kv(self, kv, i, page_tab):
+        """Layer ``i``'s (k, v) read from a PAGE POOL through per-row
+        page tables, in the compute dtype.
+
+        ``kv``: pool subtree with ``[L, num_pages, page_size, kv_heads,
+        ...]`` leaves (serve/pages.py); ``page_tab`` [b, pages_per_row]
+        int32: row r's logical page j lives at pool page
+        ``page_tab[r, j]``.  The traced gather materializes the same
+        ``[b, view_len, kv_heads, head_dim]`` operand the contiguous
+        slot cache hands attention (``view_len = pages_per_row *
+        page_size``), so downstream attention math — int8 dequant at
+        the operand included — is IDENTICAL to the stripe layout's; the
+        indirection swaps per-slot worst-case stripes for pay-as-you-go
+        pages without touching the compiled attention."""
+        def view(name):
+            layer = lax.dynamic_index_in_dim(kv[name], i, keepdims=False)
+            g = jnp.take(layer, page_tab, axis=0)   # [b, mp, pg, kvh, x]
+            return g.reshape(g.shape[0], g.shape[1] * g.shape[2],
+                             *g.shape[3:])
+        k_all, v_all = view("k"), view("v")
+        if "k_scale" not in kv:
+            return k_all, v_all
+        from ..ops import quant
+        dtype = self.config.dtype
+        return (quant.dequantize_tensor(
+                    quant.QTensor(k_all, view("k_scale")), dtype),
+                quant.dequantize_tensor(
+                    quant.QTensor(v_all, view("v_scale")), dtype))
+
     def decode_step(self, params, cache, token_ids, kv_valid=None,
                     positions=None):
         """One token through the stack against the cache.
@@ -1017,8 +1046,83 @@ class GPT:
         x = self._norm(params["ln_f"], x)
         return self.logits(params, x)[:, 0, :], new_kv
 
+    def decode_step_slots_paged(self, params, kv, token_ids, page_tab,
+                                write_col, kv_valid, positions,
+                                adapters=None, adapter_rows=None):
+        """``decode_step_slots`` against a PAGED slot cache.
+
+        Same per-row semantics as ``decode_step_slots`` — row r's token
+        writes at its logical column ``write_col[r]``, attends
+        ``kv_valid[r]`` plus its own column, embeds at ``positions[r]``
+        — but the K/V live in a shared page pool (``kv``: ``[L,
+        num_pages, page_size, ...]`` leaves) indexed by the per-row
+        ``page_tab`` [b, pages_per_row]: reads gather each row's pages
+        into the usual ``[b, view_len, ...]`` operand
+        (``_paged_layer_kv``), the write scatters into pool cell
+        ``(page_tab[r, write_col[r] // page_size], write_col[r] %
+        page_size)``.  Both the table and the column state are traced,
+        so page allocation, shared-prefix mapping, and slot retirement
+        never change the compiled step (serve/pages.py owns the host
+        bookkeeping).  Rows whose table maps the reserved trash page 0
+        are retired: their writes land where no validity mask looks.
+
+        Returns (logits [b, vocab] f32, new kv pool).  Per row the math
+        is exactly ``decode_step_slots``'s on the gathered view — the
+        serve tier's paged==contiguous bit-identity tests hold it
+        there.
+        """
+        c = self.config
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], token_ids, axis=0)[:, None, :]  # [b,1,d]
+        if c.position_embedding == "learned":
+            x = x + jnp.take(emb["position"], positions,
+                             axis=0)[:, None, :]
+        x = x.astype(c.dtype)
+
+        page_size = kv["k"].shape[2]
+        view_len = page_tab.shape[1] * page_size
+        valid = kv_valid | (jnp.arange(view_len)[None, :]
+                            == write_col[:, None])
+        kv_mask = jnp.where(valid, 0.0, attn_lib.NEG_INF)[:, None, None, :]
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            rope_cs = attn_lib.rope_tables(positions[:, None], c.head_dim,
+                                           base=c.rope_base)
+
+        # write cell per row, from the traced table (clamped index: a
+        # full slot's frozen write head cannot run off its table row)
+        page_idx = jnp.minimum(write_col // page_size,
+                               page_tab.shape[1] - 1)
+        w_pages = jnp.take_along_axis(page_tab, page_idx[:, None],
+                                      axis=1)[:, 0]
+        paged = (w_pages, write_col % page_size)
+
+        def attention(q, k_blk, v_blk, kv, i):
+            del k_blk, v_blk   # single token: read back through the pool
+            k_cache, v_cache = self._paged_layer_kv(kv, i, page_tab)
+            return attn_lib.dot_product_attention(q, k_cache, v_cache,
+                                                  mask=kv_mask)
+
+        def body(carry, inputs):
+            x, kv = carry
+            p, i = inputs
+            return self._cache_layer(p, x, kv, i,
+                                     write_pos=None, rope_cs=rope_cs,
+                                     attention=attention,
+                                     adapters=adapters,
+                                     adapter_rows=adapter_rows,
+                                     paged=paged), None
+
+        (x, new_kv), _ = lax.scan(
+            body, (x, dict(kv)),
+            (params["decoder"], jnp.arange(c.num_layers)))
+        x = self._norm(params["ln_f"], x)
+        return self.logits(params, x)[:, 0, :], new_kv
+
     def _cache_layer(self, p, x, kv, i, *, write_pos, rope_cs,
-                     attention, adapters=None, adapter_rows=None):
+                     attention, adapters=None, adapter_rows=None,
+                     paged=None):
         """ONE decoder layer of the KV-cache path — shared by decode_step
         (s=1 against the cache) and decode_block (whole-prompt prefill)
         so the layer math can never diverge between them.  The cache
@@ -1043,6 +1147,15 @@ class GPT:
         slot-serving path, ``decode_step_slots``): vector positions
         write by scatter, one (row, column-run) per batch row, so slots
         at different sequence lengths share one compiled step.
+
+        ``paged``: (page_ids [N], offs [N]) with N = b*s — the cache is
+        a PAGE POOL ([L, num_pages, page_size, kv_heads, ...] leaves,
+        serve/pages.py) and token t of the flattened (b, s) window
+        writes at pool cell ``(page_ids[t], offs[t])`` instead of a
+        column of a per-row stripe.  The traced indices come from a
+        per-slot page table, so every (slot, page) assignment runs the
+        SAME executable; ``write_pos`` is ignored for the write (reads
+        still gather through the table in ``attention``).
         """
         h = self._norm(p["ln_1"], x)
         a = p["attention"]
@@ -1065,7 +1178,7 @@ class GPT:
             q = attn_lib.apply_rope(q, *rope_cs)
             k = attn_lib.apply_rope(k, *rope_cs)
         zero = jnp.zeros((), jnp.int32)
-        per_row = jnp.ndim(write_pos) == 1
+        per_row = paged is None and jnp.ndim(write_pos) == 1
         if per_row:
             b, s = x.shape[:2]
             if s == 1:
@@ -1095,6 +1208,23 @@ class GPT:
                 kv[name] = kv[name].at[i, rows, cols].set(
                     val.astype(kv[name].dtype))
 
+        def page_write(name, val):
+            """Pool-cell scatter: the flattened (b, s) tokens land at
+            ``(page_ids[t], offs[t])`` of layer ``i``'s pool plane —
+            scattered on the LAYER slice, then slice-written back, so
+            XLA never lowers a scatter over the whole [L, ...] pool
+            (same layer-slice trick as the contiguous ``row_write``).
+            Live slots always map disjoint write cells (a slot's write
+            page is private — serve/pages.py); retired rows map the
+            reserved trash page 0, whose cells no validity mask ever
+            admits, so their frozen writes are dead weight, not state."""
+            flat = val.reshape((-1,) + val.shape[2:])
+            layer = lax.dynamic_index_in_dim(kv[name], i, keepdims=False)
+            layer = layer.at[paged].set(flat.astype(layer.dtype))
+            kv[name] = lax.dynamic_update_slice(
+                kv[name], layer[None],
+                (i,) + (jnp.int32(0),) * layer.ndim)
+
         def write(name, val):
             if "k_scale" in kv:
                 # ONE quantization scheme repo-wide: ops.quant's
@@ -1102,7 +1232,10 @@ class GPT:
                 # last axis is the reduced one)
                 from ..ops import quant
                 qt = quant.quantize_tensor(val, reduce_axes=(-1,))
-                if per_row:
+                if paged is not None:
+                    page_write(name, qt.q)
+                    page_write(name + "_scale", qt.scale)
+                elif per_row:
                     row_write(name, qt.q)
                     row_write(name + "_scale", qt.scale)
                 else:
@@ -1112,6 +1245,8 @@ class GPT:
                     kv[name + "_scale"] = lax.dynamic_update_slice(
                         kv[name + "_scale"], qt.scale[None],
                         (i, zero, write_pos, zero, zero))
+            elif paged is not None:
+                page_write(name, val)
             elif per_row:
                 row_write(name, val)
             else:
@@ -1277,6 +1412,62 @@ class GPT:
             return self.logits(params, x)[:, 0, :], new_cache
         x = self._norm(params["ln_f"], x)
         return self.logits(params, x), new_cache
+
+    def decode_window_paged(self, params, kv, token_ids, page_row, pos,
+                            head: str = "all", adapters=None,
+                            adapter_rows=None):
+        """``decode_window`` against a PAGED cache: a batch-1 window of
+        ``s`` tokens at positions ``pos..pos+s-1``, reading and writing
+        the shared page pool through one request's ``page_row``
+        [pages_per_row] int32.
+
+        The serve tier's chunked-prefill step under paging
+        (serve/pages.py): ``pos`` is a TRACED scalar, so a request that
+        maps shared prefix pages simply starts its first window at
+        ``pos = skip`` — the skipped windows are never dispatched, yet
+        row j still attends every cache column ``<= pos + j`` (shared
+        pages included).
+
+        Structure: gather the row's pages ONCE into a contiguous
+        ``[L, 1, view_len, ...]`` stripe, run the UNMODIFIED
+        ``decode_window`` on it (so the window math is the contiguous
+        engine's to the bit — and the layer scan carries one stripe,
+        never the whole pool), then scatter the ``s`` written columns
+        back to their pool cells ``(page_row[c // page_size], c %
+        page_size)``.  Pad columns of the last window map whatever
+        ``page_row`` holds there (the reserved trash page 0 when
+        unallocated) — written but never valid, exactly the contiguous
+        path's dead-weight pads.
+
+        ``head`` as in ``decode_window``.  Returns (logits, new kv
+        pool) — the pool subtree carries no ``pos``; the caller owns
+        positions (serve/scheduler tracks them host-side).
+        """
+        if head not in ("all", "last", "none"):
+            raise ValueError(f"head must be all|last|none; got {head!r}")
+        b, s = token_ids.shape
+        if b != 1:
+            raise ValueError(f"decode_window_paged is batch-1 (one page "
+                             f"row = one request); got batch {b}")
+        page_size = kv["k"].shape[2]
+
+        def gather(name):
+            g = jnp.take(kv[name], page_row, axis=1)  # [L, mp, pg, ...]
+            return g.reshape(g.shape[0], 1, g.shape[1] * g.shape[2],
+                             *g.shape[3:])
+        view = {name: gather(name) for name in kv}
+        logits, view = self.decode_window(
+            params, dict(view, pos=pos), token_ids, head=head,
+            adapters=adapters, adapter_rows=adapter_rows)
+
+        cols = pos + jnp.arange(s)
+        pids = jnp.take(page_row, cols // page_size)
+        offs = cols % page_size
+        new_kv = {}
+        for name in kv:
+            vals = jnp.take(view[name][:, 0], cols, axis=1)  # [L, s, ...]
+            new_kv[name] = kv[name].at[:, pids, offs].set(vals)
+        return logits, new_kv
 
     def prefill_cache(self, params, cache, token_ids,
                       chunk: Optional[int] = None):

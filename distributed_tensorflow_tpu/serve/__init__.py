@@ -5,7 +5,7 @@ decode step stays hot while requests are admitted and retired with no
 retracing — the batch dimension of the KV cache becomes a bank of
 SLOTS, each an independent request at its own length.
 
-Three layers (docs/SERVING.md):
+Four layers (docs/SERVING.md):
 
 * ``serve.slots`` — the slot cache state: per-slot kv_valid/write_col/
   positions, the ``insert_slot`` splice, the all-slots decode step.
@@ -17,20 +17,33 @@ Three layers (docs/SERVING.md):
   TTFT and per-request decode histograms, token counters) on the
   existing ``/metrics`` endpoint.
 
+* ``serve.pages`` — the paged K/V memory layer (the default storage):
+  a device page pool with per-slot page tables, host free-list/refcount
+  bookkeeping, and a radix prefix cache that lets requests sharing a
+  prompt prefix map the same read-only pages and skip those prefill
+  windows (``paged=False`` keeps the contiguous stripe layout).
+
 Measured by ``bench.py --config=gpt_serve`` against a lock-step-batching
 baseline in the same process; exactness (single request == greedy
-``GPT.generate``, admission never perturbs other slots) is pinned by
-tests/test_serve.py.
+``GPT.generate``, admission never perturbs other slots, paged ==
+contiguous bit-for-bit) is pinned by tests/test_serve.py and
+tests/test_pages.py.
 """
-from . import adapters, engine, scheduler, slots
+from . import adapters, engine, pages, scheduler, slots
 from .adapters import AdapterTable, AdapterTableFull
 from .engine import Engine, QueueFullError, RequestHandle, ServeMetrics
+from .pages import (PageLease, PagePool, PagePoolExhausted,
+                    auto_page_size, decode_paged_step, init_paged_cache,
+                    paged_kv_valid)
 from .scheduler import EngineStats, Request, SlotScheduler
 from .slots import (decode_slots_step, init_slot_cache, insert_slot,
                     slot_kv_valid, strip_pos)
 
 __all__ = ["AdapterTable", "AdapterTableFull", "Engine", "EngineStats",
+           "PageLease", "PagePool", "PagePoolExhausted",
            "QueueFullError", "RequestHandle", "ServeMetrics",
-           "Request", "SlotScheduler", "decode_slots_step",
-           "init_slot_cache", "insert_slot", "slot_kv_valid", "strip_pos",
-           "adapters", "engine", "scheduler", "slots"]
+           "Request", "SlotScheduler", "auto_page_size",
+           "decode_paged_step", "decode_slots_step", "init_paged_cache",
+           "init_slot_cache", "insert_slot", "paged_kv_valid",
+           "slot_kv_valid", "strip_pos",
+           "adapters", "engine", "pages", "scheduler", "slots"]

@@ -89,6 +89,28 @@ class ServeMetrics:
             "dttpu_serve_failed_total",
             "Requests failed individually (callback/decode error) "
             "without killing the scheduler.")
+        # paged-KV series (serve/pages.py; flat zero on a contiguous
+        # engine) — rendered from the same Engine.stats() snapshot as
+        # the gauges above, so there is exactly ONE bookkeeping source
+        self.pages_free = reg.gauge(
+            "dttpu_serve_pages_free",
+            "KV-cache pool pages on the free list.")
+        self.pages_per_request = reg.gauge(
+            "dttpu_serve_pages_per_request",
+            "Average pages held per in-flight request "
+            "(shared prefix pages count once per holder).")
+        self.prefix_hits = reg.counter(
+            "dttpu_serve_prefix_hits_total",
+            "Requests that mapped radix-cached prefix pages and "
+            "skipped their prefill windows.")
+        self.prefix_evictions = reg.counter(
+            "dttpu_serve_prefix_evictions_total",
+            "Radix-cached prefix pages reclaimed by LRU eviction "
+            "under allocation pressure.")
+        # counters render by delta against the stats() snapshot (the
+        # exposition forbids decreasing counters; stats are monotonic)
+        self._last_prefix_hits = 0
+        self._last_prefix_evictions = 0
         # per-tenant series, created lazily at first sight of a tenant
         # (cardinality = the tenant set, which admission policy bounds)
         self._tenant_tokens: dict = {}
@@ -147,9 +169,20 @@ class ServeMetrics:
 
     def depth(self, stats: EngineStats) -> None:
         """Render the gauges from the scheduler's ``stats()`` snapshot —
-        the one bookkeeping source (no separate counters here)."""
+        the one bookkeeping source (no separate counters here; the
+        paged-KV counters advance by snapshot delta)."""
         self.queue_depth.set(stats.queued)
         self.active_slots.set(stats.active)
+        self.pages_free.set(stats.pages_free)
+        self.pages_per_request.set(stats.pages_per_request)
+        d = stats.prefix_hits_total - self._last_prefix_hits
+        if d > 0:
+            self.prefix_hits.inc(d)
+            self._last_prefix_hits = stats.prefix_hits_total
+        d = stats.prefix_evictions_total - self._last_prefix_evictions
+        if d > 0:
+            self.prefix_evictions.inc(d)
+            self._last_prefix_evictions = stats.prefix_evictions_total
         for tenant, n in stats.inflight_per_tenant.items():
             self._tenant_gauge(tenant).set(n)
         for tenant, g in self._tenant_inflight.items():
@@ -220,8 +253,21 @@ class RequestHandle:
 class Engine:
     """Continuous-batching serving engine over one jitted decode step.
 
+    K/V storage is PAGED by default (``paged=True``, serve/pages.py):
+    slots hold fixed-size pool pages through per-slot page tables
+    instead of full ``[max_len]`` stripes — memory scales with actual
+    request lengths, requests sharing a prompt prefix map the same
+    read-only radix-cached pages and skip those prefill windows
+    entirely, and allocation/sharing/eviction never recompile the hot
+    executables.  ``paged=False`` restores the contiguous stripe
+    layout; ``page_size``/``num_pages`` tune the pool (defaults: the
+    largest divisor of ``max_len`` <= 16, and the contiguous layout's
+    token capacity).  Output tokens are bit-identical either way
+    (tests/test_pages.py).
+
     Args mirror ``SlotScheduler`` (num_slots, max_len, prefill_chunk,
-    tick_steps, temperature/top_k/top_p, eos_id/pad_id, rng) plus:
+    tick_steps, temperature/top_k/top_p, eos_id/pad_id, rng, paged/
+    page_size/num_pages) plus:
 
       registry: obs metrics registry to record into (default: the
         process registry ``obs.metrics.REGISTRY`` — served by any

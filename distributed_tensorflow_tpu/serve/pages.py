@@ -1,0 +1,393 @@
+"""Paged KV cache + shared-prefix (radix) reuse: the memory layer of
+the serving tier.
+
+The contiguous slot cache (serve/slots.py) gives every slot a full
+``[max_len]`` K/V stripe, so HBM scales with the WORST-CASE length and
+two requests sharing a system prompt each hold their own copy of its
+K/V.  This module replaces the stripe with fixed-size PAGES:
+
+* **Device**: one pool of ``num_pages`` pages per K/V leaf —
+  ``[L, num_pages, page_size, kv_heads, head_dim]`` (int8 scale planes
+  ride along as ``[..., 1]`` — the PR 4 splice-exact int8 layout).
+  Page 0 is the reserved TRASH page: retired rows' frozen writes and
+  prefill pad columns land there, and no validity mask ever admits its
+  cells.
+* **Host** (``PagePool``): free-list + per-page refcounts + the radix
+  (prefix) tree, all under one lock.  Logical slot columns map to pool
+  pages through a per-slot PAGE TABLE — a small host int32 row handed
+  to the hot executables as a TRACED argument, so allocation, sharing,
+  and retirement never recompile anything (``GPT.decode_window_paged``
+  / ``GPT.decode_step_slots_paged`` read through the table and write
+  page-indexed).
+
+**Radix prefix cache.**  Prompts are keyed by ``page_size``-token
+chunks: a tree node per FULL chunk, holding the pool page with that
+chunk's K/V.  A request whose prompt starts with cached chunks maps
+those pages read-only (refcount++) and starts its chunked prefill at
+``pos = skip`` — the skipped windows are never dispatched, which is the
+whole TTFT/FLOPs win.  At admission the request's own full prompt pages
+are registered back into the tree, so the FIRST request with a system
+prompt seeds the cache for every follower.
+
+Immutability makes copy-on-write cheap: only FULL chunks are ever
+shared, so a shared page is never written again (decode writes start at
+``write_col >= prompt_len``, always on a private page).  The one COW
+case — a prompt exactly equal to a cached chain, whose last page must
+take decode writes — is split by RE-PREFILLING that page into a fresh
+private copy (bit-identical by construction: same tokens, same
+executable) instead of a device copy; ``cow_splits_total`` counts it.
+
+Eviction is LRU over refcount-0 LEAF nodes (a pinned chain can never
+lose an interior page): when ``allocate`` finds the free list short it
+evicts stale chains page by page, and only gives up —
+``PagePoolExhausted``, the scheduler requeues the request — when every
+remaining page is pinned by an in-flight request.
+
+Thread-safety: every ``PagePool`` method takes the pool's own lock and
+never calls back out, so the scheduler may call it from ``submit``/
+``cancel`` threads as well as the pump (lock order: scheduler state
+lock -> pool lock, never the reverse).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["PageLease", "PagePool", "PagePoolExhausted", "auto_page_size",
+           "decode_paged_step", "init_paged_cache", "paged_kv_valid"]
+
+
+class PagePoolExhausted(RuntimeError):
+    """``allocate`` could not find enough free/evictable pages: every
+    remaining page is pinned by an in-flight request.  Backpressure,
+    not failure — the scheduler requeues and retries after a
+    retirement frees pages."""
+
+
+def auto_page_size(max_len: int, target: int = 16) -> int:
+    """Largest divisor of ``max_len`` that is <= ``target``.  Pages
+    must tile ``max_len`` exactly so the gathered page view has the
+    SAME shape as the contiguous stripe — that shape equality is what
+    makes paged attention bit-identical to the stripe layout."""
+    for d in range(min(target, max_len), 0, -1):
+        if max_len % d == 0:
+            return d
+    return 1
+
+
+def init_paged_cache(model, num_slots: int, num_pages: int,
+                     page_size: int):
+    """Device state for a paged slot cache: a page-pool K/V subtree
+    (``[L, num_pages, page_size, kv_heads, ...]`` leaves, int8 scale
+    planes included) plus the same per-slot column state the contiguous
+    cache carries (serve/slots.py) — ``start_col``/``write_col``/
+    ``positions`` stay LOGICAL columns; only the storage under them is
+    paged."""
+    import jax.numpy as jnp
+    c = model.config
+    shape = (c.num_layers, num_pages, page_size, c.kv_heads, c.head_dim)
+    if c.kv_cache_dtype == "int8":
+        kv = {"k": jnp.zeros(shape, jnp.int8),
+              "v": jnp.zeros(shape, jnp.int8),
+              "k_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+              "v_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32)}
+    else:
+        kv = {"k": jnp.zeros(shape, c.dtype),
+              "v": jnp.zeros(shape, c.dtype)}
+    return {"kv": kv,
+            "start_col": jnp.zeros((num_slots,), jnp.int32),
+            "write_col": jnp.zeros((num_slots,), jnp.int32),
+            "positions": jnp.zeros((num_slots,), jnp.int32)}
+
+
+def paged_kv_valid(cache, view_len: int):
+    """[S, view_len] bool view of each slot's valid LOGICAL columns —
+    the paged twin of ``slots.slot_kv_valid`` (the pool's own shape no
+    longer encodes the per-slot view length, so it is passed in)."""
+    import jax.numpy as jnp
+    cols = jnp.arange(view_len)[None, :]
+    return ((cols >= cache["start_col"][:, None])
+            & (cols < cache["write_col"][:, None]))
+
+
+def decode_paged_step(model, params, cache, page_tab, tokens, live,
+                      adapters=None, adapter_rows=None):
+    """One decode step for every slot against the page pool -> (logits
+    [S, vocab], new cache).  The paged twin of
+    ``slots.decode_slots_step``: same frozen-dead-row semantics, same
+    per-row state advancement; ``page_tab`` [S, pages_per_slot] is the
+    traced page-table snapshot for this tick (retired rows map the
+    trash page, so their frozen writes can never touch a live page)."""
+    import jax.numpy as jnp
+    page_size = cache["kv"]["k"].shape[2]
+    view_len = page_tab.shape[1] * page_size
+    logits, kv = model.decode_step_slots_paged(
+        params, cache["kv"], tokens, page_tab, cache["write_col"],
+        paged_kv_valid(cache, view_len), cache["positions"],
+        adapters=adapters, adapter_rows=adapter_rows)
+    live = live.astype(jnp.int32)
+    return logits, {
+        "kv": kv,
+        "start_col": cache["start_col"],
+        "write_col": cache["write_col"] + live,
+        "positions": cache["positions"] + live,
+    }
+
+
+class _RadixNode:
+    """One FULL prompt chunk: the pool page holding its K/V, its place
+    in the tree, a refcount (in-flight requests mapping it), and an
+    LRU stamp (monotonic counter, not wall clock — eviction order must
+    replay deterministically)."""
+
+    __slots__ = ("page", "parent", "children", "refcount", "stamp",
+                 "key")
+
+    def __init__(self, page: int, parent: Optional["_RadixNode"],
+                 key: bytes, stamp: int):
+        self.page = page
+        self.parent = parent
+        self.children: Dict[bytes, "_RadixNode"] = {}
+        self.refcount = 0
+        self.stamp = stamp
+        self.key = key
+
+
+class PageLease:
+    """One request's page holdings: the page-table row it decodes
+    through, which of those pages are shared radix nodes vs private,
+    and how many logical columns the row maps.  Created by
+    ``PagePool.begin`` at prefill start, registered into the radix tree
+    at admission, released (idempotently) at retirement/cancel."""
+
+    __slots__ = ("row", "n_pages", "skip", "shared", "private",
+                 "released")
+
+    def __init__(self, row: np.ndarray, n_pages: int, skip: int,
+                 shared: List[_RadixNode], private: List[int]):
+        self.row = row                   # [pages_per_slot] int32
+        self.n_pages = n_pages           # mapped entries (shared+private)
+        self.skip = skip                 # prefix tokens mapped shared
+        self.shared = shared             # radix nodes we hold a ref on
+        self.private = private           # pool pages we own outright
+        self.released = False
+
+
+class PagePool:
+    """Host bookkeeping for the device page pool: free list, refcounts,
+    and the radix prefix tree.  All methods are thread-safe behind the
+    pool's own lock and never invoke callbacks or block under it."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 pages_per_slot: int, prefix_cache: bool = True):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1; got {page_size}")
+        if num_pages < pages_per_slot + 2:
+            # one trash page + at least one full slot's worth: anything
+            # smaller cannot serve even a single max-length request
+            raise ValueError(
+                f"num_pages must be >= pages_per_slot + 2 = "
+                f"{pages_per_slot + 2}; got {num_pages}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.pages_per_slot = pages_per_slot
+        # prefix_cache=False: paged allocation only, no radix matching
+        # or registration — the ablation arm bench.py measures the
+        # reuse win against
+        self.prefix_cache = bool(prefix_cache)
+        self._lock = threading.Lock()
+        # page 0 is the reserved trash page — never allocated
+        self._free: List[int] = list(range(1, num_pages))
+        self._root = _RadixNode(0, None, b"", 0)
+        self._stamp = 0
+        # live-lease accounting for the pages_per_request gauge
+        self._lease_count = 0
+        self._lease_pages = 0
+        # counters (rendered via EngineStats -> /metrics)
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
+        self.evictions = 0
+        self.cow_splits = 0
+
+    # ------------------------------------------------------------ intake
+
+    def required_pages(self, total_cols: int) -> int:
+        """Pages a request writing ``total_cols`` logical columns needs
+        in the worst (no shared prefix) case."""
+        return -(-int(total_cols) // self.page_size)
+
+    def usable_pages(self) -> int:
+        """Pool capacity minus the reserved trash page — the submit
+        validation bound: one request may never need more."""
+        return self.num_pages - 1
+
+    def begin(self, prompt: np.ndarray, total_cols: int) -> PageLease:
+        """Start one request: match its prompt against the radix tree
+        (full ``page_size`` chunks only, always leaving at least one
+        token to prefill so the last window can produce logits), pin
+        the matched chain, allocate private pages for the rest, and
+        return the lease with its page-table row.
+
+        ``total_cols``: columns the request will ever write (prompt +
+        decode budget).  Raises ``PagePoolExhausted`` — with every
+        acquired ref rolled back — when not enough pages are free or
+        evictable."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = prompt.size
+        pg = self.page_size
+        total = self.required_pages(max(total_cols, plen))
+        if total > self.pages_per_slot:
+            raise ValueError(
+                f"request spans {total} pages > pages_per_slot "
+                f"{self.pages_per_slot}")
+        with self._lock:
+            self.prefix_lookups += 1
+            shared: List[_RadixNode] = []
+            node = self._root
+            chunks = plen // pg if self.prefix_cache else 0
+            for j in range(chunks):
+                child = node.children.get(
+                    prompt[j * pg:(j + 1) * pg].tobytes())
+                if child is None:
+                    break
+                shared.append(child)
+                node = child
+            if len(shared) * pg >= plen:
+                # the whole prompt is a cached chain, but its last page
+                # must take this request's decode writes: split it off
+                # as a fresh private copy, re-prefilled rather than
+                # device-copied (bit-identical — same tokens, same
+                # executable).  This is the COW case.
+                shared.pop()
+                self.cow_splits += 1
+            skip = len(shared) * pg
+            stamp = self._next_stamp()
+            for n in shared:
+                n.refcount += 1
+                n.stamp = stamp
+            try:
+                private = self._allocate_locked(total - len(shared))
+            except PagePoolExhausted:
+                for n in shared:          # roll back the pins
+                    n.refcount -= 1
+                raise
+            if skip:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += skip
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            for j, n in enumerate(shared):
+                row[j] = n.page
+            row[len(shared):total] = private
+            lease = PageLease(row, total, skip, shared, private)
+            self._lease_count += 1
+            self._lease_pages += total
+            return lease
+
+    def register(self, lease: PageLease, prompt: np.ndarray) -> None:
+        """Publish the lease's FULL prompt pages into the radix tree
+        (called at admission, when their contents are final).  Pages
+        donated to the tree move from the lease's private list to its
+        shared refs; on a chunk another request registered first, stop
+        — ours stay private (rare race, costs one duplicate page until
+        retirement)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        pg = self.page_size
+        if not self.prefix_cache:
+            return
+        with self._lock:
+            if lease.released:
+                return               # cancelled before admission landed
+            node = self._root
+            stamp = self._next_stamp()
+            for j in range(prompt.size // pg):
+                key = prompt[j * pg:(j + 1) * pg].tobytes()
+                child = node.children.get(key)
+                if child is not None:
+                    child.stamp = stamp
+                    node = child
+                    continue
+                page = int(lease.row[j])
+                if page not in lease.private:
+                    break            # a shared entry we did not match??
+                child = _RadixNode(page, node, key, stamp)
+                child.refcount = 1   # the lease's own pin
+                node.children[key] = child
+                lease.private.remove(page)
+                lease.shared.append(child)
+                node = child
+
+    def release(self, lease: PageLease) -> None:
+        """Return a lease's holdings: shared pins drop (the chain stays
+        cached, evictable once refcount-0), private pages go straight
+        back to the free list.  Idempotent — cancel racing retirement
+        must not double-free."""
+        with self._lock:
+            if lease.released:
+                return
+            lease.released = True
+            stamp = self._next_stamp()
+            for n in lease.shared:
+                n.refcount -= 1
+                n.stamp = stamp
+            self._free.extend(lease.private)
+            self._lease_count -= 1
+            self._lease_pages -= lease.n_pages
+
+    # ----------------------------------------------------- alloc / evict
+
+    def _next_stamp(self) -> int:
+        self._stamp += 1
+        return self._stamp
+
+    def _allocate_locked(self, n: int) -> List[int]:
+        while len(self._free) < n and self._evict_one_locked():
+            pass
+        if len(self._free) < n:
+            raise PagePoolExhausted(
+                f"need {n} pages, {len(self._free)} free and no "
+                "unpinned prefix chains left to evict")
+        pages, self._free = self._free[:n], self._free[n:]
+        return pages
+
+    def _evict_one_locked(self) -> bool:
+        """Evict the least-recently-used refcount-0 LEAF node (chains
+        evict tail-first, so an interior page is never freed while a
+        descendant still chains through it; pinned nodes are
+        untouchable)."""
+        best: Optional[_RadixNode] = None
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif node.refcount == 0 and (best is None
+                                         or node.stamp < best.stamp):
+                best = node
+        if best is None:
+            return False
+        del best.parent.children[best.key]
+        self._free.append(best.page)
+        self.evictions += 1
+        return True
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, float]:
+        """Counter/gauge snapshot for ``EngineStats`` (the ONE
+        bookkeeping source the serve gauges render from)."""
+        with self._lock:
+            per_req = (self._lease_pages / self._lease_count
+                       if self._lease_count else 0.0)
+            return {
+                "pages_total": self.num_pages - 1,
+                "pages_free": len(self._free),
+                "pages_per_request": per_req,
+                "prefix_lookups_total": self.prefix_lookups,
+                "prefix_hits_total": self.prefix_hits,
+                "prefix_tokens_reused_total": self.prefix_tokens_reused,
+                "prefix_evictions_total": self.evictions,
+                "cow_splits_total": self.cow_splits,
+            }

@@ -1,12 +1,21 @@
 """Slot scheduler: admission, chunked prefill, decode ticks, retirement.
 
 The control plane of the continuous-batching engine (docs/SERVING.md).
-All device work goes through FOUR jitted functions built once at
-construction — a mid-prefill window, a last-prefill window (+ first
-token sample), the slot splice (admission), and the K-step decode tick —
+All device work goes through THREE jitted functions built once at
+construction — a mid-prefill window, a fused last-prefill window
+(+ first-token sample + slot splice/arm), and the K-step decode tick —
 each with fully static shapes, so admitting and retiring requests never
 recompiles anything (pinned by tests/test_serve.py under the runtime
 sanitizer, and warn-checked by ``bench.py --config=gpt_serve``).
+
+Two storage layouts behind the SAME state machine (``paged=``, default
+True): the paged layout (serve/pages.py) maps slot columns to
+fixed-size pool pages through per-slot page tables — prefill writes
+straight into the request's leased pages, shared prompt prefixes map
+the same read-only radix-cached pages and skip their prefill windows,
+and page allocation/eviction is host bookkeeping handed to the same
+three executables as traced arguments.  ``paged=False`` keeps the
+contiguous per-slot stripes (the exactness comparator).
 
 Request lifecycle::
 
@@ -76,6 +85,7 @@ import numpy as np
 
 from ..resilience import faults as faults_lib
 from ..ops import decoding as dec
+from . import pages as pages_lib
 from . import slots as slots_lib
 from .adapters import AdapterTableFull
 
@@ -122,6 +132,10 @@ class Request:
     # terminal transitions are claim-once (cancel vs pump races resolve
     # in _retire_accounting under the scheduler lock)
     _retired: bool = dataclasses.field(default=False, repr=False)
+    # paged engines: the request's page holdings (serve/pages.py),
+    # granted at prefill begin, released once at retirement
+    _lease: Optional[object] = dataclasses.field(default=None,
+                                                 repr=False)
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -142,6 +156,19 @@ class EngineStats:
     num_slots: int
     inflight_per_tenant: Dict[str, int]      # queued+prefilling+active
     tokens_inflight_per_tenant: Dict[str, int]   # sum of max_new_tokens
+    # paged engines only (serve/pages.py; all-zero on a contiguous
+    # engine): page-pool occupancy and radix prefix-cache counters —
+    # the single source the dttpu_serve_pages_*/dttpu_serve_prefix_*
+    # series render from
+    pages_total: int = 0                     # pool capacity (sans trash)
+    pages_free: int = 0
+    pages_per_request: float = 0.0           # avg pages held per lease
+    prefix_lookups_total: int = 0
+    prefix_hits_total: int = 0               # requests that mapped pages
+    prefix_tokens_reused_total: int = 0
+    prefix_evictions_total: int = 0          # radix pages reclaimed
+    cow_splits_total: int = 0                # whole-chain prompts resplit
+    prefill_windows_skipped_total: int = 0   # window dispatches avoided
 
     @property
     def inflight(self) -> int:
@@ -150,6 +177,13 @@ class EngineStats:
     @property
     def free_slots(self) -> int:
         return self.num_slots - self.active
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of prefix lookups that mapped at least one page."""
+        if not self.prefix_lookups_total:
+            return 0.0
+        return self.prefix_hits_total / self.prefix_lookups_total
 
 
 class _NullMetrics:
@@ -189,7 +223,10 @@ class SlotScheduler:
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  eos_id: Optional[int] = None, pad_id: Optional[int] = None,
                  rng=None, metrics=None, queue=None, adapters=None,
-                 max_queue_depth: Optional[int] = None, tenancy=None):
+                 max_queue_depth: Optional[int] = None, tenancy=None,
+                 paged: bool = True, page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 prefix_cache: bool = True):
         import jax
         import jax.numpy as jnp
 
@@ -216,6 +253,37 @@ class SlotScheduler:
         self.metrics = metrics if metrics is not None else _NullMetrics()
         self.adapters = adapters
         self.max_queue_depth = max_queue_depth
+        # paged K/V (serve/pages.py, the default): slot columns map to
+        # fixed-size pool pages through per-slot page tables, prefill
+        # writes straight into the request's pages (no pooled [1,
+        # max_len] spares at all), and shared prompt prefixes map the
+        # same read-only pages.  paged=False keeps the contiguous
+        # stripe layout — the exactness comparator and the fallback.
+        self.paged = bool(paged)
+        self.pages: Optional[pages_lib.PagePool] = None
+        self._page_tab = None
+        self._windows_skipped = 0
+        if self.paged:
+            page_size = (int(page_size) if page_size
+                         else pages_lib.auto_page_size(max_len))
+            if page_size < 1 or max_len % page_size:
+                raise ValueError(
+                    f"page_size must divide max_len {max_len} (the "
+                    f"gathered page view must tile the stripe shape "
+                    f"exactly); got {page_size}")
+            pps = max_len // page_size
+            if num_pages is None:
+                # default: the contiguous layout's token capacity
+                # (num_slots stripes) plus the reserved trash page —
+                # same HBM, now shareable and pay-as-you-go (floor:
+                # one full slot plus a spare, the pool's own minimum)
+                num_pages = max(num_slots * pps + 1, pps + 2)
+            self.page_size = page_size
+            self.num_pages = int(num_pages)
+            self.pages = pages_lib.PagePool(self.num_pages, page_size,
+                                            pps,
+                                            prefix_cache=prefix_cache)
+            self._page_tab = np.zeros((num_slots, pps), np.int32)
         # duck-typed admission policy (fleet.tenancy.TenantPolicy):
         # checked under the state lock so quota decisions are atomic
         # against concurrent submitters
@@ -249,7 +317,12 @@ class SlotScheduler:
         self._tenant_tokens: Dict[str, int] = {}
 
         # -- device state -------------------------------------------------
-        self._cache = slots_lib.init_slot_cache(model, num_slots, max_len)
+        self._cache = (pages_lib.init_paged_cache(
+                           model, num_slots, self.num_pages,
+                           self.page_size)
+                       if self.paged
+                       else slots_lib.init_slot_cache(model, num_slots,
+                                                      max_len))
         self._tokens = jnp.zeros((num_slots,), jnp.int32)
         self._finished = jnp.ones((num_slots,), bool)   # empty = finished
         self._remaining = jnp.zeros((num_slots,), jnp.int32)
@@ -263,6 +336,93 @@ class SlotScheduler:
 
         # -- the three hot executables (built ONCE; static shapes) --------
         pad = self.pad_id if self.pad_id is not None else 0
+
+        def sample_step(carry_step, step_fn):
+            """Shared tick-step body: one decode dispatch via
+            ``step_fn``, in-graph sampling, EOS/budget freeze — ONE
+            implementation for the contiguous and paged ticks so their
+            retirement semantics can never diverge."""
+            cache, tokens, finished, remaining, key = carry_step
+            live = ~finished
+            logits, cache = step_fn(cache, tokens, live)
+            key, sub = jax.random.split(key)
+            nxt = dec.sample_logits(sub, logits, temperature,
+                                    top_k=top_k, top_p=top_p)
+            if eos_id is not None:
+                nxt, finished = dec.finish_step(nxt, finished,
+                                                eos_id, pad)
+            remaining = remaining - live.astype(jnp.int32)
+            emitted = jnp.where(live, nxt, jnp.int32(pad))
+            finished = finished | (remaining <= 0)
+            tokens = jnp.where(live, nxt, tokens)
+            return (cache, tokens, finished, remaining, key), \
+                (emitted, live)
+
+        def first_token(logits, last_idx, key, tokens, finished,
+                        remaining, slot_idx, budget):
+            """Shared last-window tail: sample the first token from the
+            prompt's final-position logits and arm the slot's
+            tokens/finished/remaining rows."""
+            row = jax.lax.dynamic_index_in_dim(logits[0], last_idx,
+                                               keepdims=False)
+            key, sub = jax.random.split(key)
+            tok = dec.sample_logits(sub, row[None], temperature,
+                                    top_k=top_k, top_p=top_p)[0]
+            tokens = tokens.at[slot_idx].set(tok)
+            done0 = budget <= 1
+            if eos_id is not None:
+                done0 = done0 | (tok == eos_id)
+            finished = finished.at[slot_idx].set(done0)
+            # the first token was already emitted from the prefill logits
+            remaining = remaining.at[slot_idx].set(budget - 1)
+            return tok, key, tokens, finished, remaining
+
+        def paged_win_mid(params, cache, window, page_row, pos, ad,
+                          ad_row):
+            """Mid prefill window straight into the request's pages —
+            the whole cache (pool + slot state) is donated and flows
+            through so win/admit/tick chain on one buffer set."""
+            _, kv = model.decode_window_paged(
+                params, cache["kv"], window, page_row, pos,
+                head="none", adapters=ad, adapter_rows=ad_row)
+            return dict(cache, kv=kv)
+
+        def paged_last_admit(params, cache, window, page_row, pos,
+                             last_idx, key, tokens, finished, remaining,
+                             slot_idx, length, budget, ad, ad_row):
+            """Last prefill window + first-token sample + slot arm in
+            ONE dispatch.  No splice: the prompt's K/V already live in
+            the request's pages — admission just points the slot's
+            column state at them (the page-table row is host state,
+            handed to the next tick)."""
+            logits, kv = model.decode_window_paged(
+                params, cache["kv"], window, page_row, pos,
+                head="all", adapters=ad, adapter_rows=ad_row)
+            tok, key, tokens, finished, remaining = first_token(
+                logits, last_idx, key, tokens, finished, remaining,
+                slot_idx, budget)
+            cache = {
+                "kv": kv,
+                "start_col": cache["start_col"].at[slot_idx].set(
+                    jnp.int32(0)),
+                "write_col": cache["write_col"].at[slot_idx].set(length),
+                "positions": cache["positions"].at[slot_idx].set(length),
+            }
+            return tok, cache, tokens, finished, remaining, key
+
+        def paged_tick(params, cache, page_tab, tokens, finished,
+                       remaining, key, ad, ad_rows):
+            def one(carry, _):
+                return sample_step(
+                    carry,
+                    lambda cache, toks, live: pages_lib.decode_paged_step(
+                        model, params, cache, page_tab, toks, live,
+                        adapters=ad, adapter_rows=ad_rows))
+
+            carry, (em, mask) = jax.lax.scan(
+                one, (cache, tokens, finished, remaining, key), None,
+                length=tick_steps)
+            return carry, em, mask
 
         def win_mid(params, cache, window, ad, ad_row):
             return model.decode_window(params, cache, window,
@@ -280,52 +440,38 @@ class SlotScheduler:
                                                    window, head="all",
                                                    adapters=ad,
                                                    adapter_rows=ad_row)
-            row = jax.lax.dynamic_index_in_dim(logits[0], last_idx,
-                                               keepdims=False)
-            key, sub = jax.random.split(key)
-            tok = dec.sample_logits(sub, row[None], temperature,
-                                    top_k=top_k, top_p=top_p)[0]
+            tok, key, tokens, finished, remaining = first_token(
+                logits, last_idx, key, tokens, finished, remaining,
+                slot_idx, budget)
             cache = slots_lib.insert_slot(
                 cache, slot_idx, slots_lib.strip_pos(pf_cache), length)
-            tokens = tokens.at[slot_idx].set(tok)
-            done0 = budget <= 1
-            if eos_id is not None:
-                done0 = done0 | (tok == eos_id)
-            finished = finished.at[slot_idx].set(done0)
-            # the first token was already emitted from the prefill logits
-            remaining = remaining.at[slot_idx].set(budget - 1)
             return tok, cache, tokens, finished, remaining, key
 
         def tick(params, cache, tokens, finished, remaining, key,
                  ad, ad_rows):
             def one(carry, _):
-                cache, tokens, finished, remaining, key = carry
-                live = ~finished
-                logits, cache = slots_lib.decode_slots_step(
-                    model, params, cache, tokens, live,
-                    adapters=ad, adapter_rows=ad_rows)
-                key, sub = jax.random.split(key)
-                nxt = dec.sample_logits(sub, logits, temperature,
-                                        top_k=top_k, top_p=top_p)
-                if eos_id is not None:
-                    nxt, finished = dec.finish_step(nxt, finished,
-                                                    eos_id, pad)
-                remaining = remaining - live.astype(jnp.int32)
-                emitted = jnp.where(live, nxt, jnp.int32(pad))
-                finished = finished | (remaining <= 0)
-                tokens = jnp.where(live, nxt, tokens)
-                return (cache, tokens, finished, remaining, key), \
-                    (emitted, live)
+                return sample_step(
+                    carry,
+                    lambda cache, toks, live: slots_lib.decode_slots_step(
+                        model, params, cache, toks, live,
+                        adapters=ad, adapter_rows=ad_rows))
 
             carry, (em, mask) = jax.lax.scan(
                 one, (cache, tokens, finished, remaining, key), None,
                 length=tick_steps)
             return carry, em, mask
 
-        self._win_mid = jax.jit(win_mid, donate_argnums=(1,))
-        self._last_admit = jax.jit(last_admit,
-                                   donate_argnums=(4, 5, 6, 7, 8))
-        self._tick = jax.jit(tick, donate_argnums=(1, 2, 3, 4, 5))
+        if self.paged:
+            self._win_mid = jax.jit(paged_win_mid, donate_argnums=(1,))
+            self._last_admit = jax.jit(paged_last_admit,
+                                       donate_argnums=(1, 6, 7, 8, 9))
+            self._tick = jax.jit(paged_tick,
+                                 donate_argnums=(1, 3, 4, 5, 6))
+        else:
+            self._win_mid = jax.jit(win_mid, donate_argnums=(1,))
+            self._last_admit = jax.jit(last_admit,
+                                       donate_argnums=(4, 5, 6, 7, 8))
+            self._tick = jax.jit(tick, donate_argnums=(1, 2, 3, 4, 5))
 
     # ------------------------------------------------------------- intake
 
@@ -422,13 +568,27 @@ class SlotScheduler:
         reads — the router polls this per placement and the serve gauges
         render from it, so there is exactly ONE bookkeeping source."""
         with self._lock:
-            return EngineStats(
+            base = dict(
                 queued=len(self._queue),
                 prefilling=len(self._prefills),
                 active=sum(r is not None for r in self._slots),
                 num_slots=self.num_slots,
                 inflight_per_tenant=dict(self._tenant_inflight),
                 tokens_inflight_per_tenant=dict(self._tenant_tokens))
+            skipped = self._windows_skipped
+        if self.pages is not None:
+            p = self.pages.stats()
+            base.update(
+                pages_total=p["pages_total"],
+                pages_free=p["pages_free"],
+                pages_per_request=p["pages_per_request"],
+                prefix_lookups_total=p["prefix_lookups_total"],
+                prefix_hits_total=p["prefix_hits_total"],
+                prefix_tokens_reused_total=p["prefix_tokens_reused_total"],
+                prefix_evictions_total=p["prefix_evictions_total"],
+                cow_splits_total=p["cow_splits_total"],
+                prefill_windows_skipped_total=skipped)
+        return EngineStats(**base)
 
     def tenant_inflight(self, tenant: str) -> int:
         with self._lock:
@@ -467,10 +627,11 @@ class SlotScheduler:
                 break
             try:
                 st = self._begin_prefill(req)
-            except AdapterTableFull:
-                # every adapter row is pinned by an in-flight request:
-                # leave the request queued (a retirement frees a pin,
-                # so this always drains) and stop admitting this tick
+            except (AdapterTableFull, pages_lib.PagePoolExhausted):
+                # every adapter row / pool page is pinned by an
+                # in-flight request: leave the request queued (a
+                # retirement frees pins and pages, so this always
+                # drains) and stop admitting this tick
                 with self._lock:
                     self._requeue(req)
                 break
@@ -493,23 +654,44 @@ class SlotScheduler:
         return did
 
     def _harvest_orphans(self) -> None:
-        """Pool the prefill caches of requests cancelled cross-thread
-        (only the pump owns cache recycling — a cancel mid-window must
-        not hand a buffer back while a dispatch is still writing it)."""
+        """Recycle the prefill storage of requests cancelled
+        cross-thread (only the pump owns recycling — a cancel
+        mid-window must not hand a buffer back while a dispatch is
+        still writing it).  Contiguous mode pools the [1, max_len]
+        cache; paged mode releases the lease (idempotent — the
+        cancelling thread's abort usually got there first)."""
         with self._lock:
             orphans, self._orphans = self._orphans, []
+            if not self.paged:
+                for st in orphans:
+                    self._pool_prefill_cache(st[3])
+        if self.paged:
             for st in orphans:
-                self._pf_pool.append(slots_lib.strip_pos(st[3]))
+                self.pages.release(st[3])
+
+    def _pool_prefill_cache(self, cache) -> None:
+        """Return a batch-1 prefill cache to the spare pool (caller
+        holds the state lock) — BOUNDED at ``num_slots`` entries:
+        concurrent prefills can never exceed the free-slot count, so
+        anything past that is a cancel/expiry storm's dead weight, not
+        a future saving."""
+        if len(self._pf_pool) < self.num_slots:
+            self._pf_pool.append(slots_lib.strip_pos(cache))
 
     def _freeze_stale_rows(self) -> None:
         """Freeze device rows cancelled cross-thread since the last
         tick.  Runs BEFORE admissions so a newcomer spliced into the
         freed slot this tick is never frozen by the departed request's
         leftover mark (reservation also discards its slot from the
-        set — the splice overwrites the whole row anyway)."""
+        set — the splice overwrites the whole row anyway).  Paged mode
+        also remaps the row's page table to the trash page, so its
+        frozen writes can never land in a reallocated page."""
         with self._lock:
             stale = sorted(self._stale_rows)
             self._stale_rows.clear()
+            if self._page_tab is not None:
+                for r in stale:
+                    self._page_tab[r] = 0
         if stale:
             self._finished = self._finished.at[np.asarray(stale)].set(
                 True)
@@ -532,15 +714,39 @@ class SlotScheduler:
     def _begin_prefill(self, req: Request) -> list:
         w = self.prefill_chunk
         plen = req.prompt.size
+        if self.adapters is not None:
+            # pin the adapter BEFORE touching cache storage: acquire
+            # may raise AdapterTableFull and the request must requeue
+            # with nothing to unwind
+            req.adapter_row = self.adapters.acquire(req.adapter_id)
+        if self.paged:
+            # page lease: map any cached prefix chain read-only and
+            # allocate private pages for the rest of the request's
+            # whole footprint (prompt + decode budget — upfront, so a
+            # mid-decode tick can never starve).  On exhaustion the
+            # adapter pin unwinds and the request requeues.
+            try:
+                lease = self.pages.begin(
+                    req.prompt, plen + req.max_new_tokens - 1)
+            except pages_lib.PagePoolExhausted:
+                if req.adapter_row is not None:
+                    self.adapters.release(req.adapter_id)
+                    req.adapter_row = None
+                raise
+            req._lease = lease
+            remaining = req.prompt[lease.skip:]
+            n_win = -(-remaining.size // w)
+            padded = np.zeros((n_win * w,), np.int32)
+            padded[:remaining.size] = remaining
+            with self._lock:
+                # window dispatches avoided by the prefix hit — the
+                # measured TTFT/FLOPs saving, reported via stats()
+                self._windows_skipped += -(-plen // w) - n_win
+            return [req, padded.reshape(n_win, 1, w), 0, lease]
         n_win = -(-plen // w)
         padded = np.zeros((n_win * w,), np.int32)
         padded[:plen] = req.prompt
         windows = padded.reshape(n_win, 1, w)
-        if self.adapters is not None:
-            # pin the adapter BEFORE touching the cache pool: acquire
-            # may raise AdapterTableFull and the request must requeue
-            # with nothing to unwind
-            req.adapter_row = self.adapters.acquire(req.adapter_id)
         with self._lock:
             kv = self._pf_pool.pop() if self._pf_pool else None
         if kv is None:
@@ -562,21 +768,35 @@ class SlotScheduler:
     def _advance_prefill(self, st: list, outbox: List[tuple]) -> None:
         """One window for one in-flight prefill; admits the request into
         its slot on the last window.  Pump-only; delivery of the first
-        token is queued on ``outbox`` (flushed at end of tick)."""
-        req, windows, i, cache = st
+        token is queued on ``outbox`` (flushed at end of tick).
+
+        Paged mode prefills straight into the request's leased pages
+        (``decode_window_paged`` at ``pos = skip + i*W`` — a prefix hit
+        starts past the shared pages, whose windows are simply never
+        dispatched), so admission is column-state arming plus a host
+        page-table write, not a cache splice; the request's full prompt
+        pages are published to the radix cache right after."""
+        req, windows, i, payload = st
         with self._lock:
             if st not in self._prefills:
-                return       # cancelled cross-thread: harvest pools it
+                return       # cancelled cross-thread: harvest recycles it
         ad, ad_row = self._adapter_args(req)
+        skip = payload.skip if self.paged else 0
         if i < len(windows) - 1:
-            new_cache = self._win_mid(self.params, cache, windows[i],
-                                      ad, ad_row)
+            if self.paged:
+                self._cache = self._win_mid(
+                    self.params, self._cache, windows[i], payload.row,
+                    np.int32(skip + i * self.prefill_chunk), ad, ad_row)
+            else:
+                new_cache = self._win_mid(self.params, payload,
+                                          windows[i], ad, ad_row)
+                with self._lock:
+                    st[3] = new_cache
             with self._lock:
-                st[3] = new_cache
                 st[2] = i + 1
             return
         plen = req.prompt.size
-        last_idx = np.int32(plen - 1 - (len(windows) - 1)
+        last_idx = np.int32(plen - skip - 1 - (len(windows) - 1)
                             * self.prefill_chunk)
         with self._lock:
             if st not in self._prefills or req.done.is_set():
@@ -591,21 +811,41 @@ class SlotScheduler:
             self._stale_rows.discard(slot)
         if self._adapter_rows is not None:
             self._adapter_rows[slot] = req.adapter_row
-        tok, self._cache, self._tokens, self._finished, \
-            self._remaining, self._key = self._last_admit(
-                self.params, cache, windows[-1], last_idx, self._key,
-                self._cache, self._tokens, self._finished,
-                self._remaining, np.int32(slot), np.int32(plen),
-                np.int32(req.max_new_tokens), ad, ad_row)
+        if self.paged:
+            tok, self._cache, self._tokens, self._finished, \
+                self._remaining, self._key = self._last_admit(
+                    self.params, self._cache, windows[-1], payload.row,
+                    np.int32(skip + (len(windows) - 1)
+                             * self.prefill_chunk),
+                    last_idx, self._key, self._tokens, self._finished,
+                    self._remaining, np.int32(slot), np.int32(plen),
+                    np.int32(req.max_new_tokens), ad, ad_row)
+        else:
+            tok, self._cache, self._tokens, self._finished, \
+                self._remaining, self._key = self._last_admit(
+                    self.params, payload, windows[-1], last_idx,
+                    self._key, self._cache, self._tokens,
+                    self._finished, self._remaining, np.int32(slot),
+                    np.int32(plen), np.int32(req.max_new_tokens), ad,
+                    ad_row)
         first = int(tok)          # host fetch: the TTFT barrier
         req.first_token_time = time.perf_counter()
+        if self.paged:
+            # the prompt's full pages are final now — publish them so
+            # the NEXT request with this prefix skips their windows
+            self.pages.register(payload, req.prompt)
         with self._lock:
-            # the pool entry was not donated — reusable for the next
-            # request
-            self._pf_pool.append(slots_lib.strip_pos(cache))
+            if self.paged:
+                self._page_tab[slot] = payload.row
+            else:
+                # the pool entry was not donated — reusable for the
+                # next request
+                self._pool_prefill_cache(payload)
             cancelled = req.done.is_set()
             if cancelled and self._slots[slot] is req:
                 self._slots[slot] = None
+                if self._page_tab is not None:
+                    self._page_tab[slot] = 0
         if cancelled:
             # cancel() raced the splice: retire the freshly spliced row
             # (frozen rows never perturb the others) and deliver nothing
@@ -614,9 +854,7 @@ class SlotScheduler:
         self.metrics.admitted(req)
         if req.max_new_tokens <= 1 or (self.eos_id is not None
                                        and first == self.eos_id):
-            with self._lock:
-                if self._slots[slot] is req:
-                    self._slots[slot] = None
+            self._drop_slot(slot, req)
             # spliced but already finished in-graph: the slot stays free
             # host-side and the splice is dead weight
             outbox.append(("deliver", req, [first], None))
@@ -626,14 +864,35 @@ class SlotScheduler:
 
     # ----------------------------------------------------------- decode
 
+    def _drop_slot(self, r: int, req: Request) -> None:
+        """Free slot ``r`` if ``req`` still holds it; paged mode also
+        remaps the row's page table to the trash page so the frozen
+        row's future writes can never touch a reallocated page."""
+        with self._lock:
+            if self._slots[r] is req:
+                self._slots[r] = None
+                if self._page_tab is not None:
+                    self._page_tab[r] = 0
+
     def _decode_tick(self, outbox: List[tuple]) -> None:
         with self._lock:
             slots = list(self._slots)
+            # page-table snapshot for this dispatch: host mutations
+            # (admissions, retirements) between ticks never tear a
+            # dispatch mid-read
+            tab = (self._page_tab.copy() if self._page_tab is not None
+                   else None)
         ad, ad_rows = self._adapter_args()
-        (self._cache, self._tokens, self._finished, self._remaining,
-         self._key), em, mask = self._tick(
-            self.params, self._cache, self._tokens, self._finished,
-            self._remaining, self._key, ad, ad_rows)
+        if self.paged:
+            (self._cache, self._tokens, self._finished, self._remaining,
+             self._key), em, mask = self._tick(
+                self.params, self._cache, tab, self._tokens,
+                self._finished, self._remaining, self._key, ad, ad_rows)
+        else:
+            (self._cache, self._tokens, self._finished, self._remaining,
+             self._key), em, mask = self._tick(
+                self.params, self._cache, self._tokens, self._finished,
+                self._remaining, self._key, ad, ad_rows)
         em = np.asarray(em)                      # [K, S]
         mask = np.asarray(mask)
         fin = np.asarray(self._finished)
@@ -647,9 +906,7 @@ class SlotScheduler:
             if toks.size:
                 outbox.append(("deliver", req, [int(t) for t in toks], r))
             if fin[r]:
-                with self._lock:
-                    if self._slots[r] is req:
-                        self._slots[r] = None
+                self._drop_slot(r, req)
                 outbox.append(("finish", req))
 
     def _flush(self, outbox: List[tuple]) -> None:
@@ -671,9 +928,7 @@ class SlotScheduler:
                 except Exception as e:
                     poisoned.add(id(req))
                     if row is not None:
-                        with self._lock:
-                            if self._slots[row] is req:
-                                self._slots[row] = None
+                        self._drop_slot(row, req)
                         self._finished = self._finished.at[row].set(True)
                     self._abort(req, "failed", error=e)
             else:                    # "finish"
@@ -700,7 +955,10 @@ class SlotScheduler:
             still = []
             for st in self._prefills:
                 if expired(st[0]):
-                    self._pf_pool.append(slots_lib.strip_pos(st[3]))
+                    if not self.paged:
+                        # paged: the lease comes back via the abort's
+                        # retirement accounting, not a cache pool
+                        self._pool_prefill_cache(st[3])
                     aborts.append(st[0])
                 else:
                     still.append(st)
@@ -708,6 +966,8 @@ class SlotScheduler:
             for r, req in enumerate(self._slots):
                 if expired(req):
                     self._slots[r] = None
+                    if self._page_tab is not None:
+                        self._page_tab[r] = 0
                     rows.append(r)
                     aborts.append(req)
         if rows:
@@ -739,6 +999,9 @@ class SlotScheduler:
             for r, other in enumerate(self._slots):
                 if other is req:
                     self._slots[r] = None
+                    # the page-table row is cleared by the pump's
+                    # freeze (_freeze_stale_rows) — the in-flight tick
+                    # may still be reading the snapshot that maps it
                     self._stale_rows.add(r)
         self._abort(req, status)
         self._report_depth()
@@ -790,6 +1053,12 @@ class SlotScheduler:
             # own lock (lock order stays scheduler-independent)
             self.adapters.release(req.adapter_id)
             req.adapter_row = None
+        if req._lease is not None and self.pages is not None:
+            # same discipline for the page lease: the pool has its own
+            # lock, release is idempotent, and shared prefix pages stay
+            # CACHED (refcount drops; eviction reclaims them only under
+            # allocation pressure)
+            self.pages.release(req._lease)
         return True
 
     def _finish(self, req: Request) -> None:

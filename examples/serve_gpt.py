@@ -54,6 +54,12 @@ flags_lib.DEFINE_integer("replicas", 1,
                          "fair-share, a hot-swapped LoRA adapter), "
                          "with the dttpu_router_*/dttpu_tenant_* "
                          "gauges live on /metrics")
+flags_lib.DEFINE_bool("shared_prefix", False,
+                      "also run the paged-KV radix-cache demo: "
+                      "requests sharing a system prompt map the same "
+                      "read-only pages, skip those prefill windows, "
+                      "and print the measured TTFT delta + prefix-hit "
+                      "line (serve/pages.py)")
 FLAGS = flags_lib.FLAGS
 
 
@@ -213,6 +219,52 @@ def main() -> int:
         # unpadded rows the lock-step path had to left-pad
         ragged_rows = [ragged_prompt[0, plen // 2:]] + list(prompt[1:])
         timed_engine("engine ragged", eng, ragged_rows, b * new)
+
+    if FLAGS.shared_prefix:
+        # Paged-KV radix cache (serve/pages.py): one SYSTEM PROMPT
+        # shared by every request.  The first request prefills it cold
+        # and publishes its full pages; every follower maps them
+        # read-only and skips those prefill windows — the TTFT delta
+        # printed below is that skipped work, and the hit tokens are
+        # bit-identical to a cold cache (tests/test_pages.py pins it).
+        from distributed_tensorflow_tpu import serve
+
+        reg = telemetry.registry if telemetry is not None else None
+        # page_size pinned small so a 2-page system prompt + tail +
+        # budget fits the demo's max_len whatever --new_tokens is
+        eng_sp = serve.Engine(model, params, num_slots=b,
+                              max_len=max_len, prefill_chunk=4,
+                              tick_steps=4,
+                              page_size=serve.auto_page_size(max_len, 4),
+                              registry=reg)
+        # warmup compiles the paged executables (cold-compile must not
+        # masquerade as the uncached TTFT)
+        eng_sp.submit(rng.integers(0, config.vocab_size, 6).astype(
+            np.int32), 2)
+        eng_sp.drain()
+        sys_prompt = rng.integers(0, config.vocab_size,
+                                  2 * eng_sp.scheduler.page_size
+                                  ).astype(np.int32)
+        ttfts = []
+        for i in range(b):
+            tail = rng.integers(0, config.vocab_size,
+                                2 + i).astype(np.int32)
+            h = eng_sp.submit(np.concatenate([sys_prompt, tail]), new)
+            eng_sp.drain()
+            ttfts.append(h.ttft_s)
+        st = eng_sp.stats()
+        cold_ms = ttfts[0] * 1e3
+        hit_ms = sum(ttfts[1:]) / max(len(ttfts) - 1, 1) * 1e3
+        print(f"{'shared-prefix (paged KV)':<28} ttft cold "
+              f"{cold_ms:7.1f} ms -> hit {hit_ms:7.1f} ms "
+              f"({cold_ms / max(hit_ms, 1e-9):.1f}x faster)",
+              flush=True)
+        print(f"{'':<28} prefix hits {st.prefix_hits_total}/"
+              f"{st.prefix_lookups_total}, "
+              f"{st.prefill_windows_skipped_total} prefill windows "
+              f"skipped, {st.prefix_tokens_reused_total} tokens "
+              f"reused, {st.pages_free}/{st.pages_total} pages free",
+              flush=True)
 
     if FLAGS.replicas >= 2:
         # Fleet demo (fleet/): N engine replicas behind one Router —

@@ -1139,7 +1139,8 @@ def test_walk_covers_serve_package():
     files = analysis.collect_files(["distributed_tensorflow_tpu"])
     rel = {os.path.relpath(f, REPO).replace(os.sep, "/") for f in files}
     for mod in ("serve/__init__.py", "serve/slots.py",
-                "serve/scheduler.py", "serve/engine.py"):
+                "serve/pages.py", "serve/scheduler.py",
+                "serve/engine.py"):
         assert f"distributed_tensorflow_tpu/{mod}" in rel
 
 

@@ -522,8 +522,11 @@ class TestGptLong:
     def test_gpt_serve_smoke_schema(self):
         """Continuous-batching row: the seeded mixed-length arrival
         trace runs on the CPU mesh and the JSON carries the serving
-        schema — engine tokens/s, TTFT percentiles, and a vs_lockstep
-        ratio measured against the in-process lock-step baseline.
+        schema — engine tokens/s (paged default AND contiguous
+        comparator), TTFT percentiles, a vs_lockstep ratio against the
+        in-process lock-step baseline, plus the paged-KV phases: the
+        shared-prefix trace (radix-cache reuse vs the prefix_cache=False
+        ablation) and the fixed-HBM concurrency measurement.
         Admission/retirement must never recompile the hot executables:
         after warmup the sanitizer sees zero violations, so
         retrace_warnings must be absent."""
@@ -535,14 +538,32 @@ class TestGptLong:
         r = json.loads(lines[0])
         assert r["metric"].startswith("gpt_serve_tokens_per_sec")
         assert r["tokens_per_sec"] > 0
+        assert r["contiguous_tokens_per_sec"] > 0
         assert r["lockstep_tokens_per_sec"] > 0
         assert r["vs_lockstep"] == r["vs_baseline"]
+        assert r["vs_lockstep_paged"] > 0
         assert 0 < r["ttft_p50_ms"] <= r["ttft_p95_ms"]
         assert r["requests"] > 0 and r["num_slots"] > 0
+        assert r["page_size"] > 0
         assert r.get("retrace_warnings", 0) == 0
         # the acceptance bar: strictly better than lock-step batching
         # on the mixed-length trace (CPU smoke margin is ~1.2-1.4x)
         assert r["vs_lockstep"] > 1.0
+        # paged-KV phase 1: the shared-prefix trace.  The radix cache
+        # must actually fire (hits, skipped windows) and pay for
+        # itself: tokens/s AND TTFT p50 strictly better than the same
+        # engine with reuse ablated.
+        sp = r["shared_prefix"]
+        assert sp["requests"] > 0
+        assert sp["prefix_hit_rate"] > 0
+        assert sp["prefill_windows_skipped"] > 0
+        assert sp["prefix_tokens_reused"] > 0
+        assert sp["vs_no_reuse"] > 1.0
+        assert 0 < sp["ttft_p50_ms"] < sp["no_reuse_ttft_p50_ms"]
+        assert sp["lockstep_tokens_per_sec"] > 0
+        # paged-KV phase 2: at the contiguous layout's HBM budget the
+        # paged engine runs strictly more concurrent slots
+        assert r["slots_at_fixed_mem"] > r["slots_at_fixed_mem_contiguous"]
 
     def test_fleet_smoke_schema(self):
         """Fleet row: the adversarial three-tenant block burst routed

@@ -66,6 +66,26 @@ def test_serve_gpt_demo_smoke():
     assert match and float(match[0].split()[-1]) > 0.9
 
 
+def test_serve_gpt_shared_prefix_demo_smoke():
+    """--shared_prefix adds the paged-KV radix-cache demo: the
+    cold-vs-hit TTFT delta line and the prefix-hit accounting line
+    must print, with at least one hit and at least one skipped prefill
+    window (the mechanism, not just the headline)."""
+    proc = _run(["examples/serve_gpt.py", "--device=cpu",
+                 "--new_tokens=8", "--batch=2", "--shared_prefix"])
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    assert "shared-prefix (paged KV)" in proc.stdout, proc.stdout
+    ttft = [l for l in proc.stdout.splitlines()
+            if "ttft cold" in l][0]
+    assert "-> hit" in ttft and "x faster" in ttft
+    hits = [l for l in proc.stdout.splitlines()
+            if "prefix hits" in l][0]
+    n_hits = int(hits.split("prefix hits ")[1].split("/")[0])
+    n_skipped = int(hits.split(", ")[1].split()[0])
+    assert n_hits >= 1 and n_skipped >= 1, hits
+
+
 def test_serve_gpt_fleet_demo_smoke():
     """--engine --replicas=2 adds the fleet demo: two engine replicas
     behind the Router, tenant fair-share, a hot-swapped LoRA adapter on

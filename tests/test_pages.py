@@ -1,0 +1,464 @@
+"""Paged KV cache + radix prefix reuse tests (serve/pages.py).
+
+The contracts pinned here (docs/SERVING.md):
+  * paged engine == contiguous engine == greedy ``GPT.generate``
+    token-for-token (chunked prefill, RoPE + GQA, int8 scale planes),
+  * a prefix-cache HIT request's tokens are bit-identical to the same
+    request on a COLD cache, and the skipped prefill windows are
+    measured, not assumed,
+  * whole-chain prompts split their last page copy-on-write style
+    (re-prefilled private copy) and stay exact,
+  * eviction reclaims only refcount-0 chains — a pinned chain never
+    loses a page while its holder is in flight; exhaustion requeues
+    and always drains,
+  * admission / page allocation / COW split / eviction never recompile
+    (RetraceGuard budget=1),
+  * concurrent submitters sharing a prefix never tear the pool
+    (race_harness: refcounts, free list, and radix stay consistent).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu import serve
+from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.serve import pages as pages_lib
+
+
+def _model_params(seed=0, **kw):
+    model = gpt_tiny(dropout_rate=0.0, **kw)
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+def _prompt(plen, seed=1, vocab=512):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (plen,), 0, vocab), np.int32)
+
+
+def _generate_tokens(model, params, prompt, new, max_len, **kw):
+    out = model.generate(params, jnp.asarray(prompt[None]),
+                         max_new_tokens=new, max_len=max_len, **kw)
+    return np.asarray(out)[0, prompt.size:].tolist()
+
+
+def _radix_pages(pool):
+    """Pages currently held by the radix tree (and max refcount seen)."""
+    n, max_ref = 0, 0
+    stack = list(pool._root.children.values())
+    while stack:
+        node = stack.pop()
+        n += 1
+        max_ref = max(max_ref, node.refcount)
+        stack.extend(node.children.values())
+    return n, max_ref
+
+
+# ---------------------------------------------------------------------------
+# layout units
+
+
+def test_auto_page_size_divides_max_len():
+    assert pages_lib.auto_page_size(256) == 16
+    assert pages_lib.auto_page_size(40) == 10
+    assert pages_lib.auto_page_size(16) == 16
+    assert pages_lib.auto_page_size(7) == 7
+    assert pages_lib.auto_page_size(31) == 1     # prime: token pages
+    for n in (16, 24, 40, 256, 31):
+        assert n % pages_lib.auto_page_size(n) == 0
+
+
+def test_init_paged_cache_shapes_and_int8_planes():
+    model, _ = _model_params(kv_cache_dtype="int8")
+    c = model.config
+    cache = pages_lib.init_paged_cache(model, num_slots=3, num_pages=9,
+                                       page_size=8)
+    assert cache["kv"]["k"].shape == (c.num_layers, 9, 8, c.kv_heads,
+                                      c.head_dim)
+    assert cache["kv"]["k"].dtype == jnp.int8
+    assert cache["kv"]["k_scale"].shape == (c.num_layers, 9, 8,
+                                            c.kv_heads, 1)
+    assert cache["kv"]["k_scale"].dtype == jnp.float32
+    assert cache["write_col"].shape == (3,)
+
+
+def test_pool_validation():
+    with pytest.raises(ValueError, match="num_pages"):
+        pages_lib.PagePool(num_pages=4, page_size=8, pages_per_slot=4)
+    with pytest.raises(ValueError, match="page_size"):
+        pages_lib.PagePool(num_pages=8, page_size=0, pages_per_slot=2)
+    model, params = _model_params()
+    with pytest.raises(ValueError, match="page_size"):
+        serve.Engine(model, params, num_slots=2, max_len=32,
+                     page_size=7)          # 7 does not divide 32
+
+
+# ---------------------------------------------------------------------------
+# host pool semantics (no device work)
+
+
+def test_pool_match_register_release_refcounts():
+    pool = pages_lib.PagePool(num_pages=17, page_size=4,
+                              pages_per_slot=4)
+    prompt = np.arange(10, dtype=np.int32)        # 2 full chunks + 2
+    a = pool.begin(prompt, 12)
+    assert a.skip == 0 and a.n_pages == 3 and len(a.private) == 3
+    pool.register(a, prompt)                      # publish chunks 0, 1
+    assert len(a.private) == 1 and len(a.shared) == 2
+    cached, max_ref = _radix_pages(pool)
+    assert cached == 2 and max_ref == 1           # pinned by a itself
+
+    b = pool.begin(prompt, 12)                    # same prompt: a hit
+    assert b.skip == 8 and len(b.shared) == 2 and len(b.private) == 1
+    _, max_ref = _radix_pages(pool)
+    assert max_ref == 2                           # both leases pin
+    assert pool.stats()["prefix_hits_total"] == 1
+    assert pool.stats()["prefix_tokens_reused_total"] == 8
+
+    pool.release(a)
+    pool.release(a)                               # idempotent
+    _, max_ref = _radix_pages(pool)
+    assert max_ref == 1                           # b still pins
+    pool.release(b)
+    cached, max_ref = _radix_pages(pool)
+    assert cached == 2 and max_ref == 0           # cached, evictable
+    st = pool.stats()
+    assert st["pages_free"] + cached == st["pages_total"]
+
+
+def test_pool_eviction_lru_and_pinning():
+    pool = pages_lib.PagePool(num_pages=7, page_size=4,
+                              pages_per_slot=4)   # 6 usable
+    # two cached chains of one page each
+    p1 = np.arange(4, dtype=np.int32)
+    p2 = np.arange(4, 8, dtype=np.int32)
+    for p in (p1, p2):
+        lease = pool.begin(p, 5)                  # 2 pages
+        pool.register(lease, p)
+        pool.release(lease)
+    assert pool.stats()["pages_free"] == 4
+    # PIN p2's chain: a request extending p2 maps its page read-only
+    held = pool.begin(np.concatenate([p2, np.arange(90, 94,
+                                                    dtype=np.int32)]), 9)
+    assert held.skip == 4 and len(held.shared) == 1
+    # demand 3 pages with 2 free: must evict p1's chain (refcount 0)
+    # but NEVER p2's pinned page
+    big = pool.begin(np.arange(100, 112, dtype=np.int32), 12)
+    assert pool.stats()["prefix_evictions_total"] == 1
+    pool.release(big)
+    probe = pool.begin(np.concatenate([p2, p2]), 9)
+    assert probe.skip == 4                        # p2's page survived
+    pool.release(probe)
+    # p1's chain is gone: re-seeing it is a miss now
+    miss = pool.begin(np.concatenate([p1, p1]), 9)
+    assert miss.skip == 0
+    pool.release(miss)
+    pool.release(held)
+    cached, max_ref = _radix_pages(pool)
+    assert max_ref == 0
+    assert pool.stats()["pages_free"] + cached == 6
+
+
+def test_pool_exhausted_rolls_back_pins():
+    pool = pages_lib.PagePool(num_pages=7, page_size=4,
+                              pages_per_slot=4)   # 6 usable
+    p = np.arange(8, dtype=np.int32)
+    a = pool.begin(p, 9)                          # 3 pages
+    pool.register(a, p)                           # 2 cached+pinned
+    c = pool.begin(np.arange(50, 58, dtype=np.int32), 12)  # 3 private
+    assert pool.stats()["pages_free"] == 0
+    # shares a's prefix (pins +1 each during match) but cannot get its
+    # 2 private pages: the pins must roll back on exhaustion
+    with pytest.raises(pages_lib.PagePoolExhausted):
+        pool.begin(np.concatenate([p, np.arange(60, 64, dtype=np.int32)]), 16)
+    _, max_ref = _radix_pages(pool)
+    assert max_ref == 1                           # only a's own pins
+    assert pool.stats()["pages_free"] == 0        # nothing leaked
+    pool.release(a)
+    pool.release(c)
+    cached, _ = _radix_pages(pool)
+    assert pool.stats()["pages_free"] + cached == 6
+
+
+# ---------------------------------------------------------------------------
+# engine exactness: paged == contiguous == generate
+
+
+@pytest.mark.parametrize("kw", [
+    {},
+    {"position_embedding": "rope", "num_heads": 4, "hidden_size": 128,
+     "num_kv_heads": 2},
+    {"kv_cache_dtype": "int8"},
+], ids=["base", "rope_gqa", "int8"])
+def test_paged_engine_matches_contiguous_and_generate(kw):
+    """The tentpole exactness contract, per config family: a mixed
+    workload through the paged engine equals the contiguous engine
+    request-for-request, and both equal solo generate."""
+    model, params = _model_params(**kw)
+    prompts = [_prompt(7, seed=1), _prompt(5, seed=2), _prompt(9, seed=3),
+               _prompt(3, seed=4)]
+    budgets = [9, 6, 4, 8]
+    wants = [_generate_tokens(model, params, p, n, 64)
+             for p, n in zip(prompts, budgets)]
+    outs = {}
+    for paged in (True, False):
+        eng = serve.Engine(model, params, num_slots=2, max_len=64,
+                           prefill_chunk=4, tick_steps=3, paged=paged,
+                           registry=metrics_lib.Registry())
+        hs = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+        eng.drain()
+        outs[paged] = [h.tokens for h in hs]
+    assert outs[True] == outs[False] == wants
+
+
+def test_prefix_hit_bit_identical_to_cold_cache_and_skips_windows():
+    """A request whose system prompt is radix-cached decodes tokens
+    BIT-identical to the same request on a cold cache — and measurably
+    skips its shared prefill windows."""
+    model, params = _model_params()
+    sys_prompt = _prompt(16, seed=7)              # 2 pages at page_size 8
+    tails = [_prompt(5, seed=8), _prompt(3, seed=9)]
+    reqs = [np.concatenate([sys_prompt, t]) for t in tails]
+
+    def run(eng, req, new=7):
+        h = eng.submit(req, new)
+        eng.drain()
+        assert h.status == "ok"
+        return h.tokens
+
+    warm = serve.Engine(model, params, num_slots=2, max_len=64,
+                        prefill_chunk=4, tick_steps=2, page_size=8,
+                        registry=metrics_lib.Registry())
+    got_a = run(warm, reqs[0])                    # seeds the radix cache
+    got_b = run(warm, reqs[1])                    # hits it
+    st = warm.stats()
+    assert st.prefix_hits_total == 1
+    assert st.prefix_tokens_reused_total == 16
+    assert st.prefill_windows_skipped_total == 4  # 16 skipped / W=4
+    assert st.prefix_hit_rate == 0.5              # 1 of 2 lookups
+
+    for req, got in zip(reqs, (got_a, got_b)):
+        cold = serve.Engine(model, params, num_slots=2, max_len=64,
+                            prefill_chunk=4, tick_steps=2, page_size=8,
+                            registry=metrics_lib.Registry())
+        assert run(cold, req) == got              # bit-identical tokens
+        assert cold.stats().prefix_hits_total == 0
+
+
+def test_concurrent_shared_prefix_requests_match_solo():
+    """Requests sharing a prefix IN FLIGHT TOGETHER (the second maps
+    pages the first published at admission) each equal their solo
+    generate — read-only sharing never couples the streams."""
+    model, params = _model_params()
+    sys_prompt = _prompt(8, seed=11)
+    tails = [_prompt(4, seed=20 + i) for i in range(4)]
+    reqs = [np.concatenate([sys_prompt, t]) for t in tails]
+    wants = [_generate_tokens(model, params, r, 8, 64) for r in reqs]
+    eng = serve.Engine(model, params, num_slots=2, max_len=64,
+                       prefill_chunk=4, tick_steps=2, page_size=8,
+                       registry=metrics_lib.Registry())
+    hs = [eng.submit(r, 8) for r in reqs]
+    eng.drain()
+    assert [h.tokens for h in hs] == wants
+    st = eng.stats()
+    assert st.prefix_hits_total >= 1              # later arrivals hit
+    # all leases released: free + radix-cached == total, zero pins
+    pool = eng.scheduler.pages
+    cached, max_ref = _radix_pages(pool)
+    assert pool.stats()["pages_free"] + cached == st.pages_total
+    assert max_ref == 0
+
+
+def test_cow_split_whole_chain_prompt_stays_exact():
+    """A prompt EXACTLY equal to a cached chain must re-prefill its
+    last page (the COW split — decode writes need a private copy) and
+    still match solo generate token-for-token."""
+    model, params = _model_params()
+    prompt = _prompt(16, seed=13)                 # exactly 2 pages
+    want = _generate_tokens(model, params, prompt, 6, 64)
+    eng = serve.Engine(model, params, num_slots=2, max_len=64,
+                       prefill_chunk=4, tick_steps=2, page_size=8,
+                       registry=metrics_lib.Registry())
+    h1 = eng.submit(prompt, 6)
+    eng.drain()
+    h2 = eng.submit(prompt, 6)                    # whole-chain re-submit
+    eng.drain()
+    assert h1.tokens == h2.tokens == want
+    st = eng.stats()
+    assert st.cow_splits_total == 1
+    assert st.prefix_hits_total == 1              # page 0 still mapped
+    assert st.prefix_tokens_reused_total == 8     # one page, not two
+
+
+def test_exhaustion_requeues_pinned_chains_survive_and_drains():
+    """More demand than pages: admission requeues on exhaustion (no
+    deadlock — retirements free pages), an in-flight holder's chain is
+    never evicted from under it, and every request finishes exact."""
+    model, params = _model_params()
+    # pool: 2 slots x 4 pages (page_size 8, max_len 32) + 1 spare + trash
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=4, tick_steps=2, page_size=8,
+                       num_pages=10, registry=metrics_lib.Registry())
+    prompts = [_prompt(9 + (i % 3), seed=30 + i) for i in range(6)]
+    wants = [_generate_tokens(model, params, p, 10, 32) for p in prompts]
+    hs = [eng.submit(p, 10) for p in prompts]     # each needs 3 pages
+    eng.drain()
+    for h, want in zip(hs, wants):
+        assert h.status == "ok" and h.tokens == want
+    pool = eng.scheduler.pages
+    cached, max_ref = _radix_pages(pool)
+    assert max_ref == 0
+    assert pool.stats()["pages_free"] + cached \
+        == pool.stats()["pages_total"]
+
+
+def test_eviction_under_pressure_then_reseeded_prefix_still_hits():
+    """Distinct prompts fill the radix cache past the pool's capacity:
+    LRU chains evict to keep admissions flowing, and a prefix evicted
+    then re-seen simply re-prefills (a miss), while a recent one still
+    hits."""
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=8, tick_steps=2, page_size=8,
+                       num_pages=9, registry=metrics_lib.Registry())
+    prompts = [_prompt(8, seed=50 + i) for i in range(8)]
+    for p in prompts:                             # serially: each caches
+        h = eng.submit(p, 3)                      # 2 pages in flight,
+        eng.drain()                               # 1 cached after
+        assert h.status == "ok"
+    st = eng.stats()
+    assert st.prefix_evictions_total >= 1         # pressure reclaimed LRU
+    # the most recent prefix survived: resubmitting hits
+    h = eng.submit(np.concatenate([prompts[-1], _prompt(2, seed=99)]), 3)
+    eng.drain()
+    assert h.status == "ok"
+    assert eng.stats().prefix_hits_total >= 1
+
+
+# ---------------------------------------------------------------------------
+# retrace-free + concurrency
+
+
+@pytest.mark.retrace_guard(budget=1, enforce_donation=True)
+def test_paged_admission_alloc_cow_evict_never_recompile():
+    """Every paged executable traces ONCE across a workload that
+    exercises admission, page allocation, prefix hits, a COW split,
+    eviction under pressure, and slot reuse (budget=1: the second
+    trace of anything fails; donation enforcement doubles as a
+    use-after-donate check on the pool buffer chain)."""
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=4, tick_steps=2, page_size=8,
+                       num_pages=9, eos_id=7,
+                       registry=metrics_lib.Registry())
+    sys_prompt = _prompt(8, seed=61)
+    handles = []
+    for i in range(2):                            # seed, then hit
+        handles.append(eng.submit(
+            np.concatenate([sys_prompt, _prompt(3, seed=70 + i)]), 5))
+        eng.drain()
+    handles.append(eng.submit(sys_prompt, 4))     # COW split
+    eng.drain()
+    for i in range(7):                            # distinct: evictions
+        handles.append(eng.submit(_prompt(8, seed=80 + i), 4))
+        eng.drain()
+    assert all(h.done for h in handles)
+    assert all(len(h.tokens) >= 1 for h in handles)
+    st = eng.stats()
+    assert st.prefix_hits_total >= 1
+    assert st.cow_splits_total >= 1
+    assert st.prefix_evictions_total >= 1
+
+
+@pytest.mark.race_harness(
+    seed=17, scope=("distributed_tensorflow_tpu/serve/",))
+def test_concurrent_prefix_submits_never_tear_the_pool(request):
+    """THE pool race test: 3 submitter threads sharing one system
+    prompt against a pumping engine under seeded preemption.  Every
+    request finishes exact (refcounts never dropped a live page), and
+    the pool balances to free + radix-cached == total with zero
+    refcounts — eviction/release under preemption never double-freed
+    or leaked a page."""
+    model, params = _model_params()
+    eng = serve.Engine(model, params, num_slots=3, max_len=32,
+                       prefill_chunk=4, tick_steps=2, page_size=8,
+                       registry=metrics_lib.Registry())
+    sys_prompt = _prompt(8, seed=91)
+    reqs = {i: np.concatenate([sys_prompt, _prompt(2 + (i % 3),
+                                                   seed=100 + i)])
+            for i in range(6)}
+    wants = {i: _generate_tokens(model, params, reqs[i], 5, 32)
+             for i in reqs}
+    handles = {}
+    hlock = threading.Lock()
+    barrier = threading.Barrier(3)
+
+    def submitter(ids):
+        barrier.wait(timeout=60)
+        for i in ids:
+            h = eng.submit(reqs[i], 5)
+            with hlock:
+                handles[i] = h
+
+    ts = [threading.Thread(target=submitter, args=([k, k + 3],),
+                           name=f"dttpu-pages-{k}", daemon=True)
+          for k in range(3)]
+    for t in ts:
+        t.start()
+    deadline = time.time() + 300
+    while True:
+        with hlock:
+            got = dict(handles)
+        if len(got) == 6 and all(h.done for h in got.values()):
+            break
+        eng.step()
+        assert time.time() < deadline, "engine did not drain"
+    for t in ts:
+        t.join(timeout=60)
+
+    harness = request.node.race_harness
+    assert harness.preemptions > 0, "harness never fired"
+    for i, h in handles.items():
+        assert h.status == "ok" and h.tokens == wants[i], i
+    pool = eng.scheduler.pages
+    cached, max_ref = _radix_pages(pool)
+    st = pool.stats()
+    assert max_ref == 0                           # no leaked pins
+    assert st["pages_free"] + cached == st["pages_total"]
+    assert eng.stats().prefix_hits_total >= 1
+
+
+# ---------------------------------------------------------------------------
+# metrics plumbing
+
+
+def test_paged_metrics_land_in_registry():
+    """The obs wiring for the new series: pages gauges move with the
+    stats snapshot, prefix counters advance by delta, all scrapable
+    through the standard exposition path."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    eng = serve.Engine(model, params, num_slots=2, max_len=32,
+                       prefill_chunk=4, tick_steps=2, page_size=8,
+                       registry=reg)
+    sys_prompt = _prompt(8, seed=5)
+    for i in range(2):
+        # serial: the hit needs the seeder's pages registered first
+        eng.submit(np.concatenate([sys_prompt, _prompt(3, seed=i)]), 4)
+        eng.drain()
+    st = eng.stats()
+    assert reg.get("dttpu_serve_pages_free").value == st.pages_free
+    cached, _ = _radix_pages(eng.scheduler.pages)
+    assert st.pages_free + cached == st.pages_total   # leases released
+    assert reg.get("dttpu_serve_prefix_hits_total").value \
+        == st.prefix_hits_total == 1
+    assert reg.get("dttpu_serve_prefix_evictions_total").value == 0
+    doc = metrics_lib.parse_exposition(reg.expose())
+    assert doc["dttpu_serve_pages_free"]["type"] == "gauge"
+    assert doc["dttpu_serve_pages_per_request"]["type"] == "gauge"
+    assert doc["dttpu_serve_prefix_hits_total"]["type"] == "counter"
