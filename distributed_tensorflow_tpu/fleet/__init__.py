@@ -4,9 +4,18 @@ The tier above ``serve``: one ``Engine`` is one mesh, a fleet is N of
 them behind one façade (docs/SERVING.md §Fleet):
 
 * ``fleet.router`` — ``Router``: least-loaded placement fed by the
-  ``Engine.stats()`` snapshot, in-deadline retry of rejected/failed
-  requests on a surviving replica, ``drain_replica``/``remove_replica``
-  /``add_replica`` rolling restarts, ``dttpu_router_*`` metrics.
+  ``Engine.stats()`` snapshot, in-deadline retry of rejected requests,
+  LIVE MIGRATION of in-flight requests (``RequestSnapshot`` export ->
+  import, progress intact, exactly-once streaming via the router's
+  dedup shim) across failover/drain/removal/quarantine,
+  ``drain_replica``/``remove_replica``/``add_replica``/
+  ``resume_replica`` rolling restarts, ``dttpu_router_*`` +
+  ``dttpu_migrations_total`` metrics.
+* ``fleet.watchdog`` — ``Watchdog``: a tick-deadline health policy
+  over the pump heartbeat in ``Engine.stats()``; wedged or stalled
+  replicas are quarantined (``router.quarantined``) and their requests
+  migrated — driven deterministically by the ``stall_tick``/
+  ``wedge_replica`` fault kinds.
 * ``fleet.tenancy`` — per-tenant admission policy: ``TenantQuota``
   ceilings (max in-flight, token budgets) rejected loudly at submit,
   and a deficit-weighted fair-share queue (`DeficitFairQueue`) that
@@ -16,13 +25,14 @@ LoRA adapter hot-swap rides the serve/model layers
 (``serve.AdapterTable``, ``GPT.init_lora``); ``Router.load_adapter``
 broadcasts an adapter to every replica.  Chaos coverage: the
 ``kill_replica`` fault (resilience.faults) drops a replica mid-traffic
-and the router reroutes — measured by ``bench.py --config=fleet``.
+and the router migrates — measured by ``bench.py --config=fleet``.
 """
-from . import router, tenancy
+from . import router, tenancy, watchdog
 from .router import FleetHandle, NoReplicaError, Router
 from .tenancy import (DeficitFairQueue, QuotaExceededError, TenantPolicy,
                       TenantQuota)
+from .watchdog import Watchdog
 
 __all__ = ["DeficitFairQueue", "FleetHandle", "NoReplicaError",
            "QuotaExceededError", "Router", "TenantPolicy", "TenantQuota",
-           "router", "tenancy"]
+           "Watchdog", "router", "tenancy", "watchdog"]

@@ -12,21 +12,31 @@ One ``serve.Engine`` is one mesh; a fleet is N of them behind a
   pinned by tests/test_fleet.py).
 * **Retry within the deadline** — a submit REJECTED by one replica
   (queue full, tenant quota) tries the others in load order before the
-  rejection reaches the caller; a request whose replica dies or whose
-  engine handle fails is resubmitted to a surviving replica as long as
-  its deadline allows (generation restarts from the prompt — delivery
-  is at-least-once, so ``on_token`` may replay from the start after a
-  failover; the terminal ``tokens`` are exactly one clean run's).
-* **Rolling restarts** — ``drain_replica`` stops routing new traffic to
-  a replica and pumps the fleet until it empties;
-  ``remove_replica`` / ``add_replica`` swap replicas in and out with
-  in-flight work rerouted, turning the PR 5 backpressure/deadline/drain
-  primitives into zero-downtime deploys.
-* **Chaos** — a ``kill_replica`` fault (resilience.faults) raises at
-  the router's pump site for the targeted replica; the router marks it
-  dead and reroutes, and the acceptance test pins that every
-  non-expired request completes on a survivor with survivor streams
-  bit-exact (tests/test_fleet.py).
+  rejection reaches the caller; a request whose replica dies, drains,
+  or is quarantined MIGRATES to a survivor as long as its deadline
+  allows: the router exports a ``RequestSnapshot`` (progress intact)
+  and imports it elsewhere, so decode work is preserved and the
+  terminal tokens are bit-identical to an unmigrated run.  Every
+  ``on_token`` the router attaches is an offset-deduplicating stream
+  shim, so delivery is EXACTLY-ONCE across any number of hops — even
+  on the raw-resubmit fallback when an export is impossible.
+* **Rolling restarts** — ``drain_replica`` stops routing new traffic
+  to a replica and (by default) migrates its in-flight requests to the
+  survivors instead of waiting them out; ``remove_replica`` /
+  ``add_replica`` / ``resume_replica`` swap replicas in and out with
+  in-flight work migrated, turning the PR 5 backpressure/deadline/
+  drain primitives into zero-downtime deploys.
+* **Quarantine** — ``quarantine_replica`` takes a stuck-but-alive
+  replica out of rotation (the fleet ``Watchdog``'s tick-deadline
+  policy drives it; the PR 5 checkpoint-quarantine vocabulary, applied
+  to replicas), force-exports what it can past the wedged pump, and
+  migrates; the detached engine is kept in ``router.quarantined`` for
+  the operator.
+* **Chaos** — ``kill_replica`` raises at the router's pump site,
+  ``stall_tick``/``wedge_replica`` (resilience.faults) bend the
+  engine's own pump; the acceptance tests pin that every non-expired
+  request completes on a survivor bit-identical to solo ``generate``
+  with zero duplicated stream tokens (tests/test_migration.py).
 
 The router is synchronous like the engine: the caller pumps ``step()``
 (one tick of every live replica + the retry sweep) or ``drain()``.
@@ -42,17 +52,19 @@ other way around.
 Metrics (``registry=``): ``dttpu_router_replicas`` gauge,
 ``dttpu_router_requests_total`` / ``dttpu_router_retries_total`` /
 ``dttpu_router_replica_down_total`` / ``dttpu_router_rejected_total``
-counters, and per-replica ``dttpu_router_placed_total{replica=...}``.
+/ ``dttpu_migrations_total`` counters, and per-replica
+``dttpu_router_placed_total{replica=...}``.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import metrics as metrics_lib
 from ..resilience import faults as faults_lib
-from ..serve.engine import Engine, QueueFullError, RequestHandle
+from ..serve.engine import (Engine, QueueFullError, RequestHandle,
+                            RequestSnapshot)
 from .tenancy import QuotaExceededError
 
 __all__ = ["FleetHandle", "NoReplicaError", "Router"]
@@ -71,9 +83,12 @@ class FleetHandle:
     """Caller-facing view of one fleet request across retries.
 
     Mirrors ``RequestHandle`` (tokens / done / status / error / ttft_s)
-    but survives replica failures: after a failover the handle simply
-    tracks the replacement attempt.  ``replica_id`` is the current (or
-    final) placement; ``attempts`` counts placements."""
+    but survives replica failures: after a migration or failover the
+    handle simply tracks the replacement attempt.  ``replica_id`` is
+    the current (or final) placement; ``attempts`` counts placements;
+    ``migrations`` counts snapshot-based moves and
+    ``tokens_preserved`` the decode work those moves salvaged (tokens
+    carried over instead of regenerated)."""
 
     def __init__(self, rid: int, spec: dict,
                  deadline: Optional[float], retries_left: int,
@@ -83,15 +98,24 @@ class FleetHandle:
         self.deadline = deadline            # absolute perf_counter or None
         self.retries_left = retries_left
         self.attempts = 0
+        self.migrations = 0
+        self.tokens_preserved = 0
         self.replica_id: Optional[int] = None
         self._router = router
         self._handle: Optional[RequestHandle] = None
+        self._snapshot: Optional[RequestSnapshot] = None
+        self._streamed = 0                  # tokens forwarded to the user
+        self._ttft: Optional[float] = None  # pinned at first placement
         self._status = "pending"
         self.error: Optional[BaseException] = None
 
     @property
     def tokens(self) -> List[int]:
-        return self._handle.tokens if self._handle is not None else []
+        if self._handle is not None:
+            return self._handle.tokens
+        if self._snapshot is not None:      # orphaned mid-migration
+            return list(self._snapshot.generated)
+        return []
 
     @property
     def status(self) -> str:
@@ -103,11 +127,40 @@ class FleetHandle:
 
     @property
     def ttft_s(self) -> Optional[float]:
+        """Submit-to-first-token of the FIRST placement that produced a
+        token — migration does not reset it (the caller saw the stream
+        start exactly once)."""
+        if self._ttft is not None:
+            return self._ttft
         return self._handle.ttft_s if self._handle is not None else None
 
     @property
     def tenant(self) -> str:
         return self.spec["tenant"]
+
+    def _attempt_stream(self, base: int):
+        """An ``on_token`` shim for one placement: forwards only tokens
+        the user has not seen yet, making delivery exactly-once across
+        migrations AND raw-resubmit retries.  ``base`` is the stream
+        position where this attempt starts emitting (a snapshot
+        import's ``stream_offset``; 0 for a fresh submit).  A raising
+        user callback propagates BEFORE ``_streamed`` advances, so a
+        retried attempt re-delivers exactly the tokens the user never
+        accepted."""
+        user = self.spec["on_token"]
+        pos = [base]
+
+        def shim(toks: List[int]) -> None:
+            start = pos[0]
+            pos[0] = start + len(toks)
+            fresh = toks[max(0, self._streamed - start):]
+            if not fresh:
+                return
+            if user is not None:
+                user(fresh)
+            self._streamed = max(self._streamed, pos[0])
+
+        return shim
 
     def result(self) -> List[int]:
         """Pump the fleet until this request finishes; return its
@@ -134,21 +187,29 @@ class Router:
       max_retries: placements a request may consume AFTER its first
         (failover budget; rejected-at-submit probing of other replicas
         does not count).
+      export_timeout_s: how long failure-path exports wait for a dead/
+        quarantined replica's pump mutex before falling back to a
+        forced (``clean=False``) export — the wedged-pump escape hatch.
     """
 
     def __init__(self, replicas=(), *,
                  registry: Optional[metrics_lib.Registry] = None,
-                 max_retries: int = 2):
+                 max_retries: int = 2,
+                 export_timeout_s: float = 1.0):
         if max_retries < 0:
             raise ValueError(f"max_retries must be >= 0; got {max_retries}")
         reg = registry if registry is not None else metrics_lib.REGISTRY
         self.registry = reg
         self.max_retries = int(max_retries)
+        self.export_timeout_s = float(export_timeout_s)
         # guards the replica table, draining set, in-flight list, and
         # placement log; never held while pumping an engine tick
         self._lock = threading.Lock()
         self._replicas: Dict[int, Engine] = {}
         self._draining: set = set()
+        # replicas the watchdog (or operator) pulled for being unhealthy:
+        # {replica_id: (engine, reason)} — detached, kept for inspection
+        self.quarantined: Dict[int, Tuple[Engine, str]] = {}
         self._next_replica = 0
         self._next_rid = 0
         self._inflight: List[FleetHandle] = []
@@ -169,6 +230,11 @@ class Router:
             "dttpu_router_rejected_total",
             "Submits rejected by EVERY live replica (fleet-wide "
             "backpressure surfaced to the caller).")
+        self._m_migrations = reg.counter(
+            "dttpu_migrations_total",
+            "In-flight requests moved live (RequestSnapshot export -> "
+            "import on a survivor) across failover, drain, removal, or "
+            "quarantine.")
         self._m_placed: Dict[int, metrics_lib.Counter] = {}
         for engine in replicas:
             self.add_replica(engine)
@@ -180,6 +246,9 @@ class Router:
             rid = self._next_replica
             self._next_replica += 1
             self._replicas[rid] = engine
+            # chaos identity: engine-targeted fault kinds (stall_tick,
+            # wedge_replica) address this replica by its fleet id
+            engine.chaos_tag = rid
             self._m_placed[rid] = self.registry.counter(
                 "dttpu_router_placed_total",
                 "Requests placed, by replica.",
@@ -257,11 +326,13 @@ class Router:
             key=lambda rid: (self._replicas[rid].stats().inflight, rid))
 
     def _place(self, fh: FleetHandle, raise_rejection: bool) -> bool:
-        """Try to submit ``fh`` on each candidate replica in load order.
-        True on placement; False when every candidate rejected (or none
-        exists) and ``raise_rejection`` is off.  Called with the router
-        lock held (engine submits take the engine's own state lock —
-        lock order router -> engine, never reversed)."""
+        """Try to place ``fh`` on each candidate replica in load order —
+        a snapshot-carrying handle is IMPORTED (progress intact), a
+        fresh one submitted.  True on placement; False when every
+        candidate rejected (or none exists) and ``raise_rejection`` is
+        off.  Called with the router lock held (engine submits take the
+        engine's own state lock — lock order router -> engine, never
+        reversed)."""
         remaining = None
         if fh.deadline is not None:
             remaining = fh.deadline - time.perf_counter()
@@ -275,18 +346,46 @@ class Router:
                 raise err
             fh._finalize("failed", error=fh.error or err)
             return False
+        snap = fh._snapshot
+        if snap is not None and fh.deadline is not None:
+            # the fleet deadline stays authoritative across the
+            # export->import gap (the snapshot froze its remaining
+            # budget at export time); an engine-level default deadline
+            # in the snapshot is left alone
+            snap.deadline_remaining_s = remaining
         last: Optional[BaseException] = None
         for rid in candidates:
+            eng = self._replicas[rid]
             try:
-                h = self._replicas[rid].submit(
-                    fh.spec["prompt"], fh.spec["max_new_tokens"],
-                    on_token=fh.spec["on_token"],
-                    deadline_s=remaining,
-                    tenant=fh.spec["tenant"],
-                    adapter_id=fh.spec["adapter_id"])
+                if snap is not None:
+                    h = eng.import_request(
+                        snap,
+                        on_token=fh._attempt_stream(snap.stream_offset))
+                else:
+                    h = eng.submit(
+                        fh.spec["prompt"], fh.spec["max_new_tokens"],
+                        on_token=fh._attempt_stream(0),
+                        deadline_s=remaining,
+                        tenant=fh.spec["tenant"],
+                        adapter_id=fh.spec["adapter_id"])
             except _REJECTIONS as e:
                 last = e
                 continue
+            except Exception as e:
+                # not backpressure: this request cannot be placed
+                # anywhere (validation/compat error).  Surface it
+                # instead of spinning forever in the sweep.
+                if raise_rejection:
+                    raise
+                fh._finalize("failed", error=e)
+                return False
+            if snap is not None:
+                # consumed: further failovers re-export from the new
+                # replica, which now owns the freshest progress
+                fh._snapshot = None
+                fh.migrations += 1
+                fh.tokens_preserved += len(snap.generated)
+                self._m_migrations.inc()
             fh._handle = h
             fh.replica_id = rid
             fh.attempts += 1
@@ -357,16 +456,36 @@ class Router:
     # ----------------------------------------------- rolling restarts
 
     def drain_replica(self, replica_id: int,
-                      timeout_s: Optional[float] = None) -> bool:
-        """Stop routing NEW traffic to ``replica_id`` and pump the whole
-        fleet until it is empty (other replicas keep serving).  Returns
-        False on timeout (the replica stays draining — call again or
-        ``remove_replica`` to force reroute)."""
+                      timeout_s: Optional[float] = None,
+                      migrate: bool = True) -> bool:
+        """Stop routing NEW traffic to ``replica_id`` and empty it.
+        With ``migrate=True`` (the default) its in-flight requests are
+        exported and re-placed on the survivors with their progress
+        intact — the drain completes in one export/import round instead
+        of waiting out every decode.  ``migrate=False`` keeps the
+        legacy wait-drain (pump the fleet until the replica empties).
+        Returns False on timeout (the replica stays draining — call
+        again, ``remove_replica`` to force, or ``resume_replica`` to
+        put it back in rotation)."""
         with self._lock:
             if replica_id not in self._replicas:
                 raise KeyError(f"unknown replica {replica_id}")
             self._draining.add(replica_id)
             eng = self._replicas[replica_id]
+            if migrate and not any(
+                    r != replica_id and r not in self._draining
+                    for r in self._replicas):
+                # no survivor to migrate to: fall back to wait-drain
+                # rather than failing the in-flight requests
+                migrate = False
+            victims = (self._victims_locked(replica_id) if migrate
+                       else [])
+        if migrate:
+            # blocking clean exports: a draining replica's pump is
+            # healthy, so each export just waits out the running tick
+            self._export_and_orphan(victims, eng, timeout_s=None)
+            with self._lock:
+                self._sweep()       # re-place on survivors immediately
         deadline = (None if timeout_s is None
                     else time.perf_counter() + timeout_s)
         while True:
@@ -381,44 +500,118 @@ class Router:
                 break
         return not eng.busy
 
+    def resume_replica(self, replica_id: int) -> None:
+        """Put a draining replica back into rotation (the rolling-
+        restart counterpart of ``drain_replica`` when the restart is
+        done in place)."""
+        with self._lock:
+            if replica_id not in self._replicas:
+                raise KeyError(f"unknown replica {replica_id}")
+            self._draining.discard(replica_id)
+
     def remove_replica(self, replica_id: int) -> Engine:
         """Take ``replica_id`` out of the fleet.  In-flight requests on
-        it are cancelled engine-side and rerouted to the survivors
-        (deadline/retry budget permitting) — drain first for a clean
-        handoff.  Returns the detached engine (restart it, then
-        ``add_replica`` it back)."""
+        it are exported and MIGRATED to the survivors with their
+        progress intact (deadline/retry budget permitting).  Returns
+        the detached engine (restart it, then ``add_replica`` it
+        back)."""
         with self._lock:
             eng = self._replicas.pop(replica_id)
             self._draining.discard(replica_id)
             self._m_replicas.set(len(self._replicas))
-            orphaned: List[RequestHandle] = []
-            for fh in self._inflight:
-                if fh.replica_id == replica_id and not fh.done \
-                        and fh._handle is not None:
-                    orphaned.append(fh._handle)
-                    fh._handle = None   # orphaned: the sweep reroutes
-                    fh.replica_id = None
-                    self._m_retries.inc()
-        for handle in orphaned:
-            eng.cancel(handle)
+            victims = self._victims_locked(replica_id)
+        self._export_and_orphan(victims, eng,
+                                timeout_s=self.export_timeout_s)
+        with self._lock:
+            self._sweep()
+        return eng
+
+    def quarantine_replica(self, replica_id: int,
+                           reason: str = "unhealthy",
+                           export_timeout_s: Optional[float] = None
+                           ) -> Engine:
+        """Pull a stuck-but-alive replica out of rotation (the fleet
+        ``Watchdog``'s action; same vocabulary as the PR 5 checkpoint
+        quarantine): the engine moves to ``router.quarantined`` with
+        its ``reason``, its requests are exported — past a wedged pump
+        if need be (``export_timeout_s``, default the router's) — and
+        migrated to the survivors.  Returns the detached engine for
+        inspection; ``add_replica`` re-admits it after repair."""
+        with self._lock:
+            if replica_id not in self._replicas:
+                raise KeyError(f"unknown replica {replica_id}")
+            eng = self._replicas.pop(replica_id)
+            self._draining.discard(replica_id)
+            self.quarantined[replica_id] = (eng, str(reason))
+            self._m_replicas.set(len(self._replicas))
+            victims = self._victims_locked(replica_id)
+        timeout = (self.export_timeout_s if export_timeout_s is None
+                   else export_timeout_s)
+        self._export_and_orphan(victims, eng, timeout_s=timeout)
         with self._lock:
             self._sweep()
         return eng
 
     # ------------------------------------------------------- internals
 
+    def _victims_locked(self, replica_id: int
+                        ) -> List[Tuple[FleetHandle,
+                                        Optional[RequestHandle]]]:
+        """(handle, engine handle) pairs still pending on a replica —
+        router lock held."""
+        return [(fh, fh._handle) for fh in self._inflight
+                if fh.replica_id == replica_id and not fh.done]
+
+    def _export_and_orphan(self, victims, eng: Engine,
+                           timeout_s: Optional[float],
+                           error: Optional[BaseException] = None) -> None:
+        """Export each victim's live state from ``eng`` and mark the
+        fleet handle orphaned-with-snapshot (the sweep imports it on a
+        survivor).  An export that fails — the request finished
+        concurrently, or the engine is too far gone — falls back to
+        cancel + raw resubmit, which the stream shim still keeps
+        exactly-once.  Called WITHOUT the router lock (exports take the
+        engine's pump/state locks; order router -> engine holds)."""
+        for fh, h in victims:
+            snap: Optional[RequestSnapshot] = None
+            if h is not None:
+                if h.done:
+                    continue            # sweep finalizes from the handle
+                try:
+                    snap = eng.export_request(h, timeout_s=timeout_s)
+                except Exception:
+                    snap = None
+                if snap is None:
+                    if h.done:
+                        continue        # finished during the export race
+                    eng.cancel(h)       # stop the doomed attempt
+            with self._lock:
+                if fh.done:
+                    continue
+                if fh._ttft is None and h is not None:
+                    fh._ttft = h.ttft_s
+                fh._snapshot = snap
+                if error is not None:
+                    fh.error = error
+                fh._handle = None       # orphaned: the sweep re-places
+                fh.replica_id = None
+                self._m_retries.inc()
+
     def _replica_down(self, replica_id: int, error: BaseException) -> None:
         with self._lock:
-            self._replicas.pop(replica_id, None)
+            eng = self._replicas.pop(replica_id, None)
             self._draining.discard(replica_id)
             self._m_down.inc()
             self._m_replicas.set(len(self._replicas))
-            for fh in self._inflight:
-                if fh.replica_id == replica_id and not fh.done:
-                    fh.error = error
-                    fh._handle = None   # orphaned: the sweep reroutes
-                    fh.replica_id = None
-                    self._m_retries.inc()
+            victims = self._victims_locked(replica_id)
+        if eng is not None:
+            # the pump raised but the engine's HOST state is intact (the
+            # scheduler's locks were released with the failing tick), so
+            # in-flight progress is still exportable — the kill loses a
+            # replica, not the decode work on it
+            self._export_and_orphan(victims, eng,
+                                    timeout_s=self.export_timeout_s,
+                                    error=error)
 
     def _sweep(self) -> bool:
         """Called with the router lock held."""

@@ -1,0 +1,145 @@
+"""Fleet watchdog: detect wedged/stalled replicas and quarantine them.
+
+A replica can fail without dying: a pump thread blocked on a lost RPC,
+a pathological tick that takes seconds instead of milliseconds, a host
+thread wedged in a driver call.  The Router's failure path (a pump that
+RAISES) never sees these — until this watchdog, a stuck-but-alive
+replica was invisible until every request on it blew its deadline.
+
+``Watchdog`` closes that gap with a **tick-deadline policy** over the
+pump heartbeat every engine already publishes through
+``Engine.stats()`` (``ticks_started``/``ticks_completed`` counters plus
+perf_counter stamps bracketing the most recent tick — scheduler-side
+bookkeeping, so reading it never touches the possibly-stuck pump
+thread).  A replica is declared unhealthy when either:
+
+* **wedged** — a tick is IN PROGRESS (started > completed) and its
+  start stamp is older than ``tick_deadline_s``: the pump entered a
+  tick and never came back; or
+* **stalled** — the most recent COMPLETED tick took longer than
+  ``tick_deadline_s``: the pump is alive but pathological (detected
+  post-hoc, which is what makes the policy testable single-threaded —
+  and a pump that blew its deadline once is not a pump to keep serving
+  SLO-bearing traffic).
+
+On a verdict the watchdog bumps ``dttpu_watchdog_unhealthy_total`` and
+calls ``Router.quarantine_replica`` — the replica moves out of rotation
+into ``router.quarantined`` (the PR 5 checkpoint-quarantine vocabulary,
+applied to replicas), its in-flight requests are exported (past the
+wedged pump via the bounded-wait forced export) and MIGRATED to
+survivors with their progress intact, and the detached engine is kept
+for the operator.
+
+Deterministically testable: the ``stall_tick`` and ``wedge_replica``
+fault kinds (resilience/faults.py) bend a targeted engine's pump at an
+exact tick index, so both verdict branches are pinned by fast chaos
+tests instead of real hangs (tests/test_migration.py), and
+``bench.py --config=recovery`` measures the detection latency.
+
+Threading: the watchdog owns no threads.  Call ``check()`` from any
+loop you already have (the serving driver's pump loop, a metrics
+scraper), or hand ``watch(stop_event)`` to a thread you own::
+
+    wd = fleet.Watchdog(router, tick_deadline_s=2.0)
+    stop = threading.Event()
+    t = threading.Thread(target=wd.watch, args=(stop,),
+                         name="dttpu-watchdog", daemon=True)
+    t.start()
+    ...
+    stop.set(); t.join()
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..obs import metrics as metrics_lib
+from .router import Router
+
+__all__ = ["Watchdog"]
+
+
+class Watchdog:
+    """Tick-deadline health policy over a ``Router``'s replicas.
+
+    Args:
+      router: the fleet to watch.
+      tick_deadline_s: a pump tick older (in progress) or longer
+        (completed) than this is pathological.  Set it well above the
+        fleet's honest worst-case tick — first-compile ticks included,
+        or warm the engines first.
+      export_timeout_s: bound on waiting for an unhealthy replica's
+        pump mutex during the quarantine's export (the wedged pump
+        holds it forever — the forced export path takes over after
+        this).
+      registry: obs registry for ``dttpu_watchdog_unhealthy_total``.
+    """
+
+    def __init__(self, router: Router, *, tick_deadline_s: float = 5.0,
+                 export_timeout_s: float = 0.25,
+                 registry: Optional[metrics_lib.Registry] = None):
+        if tick_deadline_s <= 0:
+            raise ValueError(
+                f"tick_deadline_s must be > 0; got {tick_deadline_s}")
+        self.router = router
+        self.tick_deadline_s = float(tick_deadline_s)
+        self.export_timeout_s = float(export_timeout_s)
+        reg = registry if registry is not None else metrics_lib.REGISTRY
+        self.unhealthy_total = reg.counter(
+            "dttpu_watchdog_unhealthy_total",
+            "Replicas declared unhealthy by the watchdog's "
+            "tick-deadline policy and quarantined.")
+        self._lock = threading.Lock()      # guards the audit log
+        self.log: List[Tuple[int, str]] = []   # (replica_id, reason)
+
+    # ------------------------------------------------------------ policy
+
+    def verdict(self, stats, now: Optional[float] = None
+                ) -> Optional[str]:
+        """The tick-deadline policy on one ``EngineStats`` snapshot:
+        a reason string when the replica is unhealthy, else None."""
+        now = time.perf_counter() if now is None else now
+        d = self.tick_deadline_s
+        if stats.ticks_started > stats.ticks_completed:
+            age = now - stats.last_tick_start_s
+            if age > d:
+                return (f"wedged: tick #{stats.ticks_started} in "
+                        f"progress for {age:.3f}s (deadline {d:g}s)")
+        elif stats.ticks_completed and stats.last_tick_duration_s > d:
+            return (f"stalled: tick #{stats.ticks_completed} took "
+                    f"{stats.last_tick_duration_s:.3f}s (deadline "
+                    f"{d:g}s)")
+        return None
+
+    # ------------------------------------------------------------- drive
+
+    def check(self, now: Optional[float] = None
+              ) -> List[Tuple[int, str]]:
+        """One sweep: read every replica's heartbeat, quarantine the
+        unhealthy ones (their requests migrate to survivors), return
+        [(replica_id, reason)] for this sweep's verdicts."""
+        hits: List[Tuple[int, str]] = []
+        for rid, stats in self.router.stats().items():
+            reason = self.verdict(stats, now)
+            if reason is None:
+                continue
+            try:
+                self.router.quarantine_replica(
+                    rid, reason=reason,
+                    export_timeout_s=self.export_timeout_s)
+            except KeyError:
+                continue        # raced another check()/operator action
+            self.unhealthy_total.inc()
+            with self._lock:
+                self.log.append((rid, reason))
+            hits.append((rid, reason))
+        return hits
+
+    def watch(self, stop: threading.Event,
+              interval_s: float = 0.5) -> None:
+        """Run ``check()`` every ``interval_s`` until ``stop`` is set —
+        the body for a caller-owned watchdog thread (the caller starts,
+        names, and joins it; see the module example)."""
+        while not stop.wait(interval_s):
+            self.check()

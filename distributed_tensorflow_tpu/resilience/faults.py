@@ -36,8 +36,20 @@ fail_decode         raise ``InjectedFault`` when the serve scheduler
 kill_replica        raise ``ConnectionError`` at the fleet Router's pump
                     site for replica id ``replica`` on its ``at``-th
                     pump — the router sees the replica die mid-traffic,
-                    removes it, and reroutes its in-flight requests to
+                    removes it, and migrates its in-flight requests to
                     the survivors (fleet/router.py)
+stall_tick          sleep ``seconds`` inside the serve scheduler's pump
+                    at its ``at``-th tick (engine tagged ``replica`` —
+                    the fleet Router stamps ``Engine.chaos_tag`` with
+                    the replica id) — the tick completes late, so the
+                    fleet ``Watchdog`` sees a blown tick deadline in
+                    ``Engine.stats()`` and quarantines the replica
+wedge_replica       block the serve scheduler's pump at its ``at``-th
+                    tick (engine tagged ``replica``) until
+                    ``plan.release_wedges()`` or the ``seconds`` cap —
+                    a stuck-but-alive replica: the pump holds its mutex
+                    mid-tick, which only the watchdog's in-progress
+                    heartbeat check can see
 ==================  =========================================================
 
 Every injection is auditable: it lands in ``plan.log``, increments the
@@ -62,6 +74,7 @@ import dataclasses
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ..obs import metrics as metrics_lib
@@ -71,7 +84,8 @@ __all__ = ["Fault", "FaultPlan", "InjectedFault", "KINDS", "activate",
            "activated", "active", "deactivate", "plan_from_env"]
 
 KINDS = ("corrupt_checkpoint", "save_oserror", "poison_batch",
-         "nan_grads", "kill_prefetch", "fail_decode", "kill_replica")
+         "nan_grads", "kill_prefetch", "fail_decode", "kill_replica",
+         "stall_tick", "wedge_replica")
 
 
 class InjectedFault(RuntimeError):
@@ -91,7 +105,11 @@ class Fault:
     at: int
     mode: str = "truncate"          # corrupt_checkpoint: truncate | flip
     file: str = "arrays.npz"        # corrupt_checkpoint target file
-    replica: int = 0                # kill_replica: target replica id
+    replica: int = 0                # kill_replica/stall_tick/wedge_replica:
+    #                                 target replica (engine chaos_tag)
+    seconds: float = 1.0            # stall_tick: sleep length;
+    #                                 wedge_replica: max block before the
+    #                                 wedge self-releases
     times: int = 1                  # max fires
     fired: int = 0
 
@@ -113,6 +131,7 @@ class FaultPlan:
         self._rng = np.random.default_rng(self.seed)
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
+        self._wedges: Dict[int, threading.Event] = {}
         self.log: List[Dict[str, Any]] = []
         reg = registry if registry is not None else metrics_lib.REGISTRY
         self._injected = reg.counter(
@@ -216,6 +235,37 @@ class FaultPlan:
             self._record(f, rid=int(rid))
             raise InjectedFault(
                 f"injected fault: decode failed for request {rid}")
+
+    def on_engine_tick(self, tag: int) -> None:
+        """The serve scheduler's pump at tick entry for the engine
+        tagged ``tag`` (the fleet Router stamps replica ids onto
+        ``Engine.chaos_tag``; a standalone engine is tag 0).  A
+        stall_tick armed at this tick index sleeps ``seconds`` — the
+        tick completes, but past any sane watchdog deadline; a
+        wedge_replica blocks the pump (mutex held, mid-tick) until
+        ``release_wedges()`` or the ``seconds`` cap, the
+        stuck-but-alive shape only an in-progress heartbeat check can
+        see."""
+        i = self._tick(f"tick:{tag}")
+        f = self._match("stall_tick", i, replica=int(tag))
+        if f is not None:
+            self._record(f, replica=int(tag), tick=i, seconds=f.seconds)
+            time.sleep(f.seconds)
+        f = self._match("wedge_replica", i, replica=int(tag))
+        if f is not None:
+            with self._lock:
+                ev = self._wedges.setdefault(int(tag), threading.Event())
+            self._record(f, replica=int(tag), tick=i)
+            ev.wait(f.seconds)
+
+    def release_wedges(self) -> None:
+        """Unblock every pump held by a fired wedge_replica fault (the
+        test/bench driver's hand on the wedge — a wedge with no release
+        self-frees at its ``seconds`` cap)."""
+        with self._lock:
+            evs = list(self._wedges.values())
+        for ev in evs:
+            ev.set()
 
     def on_replica_step(self, replica: int) -> None:
         """The fleet Router's pump of replica ``replica``: kill that

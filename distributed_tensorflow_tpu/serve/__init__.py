@@ -31,18 +31,20 @@ tests/test_pages.py.
 """
 from . import adapters, engine, pages, scheduler, slots
 from .adapters import AdapterTable, AdapterTableFull
-from .engine import Engine, QueueFullError, RequestHandle, ServeMetrics
+from .engine import (DrainResult, Engine, QueueFullError, RequestHandle,
+                     ServeMetrics)
 from .pages import (PageLease, PagePool, PagePoolExhausted,
                     auto_page_size, decode_paged_step, init_paged_cache,
                     paged_kv_valid)
-from .scheduler import EngineStats, Request, SlotScheduler
+from .scheduler import (EngineStats, Request, RequestSnapshot,
+                        SlotScheduler)
 from .slots import (decode_slots_step, init_slot_cache, insert_slot,
                     slot_kv_valid, strip_pos)
 
-__all__ = ["AdapterTable", "AdapterTableFull", "Engine", "EngineStats",
-           "PageLease", "PagePool", "PagePoolExhausted",
-           "QueueFullError", "RequestHandle", "ServeMetrics",
-           "Request", "SlotScheduler", "auto_page_size",
+__all__ = ["AdapterTable", "AdapterTableFull", "DrainResult", "Engine",
+           "EngineStats", "PageLease", "PagePool", "PagePoolExhausted",
+           "QueueFullError", "RequestHandle", "RequestSnapshot",
+           "ServeMetrics", "Request", "SlotScheduler", "auto_page_size",
            "decode_paged_step", "decode_slots_step", "init_paged_cache",
            "init_slot_cache", "insert_slot", "paged_kv_valid",
            "slot_kv_valid", "strip_pos",

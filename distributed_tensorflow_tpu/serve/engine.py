@@ -39,15 +39,15 @@ existing ``/metrics`` endpoint, docs/OBSERVABILITY.md):
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Union
 
 from ..obs import metrics as metrics_lib
 from .adapters import AdapterTable
 from .scheduler import (EngineStats, QueueFullError, Request,
-                        SlotScheduler)
+                        RequestSnapshot, SlotScheduler)
 
-__all__ = ["Engine", "EngineStats", "QueueFullError", "RequestHandle",
-           "ServeMetrics"]
+__all__ = ["DrainResult", "Engine", "EngineStats", "QueueFullError",
+           "RequestHandle", "RequestSnapshot", "ServeMetrics"]
 
 
 class ServeMetrics:
@@ -89,6 +89,15 @@ class ServeMetrics:
             "dttpu_serve_failed_total",
             "Requests failed individually (callback/decode error) "
             "without killing the scheduler.")
+        # live migration (docs/RESILIENCE.md): where imported requests'
+        # streams resume — the offset IS the decode work the snapshot
+        # salvaged, so the distribution doubles as a preserved-work view
+        self.stream_resume = reg.histogram(
+            "dttpu_serve_stream_resume_offset",
+            "Stream offset (tokens already delivered on the source "
+            "engine) at which an imported request resumed.",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                     256.0, 512.0))
         # paged-KV series (serve/pages.py; flat zero on a contiguous
         # engine) — rendered from the same Engine.stats() snapshot as
         # the gauges above, so there is exactly ONE bookkeeping source
@@ -221,8 +230,10 @@ class RequestHandle:
     @property
     def status(self) -> str:
         """``pending`` while in flight; terminal: ``ok`` |
-        ``deadline_exceeded`` | ``failed`` | ``cancelled``.  Non-ok
-        handles keep whatever tokens were delivered before the abort."""
+        ``deadline_exceeded`` | ``failed`` | ``cancelled`` |
+        ``migrated`` (exported as a ``RequestSnapshot`` — the request
+        continues wherever the snapshot is imported).  Non-ok handles
+        keep whatever tokens were delivered before the abort."""
         return self._req.status
 
     @property
@@ -248,6 +259,29 @@ class RequestHandle:
             if not self._engine.step():
                 break
         return self.tokens
+
+
+class DrainResult:
+    """Outcome of ``Engine.drain``: truthy iff every request finished
+    in place.  A timed-out drain no longer strands in-flight requests
+    in limbo — the stragglers are EXPORTED (``exported``: their
+    ``RequestSnapshot``s, the engine left idle) so the caller can
+    migrate them to another engine, ``import_request`` them back after
+    the restart, or drop them deliberately.  ``bool(result)`` keeps the
+    old ``drain() -> bool`` call sites working."""
+
+    __slots__ = ("completed", "exported")
+
+    def __init__(self, completed: bool, exported=()):
+        self.completed = bool(completed)
+        self.exported: List[RequestSnapshot] = list(exported)
+
+    def __bool__(self) -> bool:
+        return self.completed
+
+    def __repr__(self) -> str:
+        return (f"DrainResult(completed={self.completed}, "
+                f"exported={len(self.exported)})")
 
 
 class Engine:
@@ -329,9 +363,20 @@ class Engine:
 
     def stats(self) -> EngineStats:
         """Lock-cheap load snapshot (queue depth, prefilling, active
-        slots, per-tenant in-flight) — the router's placement signal and
-        the source the serve gauges render from."""
+        slots, per-tenant in-flight, pump heartbeat) — the router's
+        placement signal, the watchdog's health signal, and the source
+        the serve gauges render from."""
         return self.scheduler.stats()
+
+    @property
+    def chaos_tag(self) -> int:
+        """Identity for engine-targeted fault kinds (stall_tick /
+        wedge_replica); the fleet Router stamps the replica id here."""
+        return self.scheduler.chaos_tag
+
+    @chaos_tag.setter
+    def chaos_tag(self, tag: int) -> None:
+        self.scheduler.chaos_tag = int(tag)
 
     def load_adapter(self, adapter_id: str, adapter) -> None:
         """Register a LoRA adapter (``GPT.init_lora`` layout) for
@@ -384,25 +429,83 @@ class Engine:
         """One scheduler tick; False when fully idle."""
         return self.scheduler.step()
 
-    def drain(self, timeout_s: Optional[float] = None) -> bool:
-        """Run until every submitted request has finished; with
-        ``timeout_s``, stop pumping at the budget and return False
-        (in-flight requests stay resumable by further ``step`` calls —
-        or cancel them for a hard shutdown)."""
+    def drain(self, timeout_s: Optional[float] = None) -> DrainResult:
+        """Run until every submitted request has finished.  Returns a
+        truthy ``DrainResult`` on a complete drain.  With ``timeout_s``
+        the drain is LOSSLESS even when the budget runs out: instead of
+        returning False with requests stranded in limbo (the old
+        contract), the stragglers are exported as ``RequestSnapshot``s
+        — their handles end ``migrated``, the engine is left idle, and
+        ``result.exported`` carries the snapshots for
+        ``import_request`` here or on another engine."""
         if timeout_s is None:
             self.scheduler.drain()
-            return True
+            return DrainResult(True)
         deadline = time.perf_counter() + timeout_s
         while self.scheduler.busy:
             if time.perf_counter() >= deadline:
-                return False
+                snaps = self.scheduler.export_all()
+                return DrainResult(not snaps, snaps)
             self.scheduler.step()
-        return True
+        return DrainResult(True)
 
     def cancel(self, handle: RequestHandle) -> bool:
         """Abort one request (status ``cancelled``); False if it already
         finished."""
         return self.scheduler.cancel(handle._req)
+
+    # ------------------------------------------------- live migration
+
+    def export_request(self, handle: Union[RequestHandle, int],
+                       timeout_s: Optional[float] = None
+                       ) -> RequestSnapshot:
+        """Export one in-flight request (a handle or its rid) as a
+        portable ``RequestSnapshot`` and retire it here with status
+        ``migrated`` — no device buffers cross: the destination's
+        ``import_request`` rebuilds the KV deterministically and the
+        stream resumes at the snapshot's offset (docs/RESILIENCE.md).
+        ``timeout_s`` bounds the wait for the pump mutex — pass one
+        when the pump may be wedged (watchdog quarantine); the forced
+        export is marked ``clean=False``.  Raises ``KeyError`` for an
+        unknown rid, ``RuntimeError`` for a request already terminal."""
+        if isinstance(handle, RequestHandle):
+            req = handle._req
+        else:
+            req = self.scheduler.find(int(handle))
+            if req is None:
+                raise KeyError(f"no in-flight request with rid {handle}")
+        return self.scheduler.export(req, timeout_s=timeout_s)
+
+    def export_inflight(self, timeout_s: Optional[float] = None
+                        ) -> List[RequestSnapshot]:
+        """Export EVERY in-flight request (rid order), leaving the
+        engine idle — the quarantine/shutdown bulk path."""
+        return self.scheduler.export_all(timeout_s=timeout_s)
+
+    def import_request(self, snap: RequestSnapshot,
+                       on_token: Optional[Callable[[List[int]], None]]
+                       = None) -> RequestHandle:
+        """Resume an exported request here -> handle.  Admission is the
+        same door ``submit`` uses (queue depth, tenant quota — charged
+        at the snapshot's REMAINING budget) and the prefill/decode run
+        through the same three hot executables, so importing never
+        recompiles.  ``on_token`` streams only tokens BEYOND the
+        snapshot's ``stream_offset`` (callbacks are not serializable,
+        so the caller re-attaches one); the handle's ``tokens`` are the
+        full sequence, pre-seeded with the snapshot's."""
+        try:
+            req = self.scheduler.import_snapshot(snap, on_token=on_token)
+        except QueueFullError:
+            self.metrics.rejected.inc()
+            raise
+        except (ValueError, KeyError):
+            raise                    # validation, not admission policy
+        except Exception:
+            if self.tenancy is not None:
+                self.metrics.tenant_rejected(str(snap.tenant)).inc()
+            raise
+        self.metrics.stream_resume.observe(float(snap.stream_offset))
+        return RequestHandle(req, self)
 
     def generate_batch(self, prompts,
                        max_new_tokens: Optional[int] = None

@@ -319,6 +319,25 @@ class PagePool:
                 lease.shared.append(child)
                 node = child
 
+    def handoff(self, lease: PageLease, context: np.ndarray) -> int:
+        """Export-path lease handoff (docs/RESILIENCE.md §migration):
+        publish the lease's FINAL full-chunk pages for ``context`` (the
+        request's prompt + fully-written generated tokens — the caller
+        truncates to columns the device has actually finished) into the
+        radix tree, then release the lease.  A re-import into THIS
+        engine — a drain timeout's stragglers, a watchdog quarantine
+        that resolves locally — then radix-matches the handed-off chain
+        and skips those prefill windows, so migration re-prefill costs
+        only the unpublished tail.  Returns pages published (0 with the
+        prefix cache off, where this degrades to a plain release)."""
+        published = 0
+        if self.prefix_cache and not lease.released:
+            before = len(lease.shared)
+            self.register(lease, context)
+            published = len(lease.shared) - before
+        self.release(lease)
+        return published
+
     def release(self, lease: PageLease) -> None:
         """Return a lease's holdings: shared pins drop (the chain stays
         cached, evictable once refcount-0), private pages go straight

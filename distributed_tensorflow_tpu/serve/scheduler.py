@@ -22,6 +22,12 @@ Request lifecycle::
     QUEUED --admission--> PREFILLING --insert_slot--> ACTIVE --> FINISHED
                 (free slot)   (chunked)    (first token)  (EOS/budget)
 
+Any in-flight state is also EXPORTABLE as a portable ``RequestSnapshot``
+(``export``/``import_snapshot`` — live migration, docs/RESILIENCE.md):
+the destination re-enters the same lifecycle with its prefill context
+set to ``prompt + generated`` and its token list pre-seeded, so decode
+resumes where the source stopped through the SAME three executables.
+
 * **Chunked prefill**: the prompt is RIGHT-padded to a multiple of
   ``prefill_chunk`` and streamed through ``GPT.decode_window`` one
   fixed-width window per tick, into a pooled batch-1 prefill cache — so
@@ -89,7 +95,8 @@ from . import pages as pages_lib
 from . import slots as slots_lib
 from .adapters import AdapterTableFull
 
-__all__ = ["EngineStats", "QueueFullError", "Request", "SlotScheduler"]
+__all__ = ["EngineStats", "QueueFullError", "Request", "RequestSnapshot",
+           "SlotScheduler"]
 
 
 class QueueFullError(RuntimeError):
@@ -103,7 +110,9 @@ class Request:
 
     ``status`` is the terminal disposition: ``"pending"`` while in
     flight, then ``"ok"`` | ``"deadline_exceeded"`` | ``"failed"`` |
-    ``"cancelled"`` (docs/RESILIENCE.md).  ``deadline`` is an absolute
+    ``"cancelled"`` | ``"migrated"`` (the request's live state was
+    exported as a ``RequestSnapshot`` and continues elsewhere —
+    docs/RESILIENCE.md).  ``deadline`` is an absolute
     ``perf_counter`` instant; expiry is checked once per tick, so a
     retirement can lag the deadline by at most one tick.
 
@@ -136,12 +145,68 @@ class Request:
     # granted at prefill begin, released once at retirement
     _lease: Optional[object] = dataclasses.field(default=None,
                                                  repr=False)
+    # migration (import_snapshot): ``context`` is what prefill actually
+    # runs over — the original prompt plus every token already generated
+    # on the source engine (== prompt for a fresh submit); ``resumed``
+    # counts the pre-seeded tokens; ``token_cost`` is what tenancy
+    # accounting charged at admission (the REMAINING budget — resumed
+    # work was already paid for on the source)
+    context: Optional[np.ndarray] = dataclasses.field(default=None,
+                                                      repr=False)
+    resumed: int = 0
+    token_cost: int = 0
+
+    @property
+    def remaining_budget(self) -> int:
+        """Tokens this engine still owes the caller (== max_new_tokens
+        for a fresh submit; the unserved tail for an import)."""
+        return self.max_new_tokens - self.resumed
 
     @property
     def ttft_s(self) -> Optional[float]:
         if self.first_token_time is None:
             return None
         return self.first_token_time - self.submit_time
+
+
+@dataclasses.dataclass
+class RequestSnapshot:
+    """A portable, host-side snapshot of one in-flight request — the
+    unit of live migration (docs/RESILIENCE.md §migration).
+
+    Deliberately contains NO device state: the destination engine
+    rebuilds the KV cache bit-identically by running its deterministic
+    chunked prefill over ``prompt + generated`` (the radix prefix cache
+    makes that cheap when the destination has seen the prefix), then
+    decode continues where the source stopped.  ``generated`` is every
+    token the source delivered — the import pre-seeds the new request's
+    token list with it, so the terminal ``tokens`` are the full
+    sequence and the destination's callbacks fire only for NEW tokens
+    (``stream_offset`` == ``len(generated)`` is where the stream
+    resumes: exactly-once delivery).  Under greedy decoding the resumed
+    tail is bit-identical to an unmigrated run (stochastic sampling
+    draws from the destination's key stream — ``sampling`` carries the
+    source's static sampling config so the destination can refuse an
+    incompatible import instead of silently changing the
+    distribution).
+
+    ``max_new_tokens`` stays the ORIGINAL total budget across any
+    number of hops; ``deadline_remaining_s`` is the wall-clock budget
+    left at export (relative, so the snapshot survives a host change).
+    ``clean`` records whether the export quiesced the source pump
+    (pump mutex held) — a forced export of a wedged engine is still
+    consistent, but exactly-once streaming then relies on a
+    deduplicating consumer such as the fleet router's stream shim."""
+    rid: int
+    prompt: np.ndarray                       # [plen] int32, the original
+    generated: List[int]                     # tokens delivered so far
+    max_new_tokens: int                      # original total budget
+    stream_offset: int                       # == len(generated)
+    tenant: str = "default"
+    adapter_id: Optional[str] = None
+    deadline_remaining_s: Optional[float] = None
+    sampling: Optional[dict] = None          # source sampling config
+    clean: bool = True                       # pump-quiesced export
 
 
 @dataclasses.dataclass(frozen=True)
@@ -169,6 +234,16 @@ class EngineStats:
     prefix_evictions_total: int = 0          # radix pages reclaimed
     cow_splits_total: int = 0                # whole-chain prompts resplit
     prefill_windows_skipped_total: int = 0   # window dispatches avoided
+    # pump heartbeat (fleet/watchdog.py): tick counters + perf_counter
+    # stamps bracketing the most recent tick.  started > completed with
+    # a stale start stamp = a wedged pump; a completed tick whose
+    # duration blew the watchdog's tick deadline = a stall — both are
+    # visible here without touching the (possibly stuck) pump thread
+    ticks_started: int = 0
+    ticks_completed: int = 0
+    last_tick_start_s: float = 0.0           # perf_counter at tick entry
+    last_tick_end_s: float = 0.0             # perf_counter at tick exit
+    last_tick_duration_s: float = 0.0
 
     @property
     def inflight(self) -> int:
@@ -250,6 +325,21 @@ class SlotScheduler:
         self.tick_steps = tick_steps
         self.eos_id = eos_id
         self.pad_id = dec.resolve_pad(eos_id, pad_id)
+        # static sampling config, stamped onto exported RequestSnapshots
+        # so an import into a differently-configured engine fails loudly
+        # instead of silently resuming under another distribution
+        self._sampling = dict(temperature=float(temperature),
+                              top_k=top_k, top_p=top_p, eos_id=eos_id)
+        # chaos identity for the stall_tick/wedge_replica fault kinds
+        # (resilience/faults.py): the fleet Router stamps the replica id
+        # here so a plan can target one engine deterministically
+        self.chaos_tag = 0
+        # pump heartbeat (read by stats()/fleet.Watchdog under _lock)
+        self._ticks_started = 0
+        self._ticks_completed = 0
+        self._tick_start_t = 0.0
+        self._tick_end_t = 0.0
+        self._last_tick_s = 0.0
         self.metrics = metrics if metrics is not None else _NullMetrics()
         self.adapters = adapters
         self.max_queue_depth = max_queue_depth
@@ -536,16 +626,24 @@ class SlotScheduler:
                           on_token=on_token, submit_time=now,
                           deadline=None if deadline_s is None
                           else now + deadline_s,
-                          tenant=tenant, adapter_id=adapter_id)
+                          tenant=tenant, adapter_id=adapter_id,
+                          context=prompt,
+                          token_cost=int(max_new_tokens))
             self._next_rid += 1
-            self._queue.append(req)
-            self._tenant_inflight[tenant] = \
-                self._tenant_inflight.get(tenant, 0) + 1
-            self._tenant_tokens[tenant] = \
-                self._tenant_tokens.get(tenant, 0) + req.max_new_tokens
+            self._enqueue_locked(req)
         self.metrics.submitted(req)
         self._report_depth()
         return req
+
+    def _enqueue_locked(self, req: Request) -> None:
+        """Enqueue + per-tenant accounting (state lock held) — shared
+        by ``submit`` and ``import_snapshot`` so admission bookkeeping
+        can never diverge between the two intake paths."""
+        self._queue.append(req)
+        self._tenant_inflight[req.tenant] = \
+            self._tenant_inflight.get(req.tenant, 0) + 1
+        self._tenant_tokens[req.tenant] = \
+            self._tenant_tokens.get(req.tenant, 0) + req.token_cost
 
     # ---------------------------------------------------------- the tick
 
@@ -574,7 +672,12 @@ class SlotScheduler:
                 active=sum(r is not None for r in self._slots),
                 num_slots=self.num_slots,
                 inflight_per_tenant=dict(self._tenant_inflight),
-                tokens_inflight_per_tenant=dict(self._tenant_tokens))
+                tokens_inflight_per_tenant=dict(self._tenant_tokens),
+                ticks_started=self._ticks_started,
+                ticks_completed=self._ticks_completed,
+                last_tick_start_s=self._tick_start_t,
+                last_tick_end_s=self._tick_end_t,
+                last_tick_duration_s=self._last_tick_s)
             skipped = self._windows_skipped
         if self.pages is not None:
             p = self.pages.stats()
@@ -607,9 +710,33 @@ class SlotScheduler:
         Thread-safe: ticks are serialized by the pump mutex (concurrent
         callers queue behind the running tick); ``submit``/``cancel``/
         ``stats`` interleave freely.  Callbacks fire on the pumping
-        thread at the end of the tick and must not re-enter ``step``."""
+        thread at the end of the tick and must not re-enter ``step``.
+
+        Each tick is bracketed by a heartbeat (started/completed
+        counters + perf_counter stamps in ``stats()``) — the signal the
+        fleet ``Watchdog`` reads to tell a wedged or stalled pump from
+        a merely idle one."""
         with self._pump_lock:
-            return self._step_locked()
+            start = time.perf_counter()
+            with self._lock:
+                self._ticks_started += 1
+                self._tick_start_t = start
+            plan = faults_lib.active()
+            if plan is not None:
+                # chaos: stall_tick sleeps here, wedge_replica blocks
+                # here — DELIBERATELY inside the pump mutex, because a
+                # real pathological tick holds it too; that held mutex
+                # is exactly what the watchdog's in-progress heartbeat
+                # check and the forced-export path exist to handle
+                plan.on_engine_tick(self.chaos_tag)  # dtlint: disable=DT303 -- see comment
+            try:
+                return self._step_locked()
+            finally:
+                end = time.perf_counter()
+                with self._lock:
+                    self._ticks_completed += 1
+                    self._tick_end_t = end
+                    self._last_tick_s = end - start
 
     def _step_locked(self) -> bool:
         did = False
@@ -713,7 +840,11 @@ class SlotScheduler:
 
     def _begin_prefill(self, req: Request) -> list:
         w = self.prefill_chunk
-        plen = req.prompt.size
+        # prefill runs over the request's CONTEXT — prompt + any tokens
+        # already generated on a source engine (import_snapshot); a
+        # fresh submit's context IS its prompt
+        ctx = req.context if req.context is not None else req.prompt
+        plen = ctx.size
         if self.adapters is not None:
             # pin the adapter BEFORE touching cache storage: acquire
             # may raise AdapterTableFull and the request must requeue
@@ -722,19 +853,20 @@ class SlotScheduler:
         if self.paged:
             # page lease: map any cached prefix chain read-only and
             # allocate private pages for the rest of the request's
-            # whole footprint (prompt + decode budget — upfront, so a
-            # mid-decode tick can never starve).  On exhaustion the
-            # adapter pin unwinds and the request requeues.
+            # whole footprint (context + remaining decode budget —
+            # upfront, so a mid-decode tick can never starve).  On
+            # exhaustion the adapter pin unwinds and the request
+            # requeues.
             try:
                 lease = self.pages.begin(
-                    req.prompt, plen + req.max_new_tokens - 1)
+                    ctx, plen + req.remaining_budget - 1)
             except pages_lib.PagePoolExhausted:
                 if req.adapter_row is not None:
                     self.adapters.release(req.adapter_id)
                     req.adapter_row = None
                 raise
             req._lease = lease
-            remaining = req.prompt[lease.skip:]
+            remaining = ctx[lease.skip:]
             n_win = -(-remaining.size // w)
             padded = np.zeros((n_win * w,), np.int32)
             padded[:remaining.size] = remaining
@@ -745,7 +877,7 @@ class SlotScheduler:
             return [req, padded.reshape(n_win, 1, w), 0, lease]
         n_win = -(-plen // w)
         padded = np.zeros((n_win * w,), np.int32)
-        padded[:plen] = req.prompt
+        padded[:plen] = ctx
         windows = padded.reshape(n_win, 1, w)
         with self._lock:
             kv = self._pf_pool.pop() if self._pf_pool else None
@@ -795,7 +927,8 @@ class SlotScheduler:
             with self._lock:
                 st[2] = i + 1
             return
-        plen = req.prompt.size
+        ctx = req.context if req.context is not None else req.prompt
+        plen = ctx.size
         last_idx = np.int32(plen - skip - 1 - (len(windows) - 1)
                             * self.prefill_chunk)
         with self._lock:
@@ -819,21 +952,21 @@ class SlotScheduler:
                              * self.prefill_chunk),
                     last_idx, self._key, self._tokens, self._finished,
                     self._remaining, np.int32(slot), np.int32(plen),
-                    np.int32(req.max_new_tokens), ad, ad_row)
+                    np.int32(req.remaining_budget), ad, ad_row)
         else:
             tok, self._cache, self._tokens, self._finished, \
                 self._remaining, self._key = self._last_admit(
                     self.params, payload, windows[-1], last_idx,
                     self._key, self._cache, self._tokens,
                     self._finished, self._remaining, np.int32(slot),
-                    np.int32(plen), np.int32(req.max_new_tokens), ad,
+                    np.int32(plen), np.int32(req.remaining_budget), ad,
                     ad_row)
         first = int(tok)          # host fetch: the TTFT barrier
         req.first_token_time = time.perf_counter()
         if self.paged:
-            # the prompt's full pages are final now — publish them so
+            # the context's full pages are final now — publish them so
             # the NEXT request with this prefix skips their windows
-            self.pages.register(payload, req.prompt)
+            self.pages.register(payload, ctx)
         with self._lock:
             if self.paged:
                 self._page_tab[slot] = payload.row
@@ -852,8 +985,8 @@ class SlotScheduler:
             self._finished = self._finished.at[slot].set(True)
             return
         self.metrics.admitted(req)
-        if req.max_new_tokens <= 1 or (self.eos_id is not None
-                                       and first == self.eos_id):
+        if req.remaining_budget <= 1 or (self.eos_id is not None
+                                         and first == self.eos_id):
             self._drop_slot(slot, req)
             # spliced but already finished in-graph: the slot stays free
             # host-side and the splice is dead weight
@@ -1007,6 +1140,212 @@ class SlotScheduler:
         self._report_depth()
         return True
 
+    # -------------------------------------------- migration (snapshots)
+
+    def find(self, rid: int) -> Optional[Request]:
+        """The in-flight ``Request`` with id ``rid``, wherever it is
+        (queued, prefilling, active); None when no such request is in
+        flight."""
+        with self._lock:
+            for req in self._queue:
+                if req.rid == rid:
+                    return req
+            for st in self._prefills:
+                if st[0].rid == rid:
+                    return st[0]
+            for req in self._slots:
+                if req is not None and req.rid == rid:
+                    return req
+        return None
+
+    def export(self, req: Request,
+               timeout_s: Optional[float] = None) -> RequestSnapshot:
+        """Export one in-flight request as a portable
+        ``RequestSnapshot`` and retire it here with status
+        ``migrated`` (live migration, docs/RESILIENCE.md).
+
+        The export serializes against the pump: with ``timeout_s=None``
+        it waits for the running tick and is fully atomic (tokens are
+        delivered entirely before the snapshot or entirely after — the
+        snapshot and the callback stream can never disagree).  With a
+        ``timeout_s`` the pump mutex is only awaited that long — a
+        WEDGED pump (fleet watchdog quarantine) is then bypassed: the
+        snapshot is still consistent (host bookkeeping is lock-
+        protected and the wedged tick's late deliveries are dropped at
+        the terminal-status check), but it is stamped ``clean=False``
+        because a delivery racing the forced capture may be
+        regenerated by the destination — exactly-once streaming then
+        needs an offset-deduplicating consumer (the fleet router's
+        stream shim).
+
+        Raises ``RuntimeError`` when the request reached a terminal
+        status first (finished/cancelled mid-export): there is nothing
+        left to migrate."""
+        if timeout_s is None:
+            clean = self._pump_lock.acquire()
+        else:
+            clean = self._pump_lock.acquire(timeout=timeout_s)
+        try:
+            return self._export(req, clean)
+        finally:
+            if clean:
+                self._pump_lock.release()
+
+    def export_all(self, timeout_s: Optional[float] = None
+                   ) -> List[RequestSnapshot]:
+        """Export EVERY in-flight request (rid order, so a replayed
+        migration re-admits deterministically), leaving the scheduler
+        empty of user work.  The drain-timeout and replica-quarantine
+        path."""
+        if timeout_s is None:
+            clean = self._pump_lock.acquire()
+        else:
+            clean = self._pump_lock.acquire(timeout=timeout_s)
+        try:
+            with self._lock:
+                reqs = ([r for r in self._queue]
+                        + [st[0] for st in self._prefills]
+                        + [r for r in self._slots if r is not None])
+            snaps = []
+            for req in sorted(reqs, key=lambda r: r.rid):
+                try:
+                    snaps.append(self._export(req, clean))
+                except RuntimeError:
+                    continue          # finished while we were exporting
+            return snaps
+        finally:
+            if clean:
+                self._pump_lock.release()
+
+    def _export(self, req: Request, clean: bool) -> RequestSnapshot:
+        """Capture + retire (caller handled the pump mutex)."""
+        if req.done.is_set():
+            raise RuntimeError(
+                f"request {req.rid} already terminal ({req.status!r}); "
+                "nothing to export")
+        ctx = req.context if req.context is not None else req.prompt
+        with self._lock:
+            windows_done = next((st[2] for st in self._prefills
+                                 if st[0] is req), None)
+            active = any(r is req for r in self._slots)
+        generated = list(req.tokens)
+        now = time.perf_counter()
+        snap = RequestSnapshot(
+            rid=req.rid, prompt=req.prompt.copy(),
+            generated=generated,
+            max_new_tokens=req.max_new_tokens,
+            stream_offset=len(generated),
+            tenant=req.tenant, adapter_id=req.adapter_id,
+            deadline_remaining_s=(None if req.deadline is None
+                                  else max(0.0, req.deadline - now)),
+            sampling=dict(self._sampling), clean=clean)
+        # lease handoff (serve/pages.py): publish the request's FINAL
+        # full pages into the radix tree before the retirement below
+        # releases them — a re-import into this engine then skips those
+        # prefill windows.  "Final" = columns the device has finished:
+        # the whole context plus all but the newest generated token for
+        # an active row (its K/V is written when it is next FED), or
+        # the completed windows of an in-flight prefill (the current
+        # window may still be mid-dispatch under a forced export).
+        lease = req._lease
+        if self.pages is not None and lease is not None \
+                and not lease.released:
+            fresh = generated[req.resumed:]
+            if active:
+                written = ctx.size + max(0, len(fresh) - 1)
+                full = (np.concatenate(
+                            [ctx, np.asarray(fresh, np.int32)])
+                        if fresh else ctx)
+            else:
+                done = windows_done or 0
+                written = lease.skip + done * self.prefill_chunk
+                full = ctx
+            self.pages.handoff(lease, full[:written])
+        if not self.cancel(req, status="migrated"):
+            raise RuntimeError(
+                f"request {req.rid} finished during export")
+        return snap
+
+    def import_snapshot(self, snap: RequestSnapshot,
+                        on_token: Optional[Callable[[List[int]], None]]
+                        = None) -> Request:
+        """Admit an exported request and resume it where it stopped.
+
+        The new request's prefill context is ``prompt + generated`` —
+        the destination rebuilds the KV cache through the SAME chunked-
+        prefill executables every fresh prompt uses (no new programs,
+        RetraceGuard budget=1 holds; a radix prefix hit makes the warm
+        handoff cheap), then the last window's logits yield the NEXT
+        token and decode continues.  ``generated`` pre-seeds the token
+        list, so callbacks fire only for new tokens (exactly-once
+        streaming at ``stream_offset``) and the terminal ``tokens`` are
+        the full sequence.  Admission control is the same as
+        ``submit``: queue depth (``QueueFullError``) and tenancy quotas
+        apply, charged at the REMAINING budget.
+
+        Raises ``ValueError`` for a snapshot this engine cannot resume
+        faithfully: exhausted budget, context too long for ``max_len``,
+        or a sampling config differing from the source's."""
+        prompt = np.asarray(snap.prompt, np.int32).reshape(-1)
+        generated = [int(t) for t in snap.generated]
+        if snap.sampling is not None and snap.sampling != self._sampling:
+            raise ValueError(
+                f"sampling config mismatch: snapshot {snap.sampling} "
+                f"vs engine {self._sampling} — resuming here would "
+                "silently change the request's distribution")
+        remaining = int(snap.max_new_tokens) - len(generated)
+        if remaining < 1:
+            raise ValueError(
+                f"snapshot {snap.rid} has no remaining budget "
+                f"({len(generated)}/{snap.max_new_tokens} generated)")
+        ctx = (np.concatenate([prompt, np.asarray(generated, np.int32)])
+               if generated else prompt)
+        clen = int(ctx.size)
+        if clen < 1:
+            raise ValueError("empty snapshot context")
+        if snap.adapter_id is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    "snapshot carries adapter_id but this engine has no "
+                    "adapter table (adapter_capacity > 0)")
+            if not self.adapters.known(snap.adapter_id):
+                raise KeyError(f"unknown adapter_id {snap.adapter_id!r}; "
+                               "load_adapter() it first")
+        padded = -(-clen // self.prefill_chunk) * self.prefill_chunk
+        if clen + remaining > self.max_len or padded > self.max_len:
+            raise ValueError(
+                f"snapshot context ({clen}, chunk-padded {padded}) + "
+                f"remaining budget ({remaining}) exceeds max_len "
+                f"{self.max_len}")
+        now = time.perf_counter()
+        tenant = str(snap.tenant)
+        with self._lock:
+            if self.max_queue_depth is not None \
+                    and len(self._queue) >= self.max_queue_depth:
+                raise QueueFullError(
+                    f"queue at max_queue_depth={self.max_queue_depth}; "
+                    "retry after in-flight requests retire")
+            if self.tenancy is not None:
+                self.tenancy.check_admission(
+                    tenant, remaining,
+                    inflight=self._tenant_inflight.get(tenant, 0),
+                    tokens_inflight=self._tenant_tokens.get(tenant, 0))
+            req = Request(rid=self._next_rid, prompt=prompt,
+                          max_new_tokens=int(snap.max_new_tokens),
+                          on_token=on_token, submit_time=now,
+                          deadline=(None
+                                    if snap.deadline_remaining_s is None
+                                    else now + snap.deadline_remaining_s),
+                          tenant=tenant, adapter_id=snap.adapter_id,
+                          context=ctx, resumed=len(generated),
+                          token_cost=remaining)
+            req.tokens = list(generated)
+            self._next_rid += 1
+            self._enqueue_locked(req)
+        self.metrics.submitted(req)
+        self._report_depth()
+        return req
+
     # ------------------------------------------------------ bookkeeping
 
     def _deliver(self, req: Request, toks: List[int]) -> None:
@@ -1040,7 +1379,7 @@ class SlotScheduler:
                 self._tenant_inflight[t] = n
             else:
                 self._tenant_inflight.pop(t, None)
-            k = self._tenant_tokens.get(t, 0) - req.max_new_tokens
+            k = self._tenant_tokens.get(t, 0) - req.token_cost
             if k > 0:
                 self._tenant_tokens[t] = k
             else:

@@ -1156,14 +1156,16 @@ def test_walk_covers_resilience_package():
 
 
 def test_walk_covers_fleet_package():
-    """Same guard for the fleet tier (fleet/): the router and tenancy
-    policy drive jitted engines (placement, failover, adapter splices)
-    and must stay inside the DT101-107 + DT2xx lint walk — as must the
-    serve-side adapter table they feed."""
+    """Same guard for the fleet tier (fleet/): the router, watchdog,
+    and tenancy policy drive jitted engines (placement, migration,
+    quarantine, adapter splices) and must stay inside the DT101-107 +
+    DT2xx + DT3xx lint walk — as must the serve-side adapter table
+    they feed."""
     files = analysis.collect_files(["distributed_tensorflow_tpu"])
     rel = {os.path.relpath(f, REPO).replace(os.sep, "/") for f in files}
     for mod in ("fleet/__init__.py", "fleet/router.py",
-                "fleet/tenancy.py", "serve/adapters.py"):
+                "fleet/tenancy.py", "fleet/watchdog.py",
+                "serve/adapters.py"):
         assert f"distributed_tensorflow_tpu/{mod}" in rel
 
 

@@ -323,6 +323,15 @@ class TestRecovery:
         assert result["restarts"] >= 1
         assert result["faults_injected"] == 1
         assert result["final_step"] == 24
+        # watchdog smoke: the injected stall (1.0 s) was detected at the
+        # first post-stall check — detection latency sits just above the
+        # stall itself, never an unbounded wait — and the quarantined
+        # replica's requests migrated to the survivor
+        assert result["watchdog_quarantined"] == 1
+        assert result["watchdog_detect_ms"] is not None
+        stall_ms = result["watchdog_stall_s"] * 1e3
+        assert stall_ms < result["watchdog_detect_ms"] < stall_ms + 5e3
+        assert result["watchdog_migrations"] >= 1
         json.dumps(result)                      # one-line-JSON safe
 
 
@@ -593,3 +602,10 @@ class TestGptLong:
         # per-tenant blocks FIFO would serialize (FIFO scores 0.0; the
         # CPU smoke converges well above half)
         assert r["fairness_ratio"] > 0.5
+        # migration leg: drain-by-migration frees the replica without
+        # waiting out its decodes, and the kill leg salvages decode
+        # work through snapshots (ratio in (0, 1]: the migrated
+        # requests were mid-decode, not finished)
+        assert 0 < r["drain_migrate_ms"] < r["drain_wait_ms"]
+        assert 0 < r["tokens_preserved_ratio"] <= 1.0
+        assert r["migrations"] >= 1

@@ -1,0 +1,548 @@
+"""Live request migration, lossless drain, and the fleet watchdog.
+
+The contracts pinned here (docs/RESILIENCE.md §migration,
+docs/SERVING.md §exactly-once):
+
+  * ``Engine.export_request`` / ``import_request``: a request migrated
+    mid-decode resumes on the destination with terminal tokens
+    BIT-IDENTICAL to an unmigrated greedy run, and the concatenated
+    callback stream across both engines has zero duplicated and zero
+    lost tokens (exactly-once delivery at the snapshot's
+    ``stream_offset``);
+  * migration admits through the SAME three hot executables — importing
+    never recompiles (retrace_guard budget=1);
+  * ``Engine.drain(timeout_s=)`` is lossless: a timed-out drain exports
+    the stragglers instead of stranding them pending forever;
+  * the export's lease handoff publishes final pages into the radix
+    tree, so a re-import skips the handed-off prefill windows;
+  * chaos acceptance: ``kill_replica`` and ``stall_tick`` mid-decode
+    under a shared-prefix trace -> every non-expired request completes
+    on a survivor, bit-identical, zero duplicated stream tokens;
+  * the ``Watchdog`` tick-deadline policy catches both failure shapes —
+    a stalled tick (post-hoc, single-threaded) and a WEDGED pump (in
+    progress, seen from another thread) — and quarantine migrates.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_tensorflow_tpu import fleet, serve
+from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.resilience import faults
+
+
+def _model_params(seed=0, **kw):
+    model = gpt_tiny(dropout_rate=0.0, **kw)
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+def _prompt(plen, seed=1, vocab=512):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (plen,), 0, vocab), np.int32)
+
+
+def _generate_tokens(model, params, prompt, new, max_len, **kw):
+    out = model.generate(params, jnp.asarray(prompt[None]),
+                         max_new_tokens=new, max_len=max_len, **kw)
+    return np.asarray(out)[0, prompt.size:].tolist()
+
+
+def _engine(model, params, reg=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("tick_steps", 2)
+    return serve.Engine(model, params,
+                        registry=reg or metrics_lib.Registry(), **kw)
+
+
+def _warm(engines, steps=8):
+    """Compile every executable on every engine BEFORE arming tick-
+    indexed faults or a watchdog deadline (a first-compile tick is
+    legitimately slow, and the fault counters must start at a known
+    index)."""
+    hs = [eng.submit(_prompt(6, seed=50 + j), 3)
+          for j, eng in enumerate(engines)]
+    for _ in range(steps):
+        for eng in engines:
+            eng.step()
+    assert all(h.done for h in hs)
+
+
+def _streamer(streams, i):
+    streams[i] = []
+    return lambda toks: streams[i].extend(toks)
+
+
+# ---------------------------------------------------------------------------
+# engine-level export / import
+
+
+def test_export_mid_decode_import_bit_identical_exactly_once():
+    """THE migration exactness contract: export mid-decode, import on a
+    second engine — terminal tokens equal the unmigrated greedy run
+    token-for-token, and the stream concatenated across both engines
+    has no duplicated and no missing tokens."""
+    model, params = _model_params()
+    src, dst_reg = _engine(model, params), metrics_lib.Registry()
+    dst = _engine(model, params, reg=dst_reg)
+    p = _prompt(5, seed=1)
+    want = _generate_tokens(model, params, p, 10, 64)
+    stream = []
+    h = src.submit(p, 10, on_token=stream.extend)
+    while len(h.tokens) < 4:
+        src.step()
+    snap = src.export_request(h)
+    assert h.status == "migrated" and h.done
+    assert not src.busy                      # nothing left behind
+    assert snap.clean and snap.stream_offset == len(snap.generated)
+    assert snap.generated == want[:len(snap.generated)]
+    h2 = dst.import_request(snap, on_token=stream.extend)
+    dst.drain()
+    assert h2.status == "ok"
+    assert h2.tokens == want                 # full sequence, pre-seeded
+    assert stream == want                    # exactly-once across hops
+    # the resume offset landed on the destination's histogram
+    hist = dst_reg.get("dttpu_serve_stream_resume_offset")
+    assert hist.count == 1
+
+
+def test_export_before_first_token_restarts_cleanly():
+    """Queued and mid-prefill requests export with no generated tokens
+    (prefill progress is re-derived on the destination) and still
+    finish bit-identical."""
+    model, params = _model_params()
+    src = _engine(model, params, num_slots=1)
+    dst = _engine(model, params)
+    p_queued, p_prefill = _prompt(4, seed=2), _prompt(10, seed=3)
+    wants = [_generate_tokens(model, params, p, 6, 64)
+             for p in (p_queued, p_prefill)]
+    h_pf = src.submit(p_prefill, 6)          # 3 windows: stays prefilling
+    h_q = src.submit(p_queued, 6)            # one slot: stays queued
+    src.step()                               # h_pf mid-prefill
+    snaps = src.export_inflight()
+    assert not src.busy and len(snaps) == 2
+    assert all(s.generated == [] for s in snaps)
+    assert h_pf.status == "migrated" and h_q.status == "migrated"
+    hs = [dst.import_request(s) for s in
+          sorted(snaps, key=lambda s: s.rid)]
+    dst.drain()
+    assert hs[0].tokens == wants[1]          # rid order: prefill first
+    assert hs[1].tokens == wants[0]
+
+
+def test_export_terminal_and_unknown_rid_raise():
+    model, params = _model_params()
+    eng = _engine(model, params)
+    h = eng.submit(_prompt(4, seed=1), 4)
+    eng.drain()
+    with pytest.raises(RuntimeError, match="already terminal"):
+        eng.export_request(h)
+    with pytest.raises(KeyError, match="no in-flight request"):
+        eng.export_request(12345)
+
+
+def test_import_rejects_incompatible_or_spent_snapshots():
+    """A snapshot must fail loudly where resuming would lie: sampling
+    config drift, exhausted budget, context past max_len."""
+    model, params = _model_params()
+    src = _engine(model, params)
+    h = src.submit(_prompt(4, seed=1), 6)
+    while not h.tokens:
+        src.step()
+    snap = src.export_request(h)
+    sampled = _engine(model, params, temperature=0.7)
+    with pytest.raises(ValueError, match="sampling config mismatch"):
+        sampled.import_request(snap)
+    spent = serve.RequestSnapshot(
+        rid=0, prompt=_prompt(4, seed=1), generated=[1, 2, 3],
+        max_new_tokens=3, stream_offset=3)
+    with pytest.raises(ValueError, match="no remaining budget"):
+        _engine(model, params).import_request(spent)
+    tiny = _engine(model, params, max_len=16)
+    long_snap = serve.RequestSnapshot(
+        rid=0, prompt=_prompt(10, seed=2), generated=[1] * 4,
+        max_new_tokens=8, stream_offset=4)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        tiny.import_request(long_snap)
+
+
+def test_import_admission_respects_queue_depth_and_quota():
+    """Imports go through the same admission door as submits: a full
+    queue rejects with QueueFullError (the router's probe signal), and
+    tenancy charges only the REMAINING budget."""
+    model, params = _model_params()
+    src = _engine(model, params)
+    h = src.submit(_prompt(4, seed=1), 8, tenant="a")
+    while len(h.tokens) < 3:
+        src.step()
+    snap = src.export_request(h)
+    dst = _engine(model, params, max_queue_depth=1)
+    dst.submit(_prompt(4, seed=9), 4)        # queue now full
+    with pytest.raises(serve.QueueFullError):
+        dst.import_request(snap)
+    policy = fleet.TenantPolicy(
+        {"a": fleet.TenantQuota(max_tokens_inflight=6)})
+    quota_dst = _engine(model, params, tenancy=policy)
+    # remaining budget is 8 - 3 = 5 <= 6: admitted even though the
+    # ORIGINAL budget (8) would have blown the quota
+    h2 = quota_dst.import_request(snap)
+    quota_dst.drain()
+    assert h2.status == "ok"
+
+
+def test_export_handoff_seeds_radix_for_reimport():
+    """The export's lease handoff publishes the request's final pages —
+    including a chunk completed by GENERATED tokens, which admission
+    registration alone could never have cached: a re-import radix-hits
+    the handed-off chain and skips those prefill windows (the
+    warm-handoff half of the migration cost story)."""
+    model, params = _model_params()
+    eng = _engine(model, params, max_len=64, prefill_chunk=4)
+    page = eng.scheduler.page_size           # 16 for max_len=64
+    # prompt stops 2 short of the second chunk boundary: admission can
+    # register only 1 chunk; the 2nd chunk completes mid-DECODE
+    p = _prompt(2 * page - 2, seed=4)
+    want = _generate_tokens(model, params, p, 10, 64)
+    h = eng.submit(p, 10)
+    while len(h.tokens) < 5:                 # written = plen + 4 >= 2*page
+        eng.step()
+    before = eng.stats()
+    snap = eng.export_request(h)
+    h2 = eng.import_request(snap)
+    eng.drain()
+    after = eng.stats()
+    assert h2.tokens == want
+    assert after.prefix_hits_total > before.prefix_hits_total
+    # the re-import reused BOTH chunks (2*page tokens) — the second one
+    # exists only because the export handed it off
+    assert (after.prefix_tokens_reused_total
+            - before.prefix_tokens_reused_total) >= 2 * page
+    assert after.prefill_windows_skipped_total \
+        > before.prefill_windows_skipped_total
+
+
+def test_drain_timeout_exports_then_migrates_elsewhere():
+    """drain(timeout_s=) is lossless: stragglers export, the engine is
+    idle, and the snapshots finish bit-identical on another engine."""
+    model, params = _model_params()
+    a, b = _engine(model, params, num_slots=1), _engine(model, params)
+    prompts = [_prompt(4, seed=i) for i in range(3)]
+    wants = [_generate_tokens(model, params, p, 12, 64) for p in prompts]
+    hs = [a.submit(p, 12) for p in prompts]
+    for _ in range(3):
+        a.step()
+    res = a.drain(timeout_s=0.0)
+    assert not res and not a.busy
+    assert len(res.exported) == 3
+    assert all(h.status == "migrated" for h in hs)
+    out = [b.import_request(s) for s in res.exported]
+    assert b.drain()
+    for h2, want in zip(out, wants):
+        assert h2.status == "ok" and h2.tokens == want
+
+
+@pytest.mark.retrace_guard(budget=1, enforce_donation=True)
+def test_migration_admits_within_retrace_budget():
+    """Import goes through the SAME three hot executables: exporting
+    and re-importing (same engine — radix hit and cold paths both)
+    never retraces anything (budget=1: a second trace of any
+    executable fails the test)."""
+    model, params = _model_params()
+    eng = _engine(model, params)
+    p = _prompt(9, seed=5)
+    want = _generate_tokens(model, params, p, 10, 64)
+    h = eng.submit(p, 10)
+    h_other = eng.submit(_prompt(5, seed=6), 6)    # shares the ticks
+    while len(h.tokens) < 3:
+        eng.step()
+    snap = eng.export_request(h)
+    h2 = eng.import_request(snap)
+    while len(h2.tokens) < 6:
+        eng.step()
+    snap2 = eng.export_request(h2)               # migrate TWICE
+    h3 = eng.import_request(snap2)
+    eng.drain()
+    assert h3.tokens == want
+    assert h_other.status == "ok"
+
+
+# ---------------------------------------------------------------------------
+# fleet-level migration
+
+
+def test_drain_replica_migrates_then_resume_replica():
+    """drain_replica moves in-flight work to the survivor with progress
+    intact (no wait-out), the drained replica ends idle, and
+    resume_replica puts it back in rotation."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    router = fleet.Router(
+        [_engine(model, params, reg=reg) for _ in range(2)],
+        registry=reg)
+    prompts = [_prompt(4 + i % 3, seed=i) for i in range(4)]
+    wants = [_generate_tokens(model, params, p, 10, 64) for p in prompts]
+    streams = {}
+    hs = [router.submit(p, 10, on_token=_streamer(streams, i))
+          for i, p in enumerate(prompts)]
+    router.step()
+    router.step()                               # decode in flight
+    assert router.drain_replica(0, timeout_s=60) is True
+    assert not router.replica(0).busy           # emptied by migration
+    router.drain()
+    for i, (h, want) in enumerate(zip(hs, wants)):
+        assert h.status == "ok" and h.tokens == want
+        assert streams[i] == want, f"stream {i} dup/loss"
+    assert reg.get("dttpu_migrations_total").value >= 1
+    # preserved decode work is visible on the handles
+    assert sum(h.tokens_preserved for h in hs) >= 0
+    router.resume_replica(0)
+    h2 = router.submit(_prompt(4, seed=9), 4)
+    router.drain()
+    assert h2.status == "ok"
+    with pytest.raises(KeyError):
+        router.resume_replica(99)
+
+
+def test_remove_replica_migrates_progress():
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    router = fleet.Router(
+        [_engine(model, params, reg=reg) for _ in range(2)],
+        registry=reg)
+    prompts = [_prompt(4, seed=i) for i in range(4)]
+    hs = [router.submit(p, 10) for p in prompts]
+    for _ in range(4):
+        router.step()                           # tokens on both replicas
+    removed = router.remove_replica(1)
+    router.drain()
+    for p, h in zip(prompts, hs):
+        assert h.status == "ok"
+        assert h.tokens == _generate_tokens(model, params, p, 10, 64)
+    moved = [h for h in hs if h.migrations]
+    assert moved and sum(h.tokens_preserved for h in moved) > 0
+    router.add_replica(removed)                 # rolling restart
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance
+
+
+@pytest.mark.chaos
+def test_kill_and_stall_mid_decode_shared_prefix_exactly_once():
+    """THE migration chaos acceptance: a shared-prefix trace loses one
+    replica to ``kill_replica`` mid-decode and has the other STALL
+    (watchdog quarantine) — every non-expired request still completes
+    on a survivor with terminal tokens bit-identical to solo
+    ``generate`` and ZERO duplicated stream tokens."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    engines = [_engine(model, params, reg=reg) for _ in range(3)]
+    router = fleet.Router(engines, registry=reg)
+    _warm(engines)
+    wd = fleet.Watchdog(router, tick_deadline_s=0.25,
+                        export_timeout_s=0.1, registry=reg)
+    page = engines[0].scheduler.page_size
+    sys_prefix = _prompt(page, seed=99)          # one shared radix chunk
+    prompts = [np.concatenate([sys_prefix, _prompt(3 + i % 3, seed=i)])
+               for i in range(8)]
+    wants = [_generate_tokens(model, params, p, 8, 64) for p in prompts]
+    plan = faults.FaultPlan(
+        [{"kind": "kill_replica", "at": 5, "replica": 1},
+         {"kind": "stall_tick", "at": 6, "replica": 2, "seconds": 0.6}],
+        registry=metrics_lib.Registry())
+    streams = {}
+    with faults.activated(plan):
+        hs = [router.submit(p, 8, deadline_s=120.0,
+                            on_token=_streamer(streams, i))
+              for i, p in enumerate(prompts)]
+        quarantined = []
+        deadline = time.perf_counter() + 120
+        while router.busy:
+            assert time.perf_counter() < deadline, "chaos run hung"
+            router.step()
+            quarantined.extend(wd.check())
+    kinds = {e["kind"] for e in plan.log}
+    assert kinds == {"kill_replica", "stall_tick"}, plan.log
+    assert [rid for rid, _ in quarantined] == [2]
+    assert router.replica_ids == (0,)
+    assert 2 in router.quarantined
+    for i, (h, want) in enumerate(zip(hs, wants)):
+        assert h.status == "ok", (i, h.status, h.error)
+        assert h.tokens == want, f"request {i} terminal tokens diverged"
+        assert streams[i] == want, f"request {i} stream dup/loss"
+    assert reg.get("dttpu_migrations_total").value >= 1
+    assert reg.get("dttpu_watchdog_unhealthy_total").value == 1
+
+
+@pytest.mark.chaos
+def test_wedge_replica_watchdog_forced_export_migrates():
+    """A WEDGED pump (blocked mid-tick, mutex held) is invisible to
+    everything but the in-progress heartbeat: the watchdog detects it
+    from another thread, the quarantine's bounded-wait export goes
+    around the held mutex, and the requests finish on the survivor.
+    The released wedge's late tick delivers nothing (terminal-status
+    check drops it)."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    engines = [_engine(model, params, reg=reg) for _ in range(2)]
+    router = fleet.Router(engines, registry=reg)
+    _warm(engines)
+    wd = fleet.Watchdog(router, tick_deadline_s=0.2,
+                        export_timeout_s=0.1, registry=reg)
+    prompts = [_prompt(5, seed=90 + i) for i in range(4)]
+    wants = [_generate_tokens(model, params, p, 8, 64) for p in prompts]
+    plan = faults.FaultPlan(
+        [{"kind": "wedge_replica", "at": 3, "replica": 0,
+          "seconds": 30.0}],
+        registry=metrics_lib.Registry())
+    stop = threading.Event()
+
+    def pump_fleet():
+        while not stop.is_set() and router.busy:
+            router.step()
+
+    pump = threading.Thread(target=pump_fleet,
+                            name="dttpu-migration-pump", daemon=True)
+    try:
+        with faults.activated(plan):
+            streams = {}
+            hs = [router.submit(p, 8, on_token=_streamer(streams, i))
+                  for i, p in enumerate(prompts)]
+            pump.start()
+            hits = []
+            deadline = time.perf_counter() + 60
+            while not hits:
+                assert time.perf_counter() < deadline, "never detected"
+                time.sleep(0.02)
+                hits.extend(wd.check())
+            assert hits[0][0] == 0 and "wedged" in hits[0][1]
+            # the survivor finishes the migrated work while the wedged
+            # pump thread is still parked inside replica 0's tick
+            deadline = time.perf_counter() + 120
+            while any(not h.done for h in hs):
+                assert time.perf_counter() < deadline, "migration hung"
+                router.step()
+    finally:
+        plan.release_wedges()
+        stop.set()
+        pump.join(timeout=30)
+    assert not pump.is_alive()
+    for i, (h, want) in enumerate(zip(hs, wants)):
+        assert h.status == "ok", (i, h.status, h.error)
+        assert h.tokens == want
+        assert streams[i] == want, f"stream {i} dup/loss"
+    assert 0 in router.quarantined
+    assert "wedged" in router.quarantined[0][1]
+
+
+@pytest.mark.chaos
+def test_stall_and_wedge_faults_fire_at_most_times_and_log():
+    """The new fault kinds obey the standard plan contract: seeded,
+    index-targeted, at-most-``times`` fires, each injection logged."""
+    model, params = _model_params()
+    eng = _engine(model, params)
+    _warm([eng])                # compile: ticks timed below must be hot
+    plan = faults.FaultPlan(
+        [{"kind": "stall_tick", "at": 1, "seconds": 0.15},
+         {"kind": "wedge_replica", "at": 3, "seconds": 0.15}],
+        registry=metrics_lib.Registry())
+    with faults.activated(plan):
+        h = eng.submit(_prompt(4, seed=1), 8)
+        durations = []
+        while eng.busy:
+            t0 = time.perf_counter()
+            eng.step()
+            durations.append(time.perf_counter() - t0)
+    assert h.status == "ok"
+    assert plan.log == [
+        {"kind": "stall_tick", "at": 1, "replica": 0, "tick": 1,
+         "seconds": 0.15},
+        {"kind": "wedge_replica", "at": 3, "replica": 0, "tick": 3},
+    ]
+    # exactly the targeted ticks ran long (the unreleased wedge
+    # self-freed at its seconds cap), and only once each
+    slow = [i for i, d in enumerate(durations) if d >= 0.1]
+    assert slow == [1, 3], durations
+
+
+# ---------------------------------------------------------------------------
+# watchdog policy unit + concurrency
+
+
+def test_watchdog_verdict_policy_unit():
+    """The tick-deadline policy on synthetic heartbeats: healthy, idle,
+    wedged (in progress too long), stalled (completed too slow)."""
+    model, params = _model_params()
+    router = fleet.Router(registry=metrics_lib.Registry())
+    wd = fleet.Watchdog(router, tick_deadline_s=1.0,
+                        registry=metrics_lib.Registry())
+
+    def stats(**kw):
+        return serve.EngineStats(queued=0, prefilling=0, active=1,
+                                 num_slots=2, inflight_per_tenant={},
+                                 tokens_inflight_per_tenant={}, **kw)
+
+    now = 100.0
+    assert wd.verdict(stats(), now) is None                  # never ticked
+    healthy = stats(ticks_started=5, ticks_completed=5,
+                    last_tick_start_s=99.0, last_tick_end_s=99.1,
+                    last_tick_duration_s=0.1)
+    assert wd.verdict(healthy, now) is None
+    wedged = stats(ticks_started=6, ticks_completed=5,
+                   last_tick_start_s=98.0)
+    assert "wedged" in wd.verdict(wedged, now)
+    in_progress_fresh = stats(ticks_started=6, ticks_completed=5,
+                              last_tick_start_s=99.9)
+    assert wd.verdict(in_progress_fresh, now) is None
+    stalled = stats(ticks_started=5, ticks_completed=5,
+                    last_tick_duration_s=2.5)
+    assert "stalled" in wd.verdict(stalled, now)
+    with pytest.raises(ValueError, match="tick_deadline_s"):
+        fleet.Watchdog(router, tick_deadline_s=0.0)
+
+
+@pytest.mark.race_harness(
+    seed=11, scope=("distributed_tensorflow_tpu/serve/",
+                    "distributed_tensorflow_tpu/fleet/"))
+def test_concurrent_export_vs_pump_tick(request):
+    """Export racing a live pump under seeded preemption: the export
+    serializes against the tick (pump mutex), so however the schedule
+    interleaves, the snapshot and the stream agree — the resumed run
+    is bit-identical with zero duplicated/lost stream tokens."""
+    model, params = _model_params()
+    src, dst = _engine(model, params), _engine(model, params)
+    p = _prompt(5, seed=7)
+    want = _generate_tokens(model, params, p, 12, 64)
+    stream = []
+    h = src.submit(p, 12, on_token=stream.extend)
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set() and src.busy:
+            src.step()
+
+    t = threading.Thread(target=pump, name="dttpu-export-pump",
+                         daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 120
+        while not h.tokens:
+            assert time.time() < deadline
+            time.sleep(0.001)
+        snap = src.export_request(h)         # races the running tick
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    assert not t.is_alive()
+    assert h.status == "migrated"
+    h2 = dst.import_request(snap, on_token=stream.extend)
+    dst.drain()
+    assert h2.tokens == want
+    assert stream == want, "stream dup/loss across the export race"

@@ -488,16 +488,25 @@ def test_generate_batch_failed_submit_cancels_earlier_handles():
                     for p in prompts[:2]]
 
 
-def test_drain_timeout_returns_false_then_resumable():
+def test_drain_timeout_exports_stragglers_lossless():
+    """The old ``drain(timeout_s=) -> False`` left requests stranded in
+    limbo; now a timed-out drain EXPORTS the stragglers (DrainResult is
+    falsy, carries their snapshots, the engine ends idle) and importing
+    a snapshot resumes bit-identically to an unmigrated run."""
     model, params = _model_params()
     eng = serve.Engine(model, params, num_slots=1, max_len=64,
                        prefill_chunk=4, tick_steps=1,
                        registry=metrics_lib.Registry())
+    want = _generate_tokens(model, params, _prompt(4, seed=1), 40, 64)
     h = eng.submit(_prompt(4, seed=1), 40)
-    assert eng.drain(timeout_s=0.0) is False    # budget hit immediately
-    assert not h.done
-    assert eng.drain() is True                  # resumable afterwards
-    assert h.status == "ok" and len(h.tokens) == 40
+    res = eng.drain(timeout_s=0.0)              # budget hit immediately
+    assert not res                              # falsy: not completed
+    assert len(res.exported) == 1
+    assert h.status == "migrated" and h.done
+    assert not eng.busy                         # nothing left in limbo
+    h2 = eng.import_request(res.exported[0])    # resume in place
+    assert eng.drain()                          # truthy: fully drained
+    assert h2.status == "ok" and h2.tokens == want
 
 
 def test_cancel_frees_slot_and_marks_status():
