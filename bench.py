@@ -238,6 +238,74 @@ def _attach_mfu(result: dict, rate_per_chip: float, flops_per_example,
     return result
 
 
+# HBM bandwidth per chip by device_kind substring (public TPU specs),
+# bytes/s — the roofline's second axis next to _PEAK_BF16.
+_PEAK_HBM_BW = [("v6e", 1640e9), ("v6 lite", 1640e9), ("v5p", 2765e9),
+                ("v5e", 819e9), ("v5 lite", 819e9), ("v4", 1228e9),
+                ("v3", 900e9), ("v2", 700e9)]
+
+
+def _peak_hbm_bw():
+    """Per-chip HBM bandwidth in bytes/s, or None when unknown.
+    ``DTTPU_PEAK_BW`` overrides for parts not in the table (and for the
+    CPU smoke, where tests pin a fake roofline)."""
+    env = os.environ.get("DTTPU_PEAK_BW")
+    if env:
+        return float(env)
+    import jax
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    if dev.platform == "cpu":
+        return None
+    for key, val in _PEAK_HBM_BW:
+        if key in kind:
+            return val
+    return None
+
+
+def _attach_analytical(result: dict, step_fn, abstract_args,
+                       tokens_per_step=None) -> dict:
+    """Add the dtlint graph-tier cost model's static numbers next to the
+    measured ones, making every perf claim cross-checkable against a
+    roofline that was computed from the SAME traced program the lint
+    gate checks (docs/ANALYSIS.md §graph tier):
+
+    * ``analytical_flops`` / ``analytical_bytes``: FLOPs and bytes-moved
+      of ONE compiled step per the cost model — scan bodies count times
+      their trip count, so unlike XLA's ``cost_analysis`` this figure
+      does not undercount the layer stack or the K-step dispatch;
+    * ``analytical_flops_per_token`` when ``tokens_per_step`` is given;
+    * ``analytical_mfu``: the roofline CEILING as an MFU fraction —
+      ``min(1, peak_bw * intensity / peak_flops)`` — i.e. the best MFU
+      this program shape can reach on this part.  A measured ``mfu``
+      above it means the accounting (not the hardware) is wrong; far
+      below it means the implementation leaves roofline on the table.
+      Needs a known peak (``DTTPU_PEAK_FLOPS``/``DTTPU_PEAK_BW`` pin a
+      fake roofline on the CPU smoke; bw unknown -> compute-bound
+      ceiling 1.0).
+
+    Tracing is abstract (``jax.eval_shape``-style args) and never
+    compiles; any failure logs and leaves the measured row intact.
+    """
+    try:
+        from distributed_tensorflow_tpu.analysis import graph as graph_lib
+        cost = graph_lib.entry_cost(step_fn, *abstract_args)
+    except Exception as e:  # pragma: no cover - shape-spec drift
+        log(f"analytical cost model unavailable ({e})")
+        return result
+    result["analytical_flops"] = round(float(cost.flops), 1)
+    result["analytical_bytes"] = round(float(cost.bytes), 1)
+    if tokens_per_step:
+        result["analytical_flops_per_token"] = round(
+            float(cost.flops) / tokens_per_step, 1)
+    peak = _peak_flops_per_chip()
+    if peak:
+        bw = _peak_hbm_bw()
+        ceiling = (min(1.0, bw * cost.intensity / peak) if bw else 1.0)
+        result["analytical_mfu"] = round(ceiling, 4)
+    return result
+
+
 def _transformer_flops_per_token(params, num_layers: int, hidden: int,
                                  seq: int) -> float:
     """Analytic training FLOPs/token for a dense transformer: 6N for the
@@ -870,9 +938,17 @@ def bench_gpt(seq=None, experts=None):
         n_exp = sum(int(v.size) for p, v in tree_flatten_with_path(params)[0]
                     if any("expert" in str(k).lower() for k in p))
         analytic -= 6.0 * n_exp * max(0.0, 1.0 - config.moe_top_k / experts)
-    return _attach_mfu(
+    result = _attach_mfu(
         result, tokens_s, _per_example_flops(f_total, batch * seq, mesh),
         analytic=analytic, scanned=True)
+    # graph-tier static cross-check: trace the SAME step abstractly and
+    # attach the cost model's flops/bytes + the roofline MFU ceiling
+    state_a = jax.eval_shape(
+        lambda p: train.TrainState.create(p, optimizer.init(p)), params)
+    batch_a = {"input_ids": jax.ShapeDtypeStruct((batch, seq + 1),
+                                                 jnp.int32)}
+    return _attach_analytical(result, step, (state_a, batch_a),
+                              tokens_per_step=batch * seq)
 
 
 

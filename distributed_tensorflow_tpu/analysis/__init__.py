@@ -1,21 +1,30 @@
 """dtlint — JAX-aware static analysis for distributed-training hazards.
 
-Catches, *before anything is traced or compiled*, the bug classes that
-otherwise surface as silent recompiles or wrong numerics on the TPU.
-Two tiers share one file walk:
+Catches, *before anything is compiled or run on an accelerator*, the
+bug classes that otherwise surface as silent recompiles, HBM blowups,
+or wrong numerics on the TPU.  Four tiers share one file walk:
 
-* per-module (lexical): host syncs inside jit (DT101), PRNG key reuse
-  (DT102), collectives naming unbound mesh axes (DT103), non-hashable
-  static args (DT104), jit wrappers built in loop bodies (DT105), reads
-  of donated buffers (DT106), and wall-clock timing of unsynced jitted
-  calls — the async-dispatch measurement lie (DT107);
-* interprocedural (call-graph + dataflow summaries, ``callgraph.py`` /
-  ``dataflow.py``): keys passed unsplit to multiple consumers across
-  function boundaries (DT201), mesh-axis names flowing through
-  cross-module constants and ``make_mesh`` dicts (DT202), collective
-  sequences diverging across ``lax.cond`` branches inside shard_map
-  (DT203), and the donation contract propagated through the call graph
-  (DT204).
+* per-module (lexical, DT101-DT107): host syncs inside jit, PRNG key
+  reuse, unbound mesh axes, non-hashable static args, jit wrappers
+  built in loop bodies, reads of donated buffers, and wall-clock timing
+  of unsynced jitted calls — the async-dispatch measurement lie;
+* interprocedural (call-graph + dataflow summaries, DT201-DT204,
+  ``callgraph.py`` / ``dataflow.py``): keys passed unsplit to multiple
+  consumers across function boundaries, mesh-axis names flowing through
+  cross-module constants, collective sequences diverging across
+  ``lax.cond`` branches inside shard_map, and the donation contract
+  propagated through the call graph;
+* host-concurrency (lock-set inference, DT301-DT306,
+  ``concurrency.py``): data races, lock-order cycles, callbacks and
+  blocking calls under locks, thread hygiene;
+* graph (jaxpr-level, DT400-DT405, ``graph.py`` / ``graph_rules.py``):
+  registered entry points abstractly traced on CPU — constants baked
+  into the program, f32 upcasts of low-precision operands, donations
+  XLA rejects, liveness peaks over declared HBM budgets, and the
+  executable census (``expect_census``) pinning invariants like "the
+  serve tier has exactly 3 hot executables".  The same traversal prices
+  every entry (FLOPs/bytes — ``entry_cost``), which bench.py reports
+  as ``analytical_*`` fields next to measured numbers.
 
 Run it as a module::
 
@@ -32,14 +41,18 @@ unexpected recompile) and enforces donated-buffer invalidation at
 execution time — see docs/ANALYSIS.md.
 
 Suppress a single site with ``# dtlint: disable=DT101`` on the flagged
-line; grandfather existing debt with ``--write-baseline`` /
-``--baseline`` (see docs/ANALYSIS.md).  The analysis modules themselves
-are pure stdlib — analyzed code is parsed, never imported or traced
-(``python -m distributed_tensorflow_tpu.analysis`` does execute the
-parent package ``__init__``; set ``JAX_PLATFORMS=cpu`` where no
-accelerator should be touched).
+line (graph-tier findings anchor at the registration line); grandfather
+existing debt with ``--write-baseline`` / ``--baseline``, and drop
+fixed entries with ``--prune`` (see docs/ANALYSIS.md).  The AST tiers
+are pure stdlib — analyzed code is parsed, never imported; the graph
+tier imports the package and abstractly traces registered entries on
+CPU (no devices, no compiles — the CLI defaults ``JAX_PLATFORMS=cpu``).
+Results are content-hash cached under ``.dtlint-cache/`` (``--no-cache``
+runs cold).
 """
-from .baseline import load_baseline, partition, write_baseline
+from .baseline import (load_baseline, partition, prune_baseline,
+                       write_baseline)
+from .cache import ResultCache
 from .callgraph import FunctionInfo, Project, module_name_for
 from .cli import (analyze_file, analyze_paths, collect_files,
                   full_rule_catalog, main)
@@ -47,6 +60,12 @@ from .concurrency import (CONCURRENCY_RULES, ConcurrencyModel,
                           concurrency_rule_catalog,
                           run_concurrency_rules)
 from .dataflow import ProjectDataflow
+from .graph import (REGISTRY, Cost, Registry, Target, TracedEntry,
+                    entry_cost, estimate_cost, expect_census,
+                    program_signature, render_costs, trace_entry,
+                    trace_registry)
+from .graph_rules import (GRAPH_RULES, graph_rule_catalog,
+                          run_graph_rules)
 from .project_rules import (PROJECT_RULES, project_rule_catalog,
                             run_project_rules)
 from .race_harness import RaceHarness
@@ -59,14 +78,18 @@ from .walker import Source, SourceError
 rule_catalog = full_rule_catalog
 
 __all__ = [
-    "CONCURRENCY_RULES", "ConcurrencyModel", "Finding", "FunctionInfo",
-    "PROJECT_RULES", "Project", "ProjectDataflow", "RULES",
-    "RaceHarness", "RetraceBudgetExceeded", "RetraceGuard",
-    "Severity", "Source", "SourceError",
+    "CONCURRENCY_RULES", "ConcurrencyModel", "Cost", "Finding",
+    "FunctionInfo", "GRAPH_RULES", "PROJECT_RULES", "Project",
+    "ProjectDataflow", "REGISTRY", "RULES", "RaceHarness", "Registry",
+    "ResultCache", "RetraceBudgetExceeded", "RetraceGuard",
+    "Severity", "Source", "SourceError", "Target", "TracedEntry",
     "analyze_file", "analyze_paths", "collect_files",
-    "concurrency_rule_catalog", "full_rule_catalog",
+    "concurrency_rule_catalog", "entry_cost", "estimate_cost",
+    "expect_census", "full_rule_catalog", "graph_rule_catalog",
     "load_baseline", "main", "module_name_for", "partition",
-    "project_rule_catalog", "render_github", "render_json", "render_text",
+    "program_signature", "project_rule_catalog", "prune_baseline",
+    "render_costs", "render_github", "render_json", "render_text",
     "retrace_guard", "rule_catalog", "run_concurrency_rules",
-    "run_project_rules", "run_rules", "write_baseline",
+    "run_graph_rules", "run_project_rules", "run_rules",
+    "trace_entry", "trace_registry", "write_baseline",
 ]

@@ -19,7 +19,7 @@ from typing import Dict, Iterable, List, Tuple
 from .report import Finding
 
 __all__ = ["fingerprints", "write_baseline", "load_baseline",
-           "partition"]
+           "partition", "prune_baseline"]
 
 _VERSION = 1
 
@@ -63,6 +63,25 @@ def load_baseline(path: str) -> Dict[str, dict]:
         raise ValueError(f"{path}: unsupported baseline version "
                          f"{doc.get('version')!r}")
     return dict(doc.get("entries", {}))
+
+
+def prune_baseline(path: str, stale: Iterable[str]) -> int:
+    """Drop ``stale`` fingerprints (entries that no longer fire) from
+    the baseline file in place — ``--prune``'s hygiene pass, so fixed
+    debt can't silently re-enter under an old grandfather entry.
+    Returns the number of entries removed."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    entries = dict(doc.get("entries", {}))
+    removed = 0
+    for fp in stale:
+        if entries.pop(fp, None) is not None:
+            removed += 1
+    doc["entries"] = entries
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return removed
 
 
 def partition(findings: Iterable[Finding], baseline: Dict[str, dict]

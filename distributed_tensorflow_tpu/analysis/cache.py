@@ -1,0 +1,148 @@
+"""Incremental dtlint result cache (``.dtlint-cache/``).
+
+An unchanged tree must re-lint in well under a second: the expensive
+work — per-file AST rule passes, the interprocedural/concurrency
+project passes, and the graph tier's jax import + abstract traces — is
+memoized on *content*, never on timestamps:
+
+* per-file DT1xx results are keyed by ``sha1(path + file content)``;
+* the DT2xx / DT3xx project passes and the DT4xx graph tier are keyed
+  by a *tree hash* (every walked file's path + content hash) — any edit
+  anywhere re-runs them, which is exactly their interprocedural
+  contract.  The graph tier's key uses only the files under the package
+  root (the entry registry traces package code; fixtures outside it
+  can't change a trace);
+* everything is invalidated wholesale when the rule catalog (ids +
+  summaries), the ``--select``/``--ignore`` sets, or the cache format
+  version change.
+
+Storage is ONE json file (``index.json``) written atomically via
+``tmp + os.replace``; each save writes only the current tree's entries,
+so stale keys from old contents garbage-collect themselves.  All I/O is
+best-effort: a corrupt or unwritable cache degrades to a cold run,
+never to an error.  ``--no-cache`` (CI runs cold) skips it entirely;
+``DTLINT_CACHE_DIR`` relocates it (tests point it at a tmpdir).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .report import Finding
+
+__all__ = ["ResultCache", "cache_dir"]
+
+_VERSION = 1
+
+
+def cache_dir() -> str:
+    return os.environ.get("DTLINT_CACHE_DIR", ".dtlint-cache")
+
+
+def _sha1(text: str) -> str:
+    return hashlib.sha1(text.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+class ResultCache:
+    """Content-keyed findings cache.  Load once per run, ``save()`` once
+    at the end (only when something was recomputed)."""
+
+    def __init__(self, root: Optional[str] = None,
+                 catalog: Iterable[Tuple[str, str, str]] = (),
+                 flags: str = ""):
+        self.root = root or cache_dir()
+        self.path = os.path.join(self.root, "index.json")
+        self.catalog_key = _sha1(
+            f"v{_VERSION}|{flags}|"
+            + "|".join(f"{r}:{s}:{m}" for r, s, m in catalog))
+        self._files: Dict[str, list] = {}
+        self._tiers: Dict[str, list] = {}
+        self._dirty = False
+        self._hits = 0
+        self._misses = 0
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+            if (doc.get("version") == _VERSION
+                    and doc.get("catalog") == self.catalog_key):
+                self._files = dict(doc.get("files", {}))
+                self._tiers = dict(doc.get("tiers", {}))
+        except (OSError, ValueError):
+            pass
+
+    # ------------------------------------------------------------- keys
+
+    @staticmethod
+    def content_hash(text: str) -> str:
+        return _sha1(text)
+
+    def file_key(self, path: str, content_hash: str,
+                 mesh_axes: Iterable[str]) -> str:
+        return _sha1(f"{path}|{content_hash}|{','.join(mesh_axes)}")
+
+    @staticmethod
+    def tree_key(tier: str,
+                 hashes: Iterable[Tuple[str, str]]) -> str:
+        body = "\n".join(f"{p}:{h}" for p, h in sorted(hashes))
+        return f"{tier}:{_sha1(body)}"
+
+    # ---------------------------------------------------------- get/put
+
+    def get_file(self, key: str) -> Optional[List[Finding]]:
+        return self._decode(self._files.get(key))
+
+    def put_file(self, key: str, findings: List[Finding]) -> None:
+        self._files[key] = [f.to_dict() for f in findings]
+        self._dirty = True
+
+    def get_tier(self, key: str) -> Optional[List[Finding]]:
+        return self._decode(self._tiers.get(key))
+
+    def put_tier(self, key: str, findings: List[Finding]) -> None:
+        self._tiers[key] = [f.to_dict() for f in findings]
+        self._dirty = True
+
+    def _decode(self, rows) -> Optional[List[Finding]]:
+        if rows is None:
+            self._misses += 1
+            return None
+        self._hits += 1
+        try:
+            return [Finding(rule=r["rule"], severity=r["severity"],
+                            path=r["path"], line=int(r["line"]),
+                            col=int(r["col"]), message=r["message"],
+                            source_line=r.get("source_line", ""))
+                    for r in rows]
+        except (KeyError, TypeError, ValueError):
+            self._misses += 1
+            return None
+
+    # -------------------------------------------------------------- save
+
+    def save(self, live_file_keys: Optional[Iterable[str]] = None,
+             live_tier_keys: Optional[Iterable[str]] = None) -> None:
+        """Persist — keeping only the keys the CURRENT run touched, so
+        content churn garbage-collects old entries automatically."""
+        if not self._dirty:
+            return
+        files = self._files
+        tiers = self._tiers
+        if live_file_keys is not None:
+            live = set(live_file_keys)
+            files = {k: v for k, v in files.items() if k in live}
+        if live_tier_keys is not None:
+            live = set(live_tier_keys)
+            tiers = {k: v for k, v in tiers.items() if k in live}
+        doc = {"version": _VERSION, "catalog": self.catalog_key,
+               "files": files, "tiers": tiers}
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass          # best-effort: a read-only tree just runs cold

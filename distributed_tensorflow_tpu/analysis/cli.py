@@ -5,19 +5,31 @@
                                  ::error/::warning annotations)
       --baseline FILE          tolerate findings recorded in FILE
       --write-baseline FILE    snapshot current findings and exit 0
+      --prune                  with --baseline: drop stale entries (ones
+                               that no longer fire) from the file
       --select DT101,DT201     run only these rules
       --ignore DT105           skip these rules
       --jobs N                 parallel per-file pass (0 = cpu count)
       --no-project             skip the interprocedural DT2xx pass
       --no-concurrency         skip the host-concurrency DT3xx pass
+      --no-graph               skip the jaxpr graph-tier DT4xx pass
+      --no-cache               ignore + don't write .dtlint-cache/
+                               (CI runs cold; DTLINT_CACHE_DIR moves it)
+      --report costs           print the graph tier's per-entry cost
+                               table (FLOPs/bytes/peak/signature) and
+                               exit — CI archives it per run
       --timings                print the per-tier timing breakdown to
                                stderr (what scripts/lint.sh shows CI)
       --list-rules             print the rule catalog
 
-Three passes share one file walk: the per-module tier (DT1xx) runs file
-by file (parallelizable with ``--jobs``), then the interprocedural tier
+Four passes share one file walk: the per-module tier (DT1xx) runs file
+by file (parallelizable with ``--jobs``), the interprocedural tier
 (DT2xx) and the host-concurrency tier (DT3xx) each run once over the
-same parsed project.
+same parsed project, and the graph tier (DT4xx) abstractly traces the
+registered entry points (``analysis.entries``) — it only runs when the
+walk covers the package itself, so fixture runs stay jax-free.  Results
+are memoized by content hash in ``.dtlint-cache/`` (``analysis.cache``),
+so an unchanged tree re-lints in well under a second.
 
 Exit status: 0 when no non-baselined findings, 1 when new findings exist,
 2 on usage/parse errors.
@@ -32,9 +44,11 @@ import time
 from typing import Dict, Iterable, List, Optional, Set
 
 from . import baseline as baseline_lib
+from . import cache as cache_lib
 from .callgraph import Project, module_name_for
 from .concurrency import concurrency_rule_catalog, run_concurrency_rules
 from .context import mesh_axes_for
+from .graph_rules import graph_rule_catalog
 from .project_rules import project_rule_catalog, run_project_rules
 from .report import Finding, render_github, render_json, render_text
 from .rules import rule_catalog as _file_rule_catalog
@@ -43,6 +57,13 @@ from .walker import Source, SourceError
 
 __all__ = ["main", "collect_files", "analyze_file", "analyze_paths",
            "full_rule_catalog"]
+
+# the package root: the graph tier traces the entry registry, which IS
+# package code — a walk that never touches the package (test fixtures,
+# external trees) has nothing registered to trace
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_GRAPH_RULE_IDS = {r for r, _, _ in graph_rule_catalog()}
 
 
 def collect_files(paths: Iterable[str]) -> List[str]:
@@ -65,17 +86,17 @@ def collect_files(paths: Iterable[str]) -> List[str]:
 
 def full_rule_catalog():
     return (_file_rule_catalog() + project_rule_catalog()
-            + concurrency_rule_catalog())
+            + concurrency_rule_catalog() + graph_rule_catalog())
 
 
-def _load_source(path: str) -> Source:
+def _read(path: str) -> str:
     with open(path, "r", encoding="utf-8") as fh:
-        return Source(path, fh.read())
+        return fh.read()
 
 
 def analyze_file(path: str, select: Optional[Set[str]] = None,
                  ignore: Optional[Set[str]] = None) -> List[Finding]:
-    src = _load_source(path)
+    src = Source(path, _read(path))
     return run_rules(src, mesh_axes_for(path), select=select, ignore=ignore)
 
 
@@ -92,15 +113,35 @@ def _project_module(path: str) -> str:
     return module_name_for(rel)
 
 
+def _covers_package(files: Iterable[str]) -> bool:
+    prefix = _PKG_ROOT + os.sep
+    return any(os.path.abspath(f).startswith(prefix) for f in files)
+
+
+def _run_graph_tier(select, ignore) -> List[Finding]:
+    from . import entries as entries_mod
+    from .graph import trace_registry
+    from .graph_rules import run_graph_rules
+    registry = entries_mod.load_registry()
+    traced = trace_registry(registry)
+    return run_graph_rules(traced, registry, select=select, ignore=ignore)
+
+
 def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
                   ignore: Optional[Set[str]] = None, jobs: int = 1,
                   project_pass: bool = True,
                   concurrency_pass: bool = True,
+                  graph_pass: bool = True,
+                  cache: Optional[cache_lib.ResultCache] = None,
                   timings: Optional[Dict[str, float]] = None
                   ) -> List[Finding]:
     """Run every enabled tier over one shared file walk.  ``timings``,
     when given, is filled with per-tier wall-clock seconds (the
-    breakdown ``--timings``/scripts/lint.sh print for CI logs)."""
+    breakdown ``--timings``/scripts/lint.sh print for CI logs).
+
+    ``cache`` (a :class:`analysis.cache.ResultCache`) memoizes per-file
+    results by content hash and the project/graph tiers by tree hash;
+    pass ``None`` to run cold."""
     files = collect_files(paths)
     findings: List[Finding] = []
     sources: Dict[str, Source] = {}
@@ -108,53 +149,130 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
     t0 = time.perf_counter()
     need_project = project_pass or concurrency_pass
 
+    texts: Dict[str, str] = {f: _read(f) for f in files}
+    hashes: Dict[str, str] = {}
+    file_keys: Dict[str, str] = {}
+    if cache is not None:
+        for f in files:
+            hashes[f] = cache.content_hash(texts[f])
+            file_keys[f] = cache.file_key(f, hashes[f],
+                                          mesh_axes_for(f))
+
+    # tier keys + hits (tree-hashed: any edit re-runs the whole tier)
+    proj_key = conc_key = graph_key = None
+    proj_hit = conc_hit = graph_hit = None
+    if cache is not None:
+        tree = [(f, hashes[f]) for f in files]
+        pkg_tree = [(f, h) for f, h in tree
+                    if os.path.abspath(f).startswith(_PKG_ROOT + os.sep)]
+        proj_key = cache.tree_key("project", tree)
+        conc_key = cache.tree_key("concurrency", tree)
+        graph_key = cache.tree_key("graph", pkg_tree)
+        proj_hit = cache.get_tier(proj_key) if project_pass else None
+        conc_hit = cache.get_tier(conc_key) if concurrency_pass else None
+
+    need_sources = ((project_pass and proj_hit is None)
+                    or (concurrency_pass and conc_hit is None))
+
+    def record_source(path: str, src: Source) -> None:
+        mod = _project_module(path)
+        if mod:
+            sources[mod] = src
+            if os.path.basename(path) == "__init__.py":
+                packages.add(mod)
+
+    misses = [f for f in files
+              if cache is None or cache.get_file(file_keys[f]) is None]
+    # cache.get_file counted a hit above; re-read hits in walk order so
+    # finding order (and the parallel/serial parity) is stable
     if jobs == 0:
         jobs = os.cpu_count() or 1
-    if jobs > 1 and len(files) > 1:
+    if jobs > 1 and len(misses) > 1:
         import concurrent.futures as cf
         worker = functools.partial(analyze_file, select=select,
                                    ignore=ignore)
+        per_file: Dict[str, List[Finding]] = {}
         with cf.ProcessPoolExecutor(max_workers=jobs) as ex:
-            for per_file in ex.map(worker, files):
-                findings.extend(per_file)
-        if need_project:
+            for f, result in zip(misses, ex.map(worker, misses)):
+                per_file[f] = result
+        for f in files:
+            if f in per_file:
+                findings.extend(per_file[f])
+                if cache is not None:
+                    cache.put_file(file_keys[f], per_file[f])
+            else:
+                findings.extend(cache.get_file(file_keys[f]) or [])
+        if need_project or need_sources:
             for path in files:
                 try:
-                    src = _load_source(path)
+                    src = Source(path, texts[path])
                 except SourceError:
                     continue      # already reported by the per-file pass
-                mod = _project_module(path)
-                if mod:
-                    sources[mod] = src
-                    if os.path.basename(path) == "__init__.py":
-                        packages.add(mod)
+                record_source(path, src)
     else:
+        miss_set = set(misses)
         for path in files:
-            src = _load_source(path)   # SourceError propagates, as before
-            findings.extend(run_rules(src, mesh_axes_for(path),
-                                      select=select, ignore=ignore))
-            mod = _project_module(path)
-            if mod:
-                sources[mod] = src
-                if os.path.basename(path) == "__init__.py":
-                    packages.add(mod)
+            if path in miss_set:
+                src = Source(path, texts[path])   # SourceError propagates
+                per_file = run_rules(src, mesh_axes_for(path),
+                                     select=select, ignore=ignore)
+                findings.extend(per_file)
+                if cache is not None:
+                    cache.put_file(file_keys[path], per_file)
+            else:
+                findings.extend(cache.get_file(file_keys[path]) or [])
+                src = Source(path, texts[path]) if need_sources else None
+            if src is not None:
+                record_source(path, src)
     t1 = time.perf_counter()
 
     project = (Project.from_sources(sources, packages)
-               if need_project and sources else None)
-    if project_pass and project is not None:
-        axes = mesh_axes_for(files[0]) if files else ()
-        findings.extend(run_project_rules(project, axes, select=select,
-                                          ignore=ignore))
+               if need_sources and sources else None)
+    if project_pass:
+        if proj_hit is not None:
+            findings.extend(proj_hit)
+        elif project is not None:
+            axes = mesh_axes_for(files[0]) if files else ()
+            tier = run_project_rules(project, axes, select=select,
+                                     ignore=ignore)
+            findings.extend(tier)
+            if cache is not None:
+                cache.put_tier(proj_key, tier)
     t2 = time.perf_counter()
-    if concurrency_pass and project is not None:
-        findings.extend(run_concurrency_rules(project, select=select,
-                                              ignore=ignore))
+    if concurrency_pass:
+        if conc_hit is not None:
+            findings.extend(conc_hit)
+        elif project is not None:
+            tier = run_concurrency_rules(project, select=select,
+                                         ignore=ignore)
+            findings.extend(tier)
+            if cache is not None:
+                cache.put_tier(conc_key, tier)
     t3 = time.perf_counter()
+
+    run_graph = (graph_pass and _covers_package(files)
+                 and (select is None or select & _GRAPH_RULE_IDS))
+    if run_graph:
+        if cache is not None:
+            graph_hit = cache.get_tier(graph_key)
+        if graph_hit is not None:
+            findings.extend(graph_hit)
+        else:
+            tier = _run_graph_tier(select, ignore)
+            findings.extend(tier)
+            if cache is not None:
+                cache.put_tier(graph_key, tier)
+    t4 = time.perf_counter()
+
+    if cache is not None:
+        cache.save(live_file_keys=file_keys.values(),
+                   live_tier_keys=[k for k in (proj_key, conc_key,
+                                               graph_key)
+                                   if k is not None])
     if timings is not None:
         timings.update({"files": len(files), "per_file_s": t1 - t0,
                         "project_s": t2 - t1, "concurrency_s": t3 - t2,
-                        "total_s": t3 - t0})
+                        "graph_s": t4 - t3, "total_s": t4 - t0})
     return findings
 
 
@@ -162,6 +280,17 @@ def _rule_set(spec: Optional[str]) -> Optional[Set[str]]:
     if not spec:
         return None
     return {s.strip() for s in spec.split(",") if s.strip()}
+
+
+def _report_costs() -> int:
+    """``--report costs``: trace the registry and print the per-entry
+    cost table (deterministic, shape-derived — CI diffs it across PRs
+    to see cost-model drift)."""
+    from . import entries as entries_mod
+    from .graph import render_costs, trace_registry
+    traced = trace_registry(entries_mod.load_registry())
+    print(render_costs(traced))
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -174,6 +303,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     default="text")
     ap.add_argument("--baseline", metavar="FILE")
     ap.add_argument("--write-baseline", metavar="FILE")
+    ap.add_argument("--prune", action="store_true",
+                    help="with --baseline: remove stale entries (ones "
+                         "that no longer fire) from the baseline file")
     ap.add_argument("--select", metavar="IDS")
     ap.add_argument("--ignore", metavar="IDS")
     ap.add_argument("--jobs", type=int, default=1, metavar="N",
@@ -183,6 +315,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the interprocedural DT2xx pass")
     ap.add_argument("--no-concurrency", action="store_true",
                     help="skip the host-concurrency DT3xx pass")
+    ap.add_argument("--no-graph", action="store_true",
+                    help="skip the jaxpr graph-tier DT4xx pass")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="run cold: ignore and don't write "
+                         ".dtlint-cache/ (what CI does)")
+    ap.add_argument("--report", choices=("costs",),
+                    help="print a graph-tier report instead of linting")
     ap.add_argument("--timings", action="store_true",
                     help="print the per-tier timing breakdown to stderr")
     ap.add_argument("--list-rules", action="store_true")
@@ -192,16 +331,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         for rid, sev, summary in full_rule_catalog():
             print(f"{rid}  [{sev:7s}]  {summary}")
         return 0
+    if args.report == "costs":
+        return _report_costs()
+    if args.prune and not args.baseline:
+        print("dtlint: error: --prune requires --baseline",
+              file=sys.stderr)
+        return 2
 
+    select, ignore = _rule_set(args.select), _rule_set(args.ignore)
     paths = args.paths or ["."]
     timings: Dict[str, float] = {}
+    cache = None
+    if not args.no_cache:
+        flags = (f"select={sorted(select) if select else None}|"
+                 f"ignore={sorted(ignore) if ignore else None}")
+        cache = cache_lib.ResultCache(catalog=full_rule_catalog(),
+                                      flags=flags)
     try:
-        findings = analyze_paths(paths, select=_rule_set(args.select),
-                                 ignore=_rule_set(args.ignore),
+        findings = analyze_paths(paths, select=select, ignore=ignore,
                                  jobs=args.jobs,
                                  project_pass=not args.no_project,
                                  concurrency_pass=not args.no_concurrency,
-                                 timings=timings)
+                                 graph_pass=not args.no_graph,
+                                 cache=cache, timings=timings)
     except (FileNotFoundError, SourceError) as e:
         print(f"dtlint: error: {e}", file=sys.stderr)
         return 2
@@ -211,6 +363,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"per-file (DT1xx) {timings['per_file_s']:.2f}s | "
               f"project (DT2xx) {timings['project_s']:.2f}s | "
               f"concurrency (DT3xx) {timings['concurrency_s']:.2f}s | "
+              f"graph (DT4xx) {timings['graph_s']:.2f}s | "
               f"total {timings['total_s']:.2f}s", file=sys.stderr)
 
     if args.write_baseline:
@@ -228,6 +381,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         findings, baselined, stale = baseline_lib.partition(
             findings, entries)
+        if args.prune and stale:
+            n = baseline_lib.prune_baseline(args.baseline, stale)
+            print(f"dtlint: pruned {n} stale baseline entr(ies) from "
+                  f"{args.baseline}")
+            stale = []
 
     if args.format == "json":
         print(render_json(findings))
@@ -242,5 +400,5 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "suppressed")
         if stale:
             print(f"dtlint: {len(stale)} stale baseline entr(ies) — "
-                  "re-run --write-baseline to prune")
+                  "re-run --write-baseline, or --prune to drop them")
     return 1 if findings else 0

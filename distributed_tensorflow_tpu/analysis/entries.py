@@ -1,0 +1,73 @@
+"""Curated entry-point registry for the dtlint graph tier.
+
+Importing this module populates :data:`analysis.graph.REGISTRY` with
+every ``@trace_entry`` registration in the product tree — the serve
+scheduler's three hot executables (+ DT405 census pin), the train-step
+builders, the GPT decode/prefill paths — plus the bench-config entry
+defined here (bench.py is a repo-root script, not a package module, so
+its mirror lives in the curated registry rather than in bench.py
+itself).
+
+This module is imported ONLY by the graph tier (CLI/tests), never by
+``analysis.__init__``: pulling it in imports the whole product package,
+and the AST tiers must stay stdlib-pure.
+"""
+from __future__ import annotations
+
+import os
+
+from .graph import REGISTRY, Registry, Target, trace_entry
+
+__all__ = ["load_registry"]
+
+# Registration lives next to the code it traces; importing the modules
+# runs the decorators.  Keep this list curated: a module listed here is
+# a module whose hot executables the graph tier owns.
+_REGISTRATION_MODULES = (
+    "distributed_tensorflow_tpu.models.gpt",
+    "distributed_tensorflow_tpu.train.step",
+    "distributed_tensorflow_tpu.serve.scheduler",
+)
+
+
+@trace_entry("bench.gpt_step", hbm_budget=64 << 20)
+def _bench_gpt_entry():
+    """The bench ``--config=gpt`` train step at SMOKE shape (the
+    2-layer bf16 shrink of ``bench._gpt_bench_config``), so the cost
+    table CI archives tracks the same program whose measured numbers
+    carry ``analytical_flops``/``analytical_mfu`` — cost-model drift on
+    this row means the bench cross-check moved."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.gpt import GPT, GPTConfig
+    from ..optim import adamw
+    from ..train import TrainState, make_custom_train_step
+
+    seq = 256
+    config = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                       num_heads=2, intermediate_size=512,
+                       max_position=seq, dtype=jnp.bfloat16,
+                       dropout_rate=0.0, remat=True)
+    model = GPT(config)
+    optimizer = adamw(1e-4)
+    step = make_custom_train_step(model.lm_loss_fn(), optimizer,
+                                  grad_clip_norm=1.0)
+    def _abstract_state(k):
+        params = model.init(k)
+        return TrainState.create(params, optimizer.init(params))
+
+    state = jax.eval_shape(_abstract_state, jax.random.PRNGKey(0))
+    batch = {"input_ids": jax.ShapeDtypeStruct((4, seq + 1), jnp.int32)}
+    return Target("", step, (state, batch))
+
+
+def load_registry() -> Registry:
+    """Import every registration module and return the populated global
+    registry.  Sets ``JAX_PLATFORMS=cpu`` (if unset) BEFORE the product
+    package imports jax — linting must never grab an accelerator."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import importlib
+    for mod in _REGISTRATION_MODULES:
+        importlib.import_module(mod)
+    return REGISTRY

@@ -1,0 +1,666 @@
+"""dtlint graph tier: trace registered entry points into ClosedJaxprs.
+
+The AST tiers (DT1xx/DT2xx/DT3xx) reason about what the *source* says;
+this tier reasons about what JAX actually *traces*.  Product modules
+register their hot executables with :func:`trace_entry` (a metadata-only
+decorator — nothing is imported or traced at registration time); the
+curated registry module ``analysis.entries`` pulls those registrations
+in and :func:`trace_registry` abstractly traces every entry under
+``ShapeDtypeStruct`` inputs on CPU — no devices are grabbed, nothing is
+compiled or executed — into ``ClosedJaxpr`` program graphs.
+
+Over each traced entry this module computes:
+
+* the **closure constants** baked into the jaxpr (weights captured by
+  value instead of passed as arguments — DT401's evidence);
+* the **donation contract** straight from the ``pjit`` equation's
+  ``donated_invars`` (what XLA will actually honor — DT403's evidence);
+* a **static cost model** (:func:`estimate_cost`): FLOPs and
+  bytes-moved per call, recursing into ``scan``/``cond``/``pjit``/
+  remat sub-jaxprs with trip counts applied — unlike XLA's
+  ``cost_analysis``, a ``lax.scan`` body is counted ``length`` times
+  (the scan-undercount bench.py documents);
+* a **peak live-buffer estimate** (:func:`peak_live_bytes`): linear-scan
+  liveness over the jaxpr in program order — an *upper bound* on HBM
+  high-water (XLA fusion/rematerialization can only shrink it) that
+  DT404 compares against the budget declared at registration;
+* a **program signature** (primitive sequence + avals, hashed) — DT405
+  counts distinct signatures per census group to pin invariants like
+  "the serve tier has exactly 3 hot executables".
+
+``bench.py`` consumes the same cost model through :func:`entry_cost` to
+emit ``analytical_flops``/``analytical_bytes`` next to measured numbers.
+
+This module is stdlib-only at import time; JAX is imported lazily inside
+:func:`trace_registry`/:func:`entry_cost` (with ``JAX_PLATFORMS``
+defaulted to ``cpu`` so linting never touches an accelerator).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import sys
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Target", "Entry", "Registry", "TracedEntry", "Cost",
+           "REGISTRY", "trace_entry", "expect_census", "trace_registry",
+           "estimate_cost", "peak_live_bytes", "entry_cost",
+           "program_signature", "render_costs"]
+
+# Default DT401 threshold: a closure constant this large is weights, not
+# config (a 1 MiB f32 table is ~260k scalars — far past any legitimate
+# baked-in mask/rope table at lint-registry scale).
+DEFAULT_CONST_BYTES_LIMIT = 1 << 20
+
+
+# --------------------------------------------------------------- registry
+
+
+@dataclasses.dataclass(frozen=True)
+class Target:
+    """One traceable callable + its abstract example arguments.
+
+    ``args``/``kwargs`` are pytrees of ``jax.ShapeDtypeStruct`` (or
+    small concrete scalars/arrays — only their shapes/dtypes are used).
+    ``donate_argnums`` matters only for *unjitted* callables; jitted
+    ones carry their donation in the traced ``pjit`` equation itself.
+    """
+    name: str
+    fn: Callable
+    args: tuple = ()
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    hbm_budget: Optional[int] = None          # bytes; None = DT404 off
+    donate_argnums: Tuple[int, ...] = ()
+    const_bytes_limit: Optional[int] = None   # None = DT401 default
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    """One registration site (``@trace_entry``) — metadata only."""
+    name: str
+    build: Callable                 # () -> Target | [Target] when specs=None
+    group: Optional[str]
+    specs: Optional[tuple]          # abstract args when fn is traced directly
+    hbm_budget: Optional[int]
+    donate_argnums: Tuple[int, ...]
+    const_bytes_limit: Optional[int]
+    path: str                       # registration site, for findings
+    line: int
+
+
+class Registry:
+    """Entry-point registry.  The module-level :data:`REGISTRY` is the
+    curated one (populated by product-module imports via
+    ``analysis.entries``); tests build private instances."""
+
+    def __init__(self):
+        self.entries: List[Entry] = []
+        # group -> (expected distinct signatures, path, line)
+        self.census: Dict[str, Tuple[int, str, int]] = {}
+
+    def trace_entry(self, name: str, *, group: Optional[str] = None,
+                    specs: Optional[tuple] = None,
+                    hbm_budget: Optional[int] = None,
+                    donate_argnums: Tuple[int, ...] = (),
+                    const_bytes_limit: Optional[int] = None) -> Callable:
+        """Register a graph-tier entry point.
+
+        Decorates either the traceable function itself (pass ``specs``,
+        the abstract example args) or a zero-arg *builder* returning one
+        ``Target`` or a list of them (for entries whose functions only
+        exist after constructing an object, e.g. the serve scheduler's
+        jitted closures).  Registration is metadata-only: builders run,
+        and JAX is imported, only when the graph tier actually traces.
+        """
+        frame = sys._getframe(1)
+        path, line = frame.f_code.co_filename, frame.f_lineno
+
+        def deco(fn):
+            entry = Entry(name=name, build=fn, group=group, specs=specs,
+                          hbm_budget=hbm_budget,
+                          donate_argnums=tuple(donate_argnums),
+                          const_bytes_limit=const_bytes_limit,
+                          path=path, line=line)
+            # idempotent by name (module reloads re-register in place)
+            self.entries = [e for e in self.entries if e.name != name]
+            self.entries.append(entry)
+            return fn
+        return deco
+
+    def expect_census(self, group: str, count: int) -> None:
+        """Pin ``group`` to exactly ``count`` distinct traced program
+        signatures (DT405).  Call next to the registration whose
+        invariant it pins."""
+        frame = sys._getframe(1)
+        self.census[group] = (int(count), frame.f_code.co_filename,
+                              frame.f_lineno)
+
+    def clone(self) -> "Registry":
+        out = Registry()
+        out.entries = list(self.entries)
+        out.census = dict(self.census)
+        return out
+
+
+REGISTRY = Registry()
+trace_entry = REGISTRY.trace_entry
+expect_census = REGISTRY.expect_census
+
+
+# ------------------------------------------------------------- cost model
+
+
+@dataclasses.dataclass(frozen=True)
+class Cost:
+    """Static per-call cost: FLOPs, bytes moved (sum of operand+result
+    traffic per equation — an upper bound on HBM traffic; XLA fusion
+    only removes round-trips), and the liveness peak (upper bound on
+    resident bytes)."""
+    flops: float
+    bytes: float
+    peak_bytes: float
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity (FLOPs/byte) — the roofline abscissa."""
+        return self.flops / self.bytes if self.bytes else 0.0
+
+
+def _aval_bytes(aval) -> int:
+    try:
+        size = 1
+        for d in aval.shape:
+            size *= int(d)
+        return size * int(getattr(aval.dtype, "itemsize", 4))
+    except Exception:
+        return 0
+
+
+def _aval_elems(aval) -> int:
+    size = 1
+    for d in getattr(aval, "shape", ()):
+        size *= int(d)
+    return size
+
+
+def _dot_flops(eqn) -> float:
+    """2 * batch * M * N * K for a dot_general, from the lhs/rhs shapes
+    and dimension numbers."""
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    batch = 1
+    for d in lb:
+        batch *= int(lhs.shape[d])
+    contract = 1
+    for d in lc:
+        contract *= int(lhs.shape[d])
+    m = 1
+    for i, d in enumerate(lhs.shape):
+        if i not in lc and i not in lb:
+            m *= int(d)
+    n = 1
+    for i, d in enumerate(rhs.shape):
+        if i not in rc and i not in set(_rb):
+            n *= int(d)
+    return 2.0 * batch * m * n * contract
+
+
+def _conv_flops(eqn) -> float:
+    """2 * out_elems * kernel_elems / out_channels (in/groups folded into
+    the kernel shape already)."""
+    rhs = eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    dn = eqn.params.get("dimension_numbers")
+    out_ch_dim = dn.rhs_spec[0] if dn is not None else 0
+    kernel = 1
+    for d in rhs.shape:
+        kernel *= int(d)
+    out_ch = int(rhs.shape[out_ch_dim]) or 1
+    return 2.0 * _aval_elems(out) * kernel / out_ch
+
+
+# Primitives that are pure data movement / bookkeeping: 0 FLOPs (their
+# traffic is still charged to ``bytes``).
+_ZERO_FLOPS = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "convert_element_type",
+    "bitcast_convert_type", "slice", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "pad", "rev", "squeeze",
+    "gather", "scatter", "scatter-add", "iota", "copy", "device_put",
+    "stop_gradient", "select_n", "split", "expand_dims",
+})
+
+# Call-like primitives whose cost comes from their sub-jaxpr.
+_CALL_PRIMS = frozenset({
+    "pjit", "xla_call", "closed_call", "core_call", "remat",
+    "checkpoint", "remat2", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr", "shard_map",
+})
+
+
+def _closed(sub) -> Any:
+    """Normalize an eqn's sub-jaxpr param (ClosedJaxpr or open Jaxpr)."""
+    if hasattr(sub, "jaxpr"):          # ClosedJaxpr
+        return sub
+    from jax._src.core import ClosedJaxpr  # open Jaxpr: no consts
+    return ClosedJaxpr(sub, [])
+
+
+def _sub_jaxpr(eqn):
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is not None:
+            return _closed(sub)
+    return None
+
+
+def _eqn_cost(eqn) -> Tuple[float, float]:
+    """(flops, bytes) for one equation, recursing into sub-programs."""
+    name = eqn.primitive.name
+    if name == "scan":
+        body = _closed(eqn.params["jaxpr"])
+        f, b = _jaxpr_cost(body.jaxpr)
+        trips = int(eqn.params.get("length", 1))
+        return f * trips, b * trips
+    if name == "while":
+        cond = _closed(eqn.params["cond_jaxpr"])
+        body = _closed(eqn.params["body_jaxpr"])
+        fc, bc = _jaxpr_cost(cond.jaxpr)
+        fb, bb = _jaxpr_cost(body.jaxpr)
+        return fc + fb, bc + bb        # one trip: trip count is dynamic
+    if name == "cond":
+        best = (0.0, 0.0)
+        for br in eqn.params.get("branches", ()):
+            f, b = _jaxpr_cost(_closed(br).jaxpr)
+            if f > best[0]:
+                best = (f, b)
+        return best
+    if name in _CALL_PRIMS:
+        sub = _sub_jaxpr(eqn)
+        if sub is not None:
+            return _jaxpr_cost(sub.jaxpr)
+    in_b = sum(_aval_bytes(v.aval) for v in eqn.invars
+               if hasattr(v, "aval"))
+    out_b = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+    out_e = sum(_aval_elems(v.aval) for v in eqn.outvars)
+    if name == "dot_general":
+        return _dot_flops(eqn), in_b + out_b
+    if name == "conv_general_dilated":
+        return _conv_flops(eqn), in_b + out_b
+    if name in _ZERO_FLOPS:
+        return 0.0, in_b + out_b
+    if name.startswith(("reduce_", "argm")) or name in (
+            "reduce_precision", "cumsum", "cumprod", "cummax", "cummin",
+            "cumlogsumexp"):
+        in_e = sum(_aval_elems(v.aval) for v in eqn.invars
+                   if hasattr(v, "aval"))
+        return float(in_e), in_b + out_b
+    if name == "sort":
+        n = max(out_e, 1)
+        return float(n) * max(1, n.bit_length()), in_b + out_b
+    # default: one FLOP per output element (elementwise family; exp/erf
+    # etc. cost more microarchitecturally but this tier models *where*
+    # the FLOPs are, not polynomial degrees)
+    return float(out_e), in_b + out_b
+
+
+def _jaxpr_cost(jaxpr) -> Tuple[float, float]:
+    f = b = 0.0
+    for eqn in jaxpr.eqns:
+        ef, eb = _eqn_cost(eqn)
+        f += ef
+        b += eb
+    return f, b
+
+
+def _eqn_sub_peak(eqn) -> float:
+    """Transient bytes a call-like equation needs beyond its operands
+    and results (its sub-program's own liveness peak)."""
+    name = eqn.primitive.name
+    if name == "scan":
+        return _peak_of(_closed(eqn.params["jaxpr"]))
+    if name == "while":
+        return max(_peak_of(_closed(eqn.params["cond_jaxpr"])),
+                   _peak_of(_closed(eqn.params["body_jaxpr"])))
+    if name == "cond":
+        return max([_peak_of(_closed(br))
+                    for br in eqn.params.get("branches", ())] or [0.0])
+    if name in _CALL_PRIMS:
+        sub = _sub_jaxpr(eqn)
+        if sub is not None:
+            io = sum(_aval_bytes(v.aval) for v in eqn.invars
+                     if hasattr(v, "aval"))
+            io += sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            return max(0.0, _peak_of(sub) - io)
+    return 0.0
+
+
+def _peak_of(closed, donated: Optional[Tuple[bool, ...]] = None) -> float:
+    """Linear-scan liveness peak over one (closed) jaxpr.
+
+    Model: constants and inputs are live from entry; a *donated* input's
+    buffer dies at its last use (XLA reuses it), a non-donated input
+    stays resident to the end (the caller still owns it); every produced
+    value lives from its defining equation to its last use (jaxpr
+    outputs: to the end).  This ignores XLA's fusion (which removes
+    intermediates entirely), so it is an upper bound.
+    """
+    jaxpr = closed.jaxpr
+    n = len(jaxpr.eqns)
+    last_use: Dict[Any, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if hasattr(v, "aval") and not _is_literal(v):
+                last_use[v] = i
+    pinned = set()
+    for v in jaxpr.outvars:
+        if hasattr(v, "aval") and not _is_literal(v):
+            pinned.add(v)
+    invars = list(jaxpr.invars)
+    donated = donated or (False,) * len(invars)
+    for flag, v in zip(donated, invars):
+        if not flag:
+            pinned.add(v)
+    sizes: Dict[Any, int] = {}
+    live = 0.0
+    for v in list(jaxpr.constvars) + invars:
+        sizes[v] = _aval_bytes(v.aval)
+        live += sizes[v]
+    # constants with no use at all (or uses only inside sub-jaxprs we
+    # approximate) stay resident — conservative.
+    peak = live
+    for i, eqn in enumerate(jaxpr.eqns):
+        out_b = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+        peak = max(peak, live + out_b + _eqn_sub_peak(eqn))
+        for v in eqn.outvars:
+            if hasattr(v, "aval"):
+                sizes[v] = _aval_bytes(v.aval)
+                live += sizes[v]
+        dead = [v for v, at in last_use.items()
+                if at == i and v in sizes and v not in pinned]
+        for v in dead:
+            live -= sizes.pop(v)
+            del last_use[v]
+    return peak
+
+
+def _is_literal(v) -> bool:
+    return type(v).__name__ == "Literal"
+
+
+def peak_live_bytes(closed) -> float:
+    """Liveness peak for a traced entry.  When the entry is a single
+    jitted call (one top-level ``pjit``), descend into it and honor its
+    ``donated_invars`` — that IS the executable HBM story."""
+    jaxpr = closed.jaxpr
+    if len(jaxpr.eqns) == 1 and jaxpr.eqns[0].primitive.name == "pjit":
+        eqn = jaxpr.eqns[0]
+        return _peak_of(eqn.params["jaxpr"],
+                        tuple(eqn.params.get("donated_invars", ())))
+    return _peak_of(closed)
+
+
+def estimate_cost(closed) -> Cost:
+    """Static cost of one call of a traced program (ClosedJaxpr)."""
+    flops, bts = _jaxpr_cost(closed.jaxpr)
+    return Cost(flops=flops, bytes=bts, peak_bytes=peak_live_bytes(closed))
+
+
+def entry_cost(fn, *args, **kwargs) -> Cost:
+    """Trace ``fn`` abstractly (args may be ShapeDtypeStructs or real
+    arrays — only shapes/dtypes are read) and return its static Cost.
+    This is bench.py's hook for ``analytical_flops``/``analytical_bytes``
+    — scan bodies are counted times their trip count, unlike XLA's
+    ``cost_analysis``."""
+    import jax
+    closed = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(*args, **kwargs)
+    return estimate_cost(closed)
+
+
+# ---------------------------------------------------------------- tracing
+
+
+def program_signature(closed) -> str:
+    """Stable hash of the traced program's structure: primitive sequence
+    plus input/output avals, recursively.  Two entries with the same
+    signature are the same executable; DT405 counts distinct signatures
+    per census group."""
+    parts: List[str] = []
+
+    def walk(jaxpr):
+        parts.append("(" + ",".join(str(v.aval) for v in jaxpr.invars)
+                     + ")")
+        for eqn in jaxpr.eqns:
+            parts.append(eqn.primitive.name)
+            parts.append(",".join(str(v.aval) for v in eqn.outvars))
+            name = eqn.primitive.name
+            if name == "scan":
+                parts.append(f"x{eqn.params.get('length', 1)}")
+                walk(_closed(eqn.params["jaxpr"]).jaxpr)
+            elif name == "cond":
+                for br in eqn.params.get("branches", ()):
+                    walk(_closed(br).jaxpr)
+            elif name == "while":
+                walk(_closed(eqn.params["cond_jaxpr"]).jaxpr)
+                walk(_closed(eqn.params["body_jaxpr"]).jaxpr)
+            elif name in _CALL_PRIMS:
+                sub = _sub_jaxpr(eqn)
+                if sub is not None:
+                    walk(sub.jaxpr)
+        parts.append("->" + ",".join(str(v.aval)
+                                     for v in jaxpr.outvars))
+
+    walk(closed.jaxpr)
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _collect_consts(closed) -> List[Tuple[Tuple[int, ...], str, int]]:
+    """All closure constants baked into the program, recursively —
+    (shape, dtype, nbytes) per const, largest first."""
+    out: List[Tuple[Tuple[int, ...], str, int]] = []
+    seen: set = set()
+
+    def add(consts):
+        for c in consts:
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            shape = tuple(getattr(c, "shape", ()) or ())
+            dtype = str(getattr(c, "dtype", type(c).__name__))
+            nbytes = int(getattr(c, "nbytes", 0) or 0)
+            out.append((shape, dtype, nbytes))
+
+    def walk(cl):
+        add(getattr(cl, "consts", ()))
+        for eqn in cl.jaxpr.eqns:
+            name = eqn.primitive.name
+            subs = []
+            if name == "scan":
+                subs = [_closed(eqn.params["jaxpr"])]
+            elif name == "cond":
+                subs = [_closed(br)
+                        for br in eqn.params.get("branches", ())]
+            elif name == "while":
+                subs = [_closed(eqn.params["cond_jaxpr"]),
+                        _closed(eqn.params["body_jaxpr"])]
+            elif name in _CALL_PRIMS:
+                sub = _sub_jaxpr(eqn)
+                subs = [sub] if sub is not None else []
+            for s in subs:
+                walk(s)
+
+    walk(closed)
+    out.sort(key=lambda t: -t[2])
+    return out
+
+
+def _donations(closed, declared: Tuple[int, ...], args) -> List[tuple]:
+    """[(donated aval, matched)] pairs for DT403.
+
+    For a jitted entry (single top-level ``pjit``) the donated flat
+    invars come straight from ``donated_invars`` — what XLA will see.
+    For an unjitted entry, ``declared`` donate_argnums (flattened
+    against ``args``) stand in.  Matching is greedy multiset matching on
+    (shape, dtype): XLA aliases a donated input to an output buffer of
+    identical shape/dtype; a donated input with no such output is
+    silently rejected at compile time.
+    """
+    jaxpr = closed.jaxpr
+    donated_avals: List[Any] = []
+    passthrough: List[Any] = []
+    if len(jaxpr.eqns) == 1 and jaxpr.eqns[0].primitive.name == "pjit":
+        eqn = jaxpr.eqns[0]
+        flags = eqn.params.get("donated_invars", ())
+        # an input returned unchanged is pruned from the call's outputs
+        # by tracing, but at runtime the caller gets the same buffer
+        # back — identity aliasing, trivially donatable
+        top_out = {id(v) for v in jaxpr.outvars if not _is_literal(v)}
+        for flag, v in zip(flags, eqn.invars):
+            if flag and hasattr(v, "aval"):
+                if id(v) in top_out:
+                    passthrough.append(v.aval)
+                else:
+                    donated_avals.append(v.aval)
+        out_avals = [v.aval for v in eqn.outvars]
+    elif declared:
+        import jax
+        flat_by_arg = [jax.tree_util.tree_leaves(a) for a in args]
+        for i in declared:
+            if i < len(flat_by_arg):
+                donated_avals.extend(
+                    _shape_dtype(x) for x in flat_by_arg[i])
+        out_avals = [v.aval for v in jaxpr.outvars]
+    else:
+        return []
+    pool: Dict[Tuple[tuple, str], int] = {}
+    for a in out_avals:
+        key = (tuple(a.shape), str(a.dtype))
+        pool[key] = pool.get(key, 0) + 1
+    results = [(a, True) for a in passthrough]
+    for a in donated_avals:
+        key = (tuple(a.shape), str(a.dtype))
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+            results.append((a, True))
+        else:
+            results.append((a, False))
+    return results
+
+
+def _shape_dtype(x):
+    import jax
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    return jax.ShapeDtypeStruct(getattr(x, "shape", ()),
+                                getattr(x, "dtype", None))
+
+
+@dataclasses.dataclass
+class TracedEntry:
+    """One traced target plus everything the DT4xx rules read."""
+    name: str
+    group: Optional[str]
+    path: str
+    line: int
+    hbm_budget: Optional[int] = None
+    const_bytes_limit: Optional[int] = None
+    closed: Any = None                  # ClosedJaxpr, None on error
+    error: Optional[str] = None
+    signature: Optional[str] = None
+    cost: Optional[Cost] = None
+    consts: List[Tuple[Tuple[int, ...], str, int]] = \
+        dataclasses.field(default_factory=list)
+    donations: List[tuple] = dataclasses.field(default_factory=list)
+
+
+def _build_targets(entry: Entry) -> List[Target]:
+    if entry.specs is not None:
+        return [Target(name=entry.name, fn=entry.build,
+                       args=tuple(entry.specs),
+                       hbm_budget=entry.hbm_budget,
+                       donate_argnums=entry.donate_argnums,
+                       const_bytes_limit=entry.const_bytes_limit)]
+    built = entry.build()
+    targets = [built] if isinstance(built, Target) else list(built)
+    out = []
+    for t in targets:
+        name = (entry.name if t.name in ("", entry.name)
+                else f"{entry.name}.{t.name}")
+        out.append(dataclasses.replace(
+            t, name=name,
+            hbm_budget=t.hbm_budget if t.hbm_budget is not None
+            else entry.hbm_budget,
+            const_bytes_limit=t.const_bytes_limit
+            if t.const_bytes_limit is not None
+            else entry.const_bytes_limit))
+    return out
+
+
+def trace_registry(registry: Optional[Registry] = None
+                   ) -> List[TracedEntry]:
+    """Abstractly trace every registered entry on CPU.
+
+    Never raises for a broken entry: a builder or trace failure becomes
+    a ``TracedEntry`` with ``error`` set (DT400 reports it) so one bad
+    registration can't hide the others' findings.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax  # noqa: F401  (imported for side effect before builders run)
+
+    registry = registry if registry is not None else REGISTRY
+    traced: List[TracedEntry] = []
+    for entry in registry.entries:
+        try:
+            targets = _build_targets(entry)
+        except Exception:
+            traced.append(TracedEntry(
+                name=entry.name, group=entry.group, path=entry.path,
+                line=entry.line,
+                error="builder raised:\n" + traceback.format_exc(limit=3)))
+            continue
+        for t in targets:
+            te = TracedEntry(name=t.name, group=entry.group,
+                             path=entry.path, line=entry.line,
+                             hbm_budget=t.hbm_budget,
+                             const_bytes_limit=t.const_bytes_limit)
+            try:
+                closed = jax.make_jaxpr(
+                    lambda *a, **k: t.fn(*a, **k))(*t.args, **t.kwargs)
+                te.closed = closed
+                te.signature = program_signature(closed)
+                te.cost = estimate_cost(closed)
+                te.consts = _collect_consts(closed)
+                te.donations = _donations(closed, t.donate_argnums,
+                                          t.args)
+            except Exception:
+                te.error = ("trace raised:\n"
+                            + traceback.format_exc(limit=3))
+            traced.append(te)
+    return traced
+
+
+# ----------------------------------------------------------- cost report
+
+
+def render_costs(traced: List[TracedEntry]) -> str:
+    """The ``--report costs`` table: one deterministic row per entry
+    (shape-derived numbers only), so CI can archive and diff it across
+    PRs to see cost-model drift."""
+    header = (f"{'entry':40s} {'group':10s} {'gflops':>10s} "
+              f"{'mbytes':>10s} {'peak_mb':>9s} {'ai':>7s} "
+              f"{'consts_mb':>9s} {'sig':16s}")
+    lines = [header, "-" * len(header)]
+    for te in sorted(traced, key=lambda t: t.name):
+        if te.error:
+            lines.append(f"{te.name:40s} {te.group or '-':10s} "
+                         f"TRACE ERROR: {te.error.splitlines()[-1][:60]}")
+            continue
+        c = te.cost
+        consts_mb = sum(n for _, _, n in te.consts) / 1e6
+        lines.append(
+            f"{te.name:40s} {te.group or '-':10s} "
+            f"{c.flops / 1e9:10.4f} {c.bytes / 1e6:10.3f} "
+            f"{c.peak_bytes / 1e6:9.3f} {c.intensity:7.2f} "
+            f"{consts_mb:9.3f} {te.signature:16s}")
+    return "\n".join(lines)

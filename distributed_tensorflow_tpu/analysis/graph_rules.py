@@ -1,0 +1,342 @@
+"""dtlint graph-tier rules (DT400-DT405) over traced entry points.
+
+Each rule reads the ``TracedEntry`` records ``analysis.graph`` produced
+by abstractly tracing the registered entry points — program-level facts
+the AST tiers cannot see.  Findings anchor at the *registration site*
+(the ``@trace_entry``/``expect_census`` line), so the standard
+``# dtlint: disable=DT40x`` comment there suppresses them and the
+baseline fingerprints stay stable while the traced code churns.
+
+Catalog (docs/ANALYSIS.md has the worked examples):
+
+* **DT400** (error) — a registered entry failed to build or trace: the
+  census and every other DT4xx answer is unverifiable until it's fixed.
+* **DT401** (error) — large constant baked into the jaxpr: weights
+  captured by closure instead of passed as arguments recompile per
+  checkpoint and double-count HBM.  Threshold per entry
+  (``const_bytes_limit``, default 1 MiB).
+* **DT402** (warning/error) — dtype-promotion surprise: a matmul/conv
+  consuming an operand that was *converted* to f32 from
+  bf16/f16/int8 runs the hot-path FLOPs at full precision (warning);
+  any f64/i64 aval anywhere is x64 leakage (error).
+* **DT403** (error) — donated input not aliasable to any output
+  (no output shares its shape/dtype): XLA silently rejects the
+  donation, so the buffer the caller gave up is still resident —
+  statically, what ``RetraceGuard`` only catches at runtime.
+* **DT404** (error) — the entry's liveness peak (upper bound) exceeds
+  the HBM budget declared at registration.
+* **DT405** (error) — executable census: a census group's number of
+  distinct traced program signatures differs from the pinned count
+  (the serve tier pins "exactly 3 hot executables").
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .graph import (DEFAULT_CONST_BYTES_LIMIT, Registry, TracedEntry,
+                    _CALL_PRIMS, _closed, _sub_jaxpr)
+from .report import Finding, Severity
+
+__all__ = ["GRAPH_RULES", "graph_rule_catalog", "run_graph_rules"]
+
+GRAPH_RULES: List[Tuple[str, str, str]] = [
+    ("DT400", Severity.ERROR,
+     "registered graph entry failed to build or trace"),
+    ("DT401", Severity.ERROR,
+     "large constant baked into the jaxpr (closure-captured weights)"),
+    ("DT402", Severity.WARNING,
+     "dtype promotion surprise: f32 upcast of low-precision operands "
+     "on the hot path / x64 leakage"),
+    ("DT403", Severity.ERROR,
+     "donated input not aliasable to any output (XLA rejects the "
+     "donation silently)"),
+    ("DT404", Severity.ERROR,
+     "peak live-buffer estimate exceeds the entry's HBM budget"),
+    ("DT405", Severity.ERROR,
+     "executable census mismatch: distinct traced signatures != pinned "
+     "count"),
+]
+
+
+def graph_rule_catalog() -> List[Tuple[str, str, str]]:
+    return list(GRAPH_RULES)
+
+
+# ---------------------------------------------------------- suppressions
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dtlint:\s*disable\s*(?:=\s*([A-Z0-9,\s]+))?")
+
+_LINE_CACHE: Dict[str, List[str]] = {}
+
+
+def _line_text(path: str, line: int) -> str:
+    if path not in _LINE_CACHE:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                _LINE_CACHE[path] = fh.read().splitlines()
+        except OSError:
+            _LINE_CACHE[path] = []
+    lines = _LINE_CACHE[path]
+    if 1 <= line <= len(lines):
+        return lines[line - 1].strip()
+    return ""
+
+
+def _suppressed(path: str, line: int, rule: str) -> bool:
+    m = _SUPPRESS_RE.search(_line_text(path, line))
+    if not m:
+        return False
+    ids = m.group(1)
+    if not ids:
+        return True
+    return rule in {r.strip() for r in ids.split(",")}
+
+
+def _rel(path: str) -> str:
+    try:
+        cand = os.path.relpath(path)
+        if not cand.startswith(".."):
+            return cand
+    except ValueError:
+        pass
+    return path
+
+
+def _finding(rule: str, severity: str, path: str, line: int,
+             message: str) -> Optional[Finding]:
+    if _suppressed(path, line, rule):
+        return None
+    return Finding(rule=rule, severity=severity, path=_rel(path),
+                   line=line, col=0, message=message,
+                   source_line=_line_text(path, line))
+
+
+def _fmt_bytes(n: float) -> str:
+    return f"{n / (1 << 20):.1f} MiB"
+
+
+# ------------------------------------------------------- DT402 traversal
+
+_LOW_DTYPES = ("bfloat16", "float16", "int8", "uint8", "float8_e4m3fn",
+               "float8_e5m2")
+_X64_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+
+def _is_low(dtype) -> bool:
+    return str(dtype) in _LOW_DTYPES
+
+
+def _find_upcasts(closed) -> Tuple[List[str], List[str]]:
+    """(upcast sites, x64 sites) over the whole program.
+
+    Origin tracking: a value *converted* from a low-precision dtype to
+    f32 carries its origin dtype; elementwise ops propagate the origin;
+    a ``dot_general``/``conv`` consuming an f32 operand with a
+    low-precision origin is an upcast site.  Direct low-precision
+    operands (bf16 x bf16 -> f32 via ``preferred_element_type``) are the
+    GOOD mixed-precision pattern and never flagged.
+    """
+    upcasts: List[str] = []
+    x64: List[str] = []
+
+    def origin_of(origins, v):
+        if not hasattr(v, "aval") or type(v).__name__ == "Literal":
+            return None
+        return origins.get(v)
+
+    def walk(jaxpr, origins):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            for v in eqn.outvars:
+                if str(getattr(v.aval, "dtype", "")) in _X64_DTYPES:
+                    x64.append(f"{name} -> {v.aval}")
+            if name == "convert_element_type":
+                src = eqn.invars[0]
+                out = eqn.outvars[0]
+                src_dt = (origin_of(origins, src)
+                          or getattr(getattr(src, "aval", None),
+                                     "dtype", None))
+                if (src_dt is not None and _is_low(src_dt)
+                        and str(out.aval.dtype) == "float32"):
+                    origins[out] = str(src_dt)
+                continue
+            if name in ("dot_general", "conv_general_dilated"):
+                for v in eqn.invars:
+                    o = origin_of(origins, v)
+                    if (o is not None and hasattr(v, "aval")
+                            and str(v.aval.dtype) == "float32"):
+                        upcasts.append(
+                            f"{name}({'x'.join(map(str, v.aval.shape))} "
+                            f"f32 upcast from {o})")
+                continue
+            subs = []
+            if name == "scan":
+                subs = [(_closed(eqn.params["jaxpr"]), eqn.invars)]
+            elif name == "cond":
+                subs = [(_closed(br), eqn.invars[1:])
+                        for br in eqn.params.get("branches", ())]
+            elif name in _CALL_PRIMS:
+                sub = _sub_jaxpr(eqn)
+                if sub is not None:
+                    subs = [(sub, eqn.invars)]
+            if subs:
+                for sub, operands in subs:
+                    inner: Dict = {}
+                    for outer_v, inner_v in zip(operands,
+                                                sub.jaxpr.invars):
+                        o = origin_of(origins, outer_v)
+                        if o is not None:
+                            inner[inner_v] = o
+                    walk(sub.jaxpr, inner)
+                continue
+            # default propagation: f32 results of ops fed by an upcast
+            # value keep the origin (the low-precision data is still
+            # the payload)
+            o = None
+            for v in eqn.invars:
+                o = origin_of(origins, v)
+                if o is not None:
+                    break
+            if o is not None:
+                for v in eqn.outvars:
+                    if str(getattr(v.aval, "dtype", "")) == "float32":
+                        origins[v] = o
+
+    walk(closed.jaxpr, {})
+    return upcasts, x64
+
+
+# ------------------------------------------------------------- the rules
+
+
+def _rule_dt400(traced, registry, add):
+    for te in traced:
+        if te.error:
+            tail = te.error.strip().splitlines()[-1]
+            add("DT400", Severity.ERROR, te.path, te.line,
+                f"graph entry '{te.name}' failed to trace — every DT4xx "
+                f"answer for it is unverifiable: {tail}")
+
+
+def _rule_dt401(traced, registry, add):
+    for te in traced:
+        if te.error:
+            continue
+        limit = te.const_bytes_limit or DEFAULT_CONST_BYTES_LIMIT
+        big = [(s, d, n) for s, d, n in te.consts if n >= limit]
+        if not big:
+            continue
+        total = sum(n for _, _, n in big)
+        s, d, n = big[0]
+        add("DT401", Severity.ERROR, te.path, te.line,
+            f"entry '{te.name}' bakes {len(big)} constant(s) totalling "
+            f"{_fmt_bytes(total)} into the jaxpr (largest: {d}"
+            f"[{','.join(map(str, s))}] = {_fmt_bytes(n)}); closure-"
+            f"captured weights recompile per checkpoint and double-"
+            f"count HBM — pass them as traced arguments")
+
+
+def _rule_dt402(traced, registry, add):
+    for te in traced:
+        if te.error:
+            continue
+        upcasts, x64 = _find_upcasts(te.closed)
+        if upcasts:
+            add("DT402", Severity.WARNING, te.path, te.line,
+                f"entry '{te.name}' runs {len(upcasts)} matmul/conv "
+                f"site(s) on f32-upcast low-precision operands (first: "
+                f"{upcasts[0]}); the hot-path FLOPs run at full "
+                f"precision — keep the operands narrow and accumulate "
+                f"via preferred_element_type")
+        if x64:
+            add("DT402", Severity.ERROR, te.path, te.line,
+                f"entry '{te.name}' traces {len(x64)} 64-bit value(s) "
+                f"(first: {x64[0]}); x64 leakage doubles bytes and "
+                f"falls off the TPU fast path")
+
+
+def _rule_dt403(traced, registry, add):
+    for te in traced:
+        if te.error:
+            continue
+        rejected = [a for a, ok in te.donations if not ok]
+        if not rejected:
+            continue
+        a = rejected[0]
+        add("DT403", Severity.ERROR, te.path, te.line,
+            f"entry '{te.name}' donates {len(rejected)} buffer(s) no "
+            f"output can alias (first: {a.dtype}"
+            f"[{','.join(map(str, a.shape))}]); XLA rejects such "
+            f"donations silently — the 'freed' buffer stays resident "
+            f"(drop the donation or return a matching output)")
+
+
+def _rule_dt404(traced, registry, add):
+    for te in traced:
+        if te.error or te.hbm_budget is None or te.cost is None:
+            continue
+        peak = te.cost.peak_bytes
+        if peak > te.hbm_budget:
+            add("DT404", Severity.ERROR, te.path, te.line,
+                f"entry '{te.name}' peak live-buffer estimate "
+                f"{_fmt_bytes(peak)} exceeds its declared HBM budget "
+                f"{_fmt_bytes(te.hbm_budget)} (liveness upper bound; "
+                f"raise the budget only with a measured justification)")
+
+
+def _rule_dt405(traced, registry, add):
+    by_group: Dict[str, List[TracedEntry]] = {}
+    for te in traced:
+        if te.group:
+            by_group.setdefault(te.group, []).append(te)
+    for group, (expected, path, line) in registry.census.items():
+        members = by_group.get(group, [])
+        failed = [te.name for te in members if te.error]
+        if failed:
+            add("DT405", Severity.ERROR, path, line,
+                f"census group '{group}' is unverifiable: "
+                f"{len(failed)} member(s) failed to trace "
+                f"({', '.join(sorted(failed))})")
+            continue
+        sigs: Dict[str, List[str]] = {}
+        for te in members:
+            sigs.setdefault(te.signature, []).append(te.name)
+        if len(sigs) != expected:
+            names = "; ".join(
+                f"{sig[:8]}: {', '.join(sorted(ns))}"
+                for sig, ns in sorted(sigs.items()))
+            add("DT405", Severity.ERROR, path, line,
+                f"census group '{group}' has {len(sigs)} distinct "
+                f"traced executable(s), pinned at {expected} "
+                f"({names or 'no members registered'}); a new "
+                f"executable here means admission recompiles")
+
+
+_RULE_FNS = [
+    ("DT400", _rule_dt400), ("DT401", _rule_dt401),
+    ("DT402", _rule_dt402), ("DT403", _rule_dt403),
+    ("DT404", _rule_dt404), ("DT405", _rule_dt405),
+]
+
+
+def run_graph_rules(traced: List[TracedEntry], registry: Registry,
+                    select: Optional[Set[str]] = None,
+                    ignore: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+
+    for rule_id, fn in _RULE_FNS:
+        if select is not None and rule_id not in select:
+            continue
+        if ignore is not None and rule_id in ignore:
+            continue
+
+        def add(rule, severity, path, line, message):
+            f = _finding(rule, severity, path, line, message)
+            if f is not None:
+                findings.append(f)
+
+        fn(traced, registry, add)
+    return findings

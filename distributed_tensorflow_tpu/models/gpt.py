@@ -1838,3 +1838,38 @@ class GPT:
             # prepended to each spec — one source of truth.  (MoE cannot
             # combine with pipeline — rejected at config — so lead=None.)
         ] + [(pat, P(None, *spec)) for pat, spec in moe_partition_rules()])
+
+
+# --------------------------------------------------- dtlint graph tier
+
+from ..analysis import graph as _graph_lib  # noqa: E402  (registration)
+
+
+@_graph_lib.trace_entry("gpt", hbm_budget=1 << 20)
+def _graph_entries():
+    """Registry-scale decode/prefill paths for the DT4xx pack: the
+    chunked-prefill window (``decode_window``) and the single-token
+    decode step traced abstractly on a tiny config.  DT401 watches for
+    weights silently closed over instead of passed as ``params``;
+    DT402 for a decode path whose matmuls get upcast to f32."""
+    import jax
+
+    model = gpt_tiny(vocab_size=64, hidden_size=32, num_heads=2,
+                     intermediate_size=64, max_position=32,
+                     dropout_rate=0.0)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    cache = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype),
+        jax.eval_shape(lambda: model.init_cache(1, 32)))
+    window = jax.ShapeDtypeStruct((1, 8), jnp.int32)
+    token = jax.ShapeDtypeStruct((1,), jnp.int32)
+    return [
+        _graph_lib.Target(
+            "prefill_window",
+            lambda p, c, w: model.decode_window(p, c, w, head="last"),
+            (params, cache, window)),
+        _graph_lib.Target(
+            "decode_step",
+            lambda p, c, t: model.decode_step(p, c, t),
+            (params, cache, token)),
+    ]

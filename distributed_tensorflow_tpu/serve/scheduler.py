@@ -89,6 +89,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..analysis import graph as graph_lib
 from ..resilience import faults as faults_lib
 from ..ops import decoding as dec
 from . import pages as pages_lib
@@ -562,6 +563,69 @@ class SlotScheduler:
             self._last_admit = jax.jit(last_admit,
                                        donate_argnums=(4, 5, 6, 7, 8))
             self._tick = jax.jit(tick, donate_argnums=(1, 2, 3, 4, 5))
+
+    # ------------------------------------------------ graph-tier targets
+
+    def graph_targets(self, hbm_budget: Optional[int] = None) -> list:
+        """The three hot executables as dtlint graph-tier trace targets
+        (``analysis/graph.py``): abstract shape/dtype specs matching
+        exactly what ``_advance_prefill``/``_decode_tick`` pass, so the
+        DT4xx rules and the DT405 census lint the REAL programs.  Kept
+        in this file so the specs cannot drift from the call sites
+        without the diff showing both.  Serializes against the pump
+        (shape/dtype reads of buffers a running tick donates)."""
+        import jax
+
+        def sds(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    tuple(getattr(x, "shape", ())), x.dtype), tree)
+
+        i32 = jax.ShapeDtypeStruct((), np.int32)
+        win = jax.ShapeDtypeStruct((1, self.prefill_chunk), np.int32)
+        with self._pump_lock:
+            params, cache = sds(self.params), sds(self._cache)
+            toks, fin = sds(self._tokens), sds(self._finished)
+            rem, key = sds(self._remaining), sds(self._key)
+            ad, ad_rows = self._adapter_args()
+        ad = sds(ad) if ad is not None else None
+        row1 = (jax.ShapeDtypeStruct((1,), np.int32)
+                if ad_rows is not None else None)
+        rows = sds(ad_rows) if ad_rows is not None else None
+        if self.paged:
+            pps = self.max_len // self.page_size
+            prow = jax.ShapeDtypeStruct((pps,), np.int32)
+            tab = jax.ShapeDtypeStruct((self.num_slots, pps), np.int32)
+            return [
+                graph_lib.Target(
+                    "prefill_window", self._win_mid,
+                    (params, cache, win, prow, i32, ad, row1),
+                    hbm_budget=hbm_budget),
+                graph_lib.Target(
+                    "admit", self._last_admit,
+                    (params, cache, win, prow, i32, i32, key, toks,
+                     fin, rem, i32, i32, i32, ad, row1),
+                    hbm_budget=hbm_budget),
+                graph_lib.Target(
+                    "decode_tick", self._tick,
+                    (params, cache, tab, toks, fin, rem, key, ad, rows),
+                    hbm_budget=hbm_budget),
+            ]
+        pf = sds(jax.eval_shape(
+            lambda: self.model.init_cache(1, self.max_len)))
+        return [
+            graph_lib.Target(
+                "prefill_window", self._win_mid,
+                (params, pf, win, ad, row1), hbm_budget=hbm_budget),
+            graph_lib.Target(
+                "admit", self._last_admit,
+                (params, pf, win, i32, key, cache, toks, fin, rem,
+                 i32, i32, i32, ad, row1), hbm_budget=hbm_budget),
+            graph_lib.Target(
+                "decode_tick", self._tick,
+                (params, cache, toks, fin, rem, key, ad, rows),
+                hbm_budget=hbm_budget),
+        ]
 
     # ------------------------------------------------------------- intake
 
@@ -1420,3 +1484,36 @@ class SlotScheduler:
 
     def _report_depth(self) -> None:
         self.metrics.depth(self.stats())
+
+
+# --------------------------------------------------- dtlint graph tier
+
+# The serving contract this whole file is built around: exactly THREE
+# hot executables, so admission/retirement never recompiles.  DT405
+# makes that a lint invariant — a fourth jitted program (or two of the
+# three collapsing into one) fails `scripts/lint.sh` statically instead
+# of surfacing as a RetraceGuard warning at serve time.
+graph_lib.expect_census("serve-hot", 3)
+
+
+@graph_lib.trace_entry("serve", group="serve-hot",
+                       hbm_budget=2 << 20)
+def _graph_entries():
+    """Registry-scale serve build for the DT4xx pack: a tiny CPU config
+    with ABSTRACT params (``jax.eval_shape`` — no weights materialize),
+    running the same ``__init__`` jit-builder code as production.  The
+    HBM budget pins the tiny build's working set: a structural change
+    that blows up peak memory (a gather materializing the whole pool, a
+    lost donation) trips DT404 here at the small scale where the ratio
+    is the same."""
+    import jax
+    from ..models.gpt import gpt_tiny
+
+    model = gpt_tiny(vocab_size=64, hidden_size=32, num_heads=2,
+                     intermediate_size=64, max_position=32,
+                     dropout_rate=0.0)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    sched = SlotScheduler(model, params, num_slots=2, max_len=32,
+                          prefill_chunk=8, tick_steps=2,
+                          temperature=0.0)
+    return sched.graph_targets(hbm_budget=2 << 20)

@@ -548,3 +548,40 @@ def make_masked_eval_step(model, loss,
         return metrics
 
     return jax.jit(masked_eval_step)
+
+
+# --------------------------------------------------- dtlint graph tier
+
+from ..analysis import graph as _graph_lib  # noqa: E402  (registration)
+
+
+@_graph_lib.trace_entry("train", hbm_budget=16 << 20)
+def _graph_entries():
+    """Registry-scale train-step builds for the DT4xx pack: the single-
+    dispatch and scanned multi-step builders traced abstractly (params
+    via ``jax.eval_shape`` — nothing materializes) on the MNIST MLP.
+    DT403 reads the donation straight off the traced ``pjit`` equation,
+    so a refactor that breaks the donated-state chain (state no longer
+    aliasable to an output) fails lint before it ships a 2x HBM step."""
+    import jax
+    from ..models import mnist_mlp
+    from ..optim import adam
+
+    model = mnist_mlp()
+    optimizer = adam()
+    step = make_train_step(model, "sparse_categorical_crossentropy",
+                           optimizer)
+    multi = make_multi_train_step(model,
+                                  "sparse_categorical_crossentropy",
+                                  optimizer, steps_per_call=4)
+    state = jax.eval_shape(
+        lambda k: init_train_state(model, optimizer, k, (784,)),
+        jax.random.PRNGKey(0))
+    f32, i32 = jnp.float32, jnp.int32
+    batch = (jax.ShapeDtypeStruct((8, 784), f32),
+             jax.ShapeDtypeStruct((8,), i32))
+    mbatch = (jax.ShapeDtypeStruct((4, 8, 784), f32),
+              jax.ShapeDtypeStruct((4, 8), i32))
+    return [_graph_lib.Target("make_train_step", step, (state, batch)),
+            _graph_lib.Target("make_multi_train_step", multi,
+                              (state, mbatch))]

@@ -1,10 +1,13 @@
 #!/usr/bin/env bash
 # Repo lint gate: ruff (pyflakes + import hygiene, config in
 # pyproject.toml) then dtlint (distributed-JAX hazards, docs/ANALYSIS.md:
-# per-module DT1xx + interprocedural DT2xx + host-concurrency DT3xx)
-# against the committed baseline.  Extra args pass through to dtlint,
-# e.g.
+# per-module DT1xx + interprocedural DT2xx + host-concurrency DT3xx +
+# jaxpr graph tier DT4xx) against the committed baseline.  Results are
+# memoized in .dtlint-cache/ by content hash, so an unchanged tree
+# re-lints in well under a second; CI passes --no-cache to always run
+# cold.  Extra args pass through to dtlint, e.g.
 #   scripts/lint.sh --format github     # PR-diff annotations in CI
+#   scripts/lint.sh --no-cache          # force a cold run
 #   DTLINT_JOBS=4 scripts/lint.sh       # parallel per-file pass
 #   DTLINT_LOG=lint.log scripts/lint.sh # tee findings to a file too
 set -euo pipefail
@@ -17,12 +20,13 @@ else
 fi
 
 # --timings: per-tier breakdown (DT1xx per-file / DT2xx project /
-# DT3xx concurrency) on stderr so CI logs show where lint time goes.
-# Findings tee into $DTLINT_LOG when set; with `set -o pipefail` the
-# pipeline's status is dtlint's (tee's success must not mask findings),
-# captured via `|| rc=$?` because set -e would otherwise exit before
-# we can report it ourselves.
+# DT3xx concurrency / DT4xx graph) on stderr so CI logs show where lint
+# time goes.  Findings tee into $DTLINT_LOG when set; with
+# `set -o pipefail` the pipeline's status is dtlint's (tee's success
+# must not mask findings), captured via `|| rc=$?` because set -e would
+# otherwise exit before we can report it ourselves.
 rc=0
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
 python -m distributed_tensorflow_tpu.analysis \
   distributed_tensorflow_tpu examples scripts \
   --jobs "${DTLINT_JOBS:-0}" \

@@ -613,7 +613,7 @@ def test_cli_timings_breakdown(tmp_path):
     assert proc.returncode == 0
     assert "dtlint: timings:" in proc.stderr
     for tier in ("per-file (DT1xx)", "project (DT2xx)",
-                 "concurrency (DT3xx)"):
+                 "concurrency (DT3xx)", "graph (DT4xx)"):
         assert tier in proc.stderr
 
 

@@ -609,3 +609,63 @@ class TestGptLong:
         assert 0 < r["drain_migrate_ms"] < r["drain_wait_ms"]
         assert 0 < r["tokens_preserved_ratio"] <= 1.0
         assert r["migrations"] >= 1
+
+
+class TestAnalytical:
+    """The graph-tier static cost model riding the bench JSON
+    (``analytical_flops``/``analytical_bytes``/``analytical_mfu``):
+    every measured perf claim gets a same-program static roofline next
+    to it (docs/ANALYSIS.md §graph tier)."""
+
+    def test_attach_analytical_exact_on_a_matmul(self, monkeypatch):
+        monkeypatch.setenv("DTTPU_PEAK_FLOPS", "1e12")
+        monkeypatch.setenv("DTTPU_PEAK_BW", "1e10")
+        import jax
+        import jax.numpy as jnp
+        step = jax.jit(lambda a, b: a @ b)
+        args = (jax.ShapeDtypeStruct((4, 8), jnp.float32),
+                jax.ShapeDtypeStruct((8, 16), jnp.float32))
+        r = bench._attach_analytical({"metric": "m"}, step, args,
+                                     tokens_per_step=4)
+        assert r["analytical_flops"] == 2 * 4 * 8 * 16
+        assert r["analytical_bytes"] == (4 * 8 + 8 * 16 + 4 * 16) * 4
+        assert r["analytical_flops_per_token"] == pytest.approx(
+            2 * 8 * 16)
+        intensity = r["analytical_flops"] / r["analytical_bytes"]
+        assert r["analytical_mfu"] == pytest.approx(
+            min(1.0, 1e10 * intensity / 1e12), abs=1e-4)
+
+    def test_attach_analytical_without_peak_omits_mfu(self, monkeypatch):
+        # CPU mesh, no override: flops/bytes still land (they're
+        # hardware-independent), the roofline field does not
+        monkeypatch.delenv("DTTPU_PEAK_FLOPS", raising=False)
+        monkeypatch.delenv("DTTPU_PEAK_BW", raising=False)
+        import jax
+        import jax.numpy as jnp
+        step = jax.jit(lambda a: a + 1.0)
+        r = bench._attach_analytical(
+            {"metric": "m"}, step,
+            (jax.ShapeDtypeStruct((8,), jnp.float32),))
+        assert r["analytical_flops"] == 8
+        assert "analytical_mfu" not in r
+
+    def test_gpt_smoke_analytical_schema_and_roofline_bound(self):
+        """--config=gpt carries the graph-tier fields, and the measured
+        mfu sits below the static roofline ceiling — the sanity bound
+        that makes a too-good-to-be-true number fail loudly."""
+        proc = _run(["--config=gpt", "--device=cpu"],
+                    _env(DTTPU_PEAK_FLOPS="1e15", DTTPU_PEAK_BW="1e13"))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines()
+                 if l.strip()]
+        r = json.loads(lines[-1])
+        assert r["analytical_flops"] > 0
+        assert r["analytical_bytes"] > 0
+        assert r["analytical_flops_per_token"] > 0
+        assert 0 < r["analytical_mfu"] <= 1.0
+        # the cost model counts scan bodies times their trip count, so
+        # the static figure must not fall below XLA's scan-undercounted
+        # per-token number
+        assert r["analytical_flops_per_token"] >= r["flops_per_example"]
+        # measured <= static roofline ceiling
+        assert r["mfu"] <= r["analytical_mfu"]
