@@ -1840,6 +1840,288 @@ def bench_fleet():
                 seq_len=seq)
 
 
+def bench_fleet_sim():
+    """Million-request fleet simulation (fleet/sim.py): the REAL
+    Router/Watchdog/tenancy/faults stack driven at virtual-time speed
+    by ``SimEngine`` replicas priced with the graph-tier cost model.
+    Four legs, one JSON line:
+
+    1. **autoscaler** — the seeded diurnal+burst trace (two scheduled
+       ``correlated_kill`` events included) under the SLO-driven
+       ``Autoscaler`` (scale-out on missed attainment/backlog,
+       migrate-based scale-in, heal after kills).
+    2. **static** — the SAME trace on a fixed peak-sized fleet.
+       ``autoscaler_vs_static`` = attainment per replica-second,
+       autoscaler over static — >= 1.0 means the policy buys the same
+       SLO for less provisioned capacity.
+    3. **curve** — SLO attainment vs static replica count on a clean
+       subset trace (``slo_vs_replicas``), the capacity-planning curve.
+    4. **validation** — a small burst replayed against BOTH a real
+       2-replica ``serve.Engine`` fleet and the simulator with a
+       ``CostModel.calibrate``\\ d from two measured points on that
+       engine; asserts sim-predicted tokens/s and TTFT p50 land within
+       25% of the real replay (``DTTPU_BENCH_FLEET_SIM_VALIDATE=0``
+       skips, e.g. where no jax backend is wanted).
+
+    ``sim_wall_s`` counts legs 1-3 only (the virtual-time claim:
+    >= 1e6 simulated requests under 60 s of CPU wall-clock);
+    ``simulated_requests`` is their request total."""
+    import gc
+    import numpy as np
+    from distributed_tensorflow_tpu import fleet
+    from distributed_tensorflow_tpu.fleet import sim as sim_lib
+    from distributed_tensorflow_tpu.fleet import workload
+
+    n_main = int(os.environ.get("DTTPU_BENCH_FLEET_SIM_REQUESTS",
+                                "8000" if SMOKE else "400000"))
+    n_curve = int(os.environ.get("DTTPU_BENCH_FLEET_SIM_CURVE",
+                                 "2000" if SMOKE else "65000"))
+    horizon_s = 1800.0
+    curve_replicas = (2, 3, 4, 6)
+    slo = fleet.SLO(ttft_s=2.0, itl_s=0.02)
+    # a ~200M-param weight-streaming decode point: mean demand sits
+    # right at the 2-replica floor, so the diurnal peak and the burst
+    # spikes genuinely need the autoscaler, while a peak-sized static
+    # fleet idles through the trough
+    engine_kw = dict(num_slots=8, prefill_chunk=64, tick_steps=16)
+    cm = sim_lib.CostModel.analytic(
+        n_params=2.0e8, prefill_chunk=64, num_slots=8, tick_steps=16,
+        hw=sim_lib.HardwarePoint())
+    trace = workload.synthesize(
+        n_main, seed=0, horizon_s=horizon_s, bursts=3,
+        burst_magnitude=5.0, failures=2, failure_k=2)
+
+    sim_wall = [0.0]
+    simulated = [0]
+
+    def run_leg(tr, **kw):
+        fs = sim_lib.FleetSim(tr, cm, slo=slo, engine=dict(engine_kw),
+                              **kw)
+        gc.collect()
+        gc.disable()
+        t0 = time.perf_counter()
+        try:
+            rep = fs.run()
+        finally:
+            gc.enable()
+        sim_wall[0] += time.perf_counter() - t0
+        simulated[0] += rep["simulated_requests"]
+        return rep
+
+    auto_rep = run_leg(
+        trace, replicas=2,
+        autoscaler=dict(min_replicas=2, max_replicas=8,
+                        eval_interval_s=15.0, cooldown_s=60.0),
+        watchdog=dict(tick_deadline_s=5.0), seed=1)
+    log(f"fleet_sim autoscaler: {auto_rep['completed']:,} ok, "
+        f"attainment {auto_rep['slo_attainment']:.4f}, "
+        f"{auto_rep['scale_outs']} out / {auto_rep['scale_ins']} in, "
+        f"{auto_rep['migrations']} migrations, "
+        f"{auto_rep['replica_seconds']:,.0f} replica-s")
+
+    static_rep = run_leg(trace, replicas=6, seed=1)
+    log(f"fleet_sim static x6: attainment "
+        f"{static_rep['slo_attainment']:.4f}, "
+        f"{static_rep['replica_seconds']:,.0f} replica-s")
+    vs_static = ((auto_rep["slo_attainment"]
+                  / max(auto_rep["replica_seconds"], 1e-9))
+                 / (static_rep["slo_attainment"]
+                    / max(static_rep["replica_seconds"], 1e-9)))
+
+    curve_trace = workload.synthesize(
+        n_curve, seed=1, horizon_s=horizon_s / 4, bursts=2,
+        burst_magnitude=4.0, failures=0)
+    curve = {}
+    for r in curve_replicas:
+        rep = run_leg(curve_trace, replicas=r, seed=2)
+        curve[str(r)] = dict(
+            slo_attainment=rep["slo_attainment"],
+            attainment_ttft=rep["attainment_ttft"],
+            attainment_itl=rep["attainment_itl"],
+            ttft_p99_ms=rep["ttft_p99_ms"],
+            itl_p99_ms=rep["itl_p99_ms"])
+    log("fleet_sim curve: " + ", ".join(
+        f"{r}r {c['slo_attainment']:.3f}" for r, c in curve.items()))
+
+    validation = None
+    if os.environ.get("DTTPU_BENCH_FLEET_SIM_VALIDATE", "1") != "0":
+        validation = _fleet_sim_validate(cm_seed=0)
+        log(f"fleet_sim validation: sim/real tokens/s "
+            f"{validation['tokens_per_sec_ratio']:.3f}, ttft p50 "
+            f"{validation['ttft_p50_ratio']:.3f} (|err| <= 0.25)")
+
+    total_tokens = (auto_rep["tokens_generated"]
+                    + static_rep["tokens_generated"])
+    result = dict(
+        metric="fleet_sim_requests_per_sec",
+        value=round(simulated[0] / max(sim_wall[0], 1e-9), 1),
+        unit="requests/sec",
+        simulated_requests=simulated[0],
+        sim_wall_s=round(sim_wall[0], 3),
+        virtual_time_s=round(auto_rep["virtual_time_s"], 3),
+        autoscaler=auto_rep, static=static_rep,
+        autoscaler_vs_static=round(vs_static, 4),
+        slo_vs_replicas=curve,
+        slo=dict(ttft_s=slo.ttft_s, itl_s=slo.itl_s),
+        cost_model=dict(prefill_window_s=cm.prefill_window_s,
+                        decode_tick_s=cm.decode_tick_s,
+                        overhead_s=cm.overhead_s,
+                        provenance=cm.provenance),
+        total_tokens=total_tokens,
+        requests_main=n_main, requests_curve=n_curve)
+    if validation is not None:
+        result["validation"] = validation
+    return result
+
+
+def _fleet_sim_validate(cm_seed=0):
+    """The fleet_sim stub-validation leg: one small burst through a
+    real single-replica CPU ``serve.Engine`` fleet (still behind the
+    Router) and through the simulator with a cost model CALIBRATED
+    from two measured points (a decode tick at full batch, a
+    prefill-window tick) on that same engine.  One replica because the
+    comparison is wall-vs-virtual time: N real engines timeshare one
+    CPU (wall = sum of their work) while N sim replicas run in
+    parallel virtual time — single-replica makes the two clocks
+    commensurable.  Returns the sim/real ratios and asserts both
+    within 25%."""
+    import jax
+    import numpy as np
+    from distributed_tensorflow_tpu import fleet, serve
+    from distributed_tensorflow_tpu.analysis import graph as graph_lib
+    from distributed_tensorflow_tpu.fleet import sim as sim_lib
+    from distributed_tensorflow_tpu.fleet import workload
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+    from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+    import jax.numpy as jnp
+
+    # deliberately tiny: the contract under test is sim-vs-real on the
+    # SAME engine, not model scale
+    config = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                       num_heads=2, intermediate_size=256,
+                       max_position=128, dtype=jnp.float32,
+                       dropout_rate=0.0)
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    slots, chunk, ticks = 4, 16, 4
+    n_req, budget = 48, 10
+    rng = np.random.default_rng(cm_seed)
+    prompts = [rng.integers(0, config.vocab_size,
+                            int(rng.integers(3, 2 * chunk + 1)))
+               .astype(np.int32) for _ in range(n_req)]
+
+    def make_engine(reg):
+        return serve.Engine(model, params, num_slots=slots,
+                            max_len=128, prefill_chunk=chunk,
+                            tick_steps=ticks, registry=reg)
+
+    reg = metrics_lib.Registry()
+    engines = [make_engine(reg)]
+    router = fleet.Router(engines, registry=reg)
+    # warmup: compile every executable on both replicas
+    for _ in range(2):
+        router.submit(prompts[0], 2)
+        router.submit(rng.integers(0, config.vocab_size,
+                                   chunk + 3).astype(np.int32), 2)
+    router.drain()
+
+    # -- calibration: two measured points on engine 0 ------------------
+    eng = engines[0]
+    for _ in range(slots):                   # full decode batch
+        eng.submit(prompts[0][:4], 64)
+    while eng.stats().active < slots:        # admit + prefill everyone
+        eng.step()
+    # per-step MIN, not mean: each step is the same deterministic
+    # compute, so scheduler preemption on a shared core only ever adds
+    # time — the minimum is the clean sample
+    tick_samples = []
+    while eng.stats().active == slots and len(tick_samples) < 12:
+        t0 = time.perf_counter()
+        eng.step()
+        tick_samples.append(time.perf_counter() - t0)
+    measured_tick_s = min(tick_samples)
+    eng.drain()
+    # prefill point: one long prompt alone; the first step (admit +
+    # first window) is untimed, the remaining pure-window steps are
+    eng.submit(rng.integers(0, config.vocab_size,
+                            6 * chunk).astype(np.int32), 1)
+    eng.step()
+    window_samples = []
+    while eng.stats().prefilling and len(window_samples) < 12:
+        t0 = time.perf_counter()
+        eng.step()
+        window_samples.append(time.perf_counter() - t0)
+    measured_window_s = (min(window_samples) if window_samples
+                         else measured_tick_s)
+    eng.drain()
+    targets = {t.name: t for t in eng.scheduler.graph_targets()}
+    window_cost = graph_lib.target_cost(targets["prefill_window"])
+    tick_cost = graph_lib.target_cost(targets["decode_tick"])
+    cm = sim_lib.CostModel.calibrate(window_cost, tick_cost,
+                                     measured_window_s, measured_tick_s)
+
+    # -- real replay: the whole burst, wall-clock (min of 3 on both
+    # wall and ttft p50 to shed scheduler noise on a shared CI core) --
+    def real_replay():
+        hs = [router.submit(p, budget) for p in prompts]
+        t0 = time.perf_counter()
+        while router.busy:
+            router.step()
+        wall = time.perf_counter() - t0
+        assert all(h.status == "ok" for h in hs)
+        ttfts = sorted(h.ttft_s for h in hs)
+        return wall, ttfts[len(ttfts) // 2], hs
+    replays = [real_replay() for _ in range(3)]
+    real_wall = min(r[0] for r in replays)
+    real_ttft_p50 = min(r[1] for r in replays)
+    real_tokens = sum(len(h.tokens) for h in replays[0][2])
+    real_tps = real_tokens / real_wall
+
+    # -- sim replay: same burst shape, same engine geometry ------------
+    tr = workload.Trace(
+        arrival_s=np.zeros(n_req, dtype=np.float64),
+        plen=np.array([len(p) for p in prompts], dtype=np.int32),
+        new_tokens=np.full(n_req, budget, dtype=np.int32),
+        tenant=np.zeros(n_req, dtype=np.int16),
+        prefix_id=np.zeros(n_req, dtype=np.int32),
+        prefix_len=np.zeros(n_req, dtype=np.int32),
+        adapter=np.full(n_req, -1, dtype=np.int16),
+        tenants=(("default", 1.0),), events=(), horizon_s=0.0,
+        seed=cm_seed)
+    fs = sim_lib.FleetSim(
+        tr, cm, replicas=1,
+        engine=dict(num_slots=slots, prefill_chunk=chunk,
+                    tick_steps=ticks),
+        quantum_s=measured_tick_s, inflight_cap_per_replica=n_req,
+        seed=0)
+    sim_rep = fs.run()
+    sim_tps = sim_rep["tokens_generated"] / sim_rep["virtual_time_s"]
+    sim_ttft_p50 = sim_rep["ttft_p50_ms"] / 1e3
+
+    tps_ratio = sim_tps / real_tps
+    ttft_ratio = sim_ttft_p50 / real_ttft_p50
+    assert abs(tps_ratio - 1.0) <= 0.25, (
+        f"sim tokens/s off by {tps_ratio:.3f}x "
+        f"(sim {sim_tps:.1f} vs real {real_tps:.1f})")
+    assert abs(ttft_ratio - 1.0) <= 0.25, (
+        f"sim ttft p50 off by {ttft_ratio:.3f}x "
+        f"(sim {sim_ttft_p50*1e3:.1f} ms vs real "
+        f"{real_ttft_p50*1e3:.1f} ms)")
+    return dict(
+        requests=n_req,
+        measured_tick_s=round(measured_tick_s, 6),
+        measured_window_s=round(measured_window_s, 6),
+        calibrated=dict(prefill_window_s=round(cm.prefill_window_s, 6),
+                        decode_tick_s=round(cm.decode_tick_s, 6),
+                        overhead_s=round(cm.overhead_s, 6)),
+        real_tokens_per_sec=round(real_tps, 2),
+        sim_tokens_per_sec=round(sim_tps, 2),
+        tokens_per_sec_ratio=round(tps_ratio, 4),
+        real_ttft_p50_ms=round(real_ttft_p50 * 1e3, 3),
+        sim_ttft_p50_ms=round(sim_ttft_p50 * 1e3, 3),
+        ttft_p50_ratio=round(ttft_ratio, 4))
+
+
 def bench_gpt_moe():
     """The gpt row with a mixture-of-experts FFN (ops.moe top-2/8 capacity
     routing + aux load-balance loss) — the measured row for the MoE
@@ -2031,6 +2313,7 @@ CONFIGS = {
     "gpt_decode_spec": bench_gpt_decode_spec,
     "gpt_serve": bench_gpt_serve,
     "fleet": bench_fleet,
+    "fleet_sim": bench_fleet_sim,
     "recovery": bench_recovery,
 }
 

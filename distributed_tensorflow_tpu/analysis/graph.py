@@ -167,6 +167,17 @@ class Cost:
         """Arithmetic intensity (FLOPs/byte) — the roofline abscissa."""
         return self.flops / self.bytes if self.bytes else 0.0
 
+    def time_s(self, peak_flops: float, peak_bw: float,
+               overhead_s: float = 0.0) -> float:
+        """Roofline duration of one call on a hardware point: dispatch
+        overhead plus the slower of the compute and memory legs.  The
+        fleet simulator prices virtual ticks with this; bench's
+        calibration leg solves (peak_flops, overhead_s) from measured
+        wall times of two executables with known Costs."""
+        compute = self.flops / peak_flops if peak_flops > 0 else 0.0
+        memory = self.bytes / peak_bw if peak_bw > 0 else 0.0
+        return overhead_s + max(compute, memory)
+
 
 def _aval_bytes(aval) -> int:
     try:
@@ -416,6 +427,14 @@ def entry_cost(fn, *args, **kwargs) -> Cost:
     import jax
     closed = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(*args, **kwargs)
     return estimate_cost(closed)
+
+
+def target_cost(target: Target) -> Cost:
+    """Static Cost of one registered/constructed :class:`Target` — the
+    query API over the same abstract specs the DT4xx rules trace (e.g.
+    ``SlotScheduler.graph_targets()``), so callers price the REAL hot
+    executables, not hand-maintained shape math."""
+    return entry_cost(target.fn, *target.args, **target.kwargs)
 
 
 # ---------------------------------------------------------------- tracing

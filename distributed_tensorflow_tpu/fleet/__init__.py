@@ -21,18 +21,40 @@ them behind one façade (docs/SERVING.md §Fleet):
   and a deficit-weighted fair-share queue (`DeficitFairQueue`) that
   drops into the scheduler so one tenant's burst cannot starve others.
 
+* ``fleet.sim`` — the million-request FLEET SIMULATOR: ``SimEngine``
+  (a replica priced by the PR 10 graph-tier cost model instead of a
+  mesh — same ``EngineProtocol`` surface) and ``FleetSim`` (seeded
+  discrete-event driver on virtual time) run the REAL router /
+  watchdog / tenancy / faults stack at millions of requests per
+  wall-minute (docs/FLEET_SIM.md).
+* ``fleet.workload`` — seeded synthetic traces: diurnal + burst
+  arrivals, tenant mix, Zipf shared prefixes, adapter churn,
+  correlated-failure schedules.
+* ``fleet.autoscaler`` — ``Autoscaler``: the SLO-attainment scaling
+  policy (scale-out on missed attainment/backlog, migrate-based
+  scale-in, heal below the floor) that drives sim and real fleets
+  identically; ``dttpu_autoscaler_*`` metrics.
+
 LoRA adapter hot-swap rides the serve/model layers
 (``serve.AdapterTable``, ``GPT.init_lora``); ``Router.load_adapter``
 broadcasts an adapter to every replica.  Chaos coverage: the
 ``kill_replica`` fault (resilience.faults) drops a replica mid-traffic
-and the router migrates — measured by ``bench.py --config=fleet``.
+and the router migrates — measured by ``bench.py --config=fleet``;
+``correlated_kill`` drops K replicas inside one pump window —
+measured by ``bench.py --config=fleet_sim``.
 """
-from . import router, tenancy, watchdog
-from .router import FleetHandle, NoReplicaError, Router
+from . import autoscaler, router, sim, tenancy, watchdog, workload
+from .autoscaler import SLO, Autoscaler
+from .router import EngineProtocol, FleetHandle, NoReplicaError, Router
+from .sim import CostModel, FleetSim, HardwarePoint, SimEngine
 from .tenancy import (DeficitFairQueue, QuotaExceededError, TenantPolicy,
                       TenantQuota)
 from .watchdog import Watchdog
+from .workload import FleetEvent, Trace, synthesize
 
-__all__ = ["DeficitFairQueue", "FleetHandle", "NoReplicaError",
-           "QuotaExceededError", "Router", "TenantPolicy", "TenantQuota",
-           "Watchdog", "router", "tenancy", "watchdog"]
+__all__ = ["Autoscaler", "CostModel", "DeficitFairQueue",
+           "EngineProtocol", "FleetEvent", "FleetHandle", "FleetSim",
+           "HardwarePoint", "NoReplicaError", "QuotaExceededError",
+           "Router", "SLO", "SimEngine", "TenantPolicy", "TenantQuota",
+           "Trace", "Watchdog", "autoscaler", "router", "sim",
+           "synthesize", "tenancy", "watchdog", "workload"]

@@ -59,7 +59,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import (Callable, Dict, List, Optional, Protocol, Tuple,
+                    runtime_checkable)
 
 from ..obs import metrics as metrics_lib
 from ..resilience import faults as faults_lib
@@ -67,7 +68,7 @@ from ..serve.engine import (Engine, QueueFullError, RequestHandle,
                             RequestSnapshot)
 from .tenancy import QuotaExceededError
 
-__all__ = ["FleetHandle", "NoReplicaError", "Router"]
+__all__ = ["EngineProtocol", "FleetHandle", "NoReplicaError", "Router"]
 
 # submit errors that mean "THIS replica won't take it right now" — safe
 # to retry on another replica.  Anything else (validation, unknown
@@ -77,6 +78,40 @@ _REJECTIONS = (QueueFullError, QuotaExceededError)
 
 class NoReplicaError(RuntimeError):
     """No live replica can take this request (all dead or draining)."""
+
+
+@runtime_checkable
+class EngineProtocol(Protocol):
+    """What the router actually requires of a replica.
+
+    ``serve.Engine`` (a real mesh) and ``fleet.sim.SimEngine`` (the
+    virtual-time cost-model replica) both conform — pinned by
+    tests/test_fleet_sim.py — which is what lets one ``Router`` +
+    ``Watchdog`` + ``Autoscaler`` stack run unchanged against either
+    fleet.  ``add_replica`` enforces conformance with ``isinstance``
+    (structural: a runtime-checkable Protocol checks member presence,
+    not signatures), so a bogus replica fails loudly at registration
+    instead of at first pump."""
+
+    def submit(self, prompt, max_new_tokens=None, on_token=None,
+               **kwargs): ...
+
+    def stats(self): ...
+
+    def step(self) -> bool: ...
+
+    def drain(self, timeout_s=None) -> bool: ...
+
+    def cancel(self, handle) -> bool: ...
+
+    def export_request(self, handle, timeout_s=None): ...
+
+    def import_request(self, snapshot, on_token=None): ...
+
+    def load_adapter(self, adapter_id, adapter) -> None: ...
+
+    @property
+    def busy(self) -> bool: ...
 
 
 class FleetHandle:
@@ -242,6 +277,15 @@ class Router:
     # -------------------------------------------------------- replicas
 
     def add_replica(self, engine: Engine) -> int:
+        if not isinstance(engine, EngineProtocol):
+            missing = [m for m in ("submit", "stats", "step", "drain",
+                                   "cancel", "export_request",
+                                   "import_request", "load_adapter",
+                                   "busy")
+                       if not hasattr(engine, m)]
+            raise TypeError(
+                f"replica {type(engine).__name__} does not implement "
+                f"the router's EngineProtocol (missing: {missing})")
         with self._lock:
             rid = self._next_replica
             self._next_replica += 1

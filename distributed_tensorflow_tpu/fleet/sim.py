@@ -1,0 +1,947 @@
+"""Virtual-time fleet simulator: the REAL Router, synthetic engines.
+
+"Heavy traffic from millions of users" cannot be validated by replaying
+tens of requests through real engines — but every fleet policy we ship
+(least-loaded placement, fair-share admission, snapshot migration,
+watchdog quarantine, autoscaling) is HOST-side logic that never touches
+a device.  This module replays millions of :mod:`.workload` requests
+through the unmodified :class:`fleet.Router` at virtual-time speed:
+
+* :class:`SimEngine` implements the engine surface the router consumes
+  (``submit/stats/step/busy/cancel/export_request/import_request`` —
+  :data:`fleet.router.EngineProtocol`) with the serve scheduler's tick
+  shape — admit into free slots, one prefill window per prompt per
+  tick, ``tick_steps`` decode tokens per active slot per tick, last
+  prefill window fused with the first emitted token — but each tick
+  advances a per-engine VIRTUAL clock instead of running a device
+  program.  Tick durations come from a :class:`CostModel`.
+* :class:`CostModel` prices one prefill window and one decode tick in
+  seconds.  It can be built three ways: ``analytic`` (closed-form
+  transformer FLOPs, no JAX needed), ``from_targets`` (the PR 10 graph
+  tier: ``analysis.graph.target_cost`` over the REAL scheduler's
+  ``graph_targets()`` specs — prices the actual hot executables with
+  zero device work), or ``calibrate`` (solve an effective-FLOPs +
+  dispatch-overhead point from two measured wall times, then price any
+  shape through the same roofline — bench.py's validation leg).
+* :class:`FleetSim` is the discrete-event driver: it advances a shared
+  :class:`SimClock`, flushes trace arrivals into ``Router.submit``
+  (each request carries its TRUE arrival time, so queueing delay is
+  measured from arrival even when submits are batched), arms
+  ``correlated_kill`` faults on the active ``resilience.faults`` plan
+  as the trace schedule comes due, runs the real ``fleet.Watchdog``
+  against virtual heartbeats, and lets an ``autoscaler.Autoscaler``
+  add/drain replicas mid-run.  ``Router.step()`` stays the one pump:
+  a ``SimEngine`` ticks only when the shared clock has caught up to
+  its virtual clock, and catches up over multiple ticks in one pump
+  (placement/migration/sweep decisions between ticks are unchanged —
+  the router only intervenes at submits, failures, and scaling, all of
+  which happen between driver rounds).
+
+Deliberate modeling simplifications (documented in docs/FLEET_SIM.md):
+decode ticks cost the fixed-batch executable price regardless of how
+many slots are live (matching the real padded program), shared-prefix
+reuse is a per-engine seen-set over full chunks (no radix eviction),
+and token VALUES are not simulated (streams carry zeros; stream
+offsets, dedup, and counts are exact).
+
+Determinism: every decision derives from the seeded trace, the seeded
+fault plan, and the cost model — two runs of the same config produce
+bit-identical event logs, placements, and SLO numbers (pinned by
+tests/test_fleet_sim.py).
+"""
+from __future__ import annotations
+
+import collections
+import math
+from array import array
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..analysis import graph as graph_lib
+from ..obs import metrics as metrics_lib
+from ..resilience import faults as faults_lib
+from ..serve.engine import QueueFullError, RequestSnapshot
+from . import watchdog as watchdog_lib
+from .autoscaler import SLO, Autoscaler
+from .router import NoReplicaError, Router
+from .tenancy import TenantPolicy
+from .workload import Trace
+
+__all__ = ["CostModel", "FleetSim", "HardwarePoint", "SimClock",
+           "SimEngine", "SimMetrics"]
+
+_EPS = 1e-12
+
+
+# ------------------------------------------------------------ cost model
+
+
+class HardwarePoint:
+    """One roofline operating point: sustained FLOP/s, HBM bandwidth,
+    and per-dispatch host overhead.  The default is a mid-size
+    inference accelerator; ``CostModel.calibrate`` replaces it with a
+    measured point."""
+    __slots__ = ("peak_flops", "peak_bw", "overhead_s")
+
+    def __init__(self, peak_flops: float = 180e12,
+                 peak_bw: float = 820e9, overhead_s: float = 50e-6):
+        self.peak_flops = float(peak_flops)
+        self.peak_bw = float(peak_bw)
+        self.overhead_s = float(overhead_s)
+
+
+class CostModel:
+    """Virtual-seconds prices for the two serve-tier tick phases.
+
+    A tick costs ``overhead_s`` (host dispatch) + ``decode_tick_s``
+    (when any slot is decoding; the fixed-batch executable price —
+    batch occupancy does not change it, exactly like the real padded
+    program) + ``prefill_window_s`` per prefilling request (one window
+    each per tick)."""
+    __slots__ = ("prefill_window_s", "decode_tick_s", "overhead_s",
+                 "provenance")
+
+    def __init__(self, prefill_window_s: float, decode_tick_s: float,
+                 overhead_s: float = 50e-6,
+                 provenance: str = "explicit"):
+        if not prefill_window_s > 0 or not decode_tick_s > 0:
+            raise ValueError("phase costs must be positive")
+        if overhead_s < 0:
+            raise ValueError("overhead_s must be >= 0")
+        self.prefill_window_s = float(prefill_window_s)
+        self.decode_tick_s = float(decode_tick_s)
+        self.overhead_s = float(overhead_s)
+        self.provenance = provenance
+
+    def __repr__(self):
+        return (f"CostModel(window={self.prefill_window_s:.3e}s, "
+                f"tick={self.decode_tick_s:.3e}s, "
+                f"overhead={self.overhead_s:.3e}s, {self.provenance})")
+
+    @classmethod
+    def from_costs(cls, window: graph_lib.Cost, tick: graph_lib.Cost,
+                   hw: Optional[HardwarePoint] = None,
+                   provenance: str = "graph") -> "CostModel":
+        """Price two graph-tier :class:`analysis.graph.Cost`\\ s on a
+        roofline point."""
+        hw = hw or HardwarePoint()
+        return cls(window.time_s(hw.peak_flops, hw.peak_bw),
+                   tick.time_s(hw.peak_flops, hw.peak_bw),
+                   overhead_s=hw.overhead_s, provenance=provenance)
+
+    @classmethod
+    def from_targets(cls, targets, hw: Optional[HardwarePoint] = None
+                     ) -> "CostModel":
+        """Price the REAL scheduler's hot executables: ``targets`` is
+        ``SlotScheduler.graph_targets()`` (abstract specs; tracing via
+        ``analysis.graph.target_cost`` does no device work)."""
+        costs = {t.name: graph_lib.target_cost(t) for t in targets}
+        return cls.from_costs(costs["prefill_window"],
+                              costs["decode_tick"], hw,
+                              provenance="graph_targets")
+
+    @classmethod
+    def analytic(cls, *, n_params: float, prefill_chunk: int,
+                 num_slots: int, tick_steps: int,
+                 hw: Optional[HardwarePoint] = None,
+                 dtype_bytes: int = 4) -> "CostModel":
+        """Closed-form transformer price (2·P FLOPs per token, one
+        parameter read per pass) — no JAX import; the pure-sim default
+        for tests and the million-request bench legs."""
+        hw = hw or HardwarePoint()
+        window = graph_lib.Cost(
+            flops=2.0 * n_params * prefill_chunk,
+            bytes=n_params * dtype_bytes, peak_bytes=0.0)
+        tick = graph_lib.Cost(
+            flops=2.0 * n_params * num_slots * tick_steps,
+            bytes=n_params * dtype_bytes * tick_steps, peak_bytes=0.0)
+        return cls.from_costs(window, tick, hw, provenance="analytic")
+
+    @classmethod
+    def calibrate(cls, window: graph_lib.Cost, tick: graph_lib.Cost,
+                  measured_window_s: float, measured_tick_s: float
+                  ) -> "CostModel":
+        """Two-point calibration: solve ``t0 + flops/F_eff = T`` from
+        the measured wall times of the two executables whose static
+        Costs the graph tier provides, then price through the same
+        roofline.  The fit is REJECTED — falling back to the measured
+        times directly — when it cannot explain the measurements:
+        degenerate inputs (equal times, inverted order) or an implied
+        negative host overhead, which happens when the two executables'
+        flops are too close for their time difference to be a compute
+        effect (tiny CPU models: dispatch count, not flops, separates
+        them — a clamped t0 there silently inflates both prices)."""
+        df = tick.flops - window.flops
+        dt = measured_tick_s - measured_window_s
+        if df > 0 and dt > 0:
+            f_eff = df / dt
+            t0 = measured_window_s - window.flops / f_eff
+            if t0 >= 0:
+                return cls(t0 + window.flops / f_eff,
+                           t0 + tick.flops / f_eff,
+                           overhead_s=0.0, provenance="calibrated")
+        return cls(measured_window_s, measured_tick_s, overhead_s=0.0,
+                   provenance="measured")
+
+
+# ------------------------------------------------------------ sim engine
+
+
+class SimClock:
+    """The fleet's shared virtual clock (driver-owned)."""
+    __slots__ = ("now",)
+
+    def __init__(self, now: float = 0.0):
+        self.now = float(now)
+
+
+class _SimStats:
+    """Mutable, engine-owned stats snapshot with the attribute surface
+    the router/watchdog/autoscaler read from ``EngineStats``.  One
+    object per engine, updated in place — ``stats()`` at fleet-sim call
+    rates cannot afford a frozen dataclass per call."""
+    __slots__ = ("queued", "prefilling", "active", "num_slots",
+                 "inflight", "inflight_per_tenant",
+                 "tokens_inflight_per_tenant", "pages_total",
+                 "pages_free", "pages_per_request",
+                 "prefix_lookups_total", "prefix_hits_total",
+                 "prefix_tokens_reused_total", "ticks_started",
+                 "ticks_completed", "last_tick_start_s",
+                 "last_tick_end_s", "last_tick_duration_s")
+
+    def __init__(self, num_slots: int):
+        self.queued = 0
+        self.prefilling = 0
+        self.active = 0
+        self.num_slots = num_slots
+        self.inflight = 0
+        self.inflight_per_tenant: Dict[str, int] = {}
+        self.tokens_inflight_per_tenant: Dict[str, int] = {}
+        self.pages_total = 0
+        self.pages_free = 0
+        self.pages_per_request = 0.0
+        self.prefix_lookups_total = 0
+        self.prefix_hits_total = 0
+        self.prefix_tokens_reused_total = 0
+        self.ticks_started = 0
+        self.ticks_completed = 0
+        self.last_tick_start_s = 0.0
+        self.last_tick_end_s = 0.0
+        self.last_tick_duration_s = 0.0
+
+    @property
+    def free_slots(self) -> int:
+        return self.num_slots - self.prefilling - self.active
+
+
+class _SimRequest:
+    """One in-flight simulated request; doubles as its own engine
+    handle (``tokens/done/status/error/ttft_s`` — what ``FleetHandle``
+    reads)."""
+    __slots__ = ("rid", "prompt_ref", "plen", "context", "budget",
+                 "max_new_tokens", "tenant", "adapter_id", "prefix_id",
+                 "prefix_len", "on_token", "arrival_vt", "first_vt",
+                 "span_base", "span_start_vt", "emitted",
+                 "windows_left", "status", "error", "deadline_vt")
+
+    def __init__(self):
+        self.error: Optional[BaseException] = None
+        self.first_vt: Optional[float] = None
+        self.span_start_vt: Optional[float] = None
+        self.status = "pending"
+
+    @property
+    def done(self) -> bool:
+        return self.status != "pending"
+
+    @property
+    def tokens(self) -> List[int]:
+        # token VALUES are not simulated; counts/offsets are exact
+        return [0] * self.emitted
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_vt is None:
+            return None
+        return self.first_vt - self.arrival_vt
+
+
+class SimEngine:
+    """A virtual-time replica conforming to ``EngineProtocol``.
+
+    Prompts may be plain ints/sequences (length = token count) or the
+    fleet-sim tuple ``(plen, prefix_id, prefix_len, arrival_vt)`` —
+    carrying the TRUE arrival time through ``Router.submit`` and
+    ``RequestSnapshot.prompt`` keeps queueing delay and TTFT honest
+    across batched submits and migrations."""
+
+    def __init__(self, cost_model: CostModel, *, num_slots: int = 8,
+                 prefill_chunk: int = 32, tick_steps: int = 8,
+                 policy: Optional[TenantPolicy] = None,
+                 clock: Optional[SimClock] = None,
+                 metrics: Optional["SimMetrics"] = None,
+                 max_queue_depth: Optional[int] = None,
+                 default_max_new_tokens: int = 16):
+        self.cost = cost_model
+        self.num_slots = int(num_slots)
+        self.prefill_chunk = int(prefill_chunk)
+        self.tick_steps = int(tick_steps)
+        self.policy = policy
+        self.clock = clock
+        self.metrics = metrics
+        self.max_queue_depth = max_queue_depth
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.vt = clock.now if clock is not None else 0.0
+        # how far past clock.now one step() may pre-run: the fleet
+        # driver sets this to its round quantum so a busy engine
+        # simulates the whole upcoming window tick-exactly instead of
+        # one tick per round (admission is quantised anyway)
+        self.lookahead_s = 0.0
+        self.chaos_tag = 0
+        self._queue = (policy.make_queue() if policy is not None
+                       else collections.deque())
+        self._prefilling: List[_SimRequest] = []
+        self._active: List[_SimRequest] = []
+        self._stats = _SimStats(self.num_slots)
+        self._prefix_seen: set = set()
+        self._adapters: set = set()
+        self._next_rid = 0
+        self._wedged_until: Optional[float] = None
+        # shared zero-token payloads, one per emission size (stream
+        # shims only slice them)
+        self._zeros = [[0] * k for k in range(self.tick_steps + 1)]
+
+    # ------------------------------------------------------ intake
+
+    def _parse_prompt(self, prompt) -> Tuple[int, int, int, float]:
+        if type(prompt) is tuple:
+            return prompt
+        now = self.clock.now if self.clock is not None else self.vt
+        if isinstance(prompt, (int, np.integer)):
+            return int(prompt), 0, 0, now
+        return len(prompt), 0, 0, now
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               on_token: Optional[Callable] = None,
+               deadline_s: Optional[float] = None,
+               tenant: str = "default",
+               adapter_id: Optional[str] = None) -> _SimRequest:
+        plen, prefix_id, prefix_len, arrival = self._parse_prompt(prompt)
+        budget = (self.default_max_new_tokens if max_new_tokens is None
+                  else int(max_new_tokens))
+        if budget < 1:
+            raise ValueError(f"max_new_tokens must be >= 1; got {budget}")
+        st = self._stats
+        if self.max_queue_depth is not None \
+                and st.queued >= self.max_queue_depth:
+            raise QueueFullError(
+                f"sim queue full ({st.queued}/{self.max_queue_depth})")
+        if self.policy is not None:
+            self.policy.check_admission(
+                tenant, budget,
+                inflight=st.inflight_per_tenant.get(tenant, 0),
+                tokens_inflight=st.tokens_inflight_per_tenant.get(
+                    tenant, 0))
+        r = _SimRequest()
+        r.rid = self._next_rid
+        self._next_rid += 1
+        r.prompt_ref = prompt
+        r.plen = plen
+        r.context = plen
+        r.budget = budget
+        r.max_new_tokens = budget        # DeficitFairQueue's cost field
+        r.tenant = tenant
+        r.adapter_id = adapter_id
+        r.prefix_id = prefix_id
+        r.prefix_len = prefix_len
+        r.on_token = on_token
+        r.arrival_vt = arrival
+        r.span_base = 0
+        r.emitted = 0
+        r.windows_left = 0
+        now = self.clock.now if self.clock is not None else self.vt
+        r.deadline_vt = None if deadline_s is None else now + deadline_s
+        self._queue.append(r)
+        st.queued += 1
+        st.inflight += 1
+        t = st.inflight_per_tenant
+        t[tenant] = t.get(tenant, 0) + 1
+        t = st.tokens_inflight_per_tenant
+        t[tenant] = t.get(tenant, 0) + budget
+        return r
+
+    def import_request(self, snap: RequestSnapshot,
+                       on_token: Optional[Callable] = None
+                       ) -> _SimRequest:
+        """Re-admit a migrated request: its full context (prompt +
+        generated-so-far) is re-prefilled, then decode resumes at the
+        remaining budget — the serve-tier import semantics."""
+        resumed = int(snap.stream_offset)
+        r = self.submit(snap.prompt, snap.max_new_tokens,
+                        on_token=on_token, tenant=snap.tenant,
+                        adapter_id=snap.adapter_id,
+                        deadline_s=snap.deadline_remaining_s)
+        r.emitted = resumed
+        r.span_base = resumed
+        r.context = r.plen + resumed
+        if resumed > 0:
+            # the caller saw the stream start on the source replica
+            r.first_vt = r.arrival_vt
+        return r
+
+    def export_request(self, handle: _SimRequest,
+                       timeout_s: Optional[float] = None
+                       ) -> RequestSnapshot:
+        r = handle
+        if r.status != "pending":
+            raise RuntimeError(f"request {r.rid} is terminal "
+                               f"({r.status}); nothing to export")
+        self._forget(r)
+        r.status = "exported"
+        return RequestSnapshot(
+            rid=r.rid, prompt=r.prompt_ref,
+            generated=[0] * r.emitted, max_new_tokens=r.budget,
+            stream_offset=r.emitted, tenant=r.tenant,
+            adapter_id=r.adapter_id, deadline_remaining_s=None,
+            sampling=None, clean=self._wedged_until is None)
+
+    def export_inflight(self, timeout_s: Optional[float] = None
+                        ) -> List[RequestSnapshot]:
+        pending = (list(self._queue) + list(self._prefilling)
+                   + list(self._active))
+        return [self.export_request(r, timeout_s=timeout_s)
+                for r in pending]
+
+    def cancel(self, handle: _SimRequest) -> bool:
+        if handle.status != "pending":
+            return False
+        self._forget(handle)
+        handle.status = "cancelled"
+        if self.metrics is not None:
+            self.metrics.cancelled += 1
+        return True
+
+    def _forget(self, r: _SimRequest) -> None:
+        """Remove a pending request from whichever stage holds it and
+        settle the counters (export/cancel path)."""
+        st = self._stats
+        if r in self._active:
+            self._active.remove(r)
+            st.active -= 1
+        elif r in self._prefilling:
+            self._prefilling.remove(r)
+            st.prefilling -= 1
+        else:
+            self._queue.remove(r)
+            st.queued -= 1
+        st.inflight -= 1
+        t = st.inflight_per_tenant
+        t[r.tenant] = t.get(r.tenant, 1) - 1
+        t = st.tokens_inflight_per_tenant
+        t[r.tenant] = t.get(r.tenant, r.budget) - r.budget
+
+    def load_adapter(self, adapter_id: str, adapter: Any = None) -> None:
+        self._adapters.add(adapter_id)
+
+    def stats(self) -> _SimStats:
+        return self._stats
+
+    # ------------------------------------------------------- pump
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue or self._prefilling or self._active
+                    or self._wedged_until is not None)
+
+    def wedge(self, until_vt: float) -> None:
+        """Model a stuck pump: a tick starts and never completes until
+        ``until_vt`` — the stuck-but-alive heartbeat shape the real
+        ``Watchdog`` quarantines (virtual ``now`` in, same verdict
+        logic)."""
+        st = self._stats
+        st.ticks_started += 1
+        st.last_tick_start_s = max(
+            self.vt, self.clock.now if self.clock is not None else self.vt)
+        self._wedged_until = float(until_vt)
+
+    def step(self) -> bool:
+        clock = self.clock
+        if self._wedged_until is not None:
+            now = clock.now if clock is not None else self._wedged_until
+            if now + _EPS < self._wedged_until:
+                return False
+            st = self._stats
+            end = self._wedged_until
+            self._wedged_until = None
+            st.ticks_completed += 1
+            st.last_tick_end_s = end
+            st.last_tick_duration_s = end - st.last_tick_start_s
+            self.vt = max(self.vt, end)
+        if clock is None:
+            if not (self._queue or self._prefilling or self._active):
+                return False
+            self._tick_once()
+            return True
+        did = False
+        horizon = clock.now + self.lookahead_s + _EPS
+        # catch up: the router only intervenes between driver rounds
+        # (submits, faults, scaling), so consecutive ticks commute
+        while (self._queue or self._prefilling or self._active) \
+                and self.vt <= horizon:
+            self._tick_once()
+            did = True
+        return did
+
+    def _tick_once(self) -> None:
+        st = self._stats
+        cm = self.cost
+        clock = self.clock
+        t0 = self.vt
+        if clock is not None and clock.now > t0:
+            t0 = clock.now
+        dur = cm.overhead_s
+        active = self._active
+        prefilling = self._prefilling
+        queue = self._queue
+        chunk = self.prefill_chunk
+        if active:
+            dur += cm.decode_tick_s
+        # admit from the (fair-share) queue into free slots
+        free = self.num_slots - len(active) - len(prefilling)
+        while free > 0 and len(queue):
+            r = queue.popleft()
+            free -= 1
+            reused = 0
+            if r.prefix_id:
+                st.prefix_lookups_total += 1
+                if r.prefix_id in self._prefix_seen:
+                    st.prefix_hits_total += 1
+                    reused = min(r.prefix_len - (r.prefix_len % chunk),
+                                 r.context - 1)
+                    st.prefix_tokens_reused_total += reused
+                else:
+                    self._prefix_seen.add(r.prefix_id)
+            need = r.context - reused
+            r.windows_left = (need + chunk - 1) // chunk if need > 0 else 1
+            prefilling.append(r)
+            st.queued -= 1
+            st.prefilling += 1
+        dur += len(prefilling) * cm.prefill_window_s
+        t1 = t0 + dur
+        self.vt = t1
+        metrics = self.metrics
+        # decode: every slot active at tick start emits up to
+        # tick_steps tokens at tick end
+        if active:
+            tick_steps = self.tick_steps
+            zeros = self._zeros
+            still: List[_SimRequest] = []
+            for r in active:
+                k = r.budget - r.emitted
+                if k > tick_steps:
+                    k = tick_steps
+                r.emitted += k
+                cb = r.on_token
+                if cb is not None:
+                    cb(zeros[k])
+                if r.emitted >= r.budget:
+                    self._retire(r, t1, "ok")
+                elif r.deadline_vt is not None and t1 > r.deadline_vt:
+                    self._retire(r, t1, "deadline_exceeded")
+                else:
+                    still.append(r)
+            self._active = active = still
+            st.active = len(still)
+        # prefill: one window each; the last window is fused with the
+        # first emitted token (the serve scheduler's admit executable)
+        if prefilling:
+            still_p: List[_SimRequest] = []
+            for r in prefilling:
+                r.windows_left -= 1
+                if r.windows_left > 0:
+                    still_p.append(r)
+                    continue
+                r.emitted += 1
+                r.span_start_vt = t1
+                if r.first_vt is None:
+                    r.first_vt = t1
+                    if metrics is not None:
+                        metrics.record_ttft(t1 - r.arrival_vt)
+                cb = r.on_token
+                if cb is not None:
+                    cb(self._zeros[1])
+                if r.emitted >= r.budget:
+                    self._retire(r, t1, "ok", in_prefill=True)
+                elif r.deadline_vt is not None and t1 > r.deadline_vt:
+                    self._retire(r, t1, "deadline_exceeded",
+                                 in_prefill=True)
+                else:
+                    active.append(r)
+                    st.active += 1
+                    st.prefilling -= 1
+            self._prefilling = still_p
+        st.ticks_started += 1
+        st.ticks_completed += 1
+        st.last_tick_start_s = t0
+        st.last_tick_end_s = t1
+        st.last_tick_duration_s = dur
+
+    def _retire(self, r: _SimRequest, now_vt: float, status: str,
+                in_prefill: bool = False) -> None:
+        st = self._stats
+        if in_prefill:
+            st.prefilling -= 1
+        # active-list membership is settled by the caller's rebuild
+        st.inflight -= 1
+        t = st.inflight_per_tenant
+        t[r.tenant] = t.get(r.tenant, 1) - 1
+        t = st.tokens_inflight_per_tenant
+        t[r.tenant] = t.get(r.tenant, r.budget) - r.budget
+        r.status = status
+        release = getattr(self._queue, "release", None)
+        if release is not None:
+            release(r)
+        if self.metrics is not None:
+            self.metrics.record_retire(r, now_vt, status)
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Standalone pump-to-empty (protocol surface; the fleet driver
+        drains through the router instead)."""
+        steps = 0
+        limit = None if timeout_s is None else max(
+            1, int(timeout_s * 1e6))
+        while self._queue or self._prefilling or self._active:
+            self._tick_once()
+            steps += 1
+            if limit is not None and steps >= limit:
+                return False
+        return True
+
+
+# --------------------------------------------------------- SLO metrics
+
+
+class SimMetrics:
+    """Streaming SLO collector shared by every SimEngine of a run.
+
+    TTFT is recorded once per request at its first emitted token
+    (measured from TRUE arrival, surviving migration via the prompt
+    tuple); the inter-token metric is the per-request mean gap (TPOT)
+    over its final decode span, recorded at retirement.  Attainment
+    counters update incrementally so the autoscaler's sliding window
+    needs no array scans."""
+
+    def __init__(self, slo: Optional[SLO] = None):
+        self.slo = slo
+        self.ttft = array("d")
+        self.tpot = array("d")
+        self.completed = 0
+        self.deadline_exceeded = 0
+        self.cancelled = 0
+        self.tokens_out = 0
+        self.ttft_ok = 0
+        self.itl_ok = 0
+        self.itl_n = 0
+        self.per_tenant: Dict[str, int] = {}
+        self.autoscaler: Optional[Autoscaler] = None
+
+    @property
+    def finished(self) -> int:
+        return self.completed + self.deadline_exceeded
+
+    def record_ttft(self, v: float) -> None:
+        self.ttft.append(v)
+        ok = self.slo is None or v <= self.slo.ttft_s
+        if ok:
+            self.ttft_ok += 1
+        a = self.autoscaler
+        if a is not None:
+            a.record(ttft_ok=ok)
+
+    def record_retire(self, r: _SimRequest, now_vt: float,
+                      status: str) -> None:
+        if status != "ok":
+            self.deadline_exceeded += 1
+            return
+        self.completed += 1
+        span = r.emitted - r.span_base
+        self.tokens_out += span
+        t = self.per_tenant
+        t[r.tenant] = t.get(r.tenant, 0) + 1
+        if span > 1 and r.span_start_vt is not None:
+            tpot = (now_vt - r.span_start_vt) / (span - 1)
+        else:
+            tpot = 0.0
+        self.tpot.append(tpot)
+        self.itl_n += 1
+        ok = True
+        if self.slo is not None:
+            ok = tpot <= self.slo.itl_s
+        if ok:
+            self.itl_ok += 1
+        a = self.autoscaler
+        if a is not None:
+            a.record(itl_ok=ok)
+
+    # ------------------------------------------------------- report
+
+    def _pct(self, arr: array, q: float) -> float:
+        if not len(arr):
+            return 0.0
+        return float(np.percentile(np.frombuffer(arr, dtype=np.float64),
+                                   q))
+
+    def report(self) -> Dict[str, Any]:
+        n_ttft = len(self.ttft)
+        att_ttft = self.ttft_ok / n_ttft if n_ttft else 1.0
+        att_itl = self.itl_ok / self.itl_n if self.itl_n else 1.0
+        return {
+            "completed": self.completed,
+            "deadline_exceeded": self.deadline_exceeded,
+            "cancelled": self.cancelled,
+            "tokens_generated": self.tokens_out,
+            "ttft_p50_ms": round(self._pct(self.ttft, 50) * 1e3, 4),
+            "ttft_p99_ms": round(self._pct(self.ttft, 99) * 1e3, 4),
+            "itl_p99_ms": round(self._pct(self.tpot, 99) * 1e3, 4),
+            "attainment_ttft": round(att_ttft, 6),
+            "attainment_itl": round(att_itl, 6),
+            "slo_attainment": round(min(att_ttft, att_itl), 6),
+        }
+
+
+# ------------------------------------------------------------ the driver
+
+
+class FleetSim:
+    """Discrete-event driver: a seeded :class:`workload.Trace` through
+    the real :class:`fleet.Router` on virtual time (module docstring).
+
+    ``autoscaler=`` takes a kwargs dict for :class:`Autoscaler` (built
+    against this run's router/factory/SLO); ``watchdog=`` a kwargs dict
+    for the real :class:`fleet.Watchdog` (checked on virtual time).
+    ``inflight_cap`` bounds the router-side backlog: arrivals past the
+    cap wait in the driver with their TRUE arrival time intact, so the
+    queueing delay still lands in TTFT while ``Router._sweep`` stays
+    affordable at millions of requests.
+
+    The driver advances in ``quantum_s`` virtual-second rounds — the
+    router pumps once per round while each engine ticks internally to
+    exact sub-quantum times, so retire/TTFT timestamps are tick-exact
+    and only ADMISSION is quantised: a request can sit in the driver up
+    to one quantum past its true arrival, adding at most ``quantum_s``
+    of apparent queueing to its TTFT.  Shrink ``quantum_s`` when that
+    bias matters more than wall-clock speed."""
+
+    def __init__(self, trace: Trace, cost_model: CostModel, *,
+                 replicas: int = 2, slo: Optional[SLO] = None,
+                 engine: Optional[Dict[str, Any]] = None,
+                 policy: Optional[TenantPolicy] = None,
+                 autoscaler: Optional[Dict[str, Any]] = None,
+                 watchdog: Optional[Dict[str, Any]] = None,
+                 registry: Optional[metrics_lib.Registry] = None,
+                 quantum_s: float = 0.05,
+                 inflight_cap_per_replica: Optional[int] = None,
+                 seed: int = 0):
+        self.trace = trace
+        self.cost_model = cost_model
+        self.slo = slo or SLO()
+        self.engine_kwargs = dict(engine or {})
+        self.policy = policy
+        self.registry = (registry if registry is not None
+                         else metrics_lib.Registry())
+        self.quantum_s = float(quantum_s)
+        self.clock = SimClock(0.0)
+        self.metrics = SimMetrics(self.slo)
+        self.router = Router(registry=self.registry)
+        self.event_log: List[tuple] = []
+        self._rng = np.random.default_rng(seed)
+        self._engines: List[SimEngine] = []
+        slots = int(self.engine_kwargs.get("num_slots", 8))
+        cap = (inflight_cap_per_replica if inflight_cap_per_replica
+               is not None else 8 * slots)
+        self.inflight_cap_per_replica = int(cap)
+        for _ in range(int(replicas)):
+            self.router.add_replica(self.make_engine())
+        self.autoscaler: Optional[Autoscaler] = None
+        if autoscaler is not None:
+            self.autoscaler = Autoscaler(
+                self.router, self.make_engine, self.slo,
+                registry=self.registry, **autoscaler)
+            self.metrics.autoscaler = self.autoscaler
+        self.watchdog = None
+        self._wd_interval = math.inf
+        if watchdog is not None:
+            kw = dict(watchdog)
+            self._wd_interval = kw.pop(
+                "check_interval_s", kw.get("tick_deadline_s", 5.0) / 2)
+            self.watchdog = watchdog_lib.Watchdog(
+                self.router, registry=self.registry, **kw)
+        self.replica_seconds = 0.0
+
+    def make_engine(self) -> SimEngine:
+        eng = SimEngine(self.cost_model, policy=self.policy,
+                        clock=self.clock, metrics=self.metrics,
+                        **self.engine_kwargs)
+        eng.vt = self.clock.now
+        eng.lookahead_s = self.quantum_s
+        self._engines.append(eng)
+        return eng
+
+    # ------------------------------------------------------------- run
+
+    def run(self, max_rounds: Optional[int] = None) -> Dict[str, Any]:
+        trace = self.trace
+        clock = self.clock
+        router = self.router
+        metrics = self.metrics
+        auto = self.autoscaler
+        n = len(trace)
+        arrivals = trace.arrival_s.tolist()
+        plens = trace.plen.tolist()
+        budgets = trace.new_tokens.tolist()
+        prefix_ids = trace.prefix_id.tolist()
+        prefix_lens = trace.prefix_len.tolist()
+        names = [name for name, _ in trace.tenants]
+        tenant_of = [names[t] for t in trace.tenant.tolist()]
+        ad_label = {-1: None}
+        adapter_of = [ad_label.setdefault(a, f"ad{a}")
+                      for a in trace.adapter.tolist()]
+        events = list(trace.events)
+        submit = router.submit
+        plan = faults_lib.FaultPlan([], seed=trace.seed,
+                                    registry=self.registry)
+        next_eval = (auto.eval_interval_s if auto is not None
+                     else math.inf)
+        next_wd = self._wd_interval
+        quantum = self.quantum_s
+        kills = quarantines = 0
+        i = 0
+        rounds = 0
+        lost = 0
+        log = self.event_log
+        cap_per = self.inflight_cap_per_replica
+        with faults_lib.activated(plan):
+            while True:
+                inflight = i - metrics.finished - metrics.cancelled \
+                    - lost
+                if i >= n and inflight <= 0:
+                    break
+                rounds += 1
+                if max_rounds is not None and rounds > max_rounds:
+                    log.append(("aborted", round(clock.now, 9), rounds))
+                    break
+                rids = router.replica_ids
+                if not rids and auto is None:
+                    # dead fleet with nothing to heal it: everything
+                    # still outstanding is lost
+                    lost += (n - i) + inflight
+                    log.append(("dead_fleet", round(clock.now, 9),
+                                n - i, inflight))
+                    break
+                cap_total = cap_per * max(1, len(rids))
+                # --- next interesting virtual instant: one quantum
+                # ahead, clipped by due events and the policy cadences.
+                # Engines tick internally to exact sub-quantum times,
+                # so arrivals/wedge releases only need quantum-level
+                # granularity (admission quantisation, class docstring).
+                t_next = clock.now + quantum
+                if events and events[0].at_s < t_next:
+                    t_next = events[0].at_s
+                if next_eval < t_next:
+                    t_next = next_eval
+                if next_wd < t_next:
+                    t_next = next_wd
+                if t_next > clock.now:
+                    live = len(rids)
+                    dt = t_next - clock.now
+                    self.replica_seconds += dt * live
+                    if auto is not None:
+                        auto.charge(dt, live)
+                    clock.now = t_next
+                now = clock.now
+                # --- flush due arrivals (true arrival time rides the
+                # prompt tuple), up to the backlog cap
+                while i < n and rids and arrivals[i] <= now \
+                        and inflight < cap_total:
+                    try:
+                        submit((plens[i], prefix_ids[i], prefix_lens[i],
+                                arrivals[i]),
+                               budgets[i], tenant=tenant_of[i],
+                               adapter_id=adapter_of[i])
+                    except NoReplicaError:
+                        if auto is not None:
+                            # defer: the autoscaler heals the fleet at
+                            # its next evaluation; arrival time rides
+                            # the prompt tuple so TTFT stays honest.
+                            break
+                        lost += 1
+                        log.append(("rejected", round(now, 9), i))
+                        i += 1
+                        continue
+                    i += 1
+                    inflight += 1
+                # --- due fleet events -> the shared fault vocabulary
+                while events and events[0].at_s <= now:
+                    ev = events.pop(0)
+                    if ev.kind == "correlated_kill":
+                        plan.add(faults_lib.Fault(
+                            kind="correlated_kill",
+                            at=plan.global_pump_index, k=ev.k,
+                            window=ev.window))
+                        kills += 1
+                        log.append(("correlated_kill",
+                                    round(now, 9), ev.k, ev.window))
+                    elif ev.kind == "wedge_replica":
+                        live_rids = router.replica_ids
+                        if live_rids:
+                            victim = int(self._rng.choice(
+                                sorted(live_rids)))
+                            router.replica(victim).wedge(
+                                now + ev.seconds)
+                            log.append(("wedge", round(now, 9), victim))
+                # --- autoscaler / watchdog on virtual time
+                if auto is not None and now + _EPS >= next_eval:
+                    action = auto.evaluate(now)
+                    next_eval = now + auto.eval_interval_s
+                    if action is not None:
+                        log.append((action[0], round(now, 9),
+                                    action[1]))
+                if self.watchdog is not None and now + _EPS >= next_wd:
+                    pulled = self.watchdog.check(now=now)
+                    next_wd = now + self._wd_interval
+                    for rid, reason in (pulled or ()):
+                        quarantines += 1
+                        log.append(("quarantine", round(now, 9),
+                                    rid, reason))
+                # --- the one real pump
+                router.step()
+                inflight = i - metrics.finished - metrics.cancelled \
+                    - lost
+                if i >= n and inflight > 0 and not router.busy:
+                    # requests that went fleet-terminal without retiring
+                    # on an engine (failed past the retry budget)
+                    lost += inflight
+                    log.append(("lost", round(now, 9), inflight))
+        rep = metrics.report()
+        rep.update({
+            "simulated_requests": i,
+            "virtual_time_s": round(clock.now, 6),
+            "driver_rounds": rounds,
+            "replicas_final": len(router.replica_ids),
+            "replica_seconds": round(self.replica_seconds, 6),
+            "migrations": int(self.registry.get(
+                "dttpu_migrations_total").value),
+            "correlated_kills_armed": kills,
+            "quarantines": quarantines,
+            "lost": lost,
+            "events": len(log),
+        })
+        if self.replica_seconds > 0:
+            rep["attainment_per_kilo_replica_second"] = round(
+                rep["slo_attainment"]
+                / (self.replica_seconds / 1e3), 6)
+        if auto is not None:
+            rep["scale_outs"] = auto.scale_outs
+            rep["scale_ins"] = auto.scale_ins
+        return rep

@@ -50,6 +50,18 @@ wedge_replica       block the serve scheduler's pump at its ``at``-th
                     a stuck-but-alive replica: the pump holds its mutex
                     mid-tick, which only the watchdog's in-progress
                     heartbeat check can see
+correlated_kill     kill ``k`` replicas within a window of ``window``
+                    router pumps starting at the ``at``-th pump counted
+                    across ALL replicas: when the window opens, the
+                    plan's seeded generator picks the victims among the
+                    replicas it has seen pumped so far, and each victim
+                    raises ``ConnectionError`` on its next pump inside
+                    the window (a victim never pumped in the window
+                    escapes — failure domains, not a guaranteed body
+                    count).  The fleet simulator and the real chaos
+                    tests schedule rack/PSU-style correlated failures
+                    through this one kind (``times`` is ignored; ``k``
+                    governs)
 ==================  =========================================================
 
 Every injection is auditable: it lands in ``plan.log``, increments the
@@ -85,7 +97,7 @@ __all__ = ["Fault", "FaultPlan", "InjectedFault", "KINDS", "activate",
 
 KINDS = ("corrupt_checkpoint", "save_oserror", "poison_batch",
          "nan_grads", "kill_prefetch", "fail_decode", "kill_replica",
-         "stall_tick", "wedge_replica")
+         "stall_tick", "wedge_replica", "correlated_kill")
 
 
 class InjectedFault(RuntimeError):
@@ -111,6 +123,11 @@ class Fault:
     #                                 wedge_replica: max block before the
     #                                 wedge self-releases
     times: int = 1                  # max fires
+    k: int = 2                      # correlated_kill: victim count
+    window: int = 8                 # correlated_kill: pump window length
+    victims: tuple = ()             # correlated_kill: chosen at window
+    #                                 open by the plan's seeded rng (audit
+    #                                 trail; leave empty when scheduling)
     fired: int = 0
 
     def __post_init__(self):
@@ -132,6 +149,8 @@ class FaultPlan:
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._wedges: Dict[int, threading.Event] = {}
+        self._seen_replicas: set = set()
+        self._corr_killed: Dict[int, set] = {}   # id(fault) -> victims hit
         self.log: List[Dict[str, Any]] = []
         reg = registry if registry is not None else metrics_lib.REGISTRY
         self._injected = reg.counter(
@@ -139,6 +158,22 @@ class FaultPlan:
             "Faults injected by the resilience chaos harness.")
 
     # ----------------------------------------------------------- matching
+
+    def add(self, fault: Fault) -> Fault:
+        """Arm one more fault on a live plan — the fleet simulator
+        translates trace-scheduled incidents into plan faults as their
+        virtual time comes due."""
+        with self._lock:
+            self.faults.append(fault)
+        return fault
+
+    @property
+    def global_pump_index(self) -> int:
+        """The NEXT router-pump index across all replicas (what a
+        ``correlated_kill`` scheduled with ``at=`` this value matches
+        on the very next pump)."""
+        with self._lock:
+            return self._counters.get("replica:*", 0)
 
     def _tick(self, site: str) -> int:
         """0-based per-site call counter (thread-safe; the prefetch
@@ -278,6 +313,40 @@ class FaultPlan:
             self._record(f, replica=int(replica), step=i)
             raise ConnectionError(
                 f"injected fault: replica {replica} killed at pump #{i}")
+        f = self._match_correlated(int(replica))
+        if f is not None:
+            self._record(f, replica=int(replica), step=i,
+                         victims=f.victims)
+            raise ConnectionError(
+                f"injected fault: replica {replica} killed by correlated "
+                f"failure (victims {f.victims})")
+
+    def _match_correlated(self, replica: int) -> Optional[Fault]:
+        """correlated_kill matching: a *global* pump counter (across all
+        replicas) opens the window at ``at``; victims are drawn once,
+        seeded, from the replicas seen pumped so far; each victim dies on
+        its first pump inside ``[at, at + window)``."""
+        with self._lock:
+            self._seen_replicas.add(replica)
+            j = self._counters.get("replica:*", 0)
+            self._counters["replica:*"] = j + 1
+            for f in self.faults:
+                if f.kind != "correlated_kill" or f.fired >= f.k:
+                    continue
+                if j < f.at or j >= f.at + f.window:
+                    continue
+                if not f.victims:
+                    pool = sorted(self._seen_replicas)
+                    size = min(f.k, len(pool))
+                    f.victims = tuple(
+                        int(x) for x in self._rng.choice(
+                            pool, size=size, replace=False))
+                killed = self._corr_killed.setdefault(id(f), set())
+                if replica in f.victims and replica not in killed:
+                    killed.add(replica)
+                    f.fired += 1
+                    return f
+        return None
 
 
 def _poison(tree: Any) -> Any:

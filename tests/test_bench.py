@@ -610,6 +610,65 @@ class TestGptLong:
         assert 0 < r["tokens_preserved_ratio"] <= 1.0
         assert r["migrations"] >= 1
 
+    def test_fleet_sim_smoke_schema(self):
+        """Fleet-simulator row (docs/FLEET_SIM.md): the seeded
+        diurnal+burst trace with two scheduled correlated kills through
+        the REAL router on virtual time, autoscaler-vs-static scored as
+        attainment per replica-second, the SLO-vs-replicas capacity
+        curve, and the stub-validation leg (sim within 25% of a real
+        serve.Engine replay, asserted in-process)."""
+        proc = _run(["--config=fleet_sim", "--device=cpu"], _env())
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        assert len(lines) == 1
+        r = json.loads(lines[0])
+        assert r["metric"] == "fleet_sim_requests_per_sec"
+        assert r["value"] > 0
+        assert r["simulated_requests"] == (2 * r["requests_main"]
+                                           + 4 * r["requests_curve"])
+        assert r["sim_wall_s"] < 60.0
+        # every leg accounts for every request, and the chaos events
+        # actually fired
+        for leg in (r["autoscaler"], r["static"]):
+            assert (leg["completed"] + leg["deadline_exceeded"]
+                    + leg["lost"] == r["requests_main"])
+            assert leg["correlated_kills_armed"] == 2
+            assert 0 < leg["slo_attainment"] <= 1.0
+        assert r["autoscaler"]["scale_outs"] >= 1
+        # the acceptance bar: the SLO policy buys attainment with
+        # capacity at the right moments — never worse per replica-second
+        # than always-on peak provisioning
+        assert r["autoscaler_vs_static"] >= 1.0
+        curve = r["slo_vs_replicas"]
+        assert set(curve) == {"2", "3", "4", "6"}
+        for c in curve.values():
+            assert 0 < c["slo_attainment"] <= 1.0
+            assert c["ttft_p99_ms"] > 0
+        assert (curve["6"]["slo_attainment"]
+                >= curve["2"]["slo_attainment"])
+        assert r["cost_model"]["provenance"] == "analytic"
+        v = r["validation"]
+        assert abs(v["tokens_per_sec_ratio"] - 1.0) <= 0.25
+        assert abs(v["ttft_p50_ratio"] - 1.0) <= 0.25
+        assert v["calibrated"]["decode_tick_s"] > 0
+        assert r.get("retrace_warnings", 0) == 0
+
+    @pytest.mark.slow
+    def test_fleet_sim_full_scale_acceptance(self):
+        """The headline claim at FULL size (no smoke shrink): at least
+        one million simulated requests through the real router in under
+        60 s of CPU wall-clock, with the autoscaler no worse than
+        static provisioning per replica-second."""
+        env = _env()
+        env.pop("DTTPU_BENCH_SMOKE", None)
+        proc = _run(["--config=fleet_sim", "--device=cpu"], env)
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+        r = json.loads(lines[-1])
+        assert r["simulated_requests"] >= 1_000_000
+        assert r["sim_wall_s"] < 60.0
+        assert r["autoscaler_vs_static"] >= 1.0
+
 
 class TestAnalytical:
     """The graph-tier static cost model riding the bench JSON

@@ -1,0 +1,238 @@
+"""Fleet simulator + autoscaler tests (fleet/sim.py, fleet/workload.py,
+fleet/autoscaler.py).
+
+The contracts pinned here (docs/FLEET_SIM.md):
+  * determinism — the same (trace seed, sim seed) pair reproduces the
+    run BIT-IDENTICALLY: report dict, event log, and router placements;
+    a different trace seed produces a different arrival schedule
+    (fingerprint), so seeds are real knobs rather than decoration,
+  * ``EngineProtocol`` — ``SimEngine`` and the real ``serve.Engine``
+    both satisfy the runtime-checkable protocol, and
+    ``Router.add_replica`` rejects anything that doesn't (the sim's
+    core claim — the SAME router code runs in both worlds — is a type
+    statement, so it is enforced as one),
+  * ``correlated_kill`` — a scheduled multi-replica kill mid-trace is
+    healed by the autoscaler floor and every request is accounted for
+    (completed + expired + lost == submitted),
+  * wedge -> quarantine on VIRTUAL time — the real ``Watchdog`` reads
+    the simulated heartbeat through ``check(now=vt)``,
+  * ``CostModel.calibrate`` rejects ill-conditioned two-point fits
+    (implied negative host overhead) instead of clamping,
+  * the real-fleet acceptance: the SAME ``Autoscaler`` drives a real
+    CPU ``serve.Engine`` fleet through one backlog-triggered scale-out
+    and one migrate-based scale-in, with every request — including the
+    migrated one — token-identical to solo ``generate``.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_tpu import fleet
+from distributed_tensorflow_tpu.analysis import graph as graph_lib
+from distributed_tensorflow_tpu.fleet import sim as sim_lib
+from distributed_tensorflow_tpu.fleet import workload
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+
+from test_fleet import (_engine, _generate_tokens, _model_params,
+                        _prompt)
+
+
+def _cost_model(**kw):
+    kw.setdefault("n_params", 1.0e8)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("tick_steps", 4)
+    return sim_lib.CostModel.analytic(hw=sim_lib.HardwarePoint(), **kw)
+
+
+def _sim(trace, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("engine", dict(num_slots=4, prefill_chunk=16,
+                                 tick_steps=4))
+    kw.setdefault("slo", fleet.SLO(ttft_s=2.0, itl_s=0.05))
+    return sim_lib.FleetSim(trace, _cost_model(), **kw)
+
+
+# ---------------------------------------------------------------------------
+# determinism
+
+
+def test_same_seeds_reproduce_run_bit_identically():
+    """Same (trace, sim seed) twice -> identical report, event log,
+    and placement sequence.  This is the property that makes the
+    simulator usable for regression bisection: a policy diff is a real
+    diff, never noise."""
+    def run():
+        trace = workload.synthesize(3000, seed=7, horizon_s=90.0,
+                                    bursts=2, burst_magnitude=4.0,
+                                    failures=1, failure_k=1)
+        fs = _sim(trace,
+                  autoscaler=dict(min_replicas=2, max_replicas=4,
+                                  eval_interval_s=5.0, cooldown_s=10.0),
+                  watchdog=dict(tick_deadline_s=2.0), seed=3)
+        rep = fs.run()
+        return rep, list(fs.event_log), list(fs.router.placements)
+
+    rep_a, log_a, place_a = run()
+    rep_b, log_b, place_b = run()
+    assert rep_a == rep_b
+    assert log_a == log_b
+    assert place_a == place_b
+    assert rep_a["completed"] > 0
+
+
+def test_different_trace_seed_changes_arrivals():
+    a = workload.synthesize(500, seed=0, horizon_s=30.0)
+    b = workload.synthesize(500, seed=1, horizon_s=30.0)
+    c = workload.synthesize(500, seed=0, horizon_s=30.0)
+    assert a.fingerprint() != b.fingerprint()
+    assert a.fingerprint() == c.fingerprint()
+    assert not np.array_equal(a.arrival_s, b.arrival_s)
+
+
+# ---------------------------------------------------------------------------
+# EngineProtocol: one router, two worlds
+
+
+def test_sim_engine_satisfies_engine_protocol():
+    eng = sim_lib.SimEngine(_cost_model(), num_slots=2)
+    assert isinstance(eng, fleet.EngineProtocol)
+
+
+def test_real_engine_satisfies_engine_protocol():
+    model, params = _model_params()
+    assert isinstance(_engine(model, params), fleet.EngineProtocol)
+
+
+def test_router_rejects_non_engine():
+    class Bogus:
+        def submit(self, *a, **k):
+            pass
+
+    router = fleet.Router(registry=metrics_lib.Registry())
+    with pytest.raises(TypeError, match="EngineProtocol"):
+        router.add_replica(Bogus())
+
+
+# ---------------------------------------------------------------------------
+# chaos on virtual time
+
+
+def test_correlated_kill_healed_by_autoscaler_floor():
+    """A scheduled correlated_kill takes out the whole 2-replica fleet
+    mid-trace; the autoscaler's heal path restores the floor and the
+    run accounts for every request."""
+    trace = workload.synthesize(1500, seed=5, horizon_s=60.0,
+                                bursts=0, failures=1, failure_k=2)
+    assert any(e.kind == "correlated_kill" for e in trace.events)
+    fs = _sim(trace,
+              autoscaler=dict(min_replicas=2, max_replicas=3,
+                              eval_interval_s=2.0, cooldown_s=5.0),
+              seed=1)
+    rep = fs.run()
+    assert rep["correlated_kills_armed"] == 1
+    assert rep["replicas_final"] >= 2
+    assert rep["scale_outs"] >= 1
+    assert (rep["completed"] + rep["deadline_exceeded"] + rep["lost"]
+            == rep["simulated_requests"] == len(trace))
+    # the kill actually fired: its victims' requests moved or died,
+    # either way the router logged the arming
+    assert any(e[0] == "correlated_kill" for e in fs.event_log)
+
+
+def test_wedged_replica_quarantined_on_virtual_time():
+    """A wedge_replica event stalls one SimEngine's heartbeat; the REAL
+    Watchdog, fed virtual now, quarantines it and the router migrates
+    its requests to the survivor."""
+    base = workload.synthesize(600, seed=2, horizon_s=40.0, bursts=0,
+                               failures=0)
+    trace = dataclasses.replace(
+        base, events=(workload.FleetEvent(
+            at_s=5.0, kind="wedge_replica", seconds=30.0),))
+    fs = _sim(trace, watchdog=dict(tick_deadline_s=1.0), seed=4)
+    rep = fs.run()
+    assert rep["quarantines"] >= 1
+    assert any(e[0] == "wedge" for e in fs.event_log)
+    assert any(e[0] == "quarantine" for e in fs.event_log)
+    assert rep["completed"] + rep["deadline_exceeded"] == len(trace)
+    assert rep["migrations"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# cost model calibration
+
+
+def test_calibrate_good_fit_reproduces_measured_points():
+    window = graph_lib.Cost(flops=1.0e9, bytes=0.0, peak_bytes=0.0)
+    tick = graph_lib.Cost(flops=4.0e9, bytes=0.0, peak_bytes=0.0)
+    cm = sim_lib.CostModel.calibrate(window, tick,
+                                     measured_window_s=0.002,
+                                     measured_tick_s=0.005)
+    assert cm.provenance == "calibrated"
+    assert cm.prefill_window_s == pytest.approx(0.002)
+    assert cm.decode_tick_s == pytest.approx(0.005)
+
+
+def test_calibrate_rejects_ill_conditioned_fit():
+    """Times 3x apart but flops nearly equal -> the implied host
+    overhead is negative (the separation is dispatch, not compute);
+    the fit must fall back to the measured times, not clamp."""
+    window = graph_lib.Cost(flops=1.00e9, bytes=0.0, peak_bytes=0.0)
+    tick = graph_lib.Cost(flops=1.01e9, bytes=0.0, peak_bytes=0.0)
+    cm = sim_lib.CostModel.calibrate(window, tick,
+                                     measured_window_s=0.001,
+                                     measured_tick_s=0.003)
+    assert cm.provenance == "measured"
+    assert cm.prefill_window_s == pytest.approx(0.001)
+    assert cm.decode_tick_s == pytest.approx(0.003)
+
+
+# ---------------------------------------------------------------------------
+# the real-fleet acceptance: same policy object, real engines
+
+
+def test_autoscaler_real_fleet_scale_out_in_token_identical():
+    """The Autoscaler drives a REAL CPU serve.Engine fleet: a backlog
+    burst trips one scale-out (1 -> 2 replicas), the lull trips one
+    migrate-based scale-in (2 -> 1) while work is still decoding, and
+    every request — including the migrated one — matches solo
+    ``generate`` token-for-token."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+
+    def factory():
+        return _engine(model, params, reg=reg, num_slots=4)
+
+    router = fleet.Router([factory()], registry=reg)
+    auto = fleet.Autoscaler(
+        router, factory, fleet.SLO(ttft_s=2.0, itl_s=1.0),
+        min_replicas=1, max_replicas=2, backlog_high=0.5,
+        util_low=0.8, eval_interval_s=1.0, cooldown_s=30.0,
+        drain_timeout_s=60.0, registry=reg)
+
+    # burst: 5 queued on 4 slots > backlog_high * slots -> scale out
+    prompts = [_prompt(3 + i % 4, seed=i) for i in range(5)]
+    hs = [router.submit(p, 6) for p in prompts]
+    assert auto.evaluate(now=0.0) == ("scale_out", 1)
+    assert len(router.replica_ids) == 2
+    router.drain()
+    assert all(h.status == "ok" for h in hs)
+
+    # lull: two live decodes spread across both replicas, then the
+    # policy retires the newest replica — its in-flight request rides
+    # a migration snapshot, it does NOT restart
+    tail = [_prompt(4, seed=10), _prompt(5, seed=11)]
+    ht = [router.submit(p, 8) for p in tail]
+    router.step()
+    assert {h.replica_id for h in ht} == {0, 1}
+    assert auto.evaluate(now=60.0) == ("scale_in", 1)
+    assert router.replica_ids == (0,)
+    assert reg.get("dttpu_migrations_total").value >= 1
+    router.drain()
+
+    assert auto.scale_outs == 1 and auto.scale_ins == 1
+    for p, h in zip(prompts + tail, hs + ht):
+        assert h.status == "ok"
+        assert h.tokens == _generate_tokens(model, params, p, len(h.tokens), 32)
+    assert [len(h.tokens) for h in hs + ht] == [6] * 5 + [8] * 2
