@@ -1371,6 +1371,7 @@ def bench_gpt_serve():
     import numpy as np
     from distributed_tensorflow_tpu import serve
     from distributed_tensorflow_tpu.models.gpt import GPT
+    from distributed_tensorflow_tpu.obs import reqtrace
 
     seq = int(os.environ.get("DTTPU_BENCH_SEQ", "256"))
     config = _gpt_bench_config(seq)
@@ -1618,8 +1619,48 @@ def bench_gpt_serve():
     log(f"gpt_serve slots_at_fixed_mem: {peak_active} concurrent slots "
         f"on a {slots}-stripe budget (contiguous layout: {slots})")
 
+    # ---- tracing overhead: the span-emission budget, measured ----
+    # The mixed trace replayed with request tracing ON (ids minted at
+    # Engine.submit, lifecycle spans emitted by the scheduler) vs OFF
+    # (``reqtrace.configure(enabled=False)``: mint returns None and
+    # every carrier skips the calls — one attribute check per
+    # request).  Two fresh engines, arms INTERLEAVED best-of-2, so a
+    # background spike or cache-warmth drift can't land on one side.
+    # With no active tracer (TELEMETRY=0) both arms mint nothing and
+    # the ratio degenerates to noise around 1.0 — still reported, but
+    # the ON arm's traced lane count says which regime ran.
+    eng_on = make_engine()
+    eng_off = make_engine()
+    wall_on = wall_off = None
+    toks_on = 0
+    try:
+        for _ in range(2):
+            reqtrace.configure(enabled=True)
+            w, hs_t = replay_engine(eng_on, prompts, budgets,
+                                    arrivals, tenants)
+            if wall_on is None or w < wall_on:
+                wall_on, toks_on = w, sum(len(h.tokens) for h in hs_t)
+            reqtrace.configure(enabled=False)
+            w, hs_t = replay_engine(eng_off, prompts, budgets,
+                                    arrivals, tenants)
+            wall_off = w if wall_off is None else min(wall_off, w)
+    finally:
+        reqtrace.configure(enabled=True)
+    on_tps = toks_on / wall_on
+    off_tps = toks_on / wall_off     # same trace: same token total
+    tracing = dict(
+        on_tokens_per_sec=round(on_tps, 1),
+        off_tokens_per_sec=round(off_tps, 1),
+        ratio=round(on_tps / off_tps, 4),
+        overhead_pct=round(max(0.0, 1.0 - on_tps / off_tps) * 100, 2),
+        traced_requests=len(reqtrace.completed()))
+    log(f"gpt_serve tracing: on {on_tps:,.0f} tok/s vs off "
+        f"{off_tps:,.0f} (ratio {tracing['ratio']:.3f}, "
+        f"{tracing['traced_requests']} lanes in the ring)")
+
     return dict(metric="gpt_serve_tokens_per_sec_per_chip",
                 value=round(engine_tps, 1), unit="tokens/sec/chip",
+                tracing=tracing,
                 vs_baseline=round(ratio_contig, 3),  # lock-step, same run
                 tokens_per_sec=round(engine_tps, 1),
                 contiguous_tokens_per_sec=round(contig_tps, 1),
@@ -1871,6 +1912,7 @@ def bench_fleet_sim():
     from distributed_tensorflow_tpu import fleet
     from distributed_tensorflow_tpu.fleet import sim as sim_lib
     from distributed_tensorflow_tpu.fleet import workload
+    from distributed_tensorflow_tpu.obs import federate, reqtrace
 
     n_main = int(os.environ.get("DTTPU_BENCH_FLEET_SIM_REQUESTS",
                                 "8000" if SMOKE else "400000"))
@@ -1894,9 +1936,17 @@ def bench_fleet_sim():
     sim_wall = [0.0]
     simulated = [0]
 
+    # One federation over every leg's registries: the per-tenant SLO
+    # gauges (dttpu_slo_*) stream in from the sims' TTFT/TPOT samples,
+    # and the request lanes the SimEngines sample (1-in-trace_sample,
+    # VIRTUAL timestamps) land in the bench tracer next to the host
+    # spans — DTTPU_BENCH_TRACE_FILE carries both out for the CI merge.
+    fed = federate.FederatedMetrics()
+
     def run_leg(tr, **kw):
         fs = sim_lib.FleetSim(tr, cm, slo=slo, engine=dict(engine_kw),
                               **kw)
+        fs.metrics.federation = fed
         gc.collect()
         gc.disable()
         t0 = time.perf_counter()
@@ -1969,6 +2019,19 @@ def bench_fleet_sim():
                         provenance=cm.provenance),
         total_tokens=total_tokens,
         requests_main=n_main, requests_curve=n_curve)
+    fed_text = fed.expose()
+    result["federation"] = dict(
+        slo_series=sum(1 for ln in fed_text.splitlines()
+                       if ln.startswith("dttpu_slo_")),
+        sources=fed.source_count())
+    result["tracing"] = dict(
+        # ring-bounded (256): "did sampling run", not a request count
+        sampled_lanes=len(reqtrace.completed()),
+        trace_sample=int(engine_kw.get("trace_sample", 64)))
+    log(f"fleet_sim federation: {result['federation']['slo_series']} "
+        f"SLO series over {result['federation']['sources']} source(s), "
+        f"{result['tracing']['sampled_lanes']} sampled lanes in the "
+        f"trace ring")
     if validation is not None:
         result["validation"] = validation
     return result

@@ -14,7 +14,7 @@ or wrong numerics on the TPU.  Four tiers share one file walk:
   cross-module constants, collective sequences diverging across
   ``lax.cond`` branches inside shard_map, and the donation contract
   propagated through the call graph;
-* host-concurrency (lock-set inference, DT301-DT306,
+* host-concurrency (lock-set inference, DT301-DT308,
   ``concurrency.py``): data races, lock-order cycles, callbacks and
   blocking calls under locks, thread hygiene;
 * graph (jaxpr-level, DT400-DT405, ``graph.py`` / ``graph_rules.py``):

@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import os
 import re
 from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, \
     Set, Tuple
@@ -1060,9 +1061,98 @@ class UnnamedThread(ConcurrencyRule):
                     "exit)")
 
 
+# --------------------------------------------------------------- DT308
+
+# the obs.metrics instrument constructors; every series they mint must
+# be documented in the observability catalog
+_METRIC_CTORS = {"counter", "gauge", "histogram"}
+_CATALOG_NAME = "OBSERVABILITY.md"
+
+
+class UncataloguedMetric(ConcurrencyRule):
+    id = "DT308"
+    severity = Severity.WARNING
+    summary = ("a metric series created via obs.metrics whose name is "
+               "absent from the docs/OBSERVABILITY.md catalog — an "
+               "undocumented series is invisible to dashboards and "
+               "breaks the federation's naming contract "
+               "(observability contract)")
+
+    def check(self, cctx: ConcurrencyContext) -> Iterator[Finding]:
+        cache: Dict[str, Optional[Tuple[str, str]]] = {}
+        for _, src in sorted(cctx.project.sources.items()):
+            catalog = self._catalog_for(src.path, cache)
+            if catalog is None:
+                continue    # no catalog in scope: nothing to enforce
+            cat_path, cat_text = catalog
+            for node in ast.walk(src.tree):
+                name = self._metric_name(node)
+                if name is None:
+                    continue
+                # whole-token match so a prefix of a documented name
+                # cannot pass as documented
+                if re.search(r"(?<![A-Za-z0-9_])" + re.escape(name)
+                             + r"(?![A-Za-z0-9_])", cat_text):
+                    continue
+                yield cctx.finding(
+                    self.id, self.severity, src, node,
+                    f"metric series '{name}' is not in the "
+                    f"observability catalog ({cat_path}) — add it to "
+                    "the metric table (name, type, meaning) so "
+                    "dashboards and the fleet federation can rely on "
+                    "the documented series set")
+
+    @staticmethod
+    def _catalog_for(path: str,
+                     cache: Dict[str, Optional[Tuple[str, str]]]
+                     ) -> Optional[Tuple[str, str]]:
+        """The nearest ``docs/OBSERVABILITY.md`` above ``path`` (walking
+        up to the filesystem root), as (path, text); None when the file
+        is out of tree — sources without a catalog are simply exempt,
+        the family contract every DT-rule follows."""
+        d = os.path.dirname(os.path.abspath(path))
+        start, hops = d, []
+        while True:
+            hit = cache.get(d, False)
+            if hit is not False:
+                break
+            hops.append(d)
+            cand = os.path.join(d, "docs", _CATALOG_NAME)
+            if os.path.isfile(cand):
+                try:
+                    with open(cand, "r", encoding="utf-8") as f:
+                        hit = (cand, f.read())
+                except OSError:
+                    hit = None
+                break
+            parent = os.path.dirname(d)
+            if parent == d:
+                hit = None
+                break
+            d = parent
+        for h in hops:
+            cache[h] = hit
+        cache[start] = hit
+        return hit
+
+    @staticmethod
+    def _metric_name(node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in _METRIC_CTORS:
+            return None
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            return None
+        v = node.args[0].value
+        if not isinstance(v, str) or not v.startswith("dttpu_"):
+            return None
+        return v
+
+
 CONCURRENCY_RULES: List[ConcurrencyRule] = [
     InconsistentLockset(), LockOrderCycle(), CallbackUnderLock(),
-    BlockingUnderLock(), UnjoinedThread(), UnnamedThread()]
+    BlockingUnderLock(), UnjoinedThread(), UnnamedThread(),
+    UncataloguedMetric()]
 
 
 def concurrency_rule_catalog() -> List[Tuple[str, str, str]]:

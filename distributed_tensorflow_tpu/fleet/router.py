@@ -63,6 +63,7 @@ from typing import (Callable, Dict, List, Optional, Protocol, Tuple,
                     runtime_checkable)
 
 from ..obs import metrics as metrics_lib
+from ..obs import reqtrace
 from ..resilience import faults as faults_lib
 from ..serve.engine import (Engine, QueueFullError, RequestHandle,
                             RequestSnapshot)
@@ -347,12 +348,16 @@ class Router:
         is a FLEET deadline: retries submit with the remaining budget."""
         deadline = (None if deadline_s is None
                     else time.perf_counter() + deadline_s)
+        # mint the request trace id at the FLEET front door, so every
+        # placement attempt and migration hop shares one lane; None
+        # (tracing off) costs a module check per request
+        trace_id = reqtrace.mint()
         with self._lock:
             fh = FleetHandle(
                 rid=self._next_rid,
                 spec=dict(prompt=prompt, max_new_tokens=max_new_tokens,
                           on_token=on_token, tenant=tenant,
-                          adapter_id=adapter_id),
+                          adapter_id=adapter_id, trace_id=trace_id),
                 deadline=deadline, retries_left=self.max_retries,
                 router=self)
             self._next_rid += 1
@@ -411,7 +416,8 @@ class Router:
                         on_token=fh._attempt_stream(0),
                         deadline_s=remaining,
                         tenant=fh.spec["tenant"],
-                        adapter_id=fh.spec["adapter_id"])
+                        adapter_id=fh.spec["adapter_id"],
+                        trace_id=fh.spec.get("trace_id"))
             except _REJECTIONS as e:
                 last = e
                 continue

@@ -60,6 +60,7 @@ import numpy as np
 
 from ..analysis import graph as graph_lib
 from ..obs import metrics as metrics_lib
+from ..obs import reqtrace
 from ..resilience import faults as faults_lib
 from ..serve.engine import QueueFullError, RequestSnapshot
 from . import watchdog as watchdog_lib
@@ -243,13 +244,15 @@ class _SimRequest:
                  "max_new_tokens", "tenant", "adapter_id", "prefix_id",
                  "prefix_len", "on_token", "arrival_vt", "first_vt",
                  "span_base", "span_start_vt", "emitted",
-                 "windows_left", "status", "error", "deadline_vt")
+                 "windows_left", "status", "error", "deadline_vt",
+                 "trace_id")
 
     def __init__(self):
         self.error: Optional[BaseException] = None
         self.first_vt: Optional[float] = None
         self.span_start_vt: Optional[float] = None
         self.status = "pending"
+        self.trace_id: Optional[str] = None
 
     @property
     def done(self) -> bool:
@@ -282,7 +285,8 @@ class SimEngine:
                  clock: Optional[SimClock] = None,
                  metrics: Optional["SimMetrics"] = None,
                  max_queue_depth: Optional[int] = None,
-                 default_max_new_tokens: int = 16):
+                 default_max_new_tokens: int = 16,
+                 trace_sample: int = 64):
         self.cost = cost_model
         self.num_slots = int(num_slots)
         self.prefill_chunk = int(prefill_chunk)
@@ -292,6 +296,12 @@ class SimEngine:
         self.metrics = metrics
         self.max_queue_depth = max_queue_depth
         self.default_max_new_tokens = int(default_max_new_tokens)
+        # request tracing on VIRTUAL time: a million-request sim cannot
+        # afford a lane per request, so only 1-in-``trace_sample``
+        # router-minted trace ids are kept (<=1 keeps all); a migrated
+        # request's sampling verdict rides its snapshot, lane intact
+        self.trace_sample = int(trace_sample)
+        self._trace_seen = 0
         self.vt = clock.now if clock is not None else 0.0
         # how far past clock.now one step() may pre-run: the fleet
         # driver sets this to its round quantum so a busy engine
@@ -326,7 +336,8 @@ class SimEngine:
                on_token: Optional[Callable] = None,
                deadline_s: Optional[float] = None,
                tenant: str = "default",
-               adapter_id: Optional[str] = None) -> _SimRequest:
+               adapter_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> _SimRequest:
         plen, prefix_id, prefix_len, arrival = self._parse_prompt(prompt)
         budget = (self.default_max_new_tokens if max_new_tokens is None
                   else int(max_new_tokens))
@@ -362,6 +373,11 @@ class SimEngine:
         r.windows_left = 0
         now = self.clock.now if self.clock is not None else self.vt
         r.deadline_vt = None if deadline_s is None else now + deadline_s
+        if trace_id is not None:
+            self._trace_seen += 1
+            if self.trace_sample <= 1 \
+                    or self._trace_seen % self.trace_sample == 1:
+                r.trace_id = trace_id
         self._queue.append(r)
         st.queued += 1
         st.inflight += 1
@@ -369,6 +385,10 @@ class SimEngine:
         t[tenant] = t.get(tenant, 0) + 1
         t = st.tokens_inflight_per_tenant
         t[tenant] = t.get(tenant, 0) + budget
+        if r.trace_id:
+            reqtrace.submitted(r.trace_id, ts_us=now * 1e6, rid=r.rid,
+                               tenant=tenant, plen=plen,
+                               max_new_tokens=budget)
         return r
 
     def import_request(self, snap: RequestSnapshot,
@@ -388,6 +408,13 @@ class SimEngine:
         if resumed > 0:
             # the caller saw the stream start on the source replica
             r.first_vt = r.arrival_vt
+        if snap.trace_id is not None:
+            # the source's sampling verdict rides the snapshot — the
+            # lane continues here, not a fresh submitted()
+            r.trace_id = snap.trace_id
+            now = self.clock.now if self.clock is not None else self.vt
+            reqtrace.imported(r.trace_id, ts_us=now * 1e6, rid=r.rid,
+                              resumed=resumed)
         return r
 
     def export_request(self, handle: _SimRequest,
@@ -399,12 +426,18 @@ class SimEngine:
                                f"({r.status}); nothing to export")
         self._forget(r)
         r.status = "exported"
+        if r.trace_id:
+            now = self.clock.now if self.clock is not None else self.vt
+            reqtrace.exported(r.trace_id, ts_us=now * 1e6, rid=r.rid,
+                              generated=r.emitted,
+                              clean=self._wedged_until is None)
         return RequestSnapshot(
             rid=r.rid, prompt=r.prompt_ref,
             generated=[0] * r.emitted, max_new_tokens=r.budget,
             stream_offset=r.emitted, tenant=r.tenant,
             adapter_id=r.adapter_id, deadline_remaining_s=None,
-            sampling=None, clean=self._wedged_until is None)
+            sampling=None, clean=self._wedged_until is None,
+            trace_id=r.trace_id)
 
     def export_inflight(self, timeout_s: Optional[float] = None
                         ) -> List[RequestSnapshot]:
@@ -418,6 +451,10 @@ class SimEngine:
             return False
         self._forget(handle)
         handle.status = "cancelled"
+        if handle.trace_id:
+            now = self.clock.now if self.clock is not None else self.vt
+            reqtrace.retired(handle.trace_id, "cancelled",
+                             ts_us=now * 1e6, tokens=handle.emitted)
         if self.metrics is not None:
             self.metrics.cancelled += 1
         return True
@@ -443,6 +480,13 @@ class SimEngine:
 
     def load_adapter(self, adapter_id: str, adapter: Any = None) -> None:
         self._adapters.add(adapter_id)
+
+    def inflight_trace_ids(self) -> List[str]:
+        """Trace ids of every in-flight (sampled) request — the same
+        pre-quarantine forensics surface the real engine exposes."""
+        pending = (list(self._queue) + list(self._prefilling)
+                   + list(self._active))
+        return [r.trace_id for r in pending if r.trace_id]
 
     def stats(self) -> _SimStats:
         return self._stats
@@ -527,6 +571,9 @@ class SimEngine:
             prefilling.append(r)
             st.queued -= 1
             st.prefilling += 1
+            if r.trace_id:
+                reqtrace.stage(r.trace_id, "prefill", ts_us=t0 * 1e6,
+                               windows=r.windows_left)
         dur += len(prefilling) * cm.prefill_window_s
         t1 = t0 + dur
         self.vt = t1
@@ -564,10 +611,15 @@ class SimEngine:
                     continue
                 r.emitted += 1
                 r.span_start_vt = t1
+                if r.trace_id:
+                    reqtrace.mark(r.trace_id, "first_token",
+                                  ts_us=t1 * 1e6,
+                                  ttft_s=t1 - r.arrival_vt)
+                    reqtrace.stage(r.trace_id, "decode", ts_us=t1 * 1e6)
                 if r.first_vt is None:
                     r.first_vt = t1
                     if metrics is not None:
-                        metrics.record_ttft(t1 - r.arrival_vt)
+                        metrics.record_ttft(t1 - r.arrival_vt, r.tenant)
                 cb = r.on_token
                 if cb is not None:
                     cb(self._zeros[1])
@@ -599,6 +651,9 @@ class SimEngine:
         t = st.tokens_inflight_per_tenant
         t[r.tenant] = t.get(r.tenant, r.budget) - r.budget
         r.status = status
+        if r.trace_id:
+            reqtrace.retired(r.trace_id, status, ts_us=now_vt * 1e6,
+                             tokens=r.emitted)
         release = getattr(self._queue, "release", None)
         if release is not None:
             release(r)
@@ -645,12 +700,15 @@ class SimMetrics:
         self.itl_n = 0
         self.per_tenant: Dict[str, int] = {}
         self.autoscaler: Optional[Autoscaler] = None
+        # optional obs.federate.FederatedMetrics: per-tenant latency
+        # samples and SLO verdicts stream into its dttpu_slo_* gauges
+        self.federation: Optional[Any] = None
 
     @property
     def finished(self) -> int:
         return self.completed + self.deadline_exceeded
 
-    def record_ttft(self, v: float) -> None:
+    def record_ttft(self, v: float, tenant: str = "default") -> None:
         self.ttft.append(v)
         ok = self.slo is None or v <= self.slo.ttft_s
         if ok:
@@ -658,6 +716,9 @@ class SimMetrics:
         a = self.autoscaler
         if a is not None:
             a.record(ttft_ok=ok)
+        f = self.federation
+        if f is not None:
+            f.ingest(tenant, ttft_s=v, ttft_ok=ok)
 
     def record_retire(self, r: _SimRequest, now_vt: float,
                       status: str) -> None:
@@ -683,6 +744,9 @@ class SimMetrics:
         a = self.autoscaler
         if a is not None:
             a.record(itl_ok=ok)
+        f = self.federation
+        if f is not None:
+            f.ingest(r.tenant, tpot_s=tpot, itl_ok=ok)
 
     # ------------------------------------------------------- report
 

@@ -55,6 +55,7 @@ import time
 from typing import List, Optional, Tuple
 
 from ..obs import metrics as metrics_lib
+from ..obs import reqtrace
 from .router import Router
 
 __all__ = ["Watchdog"]
@@ -124,6 +125,14 @@ class Watchdog:
             reason = self.verdict(stats, now)
             if reason is None:
                 continue
+            # capture the victims' trace ids BEFORE the quarantine
+            # exports them away — the forensic dump below snapshots
+            # each span tree while the evidence is warm
+            try:
+                eng = self.router.replica(rid)
+            except KeyError:
+                continue        # raced another check()/operator action
+            victims = getattr(eng, "inflight_trace_ids", lambda: [])()
             try:
                 self.router.quarantine_replica(
                     rid, reason=reason,
@@ -131,6 +140,9 @@ class Watchdog:
             except KeyError:
                 continue        # raced another check()/operator action
             self.unhealthy_total.inc()
+            for trace_id in victims:
+                reqtrace.forensic_dump(trace_id, "watchdog_quarantine",
+                                       replica=rid, verdict=reason)
             with self._lock:
                 self.log.append((rid, reason))
             hits.append((rid, reason))

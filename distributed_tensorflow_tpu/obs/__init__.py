@@ -38,15 +38,17 @@ from __future__ import annotations
 import os
 from typing import Callable, Dict, Optional
 
-from . import device, http, metrics, trace
+from . import device, federate, http, metrics, reqtrace, trace
+from .federate import FederatedMetrics
 from .http import MetricsServer
 from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
-                      parse_exposition)
+                      parse_exposition, render_exposition)
 from .trace import Tracer
 
 __all__ = ["Telemetry", "Tracer", "MetricsServer", "Registry", "REGISTRY",
-           "Counter", "Gauge", "Histogram", "parse_exposition",
-           "device", "http", "metrics", "trace"]
+           "Counter", "Gauge", "Histogram", "FederatedMetrics",
+           "parse_exposition", "render_exposition",
+           "device", "federate", "http", "metrics", "reqtrace", "trace"]
 
 
 class Telemetry:

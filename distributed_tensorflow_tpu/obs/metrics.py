@@ -32,7 +32,7 @@ from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
-           "parse_exposition"]
+           "parse_exposition", "render_exposition"]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -296,6 +296,31 @@ def _parse_value(text: str) -> float:
     return float(text)
 
 
+# The escape alphabet of the exposition format: label values escape
+# backslash, double-quote, and newline; HELP text escapes backslash and
+# newline.  Decoding must walk the string ONCE — sequential .replace()
+# passes corrupt adjacent escapes (a literal backslash followed by a
+# literal n renders as ``\\n`` and a ``\\n -> newline`` pass would eat
+# the backslash it just decoded).
+_ESCAPE_MAP = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _unescape(value: str) -> str:
+    if "\\" not in value:
+        return value
+    out: List[str] = []
+    i, n = 0, len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            out.append(_ESCAPE_MAP.get(value[i + 1], "\\" + value[i + 1]))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def parse_exposition(text: str) -> Dict[str, Dict]:
     """Parse exposition text into ``{family: {"type", "help", "samples"}}``
     where samples maps ``(sample_name, labels_tuple) -> value``."""
@@ -317,7 +342,8 @@ def parse_exposition(text: str) -> Dict[str, Dict]:
             _, _, rest = line.partition("# HELP ")
             name, _, help_text = rest.partition(" ")
             out.setdefault(name, {"type": "untyped", "help": "",
-                                  "samples": {}})["help"] = help_text
+                                  "samples": {}})["help"] = _unescape(
+                                      help_text)
             continue
         if line.startswith("# TYPE "):
             _, _, rest = line.partition("# TYPE ")
@@ -331,8 +357,7 @@ def parse_exposition(text: str) -> Dict[str, Dict]:
         if not m:
             raise ValueError(f"unparsable exposition line: {line!r}")
         labels = tuple(
-            (k, v.replace('\\"', '"').replace("\\n", "\n")
-             .replace("\\\\", "\\"))
+            (k, _unescape(v))
             for k, v in _LABEL_PAIR_RE.findall(m.group("labels") or ""))
         fam = family_of(m.group("name"))
         entry = out.setdefault(fam, {"type": "untyped", "help": "",
@@ -340,3 +365,36 @@ def parse_exposition(text: str) -> Dict[str, Dict]:
         entry["samples"][(m.group("name"), labels)] = _parse_value(
             m.group("value"))
     return out
+
+
+def render_exposition(families: Dict[str, Dict],
+                      extra_labels: Optional[Dict[str, str]] = None) -> str:
+    """Inverse of :func:`parse_exposition`: render parsed families back
+    to exposition text, optionally stamping ``extra_labels`` onto every
+    sample (the federation relabel: ``host=``/``replica=``).  An extra
+    label whose key a sample already carries overrides it in place, so
+    re-federating an already-labelled exposition stays idempotent.
+    ``parse_exposition(render_exposition(parse_exposition(t)))`` equals
+    ``parse_exposition(t)`` exactly — including histogram ``+Inf``
+    buckets and escaped label values, which is what lets the federation
+    endpoint proxy peer registries losslessly."""
+    extra = tuple((k, str(v)) for k, v in (extra_labels or {}).items())
+    for k, _ in extra:
+        if not _LABEL_RE.match(k):
+            raise ValueError(f"invalid label name {k!r}")
+    lines: List[str] = []
+    for fam in families:
+        entry = families[fam]
+        if entry.get("help"):
+            lines.append(f"# HELP {fam} " +
+                         entry["help"].replace("\\", "\\\\")
+                         .replace("\n", "\\n"))
+        lines.append(f"# TYPE {fam} {entry.get('type') or 'untyped'}")
+        for (sample_name, labels), value in entry["samples"].items():
+            if extra:
+                keep = tuple((k, v) for k, v in labels
+                             if k not in dict(extra))
+                labels = keep + extra
+            lines.append(f"{sample_name}{_format_labels(labels)} "
+                         f"{_format_value(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")

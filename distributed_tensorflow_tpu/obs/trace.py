@@ -118,6 +118,19 @@ class Tracer:
         with self._lock:
             self._events.append(event)
 
+    def add_event(self, event: Dict[str, Any]) -> None:
+        """Record a raw Chrome trace event (async ``b``/``n``/``e``
+        lifecycle phases, flow ``s``/``f`` arrows — shapes the typed
+        helpers above don't cover; ``obs.reqtrace`` is the producer).
+        The caller supplies ``ts``/``ph``/``cat``/``id``; ``pid`` and
+        ``tid`` default to this tracer's lane and the calling thread."""
+        if not self.enabled:
+            return
+        event.setdefault("pid", self.pid)
+        event.setdefault("tid", threading.get_ident() & 0xFFFFFFFF)
+        with self._lock:
+            self._events.append(event)
+
     def instant(self, name: str, **args: Any) -> None:
         """Record an instant ("i") event — compiles, retraces, marks."""
         if not self.enabled:

@@ -42,6 +42,7 @@ import time
 from typing import Callable, List, Optional, Union
 
 from ..obs import metrics as metrics_lib
+from ..obs import reqtrace
 from .adapters import AdapterTable
 from .scheduler import (EngineStats, QueueFullError, Request,
                         RequestSnapshot, SlotScheduler)
@@ -392,22 +393,29 @@ class Engine:
                on_token: Optional[Callable[[List[int]], None]] = None,
                deadline_s: Optional[float] = None,
                tenant: str = "default",
-               adapter_id: Optional[str] = None) -> RequestHandle:
+               adapter_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Queue one prompt ([plen] ids, any length per request) ->
         handle.  ``on_token`` streams each delivered token batch.
         Raises ``QueueFullError`` at ``max_queue_depth`` — shed load at
         the door instead of queueing work that will miss every SLO.
         With a ``tenancy`` policy, ``tenant`` is checked against its
         quotas here too (the policy's quota error propagates);
-        ``adapter_id`` selects a loaded LoRA adapter."""
+        ``adapter_id`` selects a loaded LoRA adapter.  ``trace_id``
+        carries a caller-minted request trace id (the fleet router's);
+        when None and a tracer is active, one is minted HERE — the
+        engine is the front door for direct submits."""
         new_tokens = max_new_tokens or self.default_max_new_tokens
+        if trace_id is None:
+            trace_id = reqtrace.mint()
         try:
             req = self.scheduler.submit(
                 prompt, new_tokens,
                 on_token=on_token,
                 deadline_s=(deadline_s if deadline_s is not None
                             else self.default_deadline_s),
-                tenant=tenant, adapter_id=adapter_id)
+                tenant=tenant, adapter_id=adapter_id,
+                trace_id=trace_id)
         except QueueFullError:
             self.metrics.rejected.inc()
             raise
@@ -424,6 +432,11 @@ class Engine:
     @property
     def busy(self) -> bool:
         return self.scheduler.busy
+
+    def inflight_trace_ids(self) -> List[str]:
+        """Trace ids of every in-flight request — the fleet watchdog's
+        pre-quarantine forensics capture (``obs.reqtrace``)."""
+        return self.scheduler.inflight_trace_ids()
 
     def step(self) -> bool:
         """One scheduler tick; False when fully idle."""

@@ -90,6 +90,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..analysis import graph as graph_lib
+from ..obs import reqtrace
 from ..resilience import faults as faults_lib
 from ..ops import decoding as dec
 from . import pages as pages_lib
@@ -156,6 +157,10 @@ class Request:
                                                       repr=False)
     resumed: int = 0
     token_cost: int = 0
+    # request-scoped tracing (obs/reqtrace.py): minted at the front
+    # door (Router.submit / Engine.submit) when a tracer is active,
+    # carried across migration on the snapshot; None = tracing off
+    trace_id: Optional[str] = None
 
     @property
     def remaining_budget(self) -> int:
@@ -208,6 +213,7 @@ class RequestSnapshot:
     deadline_remaining_s: Optional[float] = None
     sampling: Optional[dict] = None          # source sampling config
     clean: bool = True                       # pump-quiesced export
+    trace_id: Optional[str] = None           # the lane continues (obs/reqtrace)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -633,7 +639,8 @@ class SlotScheduler:
                on_token: Optional[Callable[[List[int]], None]] = None,
                deadline_s: Optional[float] = None,
                tenant: str = "default",
-               adapter_id: Optional[str] = None) -> Request:
+               adapter_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Queue one request.  ``prompt``: [plen] int token ids (no
         padding — slots are per-request, unequal lengths batch freely).
         Enforces generate()'s length rule: prompt + max_new_tokens must
@@ -692,9 +699,15 @@ class SlotScheduler:
                           else now + deadline_s,
                           tenant=tenant, adapter_id=adapter_id,
                           context=prompt,
-                          token_cost=int(max_new_tokens))
+                          token_cost=int(max_new_tokens),
+                          trace_id=trace_id)
             self._next_rid += 1
             self._enqueue_locked(req)
+        if req.trace_id:
+            # the request lane opens here: async "b" request + queued
+            reqtrace.submitted(req.trace_id, rid=req.rid,
+                               tenant=req.tenant, plen=int(plen),
+                               max_new_tokens=int(max_new_tokens))
         self.metrics.submitted(req)
         self._report_depth()
         return req
@@ -826,6 +839,8 @@ class SlotScheduler:
                 with self._lock:
                     self._requeue(req)
                 break
+            if req.trace_id:
+                reqtrace.stage(req.trace_id, "prefill")
             with self._lock:
                 self._prefills.append(st)
         with self._lock:
@@ -990,6 +1005,9 @@ class SlotScheduler:
                     st[3] = new_cache
             with self._lock:
                 st[2] = i + 1
+            if req.trace_id:
+                reqtrace.mark(req.trace_id, "prefill_window",
+                              window=int(i))
             return
         ctx = req.context if req.context is not None else req.prompt
         plen = ctx.size
@@ -1049,6 +1067,13 @@ class SlotScheduler:
             self._finished = self._finished.at[slot].set(True)
             return
         self.metrics.admitted(req)
+        if req.trace_id:
+            reqtrace.mark(req.trace_id, "prefill_window",
+                          window=len(windows) - 1)
+            reqtrace.mark(req.trace_id, "admitted", slot=int(slot))
+            reqtrace.mark(req.trace_id, "first_token",
+                          ttft_s=req.first_token_time - req.submit_time)
+            reqtrace.stage(req.trace_id, "decode")
         if req.remaining_budget <= 1 or (self.eos_id is not None
                                          and first == self.eos_id):
             self._drop_slot(slot, req)
@@ -1171,6 +1196,11 @@ class SlotScheduler:
             self._finished = self._finished.at[np.asarray(rows)].set(True)
         for req in aborts:
             self._abort(req, "deadline_exceeded")
+            if req.trace_id:
+                # tail-latency forensics: snapshot the victim's span
+                # tree while the evidence is warm (bounded log)
+                reqtrace.forensic_dump(req.trace_id, "deadline_expired",
+                                       rid=req.rid, tenant=req.tenant)
         if aborts:
             self._report_depth()
 
@@ -1221,6 +1251,16 @@ class SlotScheduler:
                 if req is not None and req.rid == rid:
                     return req
         return None
+
+    def inflight_trace_ids(self) -> List[str]:
+        """Trace ids of every in-flight request (queued, prefilling,
+        active) — the fleet watchdog captures these BEFORE quarantining
+        a wedged replica so it can forensic-dump each victim."""
+        with self._lock:
+            reqs = ([r for r in self._queue]
+                    + [st[0] for st in self._prefills]
+                    + [r for r in self._slots if r is not None])
+        return [r.trace_id for r in reqs if r.trace_id]
 
     def export(self, req: Request,
                timeout_s: Optional[float] = None) -> RequestSnapshot:
@@ -1328,6 +1368,13 @@ class SlotScheduler:
         if not self.cancel(req, status="migrated"):
             raise RuntimeError(
                 f"request {req.rid} finished during export")
+        if req.trace_id:
+            # the lane continues on the importer: close this replica's
+            # stage and start the migration flow arrow
+            snap.trace_id = req.trace_id
+            reqtrace.exported(req.trace_id, rid=req.rid,
+                              generated=len(generated),
+                              clean=bool(clean))
         return snap
 
     def import_snapshot(self, snap: RequestSnapshot,
@@ -1402,10 +1449,16 @@ class SlotScheduler:
                                     else now + snap.deadline_remaining_s),
                           tenant=tenant, adapter_id=snap.adapter_id,
                           context=ctx, resumed=len(generated),
-                          token_cost=remaining)
+                          token_cost=remaining,
+                          trace_id=snap.trace_id)
             req.tokens = list(generated)
             self._next_rid += 1
             self._enqueue_locked(req)
+        if req.trace_id:
+            # NOT submitted(): the lane is already open — finish the
+            # flow arrow and re-enter queued on the same async id
+            reqtrace.imported(req.trace_id, rid=req.rid,
+                              resumed=req.resumed)
         self.metrics.submitted(req)
         self._report_depth()
         return req
@@ -1469,6 +1522,9 @@ class SlotScheduler:
             return
         req.status = "ok"
         req.finish_time = time.perf_counter()
+        if req.trace_id:
+            # claim-once above guarantees exactly one terminal span
+            reqtrace.retired(req.trace_id, "ok", tokens=len(req.tokens))
         self.metrics.finished(req)
         req.done.set()
 
@@ -1479,6 +1535,10 @@ class SlotScheduler:
         req.status = status
         req.error = error
         req.finish_time = time.perf_counter()
+        if req.trace_id:
+            # "migrated" is a no-op here: exported() owns the hop
+            reqtrace.retired(req.trace_id, status,
+                             tokens=len(req.tokens))
         self.metrics.aborted(req, status)
         req.done.set()
 

@@ -1,0 +1,76 @@
+"""Merge per-host Chrome trace files into one Perfetto-loadable file.
+
+Every process writes its own ``trace-host{i}.json`` (obs/trace.py), with
+events carrying the host's process index as the Chrome ``pid``.  Loading
+them one at a time loses the fleet picture — and the request-scoped
+async lanes (obs/reqtrace.py) only stitch a migrated request back into
+ONE lane when the exporting and importing hosts' events sit in the SAME
+file (async ``b``/``n``/``e`` events match on (cat, id) across pids).
+
+This script concatenates the ``traceEvents`` of N such files:
+
+* events pass through untouched — pids already disambiguate hosts, and
+  the async/flow ids are minted process-unique (``req-<pid>-<seq>``);
+* duplicate ``process_name`` metadata records (ph "M", one per file per
+  pid) are dropped after the first for a (pid, name) pair;
+* ``displayTimeUnit`` is preserved from the first file that sets it.
+
+Usage:
+    python scripts/merge_traces.py -o trace-fleet.json \
+        /tmp/trace-host0.json /tmp/trace-host1.json
+
+Importable: ``merge(docs) -> dict`` takes already-parsed trace dicts
+(tests/test_obs.py unit-tests it on synthetic host files).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List
+
+
+def merge(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One Chrome-trace dict from many: concatenated ``traceEvents``,
+    metadata-deduplicated, first ``displayTimeUnit`` wins."""
+    out: Dict[str, Any] = {"traceEvents": []}
+    events = out["traceEvents"]
+    seen_meta: set = set()
+    for doc in docs:
+        unit = doc.get("displayTimeUnit")
+        if unit and "displayTimeUnit" not in out:
+            out["displayTimeUnit"] = unit
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                key = (ev.get("pid"), ev.get("name"),
+                       json.dumps(ev.get("args", {}), sort_keys=True))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            events.append(ev)
+    return out
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge per-host Chrome trace JSON files into one "
+                    "Perfetto-loadable file.")
+    ap.add_argument("inputs", nargs="+",
+                    help="trace-host*.json files, any order")
+    ap.add_argument("-o", "--output", default="trace-fleet.json",
+                    help="merged output path (default %(default)s)")
+    args = ap.parse_args(argv)
+    docs = []
+    for path in args.inputs:
+        with open(path, "r", encoding="utf-8") as f:
+            docs.append(json.load(f))
+    merged = merge(docs)
+    with open(args.output, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    print(f"{args.output}: {len(merged['traceEvents'])} events "
+          f"from {len(docs)} file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -982,6 +982,7 @@ def test_rule_catalog_covers_all_families():
     assert ids == ["DT101", "DT102", "DT103", "DT104", "DT105", "DT106",
                    "DT107", "DT201", "DT202", "DT203", "DT204",
                    "DT301", "DT302", "DT303", "DT304", "DT305", "DT306",
+                   "DT308",
                    "DT400", "DT401", "DT402", "DT403", "DT404", "DT405"]
 
 

@@ -576,6 +576,97 @@ def test_dt306_negative_and_suppression():
     assert findings == []
 
 
+# ------------------------------------------------------------- DT308
+#
+# The catalog is resolved by walking UP from each source file, so these
+# fixtures build real tmp trees (absolute paths) with their own
+# docs/OBSERVABILITY.md — lint_conc's relative fixture paths would
+# resolve against the repo's actual catalog and make the tests hostage
+# to its content.
+
+DT308_CATALOG = """
+# Observability
+
+| metric | type | meaning |
+|---|---|---|
+| `dttpu_cache_hits_total` | counter | cache hits |
+"""
+
+DT308_MODULE = """
+    class Cache:
+        def __init__(self, registry):
+            self.hits = registry.counter(
+                "dttpu_cache_hits_total", "Cache hits.")
+            self.misses = registry.counter(
+                "dttpu_cache_misses_total", "Cache misses.")
+"""
+
+
+def lint_dt308(tmp_path, code, catalog=DT308_CATALOG):
+    root = tmp_path / "proj"
+    (root / "pkg").mkdir(parents=True)
+    if catalog is not None:
+        (root / "docs").mkdir()
+        (root / "docs" / "OBSERVABILITY.md").write_text(catalog)
+    path = str(root / "pkg" / "mod.py")
+    sources = {"pkg.mod": analysis.Source(path, textwrap.dedent(code))}
+    project = analysis.Project.from_sources(sources, set())
+    return analysis.run_concurrency_rules(project, select={"DT308"})
+
+
+def test_dt308_uncatalogued_series_flags(tmp_path):
+    findings = lint_dt308(tmp_path, DT308_MODULE)
+    assert rules_of(findings) == ["DT308"]
+    assert "dttpu_cache_misses_total" in findings[0].message
+    assert "OBSERVABILITY.md" in findings[0].message
+
+
+def test_dt308_documented_twin_is_silent(tmp_path):
+    findings = lint_dt308(
+        tmp_path, DT308_MODULE,
+        catalog=DT308_CATALOG
+        + "| `dttpu_cache_misses_total` | counter | cache misses |\n")
+    assert findings == []
+
+
+def test_dt308_whole_token_match(tmp_path):
+    # a documented name must not excuse a series it merely prefixes
+    findings = lint_dt308(tmp_path, """
+        def make(registry):
+            return registry.gauge(
+                "dttpu_cache_hits_total_v2", "Renamed series.")
+    """)
+    assert rules_of(findings) == ["DT308"]
+    assert "dttpu_cache_hits_total_v2" in findings[0].message
+
+
+def test_dt308_dynamic_and_foreign_names_ignored(tmp_path):
+    # only literal dttpu_ first arguments are in scope: dynamic names
+    # and foreign prefixes never flag (documenting them stays a review
+    # concern, not a lint claim)
+    findings = lint_dt308(tmp_path, """
+        def make(registry, name):
+            registry.counter(name, "Dynamic.")
+            registry.counter("dttpu_" + name, "Built.")
+            registry.histogram("other_series_seconds", "Foreign.")
+    """)
+    assert findings == []
+
+
+def test_dt308_no_catalog_in_scope_is_exempt(tmp_path):
+    findings = lint_dt308(tmp_path, DT308_MODULE, catalog=None)
+    assert findings == []
+
+
+def test_dt308_suppression(tmp_path):
+    findings = lint_dt308(tmp_path, """
+        def make(registry):
+            return registry.counter(  # dtlint: disable=DT308 -- experimental series
+                "dttpu_experimental_total", "Not yet public.")
+    """)
+    assert findings == []
+
+
 # ----------------------------------------------------- infrastructure
 
 def test_cli_concurrency_pass_and_opt_out(tmp_path):

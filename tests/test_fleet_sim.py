@@ -160,6 +160,77 @@ def test_wedged_replica_quarantined_on_virtual_time():
 
 
 # ---------------------------------------------------------------------------
+# sampled request tracing on virtual time (obs/reqtrace.py)
+
+
+def test_sim_emits_sampled_request_lanes_on_virtual_time():
+    """SimEngines mint nothing themselves — router-minted ids arrive at
+    submit and are kept 1-in-``trace_sample``.  Kept lanes carry the
+    full lifecycle vocabulary with VIRTUAL timestamps (ts == virtual
+    seconds * 1e6), and a sim-migrated lane keeps its id across the
+    hop (the import side never re-samples)."""
+    from distributed_tensorflow_tpu.obs import reqtrace
+    from distributed_tensorflow_tpu.obs import trace as obs_trace
+    reqtrace.reset()
+    tracer = obs_trace.activate(obs_trace.Tracer(enabled=True))
+    try:
+        trace = workload.synthesize(400, seed=3, horizon_s=30.0,
+                                    bursts=0, failures=0)
+        fs = _sim(trace, engine=dict(num_slots=4, prefill_chunk=16,
+                                     tick_steps=4, trace_sample=8),
+                  seed=1)
+        rep = fs.run()
+        assert rep["completed"] == len(trace)
+        lanes = reqtrace.completed()
+        # 1-in-8 of 400 over 2 replicas: sampled, not all, not none
+        assert 20 <= len(lanes) <= 80
+        for rec in lanes[:10]:
+            names = [e["name"] for e in rec["events"]]
+            assert names[0] == "request" and "prefill" in names
+            # virtual clocks: the whole run spans ~30 virtual seconds,
+            # so every ts sits far below any wall-clock microsecond
+            # stamp (perf-counter epochs are >> 1e9)
+            assert all(0 <= e["ts"] < 300e6 for e in rec["events"])
+            t = reqtrace.tree(rec["trace_id"])
+            (root,) = t["spans"]
+            assert root["args"]["status"] == "ok"
+    finally:
+        obs_trace.deactivate(tracer)
+        reqtrace.reset()
+
+
+def test_sim_migrated_lane_survives_hop_without_resampling():
+    """A wedge-driven sim migration: every victim lane that was sampled
+    on the source replica continues on the survivor under the SAME id
+    (hops >= 1), never re-rolled by the destination's sampler."""
+    from distributed_tensorflow_tpu.obs import reqtrace
+    from distributed_tensorflow_tpu.obs import trace as obs_trace
+    reqtrace.reset()
+    tracer = obs_trace.activate(obs_trace.Tracer(enabled=True))
+    try:
+        base = workload.synthesize(600, seed=2, horizon_s=40.0,
+                                   bursts=0, failures=0)
+        trace = dataclasses.replace(
+            base, events=(workload.FleetEvent(
+                at_s=5.0, kind="wedge_replica", seconds=30.0),))
+        fs = _sim(trace, engine=dict(num_slots=4, prefill_chunk=16,
+                                     tick_steps=4, trace_sample=4),
+                  watchdog=dict(tick_deadline_s=1.0), seed=4)
+        rep = fs.run()
+        assert rep["migrations"] >= 1
+        migrated = [r for r in reqtrace.completed() if r["hops"] >= 1]
+        assert migrated, "no sampled lane crossed the hop"
+        for rec in migrated:
+            flow = [e["ph"] for e in rec["events"]
+                    if e["cat"] == reqtrace.FLOW_CAT]
+            assert flow == ["s", "f"] * rec["hops"]
+            assert rec["status"] in ("ok", "deadline_exceeded")
+    finally:
+        obs_trace.deactivate(tracer)
+        reqtrace.reset()
+
+
+# ---------------------------------------------------------------------------
 # cost model calibration
 
 

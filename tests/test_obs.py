@@ -5,6 +5,8 @@ MetricsExportHook + RetraceGuard retrace instants + a live scrape).
 """
 import json
 import math
+import os
+import sys
 import urllib.error
 import urllib.request
 
@@ -15,7 +17,10 @@ import pytest
 
 from distributed_tensorflow_tpu import data, obs, ops, optim, train
 from distributed_tensorflow_tpu.obs import device as obs_device
+from distributed_tensorflow_tpu.obs import reqtrace
 from distributed_tensorflow_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _get(url, timeout=10):
@@ -163,6 +168,100 @@ class TestHttp:
             server.stop()
 
 
+# --------------------------------------------- exposition round-trip
+
+class TestExpositionRoundTrip:
+    """parse_exposition/render_exposition must be exact duals —
+    including +Inf histogram buckets and escaped label values, the two
+    spots where a lossy pass would silently corrupt a federated proxy.
+    No hypothesis in the image, so "property test" = seeded random
+    adversarial cases + the parse∘render fixpoint law on each."""
+
+    ALPHABET = ['a', 'Z', '0', ' ', '"', "\\", "\n", "n",
+                "\\n", "\\\\", 'x"y', "µ", "{", "}", "=", ","]
+
+    def _random_families(self, rng):
+        fams = {}
+        for fi in range(rng.randrange(1, 4)):
+            name = f"dttpu_prop_{fi}_total"
+            samples = {}
+            for si in range(rng.randrange(1, 4)):
+                labels = tuple(sorted(
+                    (f"l{li}", "".join(rng.choice(self.ALPHABET)
+                                       for _ in range(rng.randrange(0, 6))))
+                    for li in range(rng.randrange(0, 3))))
+                value = rng.choice(
+                    [0.0, -1.5, 3e18, float("inf"), float("-inf"),
+                     rng.random()])
+                samples[(name, labels)] = value
+            # help is "rest of line": trailing SPACES can't survive a
+            # line-stripping parser (escaped \n and \\ do) — rstrip
+            # them; label VALUES stay fully adversarial, they're quoted
+            help_text = "".join(rng.choice(self.ALPHABET)
+                                for _ in range(5)).rstrip(" ")
+            fams[name] = {"type": rng.choice(["counter", "gauge"]),
+                          "help": help_text,
+                          "samples": samples}
+        return fams
+
+    def test_random_families_survive_parse_render_parse(self):
+        import random
+        rng = random.Random(0xD77)
+        for _ in range(50):
+            fams = self._random_families(rng)
+            text = obs.render_exposition(fams)
+            parsed = obs.parse_exposition(text)
+            for fam, entry in fams.items():
+                assert parsed[fam]["samples"] == entry["samples"], text
+                assert parsed[fam]["help"] == entry["help"], text
+            # the fixpoint law: one more render/parse round changes
+            # nothing (what lets the federation re-proxy a proxy)
+            again = obs.parse_exposition(obs.render_exposition(parsed))
+            assert again == parsed
+
+    def test_inf_buckets_and_escapes_roundtrip_through_registry(self):
+        reg = obs.Registry()
+        h = reg.histogram("dttpu_prop_lat_seconds", "Latency.",
+                          buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        reg.counter("dttpu_prop_req_total", 'Say "hi"\nback\\slash.',
+                    labels={"path": 'a\\n"b"\nc'}).inc()
+        text = reg.expose()
+        parsed = obs.parse_exposition(text)
+        hs = parsed["dttpu_prop_lat_seconds"]["samples"]
+        assert hs[("dttpu_prop_lat_seconds_bucket",
+                   (("le", "+Inf"),))] == 3.0
+        assert parsed["dttpu_prop_req_total"]["samples"][
+            ("dttpu_prop_req_total",
+             (("path", 'a\\n"b"\nc'),))] == 1.0
+        # literal-backslash-then-n must NOT decode as newline, and the
+        # second round trip must agree with the first exactly
+        assert obs.parse_exposition(
+            obs.render_exposition(parsed)) == parsed
+
+    def test_adjacent_escape_sequences_decode_single_pass(self):
+        # ``\\n`` (escaped backslash, then literal n) was the v3 bug:
+        # a sequential .replace() chain ate the backslash it decoded
+        reg = obs.Registry()
+        reg.gauge("dttpu_prop_g", "G.", labels={"v": "\\n"}).set(1)
+        parsed = obs.parse_exposition(reg.expose())
+        assert parsed["dttpu_prop_g"]["samples"][
+            ("dttpu_prop_g", (("v", "\\n"),))] == 1.0
+
+    def test_extra_labels_stamp_and_override(self):
+        reg = obs.Registry()
+        reg.counter("dttpu_prop_c", "C.", labels={"replica": "9",
+                                                  "path": "a"}).inc(2)
+        text = obs.render_exposition(obs.parse_exposition(reg.expose()),
+                                     extra_labels={"replica": "0"})
+        parsed = obs.parse_exposition(text)
+        assert parsed["dttpu_prop_c"]["samples"][
+            ("dttpu_prop_c", (("path", "a"), ("replica", "0")))] == 2.0
+        with pytest.raises(ValueError):
+            obs.render_exposition({}, extra_labels={"bad name!": "x"})
+
+
 # -------------------------------------------------------- device health
 
 class TestDeviceHealth:
@@ -308,3 +407,232 @@ def test_telemetry_off_is_inert(tmp_path):
     assert tele.save_trace() is None
     assert [e for e in tele.tracer.events() if e["ph"] != "M"] == []
     tele.close()
+
+
+# ------------------------------------------------------ request tracing
+
+class TestReqtrace:
+    """obs.reqtrace unit tier: minting gates, lane lifecycle, migration
+    stitching, forensics.  The integration tier (real scheduler through
+    a double migration) lives in tests/test_migration.py."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        reqtrace.reset()
+        yield
+        reqtrace.reset()
+
+    def test_mint_gates_on_tracer_and_configure(self):
+        assert not reqtrace.enabled()
+        assert reqtrace.mint() is None          # no active tracer
+        t = obs_trace.activate(obs.Tracer(enabled=True))
+        try:
+            assert reqtrace.enabled()
+            tid = reqtrace.mint()
+            assert tid is not None and tid.startswith("req-")
+            assert reqtrace.mint() != tid       # sequence advances
+            reqtrace.configure(enabled=False)
+            assert reqtrace.mint() is None      # the bench's off arm
+            reqtrace.configure(enabled=True)
+            assert reqtrace.mint("sim").startswith("sim-")
+        finally:
+            obs_trace.deactivate(t)
+
+    def test_lifecycle_lane_rings_and_trees(self):
+        tid = "req-t-000001"
+        reqtrace.submitted(tid, ts_us=0.0, rid=1, plen=7)
+        reqtrace.stage(tid, "prefill", ts_us=10.0)
+        reqtrace.mark(tid, "first_token", ts_us=15.0, ttft_s=1.5e-5)
+        reqtrace.stage(tid, "decode", ts_us=15.0)
+        assert reqtrace.live_ids() == [tid]
+        reqtrace.retired(tid, "ok", ts_us=40.0, tokens=3)
+        assert reqtrace.live_ids() == []
+        (rec,) = reqtrace.completed()
+        assert rec["status"] == "ok" and rec["hops"] == 0
+        # every async event shares the one (cat, id) pair — the track key
+        assert {(e["cat"], e["id"]) for e in rec["events"]} == {
+            ("request", tid)}
+        t = reqtrace.tree(tid)
+        (root,) = t["spans"]
+        assert root["name"] == "request"
+        assert root["start_us"] == 0.0 and root["end_us"] == 40.0
+        assert [c["name"] for c in root["children"]] == [
+            "queued", "prefill", "decode"]
+        assert [m["name"] for m in root["children"][1]["marks"]] == [
+            "first_token"]
+        assert root["args"]["status"] == "ok"
+
+    def test_migrated_lane_is_one_contiguous_tree(self):
+        tid = "req-t-000002"
+        reqtrace.submitted(tid, ts_us=0.0)
+        reqtrace.stage(tid, "prefill", ts_us=5.0)
+        reqtrace.exported(tid, ts_us=9.0, generated=2)
+        reqtrace.retired(tid, "migrated", ts_us=9.0)   # no-op: lane open
+        assert reqtrace.live_ids() == [tid]
+        reqtrace.imported(tid, ts_us=11.0, resumed=2)
+        reqtrace.stage(tid, "decode", ts_us=14.0)
+        reqtrace.retired(tid, "ok", ts_us=20.0)
+        rec = reqtrace.lookup(tid)
+        assert rec["hops"] == 1 and rec["status"] == "ok"
+        # exactly one flow arrow: s (export, binding-point e) then f
+        flow = [(e["ph"], e.get("bp")) for e in rec["events"]
+                if e["cat"] == "migration"]
+        assert flow == [("s", "e"), ("f", None)]
+        t = reqtrace.tree(tid)
+        (root,) = t["spans"]                  # ONE root: one lane
+        assert [c["name"] for c in root["children"]] == [
+            "queued", "prefill", "queued", "decode"]
+        assert all(c["end_us"] is not None for c in root["children"])
+        assert [m["name"] for m in root["marks"]] == [
+            "exported", "imported"]
+
+    def test_events_forward_to_active_tracer(self):
+        t = obs_trace.activate(obs.Tracer(enabled=True))
+        try:
+            tid = reqtrace.mint()
+            reqtrace.submitted(tid)
+            reqtrace.retired(tid, "ok")
+        finally:
+            obs_trace.deactivate(t)
+        evs = [e for e in t.events() if e.get("cat") == "request"]
+        assert [e["ph"] for e in evs] == ["b", "b", "e", "e"]
+        assert {e["id"] for e in evs} == {tid}
+
+    def test_forensic_dump_snapshots_live_victim(self):
+        tid = "req-t-000003"
+        reqtrace.submitted(tid, ts_us=0.0)
+        reqtrace.stage(tid, "prefill", ts_us=3.0)
+        entry = reqtrace.forensic_dump(tid, "watchdog_quarantine",
+                                       replica=4)
+        assert entry["reason"] == "watchdog_quarantine"
+        assert entry["context"] == {"replica": 4}
+        (root,) = entry["spans"]
+        assert root["end_us"] is None          # still live when dumped
+        assert root["children"][-1]["name"] == "prefill"
+        assert reqtrace.forensics_log()[-1]["trace_id"] == tid
+        assert reqtrace.forensic_dump("req-unknown", "x") is None
+
+    def test_ring_is_bounded(self):
+        reqtrace.configure(ring=4)
+        for i in range(9):
+            tid = f"req-t-{i:06x}"
+            reqtrace.submitted(tid, ts_us=0.0)
+            reqtrace.retired(tid, "ok", ts_us=1.0)
+        ids = [r["trace_id"] for r in reqtrace.completed()]
+        assert len(ids) == 4 and ids[-1] == "req-t-000008"
+
+
+# --------------------------------------------------------- merge_traces
+
+class TestMergeTraces:
+    def _merge_mod(self):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import merge_traces
+        finally:
+            sys.path.pop(0)
+        return merge_traces
+
+    def _host_doc(self, pid, tid):
+        meta = {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"host{pid}"}}
+        return {"displayTimeUnit": "ms", "traceEvents": [
+            meta, dict(meta),                 # per-file duplicate
+            {"name": "request", "ph": "b", "cat": "request", "id": tid,
+             "ts": 1.0 + pid, "pid": pid, "tid": 0}]}
+
+    def test_merge_concatenates_and_dedupes_metadata(self):
+        mod = self._merge_mod()
+        tid = "req-abc-000001"
+        merged = mod.merge([self._host_doc(0, tid),
+                            self._host_doc(1, tid)])
+        assert merged["displayTimeUnit"] == "ms"
+        evs = merged["traceEvents"]
+        metas = [e for e in evs if e["ph"] == "M"]
+        # one per (pid, name, args): in-file + cross-file dupes dropped
+        assert [m["pid"] for m in metas] == [0, 1]
+        lanes = [e for e in evs if e.get("cat") == "request"]
+        # both hosts' async events survive with the SAME (cat, id) —
+        # the stitching invariant the merge exists to preserve
+        assert len(lanes) == 2
+        assert {(e["cat"], e["id"]) for e in lanes} == {
+            ("request", tid)}
+        assert {e["pid"] for e in lanes} == {0, 1}
+
+    def test_cli_merges_files(self, tmp_path):
+        mod = self._merge_mod()
+        a, b = tmp_path / "trace-host0.json", tmp_path / "trace-host1.json"
+        a.write_text(json.dumps(self._host_doc(0, "req-1")))
+        b.write_text(json.dumps(self._host_doc(1, "req-1")))
+        out = tmp_path / "trace-fleet.json"
+        assert mod.main([str(a), str(b), "-o", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert len(doc["traceEvents"]) == 4   # 2 metas + 2 lane events
+
+
+# ------------------------------------------------------------ federation
+
+class TestFederatedMetrics:
+    def test_registries_merge_under_distinct_replica_labels(self):
+        fed = obs.FederatedMetrics()
+        for i in range(2):
+            reg = obs.Registry()
+            reg.counter("dttpu_serve_tokens_total", "Tokens.").inc(
+                10 * (i + 1))
+            fed.add_registry(reg, replica=str(i))
+        parsed = obs.parse_exposition(fed.expose())
+        s = parsed["dttpu_serve_tokens_total"]["samples"]
+        assert s[("dttpu_serve_tokens_total",
+                  (("replica", "0"),))] == 10.0
+        assert s[("dttpu_serve_tokens_total",
+                  (("replica", "1"),))] == 20.0
+        assert parsed["dttpu_federation_sources"]["samples"][
+            ("dttpu_federation_sources", ())] == 3.0  # 2 regs + own
+
+    def test_scraped_peer_and_dead_peer(self):
+        peer = obs.Registry()
+        peer.gauge("dttpu_serve_queue_depth", "Depth.").set(5)
+        server = obs.MetricsServer(peer, port=0).start()
+        fed = obs.FederatedMetrics()
+        fed.add_scrape(server.url + "/metrics", host="peer0")
+        try:
+            parsed = obs.parse_exposition(fed.expose())
+            assert parsed["dttpu_serve_queue_depth"]["samples"][
+                ("dttpu_serve_queue_depth", (("host", "peer0"),))] == 5.0
+        finally:
+            server.stop()
+        # dead peer: skipped + counted, never raises
+        parsed = obs.parse_exposition(fed.expose())
+        assert "dttpu_serve_queue_depth" not in parsed
+        assert parsed["dttpu_federation_scrape_errors_total"]["samples"][
+            ("dttpu_federation_scrape_errors_total", ())] >= 1.0
+
+    def test_slo_gauges_from_streamed_evidence(self):
+        fed = obs.FederatedMetrics()
+        for i in range(100):
+            fed.ingest("pro", ttft_s=0.01 * (i + 1),
+                       tpot_s=0.001, ttft_ok=i < 90, itl_ok=True)
+        parsed = obs.parse_exposition(fed.expose())
+        pro = (("tenant", "pro"),)
+        sam = lambda n: parsed[n]["samples"][(n, pro)]
+        # nearest-rank percentiles over the sorted reservoir
+        assert sam("dttpu_slo_ttft_p50_seconds") == pytest.approx(0.50)
+        assert sam("dttpu_slo_ttft_p99_seconds") == pytest.approx(0.99)
+        assert sam("dttpu_slo_tpot_p50_seconds") == pytest.approx(0.001)
+        assert sam("dttpu_slo_tpot_p99_seconds") == pytest.approx(0.001)
+        # verdicts pool TTFT and inter-token: (90 + 100) / 200
+        assert sam("dttpu_slo_attainment") == pytest.approx(0.95)
+
+    def test_federation_behind_metrics_server(self):
+        reg = obs.Registry()
+        reg.counter("dttpu_steps_total", "Steps.").inc(3)
+        fed = obs.FederatedMetrics().add_registry(reg, replica="0")
+        server = obs.MetricsServer(fed, port=0).start()
+        try:
+            status, text = _get(server.url + "/metrics")
+            assert status == 200
+            parsed = obs.parse_exposition(text)
+            assert parsed["dttpu_steps_total"]["samples"][
+                ("dttpu_steps_total", (("replica", "0"),))] == 3.0
+        finally:
+            server.stop()

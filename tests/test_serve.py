@@ -412,6 +412,43 @@ def test_deadline_expires_queued_and_active_requests():
     assert not eng.busy
 
 
+def test_deadline_expiry_dumps_victim_span_tree():
+    """Tail-latency forensics at the scheduler: a traced request that
+    blows its deadline lands in reqtrace.forensics_log() with reason
+    ``deadline_expired`` and its span tree intact — queued-only for a
+    never-admitted victim, so the dump itself shows WHERE the budget
+    went."""
+    import time as time_mod
+    from distributed_tensorflow_tpu.obs import reqtrace
+    from distributed_tensorflow_tpu.obs import trace as obs_trace
+    model, params = _model_params()
+    reqtrace.reset()
+    tracer = obs_trace.activate(obs_trace.Tracer(enabled=True))
+    try:
+        eng = serve.Engine(model, params, num_slots=1, max_len=64,
+                           prefill_chunk=4, tick_steps=1,
+                           registry=metrics_lib.Registry())
+        h_busy = eng.submit(_prompt(4, seed=1), 8)
+        h_q = eng.submit(_prompt(4, seed=2), 8, deadline_s=0.0)
+        time_mod.sleep(0.005)
+        eng.drain()
+        assert h_busy.status == "ok"
+        assert h_q.status == "deadline_exceeded"
+        victims = [d for d in reqtrace.forensics_log()
+                   if d["reason"] == "deadline_expired"]
+        assert len(victims) == 1
+        (root,) = victims[0]["spans"]
+        assert root["name"] == "request"
+        # the victim never left the queue — the dump says so
+        assert [c["name"] for c in root["children"]] == ["queued"]
+        # and the lane itself retired with the honest status
+        assert reqtrace.lookup(
+            victims[0]["trace_id"])["status"] == "deadline_exceeded"
+    finally:
+        obs_trace.deactivate(tracer)
+        reqtrace.reset()
+
+
 def test_poisoned_request_fails_alone_survivors_bit_exact():
     """THE serve acceptance contract: one request whose callback raises
     mid-decode fails ONLY its own handle; the scheduler keeps ticking

@@ -529,3 +529,83 @@ def test_prefetch_abandonment_joins_producer_under_preemption():
     leftover = [t for t in threading.enumerate()
                 if t.name == "dttpu-prefetch" and t.is_alive()]
     assert leftover == []
+
+
+# ---------------------------------------------------------------------------
+# obs.federate: federation mutates sources while exposing
+
+
+@pytest.mark.race_harness(
+    seed=11, scope=("distributed_tensorflow_tpu/obs/federate.py",))
+def test_federated_metrics_expose_races_ingest_and_add(request):
+    """FederatedMetrics under the forced schedule: two ingest threads
+    stream SLO evidence and a third keeps adding registries while the
+    main thread scrapes ``expose()`` in a loop.  Every exposition must
+    parse cleanly (no torn merge), the per-tenant attainment gauge must
+    equal the pooled verdict ratio at the end, and late-added registries
+    must eventually surface under their replica label."""
+    from distributed_tensorflow_tpu.obs.federate import FederatedMetrics
+
+    fed = FederatedMetrics()
+    base = metrics_lib.Registry()
+    base.counter("dttpu_test_base_total", "seed series").inc(7)
+    fed.add_registry(base, replica="0")
+    errors = []
+    stop = threading.Event()
+
+    def ingester(tenant, ok_every):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                fed.ingest(tenant, ttft_s=0.01 * (i % 10 + 1),
+                           tpot_s=0.001, ttft_ok=i % ok_every != 0,
+                           itl_ok=True)
+            except Exception as e:              # pragma: no cover
+                errors.append(e)
+
+    def adder():
+        for k in range(1, 9):
+            if stop.is_set():
+                break
+            reg = metrics_lib.Registry()
+            reg.gauge("dttpu_test_added", "late source").set(float(k))
+            try:
+                fed.add_registry(reg, replica=str(k))
+            except Exception as e:              # pragma: no cover
+                errors.append(e)
+
+    ts = [threading.Thread(target=ingester, args=("a", 5),
+                           name="dttpu-fed-a", daemon=True),
+          threading.Thread(target=ingester, args=("b", 3),
+                           name="dttpu-fed-b", daemon=True),
+          threading.Thread(target=adder, name="dttpu-fed-add",
+                           daemon=True)]
+    for t in ts:
+        t.start()
+    try:
+        for _ in range(40):
+            text = fed.expose()
+            fams = metrics_lib.parse_exposition(text)   # parses whole
+            fam = fams.get("dttpu_test_base_total")
+            assert fam is not None
+            (key,) = [k for k in fam["samples"] if k[0].endswith("_total")]
+            assert dict(key[1])["replica"] == "0"
+            assert fam["samples"][key] == 7.0
+    finally:
+        stop.set()
+        for t in ts:
+            t.join(timeout=60)
+    assert not errors
+    harness = request.node.race_harness
+    assert harness.preemptions > 0, "harness never fired"
+    # all 8 late registries landed and expose under distinct replicas
+    assert fed.source_count() == 1 + 1 + 8
+    fams = metrics_lib.parse_exposition(fed.expose())
+    added = fams["dttpu_test_added"]["samples"]
+    assert {dict(lbls)["replica"] for _, lbls in added} == {
+        str(k) for k in range(1, 9)}
+    for tenant in ("a", "b"):
+        key = ("dttpu_slo_attainment", (("tenant", tenant),))
+        att = fams["dttpu_slo_attainment"]["samples"][key]
+        assert 0.0 < att <= 1.0
