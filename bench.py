@@ -1477,6 +1477,17 @@ def bench_gpt_serve():
          for _ in range(2)), key=lambda r: r[0])
     contig_tps = sum(len(h.tokens) for h in handles_c) / wall_contig
 
+    # Kernel read path: the SAME paged layout read through the fused
+    # Pallas page-walk kernel instead of the XLA gather.  Off-TPU the
+    # kernel runs in interpret mode, so the CPU smoke exercises the
+    # real kernel body but the ratio only certifies a win on TPU
+    # (scripts/validate_paged_tpu.py owns the Mosaic-compiled numbers).
+    eng_k = make_engine(use_paged_kernel=True)
+    wall_kernel, handles_k = min(
+        (replay_engine(eng_k, prompts, budgets, arrivals, tenants)
+         for _ in range(2)), key=lambda r: r[0])
+    kernel_tps = sum(len(h.tokens) for h in handles_k) / wall_kernel
+
     # Lock-step comparator: same requests, batches of `slots` in arrival
     # order, LEFT-padded to the global max prompt, each batch running its
     # longest member's budget.  Useful tokens = each request's own
@@ -1511,9 +1522,14 @@ def bench_gpt_serve():
 
     ratio_contig = contig_tps / lock_tps
     ratio_paged = engine_tps / lock_tps
+    ratio_kernel = kernel_tps / lock_tps
+    kernel_vs_gather = kernel_tps / engine_tps
     log(f"gpt_serve: paged {engine_tps:,.0f} tok/s, contiguous "
-        f"{contig_tps:,.0f}, lockstep {lock_tps:,.0f} "
-        f"(contiguous {ratio_contig:.2f}x / paged {ratio_paged:.2f}x), "
+        f"{contig_tps:,.0f}, kernel {kernel_tps:,.0f}, lockstep "
+        f"{lock_tps:,.0f} "
+        f"(contiguous {ratio_contig:.2f}x / paged {ratio_paged:.2f}x / "
+        f"kernel {ratio_kernel:.2f}x, kernel vs gather "
+        f"{kernel_vs_gather:.2f}x), "
         f"ttft p50 {ttft_p50*1e3:.1f} ms / p95 {ttft_p95*1e3:.1f} ms "
         f"over {n_req} requests")
 
@@ -1553,6 +1569,14 @@ def bench_gpt_serve():
             tps=sum(len(h.tokens) for h in hs) / wall,
             p50=p50, p95=p95, stats=eng_sp.stats())
 
+    # the kernel read path over the SAME shared-prefix trace (radix
+    # reuse on): prefix hits land pages the kernel then walks
+    eng_spk = make_engine(prefix_cache=True, use_paged_kernel=True)
+    wall_spk, hs_spk = min(
+        (replay_engine(eng_spk, sp_prompts, sp_budgets, sp_arrivals)
+         for _ in range(2)), key=lambda r: r[0])
+    sp_kernel_tps = sum(len(h.tokens) for h in hs_spk) / wall_spk
+
     sp_args = []
     for lo in range(0, n_sp, slots):
         idx = range(lo, min(lo + slots, n_sp))
@@ -1582,6 +1606,9 @@ def bench_gpt_serve():
                           / sp_results["no_reuse"]["tps"], 3),
         lockstep_tokens_per_sec=round(sp_lock_tps, 1),
         vs_lockstep=round(sp_results["reuse"]["tps"] / sp_lock_tps, 3),
+        kernel_tokens_per_sec=round(sp_kernel_tps, 1),
+        kernel_vs_gather=round(
+            sp_kernel_tps / sp_results["reuse"]["tps"], 3),
         prefix_hit_rate=round(st.prefix_hit_rate, 3),
         prefill_windows_skipped=st.prefill_windows_skipped_total,
         prefix_tokens_reused=st.prefix_tokens_reused_total,
@@ -1667,6 +1694,9 @@ def bench_gpt_serve():
                 lockstep_tokens_per_sec=round(lock_tps, 1),
                 vs_lockstep=round(ratio_contig, 3),
                 vs_lockstep_paged=round(ratio_paged, 3),
+                kernel_tokens_per_sec=round(kernel_tps, 1),
+                vs_lockstep_paged_kernel=round(ratio_kernel, 3),
+                paged_kernel_vs_gather=round(kernel_vs_gather, 3),
                 ttft_p50_ms=round(ttft_p50 * 1e3, 3),
                 ttft_p95_ms=round(ttft_p95 * 1e3, 3),
                 requests=n_req, num_slots=slots, prefill_chunk=chunk,
@@ -2442,7 +2472,7 @@ class _BringupExhausted(RuntimeError):
 
 def supervise(config: str, device: str | None = None) -> int:
     """Backend bring-up routed through ``resilience.Supervisor``
-    (ROADMAP Open item 4, honesty-gap half): the probe/backoff/retry
+    (ROADMAP Open item 3, honesty-gap half): the probe/backoff/retry
     loop that used to be hand-rolled here is now the SAME bounded-
     restart machinery the training tier survives preemption with —
     a dead tunnel probe raises ``ConnectionError`` (transient: backoff

@@ -27,6 +27,7 @@ _REGISTRATION_MODULES = (
     "distributed_tensorflow_tpu.models.gpt",
     "distributed_tensorflow_tpu.train.step",
     "distributed_tensorflow_tpu.serve.scheduler",
+    "distributed_tensorflow_tpu.ops.pallas.paged_attention",
 )
 
 

@@ -1048,7 +1048,8 @@ class GPT:
 
     def decode_step_slots_paged(self, params, kv, token_ids, page_tab,
                                 write_col, kv_valid, positions,
-                                adapters=None, adapter_rows=None):
+                                adapters=None, adapter_rows=None,
+                                use_kernel: bool = False):
         """``decode_step_slots`` against a PAGED slot cache.
 
         Same per-row semantics as ``decode_step_slots`` — row r's token
@@ -1070,6 +1071,14 @@ class GPT:
         is exactly ``decode_step_slots``'s on the gathered view — the
         serve tier's paged==contiguous bit-identity tests hold it
         there.
+
+        ``use_kernel`` (STATIC, resolved by the caller through
+        ``attn_lib.resolve_use_paged_kernel``): read the pool through
+        the fused Pallas kernel (ops/pallas/paged_attention.py) — the
+        page walk happens inside the attention loop and the gathered
+        ``[b, view_len, ...]`` operand never materializes.  The write
+        path is the same either way; tests pin kernel == gather token
+        streams bit-for-bit.
         """
         c = self.config
         emb = params["embeddings"]
@@ -1100,6 +1109,10 @@ class GPT:
 
         def attention(q, k_blk, v_blk, kv, i):
             del k_blk, v_blk   # single token: read back through the pool
+            if use_kernel:
+                from ..ops.pallas import paged_attention as paged_lib
+                return paged_lib.paged_decode_attention(q, kv, i,
+                                                        page_tab, valid)
             k_cache, v_cache = self._paged_layer_kv(kv, i, page_tab)
             return attn_lib.dot_product_attention(q, k_cache, v_cache,
                                                   mask=kv_mask)
@@ -1415,7 +1428,7 @@ class GPT:
 
     def decode_window_paged(self, params, kv, token_ids, page_row, pos,
                             head: str = "all", adapters=None,
-                            adapter_rows=None):
+                            adapter_rows=None, use_kernel: bool = False):
         """``decode_window`` against a PAGED cache: a batch-1 window of
         ``s`` tokens at positions ``pos..pos+s-1``, reading and writing
         the shared page pool through one request's ``page_row``
@@ -1442,6 +1455,14 @@ class GPT:
         ``head`` as in ``decode_window``.  Returns (logits, new kv
         pool) — the pool subtree carries no ``pos``; the caller owns
         positions (serve/scheduler tracks them host-side).
+
+        ``use_kernel`` (STATIC): skip the stripe entirely — K/V write
+        straight into their pool cells (the same ``_cache_layer``
+        page-write the per-token step uses) and attention walks the
+        page table inside the fused Pallas kernel
+        (``ops.pallas.paged_window_attention``), causal against the
+        traced ``pos``.  No ``[L, 1, view_len, ...]`` stripe, no
+        scatter-back.
         """
         if head not in ("all", "last", "none"):
             raise ValueError(f"head must be all|last|none; got {head!r}")
@@ -1450,6 +1471,10 @@ class GPT:
             raise ValueError(f"decode_window_paged is batch-1 (one page "
                              f"row = one request); got batch {b}")
         page_size = kv["k"].shape[2]
+        if use_kernel:
+            return self._decode_window_paged_kernel(
+                params, kv, token_ids, page_row, pos, head=head,
+                adapters=adapters, adapter_rows=adapter_rows)
 
         def gather(name):
             g = jnp.take(kv[name], page_row, axis=1)  # [L, mp, pg, ...]
@@ -1468,6 +1493,62 @@ class GPT:
             vals = jnp.take(view[name][:, 0], cols, axis=1)  # [L, s, ...]
             new_kv[name] = kv[name].at[:, pids, offs].set(vals)
         return logits, new_kv
+
+    def _decode_window_paged_kernel(self, params, kv, token_ids,
+                                    page_row, pos, *, head,
+                                    adapters=None, adapter_rows=None):
+        """``decode_window_paged``'s fused-kernel body: the
+        ``decode_window`` structure (embed at ``pos + j``, RoPE at the
+        window positions, write-then-attend per layer, same head
+        modes), but the cache is the POOL — writes land on their pool
+        cells via ``_cache_layer``'s page-write, reads walk ``page_row``
+        inside ``ops.pallas.paged_window_attention`` with the
+        ``col <= pos + j`` causal mask computed in-kernel."""
+        from ..ops.pallas import paged_attention as paged_lib
+        c = self.config
+        b, s = token_ids.shape
+        emb = params["embeddings"]
+        x = jnp.take(emb["word"], token_ids, axis=0)            # [1,s,d]
+        win_pos = pos + jnp.arange(s)
+        if c.position_embedding == "learned":
+            x = x + jnp.take(emb["position"], win_pos, axis=0)
+        x = x.astype(c.dtype)
+
+        rope_cs = None
+        if c.position_embedding == "rope":
+            rope_cs = attn_lib.rope_tables(win_pos, c.head_dim,
+                                           base=c.rope_base)
+
+        page_size = kv["k"].shape[2]
+        cols = pos + jnp.arange(s)
+        pids = jnp.take(page_row, cols // page_size)
+        paged = (pids, cols % page_size)
+
+        def window_attn(q, k_blk, v_blk, kv, i):
+            del k_blk, v_blk   # read back through the pool (prefix + win)
+            return paged_lib.paged_window_attention(q, kv, i, page_row,
+                                                    pos)
+
+        def body(carry, inputs):
+            x, kv = carry
+            p, i = inputs
+            return self._cache_layer(p, x, kv, i,
+                                     write_pos=None, rope_cs=rope_cs,
+                                     attention=window_attn,
+                                     adapters=adapters,
+                                     adapter_rows=adapter_rows,
+                                     paged=paged), None
+
+        (x, new_kv), _ = lax.scan(
+            body, (x, dict(kv)),
+            (params["decoder"], jnp.arange(c.num_layers)))
+        if head == "none":
+            return None, new_kv
+        if head == "last":
+            x = self._norm(params["ln_f"], x[:, -1:, :])
+            return self.logits(params, x)[:, 0, :], new_kv
+        x = self._norm(params["ln_f"], x)
+        return self.logits(params, x), new_kv
 
     def prefill_cache(self, params, cache, token_ids,
                       chunk: Optional[int] = None):

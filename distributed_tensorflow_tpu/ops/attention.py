@@ -31,7 +31,8 @@ from .layers import Layer
 __all__ = ["dot_product_attention", "causal_mask", "padding_mask",
            "attention_core", "ffn_core", "ffn_swiglu_core",
            "rotary_embedding", "rope_tables", "apply_rope",
-           "MultiHeadAttention", "flash_wins", "resolve_use_flash"]
+           "MultiHeadAttention", "flash_wins", "resolve_use_flash",
+           "paged_kernel_wins", "resolve_use_paged_kernel"]
 
 NEG_INF = -1e9  # finite -inf stand-in: keeps softmax well-defined in f32
 
@@ -80,6 +81,43 @@ def resolve_use_flash(use_flash, seq_len: int) -> bool:
     if use_flash == "auto":
         return flash_wins(seq_len)
     return bool(use_flash)
+
+
+# Per-slot view length (pages_per_slot x page_size) at/above which the
+# fused paged-attention kernel (ops/pallas/paged_attention.py)
+# dispatches under use_paged_kernel="auto".  Seeded from the same v5e
+# methodology as _FLASH_MIN_SEQ_DEFAULT: the XLA page-gather the kernel
+# removes costs O(view_len) HBM traffic per layer per step, so the
+# kernel wins as soon as the gathered operand stops fitting the fusion
+# window — measured crossover printed by scripts/validate_paged_tpu.py;
+# override with DTTPU_PAGED_KERNEL_MIN_VIEW, re-calibrate on new
+# hardware.
+_PAGED_KERNEL_MIN_VIEW_DEFAULT = 512
+
+
+def paged_kernel_wins(view_len: int) -> bool:
+    """Auto-dispatch policy: the fused paged-attention kernel only on a
+    real TPU backend and only at per-slot view lengths past the measured
+    crossover (off-TPU the interpret-mode kernel is a correctness tool,
+    never a win)."""
+    import os
+
+    import jax as _jax
+    min_view = int(os.environ.get("DTTPU_PAGED_KERNEL_MIN_VIEW",
+                                  _PAGED_KERNEL_MIN_VIEW_DEFAULT))
+    return view_len >= min_view and _jax.default_backend() == "tpu"
+
+
+def resolve_use_paged_kernel(use_paged_kernel, view_len: int) -> bool:
+    """Resolve a scheduler's ``use_paged_kernel`` (True / False /
+    "auto") for a paged build whose slots see ``view_len`` logical
+    columns — the single dispatch point for the serve tier's paged read
+    path (serve/scheduler.py resolves once at construction; the page-
+    size tileability check lives there too, so this stays a pure policy
+    function)."""
+    if use_paged_kernel == "auto":
+        return paged_kernel_wins(view_len)
+    return bool(use_paged_kernel)
 
 
 def causal_mask(seq_len: int) -> jnp.ndarray:

@@ -13,6 +13,9 @@ exercises the real kernel code paths on the virtual CPU mesh.
 from .flash_attention import flash_attention, make_flash_attention_fn
 from .fused import (fused_adam_update, fused_layernorm, fused_rmsnorm,
                     resolve_fused_ln)
+from .paged_attention import (MIN_PAGE_SIZE, page_size_kernel_ok,
+                              paged_decode_attention,
+                              paged_window_attention)
 
 __all__ = [
     "flash_attention",
@@ -21,4 +24,8 @@ __all__ = [
     "fused_layernorm",
     "fused_rmsnorm",
     "resolve_fused_ln",
+    "MIN_PAGE_SIZE",
+    "page_size_kernel_ok",
+    "paged_decode_attention",
+    "paged_window_attention",
 ]

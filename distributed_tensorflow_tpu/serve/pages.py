@@ -66,14 +66,25 @@ class PagePoolExhausted(RuntimeError):
     retirement frees pages."""
 
 
-def auto_page_size(max_len: int, target: int = 16) -> int:
+def auto_page_size(max_len: int, target: int = 16,
+                   multiple_of: int = 1) -> int:
     """Largest divisor of ``max_len`` that is <= ``target``.  Pages
     must tile ``max_len`` exactly so the gathered page view has the
     SAME shape as the contiguous stripe — that shape equality is what
-    makes paged attention bit-identical to the stripe layout."""
+    makes paged attention bit-identical to the stripe layout.
+
+    ``multiple_of`` additionally constrains the result to multiples of
+    that value — the fused paged-attention kernel's lane-tileability
+    rule (``ops.pallas.MIN_PAGE_SIZE``): Mosaic tiles a page block in
+    sublane units of 8, so the scheduler asks for ``multiple_of=8``
+    when the kernel is in play.  Falls back to the unconstrained pick
+    (kernel-incompatible — the scheduler then logs and takes the
+    gather path) when no such divisor exists."""
     for d in range(min(target, max_len), 0, -1):
-        if max_len % d == 0:
+        if max_len % d == 0 and d % multiple_of == 0:
             return d
+    if multiple_of > 1:
+        return auto_page_size(max_len, target)
     return 1
 
 
@@ -113,20 +124,25 @@ def paged_kv_valid(cache, view_len: int):
 
 
 def decode_paged_step(model, params, cache, page_tab, tokens, live,
-                      adapters=None, adapter_rows=None):
+                      adapters=None, adapter_rows=None,
+                      use_kernel: bool = False):
     """One decode step for every slot against the page pool -> (logits
     [S, vocab], new cache).  The paged twin of
     ``slots.decode_slots_step``: same frozen-dead-row semantics, same
     per-row state advancement; ``page_tab`` [S, pages_per_slot] is the
     traced page-table snapshot for this tick (retired rows map the
-    trash page, so their frozen writes can never touch a live page)."""
+    trash page, so their frozen writes can never touch a live page).
+    ``use_kernel`` (STATIC, resolved once at scheduler construction):
+    read through the fused Pallas page-walk kernel instead of the XLA
+    gather (models/gpt.py ``decode_step_slots_paged``)."""
     import jax.numpy as jnp
     page_size = cache["kv"]["k"].shape[2]
     view_len = page_tab.shape[1] * page_size
     logits, kv = model.decode_step_slots_paged(
         params, cache["kv"], tokens, page_tab, cache["write_col"],
         paged_kv_valid(cache, view_len), cache["positions"],
-        adapters=adapters, adapter_rows=adapter_rows)
+        adapters=adapters, adapter_rows=adapter_rows,
+        use_kernel=use_kernel)
     live = live.astype(jnp.int32)
     return logits, {
         "kv": kv,

@@ -308,7 +308,8 @@ class SlotScheduler:
                  max_queue_depth: Optional[int] = None, tenancy=None,
                  paged: bool = True, page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 use_paged_kernel="auto"):
         import jax
         import jax.numpy as jnp
 
@@ -360,14 +361,52 @@ class SlotScheduler:
         self.pages: Optional[pages_lib.PagePool] = None
         self._page_tab = None
         self._windows_skipped = 0
+        self.use_paged_kernel = False
         if self.paged:
-            page_size = (int(page_size) if page_size
-                         else pages_lib.auto_page_size(max_len))
+            from ..ops import attention as attn_lib
+            from ..ops.pallas import paged_attention as paged_kernel_lib
+            if page_size:
+                page_size = int(page_size)
+            else:
+                # prefer a kernel-tileable size whenever the kernel may
+                # dispatch; plain largest-divisor pick otherwise
+                page_size = pages_lib.auto_page_size(
+                    max_len,
+                    multiple_of=(1 if use_paged_kernel is False
+                                 else paged_kernel_lib.MIN_PAGE_SIZE))
             if page_size < 1 or max_len % page_size:
                 raise ValueError(
                     f"page_size must divide max_len {max_len} (the "
                     f"gathered page view must tile the stripe shape "
                     f"exactly); got {page_size}")
+            # fused-kernel gate: resolved ONCE here (the executables
+            # below close over the static answer — no retrace surface).
+            # An explicit use_paged_kernel=True with a non-tileable
+            # page_size is a configuration error, surfaced NOW as a
+            # ValueError instead of a Mosaic failure inside the kernel;
+            # "auto" falls back to the gather read path with a logged
+            # reason.
+            kernel_ok = paged_kernel_lib.page_size_kernel_ok(page_size)
+            if use_paged_kernel is True and not kernel_ok:
+                raise ValueError(
+                    f"use_paged_kernel=True requires a lane-tileable "
+                    f"page_size (a multiple of "
+                    f"{paged_kernel_lib.MIN_PAGE_SIZE}, Mosaic's "
+                    f"sublane tile); got page_size={page_size}. Pick a "
+                    f"compatible page_size or leave use_paged_kernel="
+                    f"'auto' to fall back to the gather read path.")
+            resolved = attn_lib.resolve_use_paged_kernel(
+                use_paged_kernel, max_len)
+            if resolved and not kernel_ok:
+                import warnings
+                warnings.warn(
+                    f"paged-attention kernel disabled: page_size "
+                    f"{page_size} is not a multiple of "
+                    f"{paged_kernel_lib.MIN_PAGE_SIZE} (Mosaic lane "
+                    f"tiling) — falling back to the XLA gather read "
+                    f"path", RuntimeWarning, stacklevel=2)
+                resolved = False
+            self.use_paged_kernel = resolved
             pps = max_len // page_size
             if num_pages is None:
                 # default: the contiguous layout's token capacity
@@ -474,6 +513,11 @@ class SlotScheduler:
             remaining = remaining.at[slot_idx].set(budget - 1)
             return tok, key, tokens, finished, remaining
 
+        # static per-build: the fused-kernel gate resolved above — the
+        # three paged executables close over the answer, so the kernel
+        # build REPLACES the gather build (same 3 programs, DT405-pinned)
+        use_kernel = self.use_paged_kernel
+
         def paged_win_mid(params, cache, window, page_row, pos, ad,
                           ad_row):
             """Mid prefill window straight into the request's pages —
@@ -481,7 +525,8 @@ class SlotScheduler:
             through so win/admit/tick chain on one buffer set."""
             _, kv = model.decode_window_paged(
                 params, cache["kv"], window, page_row, pos,
-                head="none", adapters=ad, adapter_rows=ad_row)
+                head="none", adapters=ad, adapter_rows=ad_row,
+                use_kernel=use_kernel)
             return dict(cache, kv=kv)
 
         def paged_last_admit(params, cache, window, page_row, pos,
@@ -494,7 +539,8 @@ class SlotScheduler:
             handed to the next tick)."""
             logits, kv = model.decode_window_paged(
                 params, cache["kv"], window, page_row, pos,
-                head="all", adapters=ad, adapter_rows=ad_row)
+                head="all", adapters=ad, adapter_rows=ad_row,
+                use_kernel=use_kernel)
             tok, key, tokens, finished, remaining = first_token(
                 logits, last_idx, key, tokens, finished, remaining,
                 slot_idx, budget)
@@ -514,7 +560,8 @@ class SlotScheduler:
                     carry,
                     lambda cache, toks, live: pages_lib.decode_paged_step(
                         model, params, cache, page_tab, toks, live,
-                        adapters=ad, adapter_rows=ad_rows))
+                        adapters=ad, adapter_rows=ad_rows,
+                        use_kernel=use_kernel))
 
             carry, (em, mask) = jax.lax.scan(
                 one, (cache, tokens, finished, remaining, key), None,

@@ -551,6 +551,13 @@ class TestGptLong:
         assert r["lockstep_tokens_per_sec"] > 0
         assert r["vs_lockstep"] == r["vs_baseline"]
         assert r["vs_lockstep_paged"] > 0
+        # the fused page-walk kernel leg: same paged layout read
+        # through the Pallas kernel (interpret mode on CPU, so the
+        # ratio vs the gather path is informational off-TPU — the
+        # fields just have to exist and be sane)
+        assert r["kernel_tokens_per_sec"] > 0
+        assert r["vs_lockstep_paged_kernel"] > 0
+        assert r["paged_kernel_vs_gather"] > 0
         assert 0 < r["ttft_p50_ms"] <= r["ttft_p95_ms"]
         assert r["requests"] > 0 and r["num_slots"] > 0
         assert r["page_size"] > 0
@@ -570,6 +577,8 @@ class TestGptLong:
         assert sp["vs_no_reuse"] > 1.0
         assert 0 < sp["ttft_p50_ms"] < sp["no_reuse_ttft_p50_ms"]
         assert sp["lockstep_tokens_per_sec"] > 0
+        assert sp["kernel_tokens_per_sec"] > 0
+        assert sp["kernel_vs_gather"] > 0
         # paged-KV phase 2: at the contiguous layout's HBM budget the
         # paged engine runs strictly more concurrent slots
         assert r["slots_at_fixed_mem"] > r["slots_at_fixed_mem_contiguous"]

@@ -7,8 +7,9 @@ import pytest
 from distributed_tensorflow_tpu.ops.attention import (
     dot_product_attention, padding_mask, causal_mask)
 from distributed_tensorflow_tpu.ops.pallas import (
-    flash_attention, make_flash_attention_fn, fused_adam_update,
-    fused_layernorm, fused_rmsnorm)
+    MIN_PAGE_SIZE, flash_attention, make_flash_attention_fn,
+    fused_adam_update, fused_layernorm, fused_rmsnorm,
+    page_size_kernel_ok, paged_decode_attention, paged_window_attention)
 
 
 def _qkv(key, b=2, s=64, h=4, d=16, dtype=jnp.float32):
@@ -454,3 +455,169 @@ class TestFlashShapeFuzz:
                 np.asarray(got), np.asarray(want), atol=2e-5,
                 err_msg=f"trial {trial}: b={b} s={s} h={h} kvh={kvh} "
                         f"d={d} causal={causal} pad={use_pad}")
+
+
+class TestPagedAttention:
+    """The fused page-walk kernel vs the gather reference: same pool,
+    same table, same masks — the kernel must agree to float round-off
+    (token-level bit-identity is pinned at engine level in
+    tests/test_pages.py)."""
+    L, NP, PG, HD = 2, 14, 8, 16
+
+    def _pool(self, key, kvh, quantized=False):
+        kk, kv_, ks, vs = jax.random.split(key, 4)
+        shape = (self.L, self.NP, self.PG, kvh, self.HD)
+        if quantized:
+            pool = {
+                "k": jax.random.randint(kk, shape, -127, 128, jnp.int8),
+                "v": jax.random.randint(kv_, shape, -127, 128, jnp.int8),
+                "k_scale": jax.random.uniform(
+                    ks, shape[:-1] + (1,), jnp.float32, 0.01, 0.05),
+                "v_scale": jax.random.uniform(
+                    vs, shape[:-1] + (1,), jnp.float32, 0.01, 0.05),
+            }
+        else:
+            pool = {"k": jax.random.normal(kk, shape),
+                    "v": jax.random.normal(kv_, shape)}
+        return pool
+
+    def _dense_kv(self, pool, layer, tab):
+        """The gather read path at test scale: pages -> contiguous."""
+        view = tab.shape[-1] * self.PG
+        def gather(leaf):
+            g = leaf[layer][tab.reshape(-1)]
+            return g.reshape(tab.shape[0], view, *leaf.shape[3:])
+        k, v = gather(pool["k"]), gather(pool["v"])
+        if "k_scale" in pool:
+            k = k.astype(jnp.float32) * gather(pool["k_scale"])
+            v = v.astype(jnp.float32) * gather(pool["v_scale"])
+        return k, v
+
+    @pytest.mark.parametrize("kvh,h,quantized", [
+        (4, 4, False), (2, 4, False), (2, 4, True)],
+        ids=["base", "gqa", "int8"])
+    def test_decode_matches_gather(self, kvh, h, quantized):
+        S, P = 3, 4
+        key = jax.random.PRNGKey(7)
+        pool = self._pool(key, kvh, quantized)
+        rng = np.random.default_rng(11)
+        tab = jnp.asarray(rng.choice(self.NP, size=(S, P), replace=False)
+                          if S * P <= self.NP else
+                          rng.integers(0, self.NP, (S, P)), jnp.int32)
+        view = P * self.PG
+        valid = jnp.asarray(rng.random((S, view)) < 0.6)
+        valid = valid.at[:, 0].set(True)     # no fully-masked rows
+        q = jax.random.normal(jax.random.PRNGKey(8), (S, 1, h, self.HD))
+        for layer in range(self.L):
+            got = paged_decode_attention(q, pool, layer, tab, valid)
+            k, v = self._dense_kv(pool, layer, tab)
+            want = dot_product_attention(
+                q, k.astype(q.dtype), v.astype(q.dtype),
+                mask=padding_mask(valid))
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=2e-6, rtol=2e-6)
+
+    @pytest.mark.parametrize("pos", [0, 5, 17])
+    def test_window_matches_reference(self, pos):
+        kvh = h = 4
+        P, s = 4, 8
+        pool = self._pool(jax.random.PRNGKey(3), kvh)
+        row = jnp.asarray([5, 2, 9, 0], jnp.int32)
+        view = P * self.PG
+        q = jax.random.normal(jax.random.PRNGKey(4), (1, s, h, self.HD))
+        got = paged_window_attention(q, pool, 1, row, pos)
+        k, v = self._dense_kv(pool, 1, row[None, :])
+        cols = jnp.arange(view)[None, None, None, :]
+        rows = jnp.arange(s)[None, None, :, None]
+        mask = jnp.where(cols <= pos + rows, 0.0, -1e9)
+        want = dot_product_attention(q, k, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-6, rtol=2e-6)
+
+    def test_gqa_window_matches_reference(self):
+        kvh, h = 2, 4
+        P, s, pos = 3, 6, 4
+        pool = self._pool(jax.random.PRNGKey(5), kvh)
+        row = jnp.asarray([1, 7, 3], jnp.int32)
+        view = P * self.PG
+        q = jax.random.normal(jax.random.PRNGKey(6), (1, s, h, self.HD))
+        got = paged_window_attention(q, pool, 0, row, pos)
+        k, v = self._dense_kv(pool, 0, row[None, :])
+        cols = jnp.arange(view)[None, None, None, :]
+        rows = jnp.arange(s)[None, None, :, None]
+        mask = jnp.where(cols <= pos + rows, 0.0, -1e9)
+        want = dot_product_attention(q, k, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-6, rtol=2e-6)
+
+    def test_trash_pages_bitwise_inert(self):
+        """Pages the table never references (the retirement trash
+        mapping) must not perturb a single output bit."""
+        S, P, kvh, h = 2, 3, 2, 4
+        pool = self._pool(jax.random.PRNGKey(9), kvh)
+        tab = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
+        view = P * self.PG
+        rng = np.random.default_rng(13)
+        valid = jnp.asarray(rng.random((S, view)) < 0.7).at[:, 0].set(True)
+        q = jax.random.normal(jax.random.PRNGKey(10), (S, 1, h, self.HD))
+        base = np.asarray(paged_decode_attention(q, pool, 0, tab, valid))
+        trash = np.setdiff1d(np.arange(self.NP), np.asarray(tab))
+        scrambled = dict(pool)
+        for leaf in ("k", "v"):
+            scrambled[leaf] = pool[leaf].at[:, trash].set(
+                jax.random.normal(jax.random.PRNGKey(99),
+                                  (self.L, trash.size, self.PG, kvh,
+                                   self.HD)))
+        got = np.asarray(paged_decode_attention(q, scrambled, 0, tab,
+                                                valid))
+        assert np.array_equal(base, got)
+
+    def test_under_jit_with_traced_layer(self):
+        """The serve tier calls the kernel inside lax.scan with a traced
+        layer index; pin that the scalar-prefetch operand tolerates it."""
+        S, P, kvh, h = 2, 2, 2, 4
+        pool = self._pool(jax.random.PRNGKey(12), kvh)
+        tab = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        valid = jnp.ones((S, P * self.PG), jnp.bool_)
+        q = jax.random.normal(jax.random.PRNGKey(13), (S, 1, h, self.HD))
+
+        @jax.jit
+        def both_layers(q, pool, tab, valid):
+            def body(_, i):
+                return None, paged_decode_attention(q, pool, i, tab, valid)
+            _, outs = jax.lax.scan(body, None, jnp.arange(self.L))
+            return outs
+
+        outs = both_layers(q, pool, tab, valid)
+        for layer in range(self.L):
+            direct = paged_decode_attention(q, pool, layer, tab, valid)
+            np.testing.assert_allclose(np.asarray(outs[layer]),
+                                       np.asarray(direct), atol=1e-6)
+
+    def test_page_size_kernel_ok(self):
+        assert page_size_kernel_ok(8) and page_size_kernel_ok(16)
+        assert page_size_kernel_ok(MIN_PAGE_SIZE)
+        assert not page_size_kernel_ok(4)
+        assert not page_size_kernel_ok(10)
+        assert not page_size_kernel_ok(0)
+
+
+class TestPagedKernelDispatch:
+    def test_resolve_use_paged_kernel(self, monkeypatch):
+        from distributed_tensorflow_tpu.ops import attention as attn_lib
+        assert attn_lib.resolve_use_paged_kernel(True, 8) is True
+        assert attn_lib.resolve_use_paged_kernel(False, 99999) is False
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert attn_lib.resolve_use_paged_kernel("auto", 99999) is False
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert attn_lib.resolve_use_paged_kernel("auto", 2048) is True
+        assert attn_lib.resolve_use_paged_kernel("auto", 128) is False
+
+    def test_paged_kernel_min_view_env(self, monkeypatch):
+        from distributed_tensorflow_tpu.ops import attention as attn_lib
+        monkeypatch.setenv("DTTPU_PAGED_KERNEL_MIN_VIEW", "64")
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert attn_lib.paged_kernel_wins(128) is False
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert attn_lib.paged_kernel_wins(128) is True
+        assert attn_lib.paged_kernel_wins(32) is False
