@@ -2307,11 +2307,18 @@ def bench_recovery():
     plan = faults.FaultPlan(
         [{"kind": "kill_prefetch", "at": kill_at_batch}], registry=reg)
     sup = Supervisor(max_restarts=2, backoff_base=0.01, registry=reg)
+    # goodput accounting (obs/goodput.py): the supervised run's wall
+    # clock attributed into step / checkpoint / backoff / stall buckets
+    # — the recovery row carries the split so "how much did that fault
+    # cost" is a number, not a rerun
+    from distributed_tensorflow_tpu.obs import goodput as goodput_lib
+    acct = goodput_lib.GoodputAccountant(registry=reg)
     try:
-        with faults.activated(plan):
+        with faults.activated(plan), goodput_lib.activated(acct):
             final_step = sup.run(build_session, train_fn)
     finally:
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+    goodput_report = acct.report()
 
     lost = (fail_steps[0] - resumed_steps[0]
             if fail_steps and resumed_steps else -1)
@@ -2389,6 +2396,10 @@ def bench_recovery():
         "watchdog_quarantined": len(wrouter.quarantined),
         "watchdog_migrations": int(
             wreg.get("dttpu_migrations_total").value),
+        # where the supervised run's wall clock went (buckets sum to
+        # wall_s by construction; goodput_pct = step/wall)
+        "goodput": goodput_report,
+        "goodput_pct": goodput_report["goodput_pct"],
     }
 
 
@@ -2622,6 +2633,52 @@ def supervise(config: str, device: str | None = None) -> int:
     return 3
 
 
+def _git_sha() -> str:
+    """Code identity for the perf ledger: ``DTTPU_GIT_SHA`` when the
+    driver exports it (detached workdirs), else ``git rev-parse`` of the
+    bench's own checkout, else "unknown" — never an exception."""
+    sha = os.environ.get("DTTPU_GIT_SHA")
+    if sha:
+        return sha
+    try:
+        import subprocess
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _backend_fingerprint() -> dict:
+    """Backend/mesh identity for the perf ledger: rows from an 8-way
+    virtual CPU mesh, a single CPU device, and a v4-8 must never be
+    compared as if they were the same machine."""
+    import jax
+    devices = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "device_count": len(devices),
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "process_count": jax.process_count(),
+    }
+
+
+def _stamp_identity(result: dict, config: str) -> dict:
+    """Stamp the JSON line with run identity (obs/ledger.py schema):
+    anonymous rows can only be compared by filename convention."""
+    import uuid
+    from distributed_tensorflow_tpu.obs import ledger as ledger_lib
+    result["schema_version"] = ledger_lib.SCHEMA_VERSION
+    result["run_id"] = uuid.uuid4().hex[:16]
+    result["git_sha"] = _git_sha()
+    result["config"] = config
+    result["timestamp"] = round(time.time(), 3)
+    result["fingerprint"] = _backend_fingerprint()
+    return result
+
+
 def main():
     _load_promoted_defaults()
     config = "mnist_mlp"
@@ -2726,6 +2783,18 @@ def main():
             result["trace_file"] = tracer.save(path)
         except OSError as e:
             log(f"could not write trace file {path}: {e}")
+    _stamp_identity(result, config)
+    ledger_path = os.environ.get("DTTPU_BENCH_LEDGER")
+    if ledger_path:
+        # opt-in (CI sets it): a default repo path would dirty every
+        # test run's working tree with measurement rows
+        try:
+            from distributed_tensorflow_tpu.obs import ledger as ledger_lib
+            ledger_lib.PerfLedger(ledger_path).append(
+                ledger_lib.row_from_bench(result))
+            log(f"ledger: appended {config} row to {ledger_path}")
+        except Exception as e:
+            log(f"ledger append failed ({e}); JSON line still printed")
     if claim_report():
         print(json.dumps(result), flush=True)
 

@@ -168,6 +168,19 @@ def _emit_trace_instant(rec: "_FnTraces", n: int) -> None:
                                       rec.signatures[-1]))
 
 
+def _account_compile():
+    """Goodput frame for the Python tracing this (re)trace is about to
+    run (``obs.goodput`` "compile" bucket).  Trace time is the honest
+    host-side proxy for compilation cost — the XLA compile proper happens
+    later inside the jit call's first execution, invisible from here —
+    and it is exactly the time a retrace steals from a step.  Lazy
+    import for the same no-JAX-contract reason as
+    :func:`_emit_trace_instant`; with no active accountant this returns
+    a cached no-op."""
+    from ..obs import goodput as obs_goodput
+    return obs_goodput.account("compile")
+
+
 class _FnTraces:
     def __init__(self, name: str):
         self.name = name
@@ -298,7 +311,8 @@ class RetraceGuard:
                     raise RetraceBudgetExceeded(msg)
                 print(f"RetraceGuard: {msg}",
                       file=guard.stream or sys.stderr, flush=True)
-            return fun(*args, **kwargs)
+            with _account_compile():
+                return fun(*args, **kwargs)
 
         return traced
 

@@ -21,6 +21,7 @@ from typing import Iterable, Iterator, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..obs import goodput as goodput_lib
 from ..resilience import faults as faults_lib
 
 __all__ = ["Dataset", "prefetch_to_device"]
@@ -196,7 +197,12 @@ def prefetch_to_device(iterator: Iterable, size: int = 2,
 
     try:
         while True:
-            item = handoff.get()     # blocking handoff, no poll
+            # goodput "data_stall": the consumer's blocking wait on the
+            # handoff IS the input-starvation time (a full queue returns
+            # immediately and accrues ~nothing); closed before the yield
+            # so the caller's step time never lands here
+            with goodput_lib.account("data_stall"):
+                item = handoff.get()     # blocking handoff, no poll
             if item is done:
                 if err:
                     raise err[0]

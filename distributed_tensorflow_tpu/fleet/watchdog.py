@@ -54,6 +54,7 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from ..obs import goodput as goodput_lib
 from ..obs import metrics as metrics_lib
 from ..obs import reqtrace
 from .router import Router
@@ -140,9 +141,17 @@ class Watchdog:
             except KeyError:
                 continue        # raced another check()/operator action
             self.unhealthy_total.inc()
+            # the process goodput split at quarantine time: forensics
+            # then show WHERE the wedged replica's wall-clock went
+            # (a fat data_stall or checkpoint bucket vs a genuine hang)
+            acct = goodput_lib.active()
+            extra = ({"goodput_s": {k: round(v, 6) for k, v in
+                                    acct.snapshot().items()}}
+                     if acct is not None else {})
             for trace_id in victims:
                 reqtrace.forensic_dump(trace_id, "watchdog_quarantine",
-                                       replica=rid, verdict=reason)
+                                       replica=rid, verdict=reason,
+                                       **extra)
             with self._lock:
                 self.log.append((rid, reason))
             hits.append((rid, reason))

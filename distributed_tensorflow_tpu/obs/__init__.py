@@ -38,17 +38,23 @@ from __future__ import annotations
 import os
 from typing import Callable, Dict, Optional
 
-from . import device, federate, http, metrics, reqtrace, trace
+from . import (device, federate, goodput, http, ledger, metrics, reqtrace,
+               sentinel, trace)
 from .federate import FederatedMetrics
+from .goodput import GoodputAccountant
 from .http import MetricsServer
+from .ledger import PerfLedger
 from .metrics import (Counter, Gauge, Histogram, Registry, REGISTRY,
                       parse_exposition, render_exposition)
+from .sentinel import Sentinel
 from .trace import Tracer
 
 __all__ = ["Telemetry", "Tracer", "MetricsServer", "Registry", "REGISTRY",
            "Counter", "Gauge", "Histogram", "FederatedMetrics",
+           "GoodputAccountant", "PerfLedger", "Sentinel",
            "parse_exposition", "render_exposition",
-           "device", "federate", "http", "metrics", "reqtrace", "trace"]
+           "device", "federate", "goodput", "http", "ledger", "metrics",
+           "reqtrace", "sentinel", "trace"]
 
 
 class Telemetry:
@@ -72,6 +78,8 @@ class Telemetry:
         explicitly after ``jax.distributed`` init when you have it.
       service: label reported by ``/healthz`` ("train", "serve", ...).
       health_fn: extra health fields merged into the ``/healthz`` doc.
+      statusz_fn: extra debug fields merged into the ``/statusz``
+        snapshot (a serving replica passes ``Engine.stats()`` here).
     """
 
     def __init__(self, trace_dir: Optional[str] = None,
@@ -79,7 +87,8 @@ class Telemetry:
                  registry: Optional[Registry] = None,
                  host_index: Optional[int] = None,
                  service: str = "train",
-                 health_fn: Optional[Callable[[], Dict]] = None):
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 statusz_fn: Optional[Callable[[], Dict]] = None):
         if host_index is None:
             try:
                 host_index = int(os.environ.get("PROCESS_ID", "0"))
@@ -89,12 +98,14 @@ class Telemetry:
         self.trace_dir = trace_dir
         self.service = service
         self.health_fn = health_fn
+        self.statusz_fn = statusz_fn
         self.tracer = Tracer(enabled=trace_dir is not None, pid=host_index)
         self.registry = registry if registry is not None else Registry()
         self.server: Optional[MetricsServer] = None
         if metrics_port is not None:
             self.server = MetricsServer(self.registry, port=metrics_port,
-                                        health_fn=self._health)
+                                        health_fn=self._health,
+                                        statusz_fn=self._statusz)
         self._started = False
         self._closed = False
 
@@ -108,6 +119,15 @@ class Telemetry:
             doc["steps_total"] = steps.value
         if self.health_fn is not None:
             doc.update(self.health_fn())
+        return doc
+
+    def _statusz(self) -> Dict:
+        """Identity fields + the caller's extras; merged over
+        ``http.default_statusz()`` by the endpoint."""
+        doc: Dict = {"service": self.service,
+                     "host_index": self.host_index}
+        if self.statusz_fn is not None:
+            doc.update(self.statusz_fn())
         return doc
 
     # --------------------------------------------------------- lifecycle

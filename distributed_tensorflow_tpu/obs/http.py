@@ -10,6 +10,12 @@ hangs on exit because a scraper holds a connection.
 * ``GET /healthz`` — JSON health document from ``health_fn`` (default
   ``{"status": "ok"}``); a ``health_fn`` raising marks the replica
   unhealthy (HTTP 503) instead of crashing the server.
+* ``GET /statusz`` — JSON *debug* snapshot for a human with ``curl``
+  and a wedged process: the active goodput split (``obs.goodput``),
+  active-tracer event counts, request-trace ring occupancy, plus
+  whatever ``statusz_fn`` contributes (a serving replica passes
+  ``Engine.stats()`` through here).  Unlike ``/metrics`` it needs no
+  exposition parser, and unlike ``/healthz`` it is allowed to be big.
 
 ``port=0`` binds an ephemeral port (tests, multiple replicas per host);
 the bound port is ``server.port`` after ``start()``.
@@ -24,7 +30,30 @@ from typing import Callable, Optional
 
 from .metrics import Registry
 
-__all__ = ["MetricsServer"]
+__all__ = ["MetricsServer", "default_statusz"]
+
+
+def default_statusz() -> dict:
+    """The process-wide debug snapshot ``/statusz`` serves: whatever the
+    module-level obs sinks are currently tracking.  Lazy imports keep
+    http importable standalone; every section degrades to absence, so
+    the endpoint always answers."""
+    from . import goodput as goodput_lib
+    from . import reqtrace
+    from . import trace as trace_lib
+    doc: dict = {}
+    acct = goodput_lib.active()
+    if acct is not None:
+        doc["goodput"] = acct.report()
+    tracer = trace_lib.active_tracer()
+    if tracer is not None and tracer.enabled:
+        doc["trace"] = {"events": len(tracer.events()),
+                        "instant_counts": dict(tracer.instant_counts)}
+    doc["reqtrace"] = {"enabled": reqtrace.enabled(),
+                       "live": len(reqtrace.live_ids()),
+                       "completed_ring": len(reqtrace.completed()),
+                       "forensics": len(reqtrace.forensics_log())}
+    return doc
 
 log = logging.getLogger(__name__)
 
@@ -36,11 +65,15 @@ class MetricsServer:
 
     def __init__(self, registry: Registry, port: int = 0,
                  host: str = "127.0.0.1",
-                 health_fn: Optional[Callable[[], dict]] = None):
+                 health_fn: Optional[Callable[[], dict]] = None,
+                 statusz_fn: Optional[Callable[[], dict]] = None):
         self.registry = registry
         self.host = host
         self.requested_port = int(port)
         self.health_fn = health_fn or (lambda: {"status": "ok"})
+        # extra /statusz fields merged OVER the default snapshot (a
+        # serving replica contributes Engine.stats() through this)
+        self.statusz_fn = statusz_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -77,9 +110,21 @@ class MetricsServer:
                         doc, code = {"status": "error", "error": str(e)}, 503
                     self._send(code, "application/json",
                                json.dumps(doc).encode("utf-8"))
+                elif path == "/statusz":
+                    try:
+                        doc = default_statusz()
+                        if server.statusz_fn is not None:
+                            doc.update(server.statusz_fn())
+                        code = 200
+                    except Exception as e:  # debuggable, not crashed
+                        doc, code = {"error": str(e)}, 500
+                    self._send(code, "application/json",
+                               json.dumps(doc, default=str)
+                               .encode("utf-8"))
                 else:
                     self._send(404, "text/plain; charset=utf-8",
-                               b"not found (try /metrics or /healthz)\n")
+                               b"not found (try /metrics, /healthz, "
+                               b"or /statusz)\n")
 
         self._httpd = ThreadingHTTPServer((self.host, self.requested_port),
                                           Handler)
@@ -88,7 +133,8 @@ class MetricsServer:
                                         name="dttpu-metrics-http",
                                         daemon=True)
         self._thread.start()
-        log.info("telemetry endpoint at %s (/metrics, /healthz)", self.url)
+        log.info("telemetry endpoint at %s (/metrics, /healthz, "
+                 "/statusz)", self.url)
         return self
 
     @property

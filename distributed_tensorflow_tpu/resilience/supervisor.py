@@ -37,6 +37,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ..obs import device as obs_device
+from ..obs import goodput as goodput_lib
 from ..obs import metrics as metrics_lib
 from .faults import InjectedFault
 
@@ -137,11 +138,18 @@ class Supervisor:
         failed_at: Optional[float] = None
         while True:
             try:
-                session = build_session()
                 if failed_at is not None:
+                    # goodput "fault_recovery": the post-failure session
+                    # rebuild.  The checkpoint restore inside it accrues
+                    # to its own exclusive "checkpoint_restore" frame, so
+                    # this bucket is the rebuild glue around the restore.
+                    with goodput_lib.account("fault_recovery"):
+                        session = build_session()
                     self.recovery_seconds.observe(
                         time.monotonic() - failed_at)
                     failed_at = None
+                else:
+                    session = build_session()
                 with session:
                     return train(session)
             except BaseException as e:
@@ -157,7 +165,8 @@ class Supervisor:
                     "transient failure (%r) — restart %d/%d from last good "
                     "checkpoint in %.2fs", e, attempt, self.max_restarts,
                     delay)
-                self.sleep(delay)
+                with goodput_lib.account("restart_backoff"):
+                    self.sleep(delay)
 
 
 class NonfiniteGuardHook:

@@ -44,6 +44,7 @@ from typing import Any, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ..obs import goodput as goodput_lib
 from ..obs import metrics as obs_metrics
 from ..resilience import faults as faults_lib
 from ..summary.crc32c import masked_crc32c
@@ -408,12 +409,15 @@ def restore_latest_good(target: Any, ckpt_dir: str
     fresh (loudly), exactly what an operator wants from an auto-resume
     loop at 3am.
     """
-    for path in reversed(all_checkpoints(ckpt_dir)):
-        ok, reason = verify(path, target=target)
-        if ok:
-            try:
-                return restore(target, path), path
-            except Exception as e:
-                reason = f"restore failed: {e!r}"
-        quarantine(path, reason)
-    return None, None
+    # goodput "checkpoint_restore": the whole verified walk counts —
+    # checksumming and quarantining corrupt candidates is restore cost
+    with goodput_lib.account("checkpoint_restore"):
+        for path in reversed(all_checkpoints(ckpt_dir)):
+            ok, reason = verify(path, target=target)
+            if ok:
+                try:
+                    return restore(target, path), path
+                except Exception as e:
+                    reason = f"restore failed: {e!r}"
+            quarantine(path, reason)
+        return None, None

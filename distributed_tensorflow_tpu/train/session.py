@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs import goodput as goodput_lib
 from ..parallel import cluster
 from ..resilience import faults as faults_lib
 from . import checkpoint as ckpt_lib
@@ -152,12 +153,18 @@ class TrainSession:
             args = plan.on_step(self.step, args)
         for hook in self.hooks:
             hook.before_step(self)
-        if self.telemetry is not None:
-            with self.telemetry.tracer.span("dispatch"):
+        # goodput "step" frame: with an active accountant this is where
+        # productive time accrues (a retrace inside the dispatch lands in
+        # "compile" instead — frames are exclusive); inactive = a cached
+        # no-op context manager
+        with goodput_lib.account("step"):
+            if self.telemetry is not None:
+                with self.telemetry.tracer.span("dispatch"):
+                    new_state, metrics = self.step_fn(self.state, *args,
+                                                      **kwargs)
+            else:
                 new_state, metrics = self.step_fn(self.state, *args,
                                                   **kwargs)
-        else:
-            new_state, metrics = self.step_fn(self.state, *args, **kwargs)
         self.state = new_state
         for hook in self.hooks:
             hook.after_step(self, metrics)
@@ -169,14 +176,15 @@ class TrainSession:
         example.py:74-76); non-chief calls are no-ops — except in sharded
         mode, where EVERY process writes the chunks it owns and only the
         manifest is chief-only (inside save_sharded)."""
-        if self.telemetry is None:
-            return self._save_impl()
-        t0 = time.perf_counter()
-        with self.telemetry.tracer.span("checkpoint", step=self.step):
-            path = self._save_impl()
-        self.telemetry.checkpoint_seconds().observe(
-            time.perf_counter() - t0)
-        return path
+        with goodput_lib.account("checkpoint_save"):
+            if self.telemetry is None:
+                return self._save_impl()
+            t0 = time.perf_counter()
+            with self.telemetry.tracer.span("checkpoint", step=self.step):
+                path = self._save_impl()
+            self.telemetry.checkpoint_seconds().observe(
+                time.perf_counter() - t0)
+            return path
 
     def _save_impl(self) -> Optional[str]:
         if not self.checkpoint_dir:

@@ -491,23 +491,28 @@ def restore_latest_good_sharded(target: Any, ckpt_dir: str,
     ``(None, None)``."""
     if not os.path.isdir(ckpt_dir):
         return None, None
-    found = []
-    for name in os.listdir(ckpt_dir):
-        m = _plain._CKPT_RE.match(name)
-        if m:
-            found.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
-    for _, path in sorted(found, reverse=True):
-        ok, reason = verify_sharded(path)
-        if ok:
+    from ..obs import goodput as goodput_lib
+    # goodput "checkpoint_restore": same accounting contract as the
+    # plain walk — verify + quarantine of bad candidates is restore cost
+    with goodput_lib.account("checkpoint_restore"):
+        found = []
+        for name in os.listdir(ckpt_dir):
+            m = _plain._CKPT_RE.match(name)
+            if m:
+                found.append((int(m.group(1)),
+                              os.path.join(ckpt_dir, name)))
+        for _, path in sorted(found, reverse=True):
+            ok, reason = verify_sharded(path)
+            if ok:
+                try:
+                    return restore_sharded(target, path,
+                                           shardings=shardings), path
+                except Exception as e:
+                    reason = f"restore failed: {e!r}"
+            elif reason.startswith("not a sharded-v1"):
+                continue  # a plain checkpoint sharing the dir isn't corrupt
             try:
-                return restore_sharded(target, path,
-                                       shardings=shardings), path
-            except Exception as e:
-                reason = f"restore failed: {e!r}"
-        elif reason.startswith("not a sharded-v1"):
-            continue   # a plain checkpoint sharing the dir is not corrupt
-        try:
-            _plain.quarantine(path, reason)
-        except OSError:   # another process of this job quarantined it first
-            pass
-    return None, None
+                _plain.quarantine(path, reason)
+            except OSError:  # another process quarantined it first
+                pass
+        return None, None

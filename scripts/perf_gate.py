@@ -1,0 +1,139 @@
+"""Perf gate: exit nonzero when a fresh bench row regressed.
+
+The CLI face of ``obs.sentinel``: feed it one fresh bench result line
+(a stamped ``bench.py`` JSON line, or an already-built ledger row) and a
+baseline ledger, and it prints the sentinel's verdict table and exits
+
+* ``0`` — every gated field within tolerance,
+* ``1`` — regression: the output names each failing field, the measured
+  and baseline values, and the delta,
+* ``2`` — usage / input errors (missing row, unreadable ledger, no
+  baseline row for the config when ``--require-baseline``).
+
+CI runs this against the committed ``ledger/baseline.jsonl`` after the
+smoke bench (``.github/workflows/ci.yml`` perf-gate job); the red
+direction is exercised by an injected-regression test, not a red CI.
+
+Usage:
+    python bench.py --config=mnist_mlp | \
+        python scripts/perf_gate.py --row=- \
+            --baseline=ledger/baseline.jsonl
+    python scripts/perf_gate.py --row=fresh.json \
+        --baseline=ledger/baseline.jsonl \
+        --tolerance value=0.5: --tolerance step_time_p50_ms=:2.0
+
+``--tolerance field=min:max`` overrides the per-field ratio bounds
+(either side empty keeps the jitter-sized default).  ``--append-to``
+additionally appends the fresh row to a ledger (the CI job uses this to
+upload the run's ledger as an artifact).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_tensorflow_tpu.obs import ledger as ledger_lib  # noqa: E402
+from distributed_tensorflow_tpu.obs import sentinel as sentinel_lib  # noqa: E402
+
+
+def _load_row(spec: str) -> dict:
+    """Read a row from a file (or stdin for ``-``): accepts a stamped
+    bench result line or an already-shaped ledger row, last JSON object
+    wins (bench children may log above the result line)."""
+    text = sys.stdin.read() if spec == "-" else open(
+        spec, "r", encoding="utf-8").read()
+    row = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+    if row is None:
+        raise ValueError(f"no JSON object found in {spec!r}")
+    if "measured" not in row:      # a raw bench line, not a ledger row
+        row = ledger_lib.row_from_bench(row)
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--row", required=True,
+                    help="fresh bench JSON line / ledger row file, "
+                         "or - for stdin")
+    ap.add_argument("--baseline", required=True,
+                    help="baseline ledger JSONL "
+                         "(e.g. ledger/baseline.jsonl)")
+    ap.add_argument("--config", default=None,
+                    help="baseline config to compare against "
+                         "(default: the fresh row's own config)")
+    ap.add_argument("--backend", default=None,
+                    help="restrict the baseline lookup to one backend "
+                         "fingerprint (cpu/tpu)")
+    ap.add_argument("--tolerance", action="append", default=[],
+                    metavar="FIELD=MIN:MAX",
+                    help="per-field ratio bounds override (repeatable; "
+                         "empty side keeps the default)")
+    ap.add_argument("--roofline-floor", type=float,
+                    default=sentinel_lib.DEFAULT_ROOFLINE_FLOOR,
+                    help="minimum measured-mfu / analytical-mfu ratio")
+    ap.add_argument("--require-baseline", action="store_true",
+                    help="error (exit 2) when the baseline ledger has "
+                         "no row for this config, instead of gating on "
+                         "roofline only")
+    ap.add_argument("--append-to", default=None,
+                    help="also append the fresh row to this ledger "
+                         "(the CI artifact ledger)")
+    args = ap.parse_args(argv)
+
+    try:
+        row = _load_row(args.row)
+        tolerances = sentinel_lib.parse_tolerance_overrides(args.tolerance)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+
+    config = args.config or row.get("config") or ""
+    baseline_row = None
+    try:
+        baseline_row = ledger_lib.PerfLedger(args.baseline).latest(
+            config, backend=args.backend)
+    except OSError as e:
+        print(f"perf_gate: unreadable baseline ledger: {e}",
+              file=sys.stderr)
+        return 2
+    if baseline_row is None:
+        msg = (f"perf_gate: no baseline row for config={config!r}"
+               + (f" backend={args.backend!r}" if args.backend else "")
+               + f" in {args.baseline}")
+        if args.require_baseline:
+            print(msg, file=sys.stderr)
+            return 2
+        print(msg + " — gating on roofline drift only", file=sys.stderr)
+
+    sent = sentinel_lib.Sentinel(tolerances=tolerances,
+                                 roofline_floor=args.roofline_floor)
+    verdicts = sent.check(row, baseline=baseline_row)
+    print(sentinel_lib.Sentinel.report(verdicts, row=row))
+
+    if args.append_to:
+        try:
+            ledger_lib.PerfLedger(args.append_to).append(row)
+        except (OSError, ledger_lib.LedgerSchemaError) as e:
+            print(f"perf_gate: could not append to {args.append_to}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    return 1 if any(not v.ok for v in verdicts) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,7 +332,72 @@ class TestRecovery:
         stall_ms = result["watchdog_stall_s"] * 1e3
         assert stall_ms < result["watchdog_detect_ms"] < stall_ms + 5e3
         assert result["watchdog_migrations"] >= 1
+        # goodput ledger satellite: the recovery row carries the full
+        # wall-clock split, and the buckets sum to wall within 1%
+        gp = result["goodput"]
+        assert result["goodput_pct"] == gp["goodput_pct"]
+        assert 0.0 < gp["goodput_pct"] <= 100.0
+        assert sum(gp["buckets_s"].values()) == pytest.approx(
+            gp["wall_s"], rel=0.01)
+        for bucket in ("step", "checkpoint_save", "checkpoint_restore",
+                       "restart_backoff", "fault_recovery"):
+            assert gp["buckets_s"][bucket] > 0.0, (bucket, gp)
         json.dumps(result)                      # one-line-JSON safe
+
+
+class TestIdentityStamp:
+    """Every bench line carries run identity (obs/ledger.py schema):
+    run_id, git_sha, backend/mesh fingerprint — anonymous rows can only
+    be compared by filename convention."""
+
+    def test_stamp_identity_fields(self):
+        from distributed_tensorflow_tpu.obs import ledger as ledger_lib
+        r = bench._stamp_identity({"value": 1.0}, "mnist_mlp")
+        assert r["schema_version"] == ledger_lib.SCHEMA_VERSION
+        assert len(r["run_id"]) == 16
+        assert r["config"] == "mnist_mlp"
+        assert r["timestamp"] > 0
+        fp = r["fingerprint"]
+        assert fp["backend"] == "cpu"
+        assert fp["device_count"] >= 1
+        assert fp["process_count"] >= 1
+        assert "device_kind" in fp
+        # two runs never share a run_id
+        r2 = bench._stamp_identity({"value": 1.0}, "mnist_mlp")
+        assert r2["run_id"] != r["run_id"]
+        # a stamped line is directly convertible to a ledger row
+        ledger_lib.validate_row(ledger_lib.row_from_bench(r))
+
+    def test_git_sha_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("DTTPU_GIT_SHA", "cafe1234babe")
+        assert bench._git_sha() == "cafe1234babe"
+        monkeypatch.delenv("DTTPU_GIT_SHA")
+        sha = bench._git_sha()       # this repo IS a git checkout
+        assert sha and sha != "unknown" and "\n" not in sha
+
+    @pytest.mark.slow
+    def test_smoke_line_is_stamped_and_ledgered(self, tmp_path):
+        """Subprocess contract: the stamps survive the supervise()
+        parent re-dump, and DTTPU_BENCH_LEDGER appends one valid row.
+        A full bench subprocess, so slow-tier like the other smokes."""
+        from distributed_tensorflow_tpu.obs import ledger as ledger_lib
+        ledger_path = str(tmp_path / "ledger.jsonl")
+        proc = _run(["--device=cpu"],
+                    _env(DTTPU_BENCH_LEDGER=ledger_path,
+                         DTTPU_GIT_SHA="feedbeef0123"))
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        r = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+        assert r["git_sha"] == "feedbeef0123"
+        assert r["config"] == "mnist_mlp"
+        assert len(r["run_id"]) == 16
+        assert r["fingerprint"]["backend"] == "cpu"
+        rows = ledger_lib.PerfLedger(ledger_path).rows()
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["run_id"] == r["run_id"]
+        assert row["git_sha"] == "feedbeef0123"
+        assert row["measured"]["value"] == r["value"]
+        assert row["knobs"].get("DTTPU_BENCH_SMOKE") == "1"
 
 
 class TestHelpers:

@@ -1,0 +1,378 @@
+"""Perf ledger + regression sentinel tests (obs/ledger.py,
+obs/sentinel.py, scripts/perf_gate.py; docs/OBSERVABILITY.md
+§Ledger/Sentinel).
+
+Durability is the headline: concurrent appenders under the race harness
+must never produce a torn line, a corrupt trailing line must be skipped
+loudly on load (a crash mid-append), and schema-version skew must skip,
+not crash.  The sentinel half pins both gate directions: green on an
+unchanged row, red — naming the field and delta — on a ~2x slowdown and
+on measured MFU collapsing away from the analytical ceiling.
+"""
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from distributed_tensorflow_tpu.obs import ledger as ledger_lib
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.obs import sentinel as sentinel_lib
+from distributed_tensorflow_tpu.obs.ledger import (LedgerSchemaError,
+                                                   PerfLedger,
+                                                   row_from_bench)
+from distributed_tensorflow_tpu.obs.sentinel import (Sentinel, Tolerance,
+                                                     classify_field)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _row(config="mnist_mlp", run_id="r1", value=1000.0, p50=2.0,
+         mfu=None, analytical_mfu=None, ts=1000.0, backend="cpu"):
+    row = {
+        "schema_version": ledger_lib.SCHEMA_VERSION,
+        "run_id": run_id, "git_sha": "abc123def456", "config": config,
+        "timestamp": ts,
+        "fingerprint": {"backend": backend, "device_count": 8,
+                        "device_kind": "cpu", "process_count": 1},
+        "measured": {"value": value, "step_time_p50_ms": p50},
+        "analytical": {},
+    }
+    if mfu is not None:
+        row["measured"]["mfu"] = mfu
+    if analytical_mfu is not None:
+        row["analytical"]["analytical_mfu"] = analytical_mfu
+    return row
+
+
+# ---------------------------------------------------------------------------
+# append / load mechanics
+
+
+class TestLedgerBasics:
+    def test_append_rows_round_trip(self, tmp_path):
+        led = PerfLedger(str(tmp_path / "perf.jsonl"))
+        written = led.append(_row(run_id="a"))
+        led.append(_row(run_id="b", ts=2000.0))
+        assert written["schema_version"] == ledger_lib.SCHEMA_VERSION
+        rows = led.rows()
+        assert [r["run_id"] for r in rows] == ["a", "b"]
+        assert led.skipped_lines == 0 and led.skipped_versions == 0
+
+    def test_append_stamps_version_and_timestamp(self, tmp_path):
+        led = PerfLedger(str(tmp_path / "perf.jsonl"))
+        row = _row()
+        del row["schema_version"]
+        row.pop("timestamp")
+        out = led.append(row)
+        assert out["schema_version"] == ledger_lib.SCHEMA_VERSION
+        assert out["timestamp"] > 0
+
+    def test_schema_violations_raise(self, tmp_path):
+        led = PerfLedger(str(tmp_path / "perf.jsonl"))
+        bad = _row()
+        del bad["run_id"]
+        with pytest.raises(LedgerSchemaError, match="run_id"):
+            led.append(bad)
+        bad = _row()
+        bad["measured"]["value"] = "fast"
+        with pytest.raises(LedgerSchemaError, match="number"):
+            led.append(bad)
+        with pytest.raises(LedgerSchemaError):
+            led.append(["not", "a", "dict"])
+        assert led.rows() == []        # nothing invalid reached disk
+
+    def test_missing_file_is_empty_not_an_error(self, tmp_path):
+        assert PerfLedger(str(tmp_path / "nope.jsonl")).rows() == []
+
+    def test_corrupt_trailing_line_skipped_loudly(self, tmp_path, caplog):
+        path = str(tmp_path / "perf.jsonl")
+        led = PerfLedger(path)
+        led.append(_row(run_id="good"))
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"schema_version": 1, "run_id": "torn", "mea')
+        with caplog.at_level("WARNING"):
+            rows = led.rows()
+        assert [r["run_id"] for r in rows] == ["good"]
+        assert led.skipped_lines == 1
+        assert any("corrupt" in r.message for r in caplog.records)
+
+    def test_schema_version_skew_skipped_loudly(self, tmp_path, caplog):
+        path = str(tmp_path / "perf.jsonl")
+        led = PerfLedger(path)
+        led.append(_row(run_id="current"))
+        future = _row(run_id="future")
+        future["schema_version"] = ledger_lib.SCHEMA_VERSION + 7
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(future) + "\n")
+        with caplog.at_level("WARNING"):
+            rows = led.rows()
+        assert [r["run_id"] for r in rows] == ["current"]
+        assert led.skipped_versions == 1
+        assert any("schema_version" in r.message for r in caplog.records)
+
+
+@pytest.mark.race_harness(seed=11, scope=("obs/",))
+def test_concurrent_appenders_never_tear_a_line(tmp_path):
+    """Eight threads hammering one ledger file under forced preemption:
+    every byte run between newlines must parse as one whole row — the
+    O_APPEND single-write contract."""
+    path = str(tmp_path / "perf.jsonl")
+    THREADS, EACH = 8, 12
+    errors = []
+
+    def appender(tid):
+        led = PerfLedger(path)       # one handle per thread, like CI jobs
+        try:
+            for i in range(EACH):
+                led.append(_row(run_id=f"t{tid}-{i}",
+                                ts=float(tid * 1000 + i)))
+        except Exception as e:       # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=appender, args=(t,),
+                           name=f"dttpu-ledger-{t}", daemon=True)
+          for t in range(THREADS)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errors
+    with open(path, "r", encoding="utf-8") as f:
+        lines = [ln for ln in f.read().splitlines() if ln]
+    assert len(lines) == THREADS * EACH
+    ids = set()
+    for line in lines:
+        row = json.loads(line)       # a torn line dies right here
+        ids.add(row["run_id"])
+    assert len(ids) == THREADS * EACH
+    led = PerfLedger(path)
+    assert len(led.rows()) == THREADS * EACH
+    assert led.skipped_lines == 0
+
+
+# ---------------------------------------------------------------------------
+# queries
+
+
+class TestLedgerQueries:
+    def test_latest_filters_config_and_backend(self, tmp_path):
+        led = PerfLedger(str(tmp_path / "perf.jsonl"))
+        led.append(_row(config="a", run_id="old", ts=1.0))
+        led.append(_row(config="a", run_id="new", ts=2.0))
+        led.append(_row(config="a", run_id="tpu", ts=3.0, backend="tpu"))
+        led.append(_row(config="b", run_id="other", ts=9.0))
+        assert led.latest("a", backend="cpu")["run_id"] == "new"
+        assert led.latest("a", backend="tpu")["run_id"] == "tpu"
+        assert led.latest("a")["run_id"] == "tpu"    # newest overall
+        assert led.latest("zzz") is None
+
+    def test_series_is_time_ordered(self, tmp_path):
+        led = PerfLedger(str(tmp_path / "perf.jsonl"))
+        led.append(_row(run_id="r2", ts=2.0, value=20.0))
+        led.append(_row(run_id="r1", ts=1.0, value=10.0))
+        assert led.series("value", config="mnist_mlp") == [
+            (1.0, 10.0), (2.0, 20.0)]
+        assert led.series("not_measured") == []
+
+    def test_delta_ratios(self):
+        new = _row(value=500.0, p50=4.0)
+        base = _row(value=1000.0, p50=2.0)
+        d = PerfLedger.delta(new, base)
+        assert d["value"]["ratio"] == pytest.approx(0.5)
+        assert d["step_time_p50_ms"]["ratio"] == pytest.approx(2.0)
+
+    def test_row_field_reaches_goodput_buckets(self):
+        row = _row()
+        row["goodput"] = {"goodput_pct": 61.5,
+                          "buckets_s": {"step": 1.25, "other": 0.5}}
+        assert ledger_lib.row_field(row, "goodput_pct") == 61.5
+        assert ledger_lib.row_field(row, "goodput_step_s") == 1.25
+        assert ledger_lib.row_field(row, "value") == 1000.0
+        assert ledger_lib.row_field(row, "nope") is None
+
+
+# ---------------------------------------------------------------------------
+# bench row -> ledger row
+
+
+class TestRowFromBench:
+    def test_splits_measured_and_analytical(self):
+        result = {
+            "metric": "mnist_mlp_train_examples_per_sec_per_chip",
+            "value": 178683.1, "unit": "examples/sec/chip",
+            "eval_accuracy": 1.0, "data": "synthetic",
+            "analytical_flops": 1.0e9, "analytical_mfu": 0.42,
+            "schema_version": ledger_lib.SCHEMA_VERSION,
+            "run_id": "deadbeef", "git_sha": "cafe", "config": "mnist_mlp",
+            "timestamp": 123.0,
+            "fingerprint": {"backend": "cpu", "device_count": 8},
+            "goodput": {"goodput_pct": 50.0},
+        }
+        row = row_from_bench(result, knobs={"DTTPU_BENCH_SMOKE": "1"})
+        ledger_lib.validate_row(row)
+        assert row["run_id"] == "deadbeef"
+        assert row["measured"]["value"] == 178683.1
+        assert "analytical_flops" not in row["measured"]
+        assert row["analytical"]["analytical_mfu"] == 0.42
+        assert row["goodput"]["goodput_pct"] == 50.0
+        assert row["knobs"] == {"DTTPU_BENCH_SMOKE": "1"}
+        # identity/bookkeeping fields never masquerade as measurements
+        assert "timestamp" not in row["measured"]
+        assert "schema_version" not in row["measured"]
+
+
+# ---------------------------------------------------------------------------
+# sentinel
+
+
+class TestSentinel:
+    def test_classify_field_directions(self):
+        assert classify_field("value") == "higher"
+        assert classify_field("tokens_per_sec") == "higher"
+        assert classify_field("mfu") == "higher"
+        assert classify_field("step_time_p50_ms") == "lower"
+        assert classify_field("ttft_ms") == "lower"
+        assert classify_field("watchdog_stall_s") == "lower"
+        # NOT misread as a duration by the "_s" suffix rule
+        assert classify_field("single_step_value") == "higher"
+        assert classify_field("data") is None
+        assert classify_field("dispatch_mode") is None
+
+    def test_green_on_identical_row(self):
+        sent = Sentinel()
+        verdicts = sent.check(_row(), baseline=_row())
+        assert verdicts and all(v.ok for v in verdicts)
+
+    def test_red_on_2x_slowdown_names_field_and_delta(self):
+        sent = Sentinel()
+        slow = _row(value=380.0, p50=5.2)       # ~2.6x worse both ways
+        verdicts = sent.check(slow, baseline=_row(value=1000.0, p50=2.0))
+        bad = {v.field: v for v in verdicts if not v.ok}
+        assert "value" in bad and "step_time_p50_ms" in bad
+        assert bad["value"].ratio == pytest.approx(0.38)
+        report = Sentinel.report(verdicts, row=slow)
+        assert "REGRESSED" in report
+        assert "step_time_p50_ms" in report and "+160.0%" in report
+
+    def test_jitter_within_tolerance_is_green(self):
+        sent = Sentinel()
+        wobbly = _row(value=700.0, p50=2.6)     # 30% wobble: CI jitter
+        assert all(v.ok for v in
+                   sent.check(wobbly, baseline=_row()))
+
+    def test_roofline_drift_flags_without_history(self):
+        sent = Sentinel(roofline_floor=0.25)
+        good = sent.check(_row(mfu=0.30, analytical_mfu=0.9))
+        assert [v.kind for v in good] == ["roofline"]
+        assert good[0].ok
+        bad = sent.check(_row(mfu=0.01, analytical_mfu=0.9))
+        assert not bad[0].ok
+        assert "roofline" in bad[0].kind
+        assert "analytical ceiling" in bad[0].detail
+
+    def test_per_field_tolerance_override(self):
+        sent = Sentinel(tolerances={"value": Tolerance(min_ratio=0.95)})
+        verdicts = sent.check(_row(value=900.0),
+                              baseline=_row(value=1000.0))
+        assert not [v for v in verdicts if v.field == "value"][0].ok
+
+    def test_metrics_export(self):
+        reg = metrics_lib.Registry()
+        sent = Sentinel(registry=reg)
+        sent.check(_row(value=100.0), baseline=_row(value=1000.0))
+        assert reg.get("dttpu_sentinel_checks_total").value > 0
+        assert reg.get("dttpu_sentinel_regressions_total").value >= 1
+        g = reg.get("dttpu_sentinel_verdict",
+                    labels={"config": "mnist_mlp"})
+        assert g is not None and g.value == 0.0
+
+    def test_parse_tolerance_overrides(self):
+        tol = sentinel_lib.parse_tolerance_overrides(
+            ["value=0.9:", "p50_ms=:1.5"])
+        assert tol["value"].min_ratio == 0.9
+        assert tol["value"].max_ratio == sentinel_lib.DEFAULT_MAX_RATIO
+        assert tol["p50_ms"].max_ratio == 1.5
+        with pytest.raises(ValueError, match="tolerance"):
+            sentinel_lib.parse_tolerance_overrides(["nonsense"])
+
+
+# ---------------------------------------------------------------------------
+# perf_gate CLI (in-process: the module is import-light by design)
+
+
+@pytest.fixture()
+def perf_gate():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import perf_gate
+        yield perf_gate
+    finally:
+        sys.path.pop(0)
+
+
+class TestPerfGate:
+    def _baseline(self, tmp_path) -> str:
+        path = str(tmp_path / "baseline.jsonl")
+        PerfLedger(path).append(_row(run_id="base"))
+        return path
+
+    def test_green_on_unchanged_row(self, tmp_path, perf_gate, capsys):
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(_row(run_id="fresh")))
+        rc = perf_gate.main(["--row", str(fresh),
+                             "--baseline", self._baseline(tmp_path)])
+        assert rc == 0
+        assert "verdict: pass" in capsys.readouterr().out
+
+    def test_red_on_synthetic_2x_slowdown(self, tmp_path, perf_gate,
+                                          capsys):
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(
+            _row(run_id="slow", value=400.0, p50=5.0)))
+        rc = perf_gate.main(["--row", str(fresh),
+                             "--baseline", self._baseline(tmp_path)])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "FAIL" in out and "step_time_p50_ms" in out
+        assert "+150.0%" in out            # the delta is named
+
+    def test_accepts_raw_bench_line_and_appends(self, tmp_path,
+                                                perf_gate):
+        # a raw stamped bench line (no "measured" section yet) with log
+        # noise above it, exactly what CI pipes in
+        fresh = tmp_path / "bench.out"
+        fresh.write_text(
+            "bench: backend up: 8 device(s)\n" + json.dumps({
+                "metric": "mnist_mlp_train_examples_per_sec_per_chip",
+                "value": 1000.0, "unit": "examples/sec/chip",
+                "step_time_p50_ms": 2.0, "config": "mnist_mlp",
+                "run_id": "raw", "git_sha": "cafe",
+                "schema_version": ledger_lib.SCHEMA_VERSION,
+                "timestamp": 5.0,
+                "fingerprint": {"backend": "cpu", "device_count": 8},
+            }) + "\n")
+        out_ledger = str(tmp_path / "out.jsonl")
+        rc = perf_gate.main(["--row", str(fresh),
+                             "--baseline", self._baseline(tmp_path),
+                             "--append-to", out_ledger])
+        assert rc == 0
+        appended = PerfLedger(out_ledger).rows()
+        assert len(appended) == 1 and appended[0]["run_id"] == "raw"
+
+    def test_missing_baseline_row_modes(self, tmp_path, perf_gate,
+                                        capsys):
+        empty = str(tmp_path / "empty.jsonl")
+        PerfLedger(empty).append(_row(config="unrelated"))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(_row(run_id="fresh")))
+        # default: falls back to roofline-only gating (here: no statics,
+        # so zero checks) and passes
+        rc = perf_gate.main(["--row", str(fresh), "--baseline", empty])
+        assert rc == 0
+        assert "roofline drift only" in capsys.readouterr().err
+        # strict mode: usage error, not a silent pass
+        rc = perf_gate.main(["--row", str(fresh), "--baseline", empty,
+                             "--require-baseline"])
+        assert rc == 2

@@ -153,6 +153,59 @@ class TestHttp:
         finally:
             server.stop()
 
+    def test_statusz_debug_snapshot(self):
+        """/statusz merges the live obs sinks (goodput split, tracer
+        occupancy, reqtrace ring) with whatever statusz_fn contributes —
+        the curl-a-wedged-process endpoint (docs/OBSERVABILITY.md)."""
+        from distributed_tensorflow_tpu.obs import goodput as goodput_lib
+        acct = goodput_lib.GoodputAccountant()
+        tracer = obs_trace.Tracer(enabled=True)
+        tracer.instant("retrace", fn="step")
+        server = obs.MetricsServer(
+            obs.Registry(), port=0,
+            statusz_fn=lambda: {"engine": {"running": 3,
+                                           "waiting": 1}}).start()
+        try:
+            with obs_trace.activated(tracer), \
+                    goodput_lib.activated(acct):
+                with goodput_lib.account("step"):
+                    pass
+                status, body = _get(server.url + "/statusz")
+            assert status == 200
+            doc = json.loads(body)
+            gp = doc["goodput"]
+            assert set(gp["buckets_s"]) == set(goodput_lib.BUCKETS)
+            assert gp["wall_s"] >= gp["buckets_s"]["step"] >= 0.0
+            assert doc["trace"]["events"] >= 1
+            assert doc["trace"]["instant_counts"]["retrace"] == 1
+            # a tracer is active inside the with-block, so reqtrace
+            # minting reports enabled; the ring is untouched
+            assert doc["reqtrace"]["enabled"] is True
+            assert doc["reqtrace"]["live"] == 0
+            # the statusz_fn extras (Engine.stats() in serving) merge in
+            assert doc["engine"] == {"running": 3, "waiting": 1}
+
+            # with every sink inactive, the endpoint still answers
+            status, body = _get(server.url + "/statusz")
+            doc = json.loads(body)
+            assert status == 200 and "goodput" not in doc
+        finally:
+            server.stop()
+
+    def test_statusz_fn_failure_is_500_not_a_crash(self):
+        def broken():
+            raise RuntimeError("stats wedged")
+
+        server = obs.MetricsServer(obs.Registry(), port=0,
+                                   statusz_fn=broken).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.url + "/statusz")
+            assert e.value.code == 500
+            assert "wedged" in e.value.read().decode()
+        finally:
+            server.stop()
+
     def test_healthz_failure_is_503_not_a_crash(self):
         def sick():
             raise RuntimeError("replica wedged")
