@@ -264,7 +264,8 @@ def _peak_hbm_bw():
 
 
 def _attach_analytical(result: dict, step_fn, abstract_args,
-                       tokens_per_step=None) -> dict:
+                       tokens_per_step=None, in_specs=None,
+                       mesh=None) -> dict:
     """Add the dtlint graph-tier cost model's static numbers next to the
     measured ones, making every perf claim cross-checkable against a
     roofline that was computed from the SAME traced program the lint
@@ -282,7 +283,14 @@ def _attach_analytical(result: dict, step_fn, abstract_args,
       below it means the implementation leaves roofline on the table.
       Needs a known peak (``DTTPU_PEAK_FLOPS``/``DTTPU_PEAK_BW`` pin a
       fake roofline on the CPU smoke; bw unknown -> compute-bound
-      ceiling 1.0).
+      ceiling 1.0);
+    * ``analytical_comm_bytes`` / ``analytical_comm_time_s`` (when the
+      caller passes ``in_specs``+``mesh``): the SPMD tier's static
+      communication ledger for the same traced step — per-device wire
+      bytes and modeled time of every collective the propagation finds
+      (docs/ANALYSIS.md §spmd tier).  The sentinel holds these to a
+      tight tolerance: static comm volume only moves when the program
+      changes, so unexpected growth reds ``scripts/perf_gate.py``.
 
     Tracing is abstract (``jax.eval_shape``-style args) and never
     compiles; any failure logs and leaves the measured row intact.
@@ -303,6 +311,17 @@ def _attach_analytical(result: dict, step_fn, abstract_args,
         bw = _peak_hbm_bw()
         ceiling = (min(1.0, bw * cost.intensity / peak) if bw else 1.0)
         result["analytical_mfu"] = round(ceiling, 4)
+    if in_specs is not None and mesh is not None:
+        try:
+            from distributed_tensorflow_tpu.analysis import spmd as spmd_lib
+            ledger = spmd_lib.entry_comm(step_fn, *abstract_args,
+                                         in_specs=in_specs, mesh=mesh)
+            result["analytical_comm_bytes"] = round(
+                float(ledger.total_bytes), 1)
+            result["analytical_comm_time_s"] = float(
+                f"{ledger.total_time_s:.3e}")
+        except Exception as e:  # pragma: no cover - propagation drift
+            log(f"analytical comm ledger unavailable ({e})")
     return result
 
 
@@ -947,8 +966,9 @@ def bench_gpt(seq=None, experts=None):
         lambda p: train.TrainState.create(p, optimizer.init(p)), params)
     batch_a = {"input_ids": jax.ShapeDtypeStruct((batch, seq + 1),
                                                  jnp.int32)}
-    return _attach_analytical(result, step, (state_a, batch_a),
-                              tokens_per_step=batch * seq)
+    return _attach_analytical(
+        result, step, (state_a, batch_a), tokens_per_step=batch * seq,
+        in_specs=(P(), {"input_ids": P("data")}), mesh=mesh)
 
 
 

@@ -13,23 +13,30 @@
       --no-project             skip the interprocedural DT2xx pass
       --no-concurrency         skip the host-concurrency DT3xx pass
       --no-graph               skip the jaxpr graph-tier DT4xx pass
+      --no-spmd                skip the SPMD sharding-tier DT5xx pass
       --no-cache               ignore + don't write .dtlint-cache/
                                (CI runs cold; DTLINT_CACHE_DIR moves it)
       --report costs           print the graph tier's per-entry cost
                                table (FLOPs/bytes/peak/signature) and
                                exit — CI archives it per run
+      --report comms           print the SPMD tier's per-entry static
+                               communication ledger (collective counts,
+                               wire bytes per mesh axis, modeled time)
       --timings                print the per-tier timing breakdown to
                                stderr (what scripts/lint.sh shows CI)
       --list-rules             print the rule catalog
 
-Four passes share one file walk: the per-module tier (DT1xx) runs file
+Five passes share one file walk: the per-module tier (DT1xx) runs file
 by file (parallelizable with ``--jobs``), the interprocedural tier
 (DT2xx) and the host-concurrency tier (DT3xx) each run once over the
 same parsed project, and the graph tier (DT4xx) abstractly traces the
 registered entry points (``analysis.entries``) — it only runs when the
-walk covers the package itself, so fixture runs stay jax-free.  Results
-are memoized by content hash in ``.dtlint-cache/`` (``analysis.cache``),
-so an unchanged tree re-lints in well under a second.
+walk covers the package itself, so fixture runs stay jax-free.  The
+SPMD tier (DT5xx) reuses the graph tier's traced registry (one trace
+serves both) to propagate shardings and build communication ledgers.
+Results are memoized by content hash in ``.dtlint-cache/``
+(``analysis.cache``), so an unchanged tree re-lints in well under a
+second.
 
 Exit status: 0 when no non-baselined findings, 1 when new findings exist,
 2 on usage/parse errors.
@@ -53,6 +60,7 @@ from .project_rules import project_rule_catalog, run_project_rules
 from .report import Finding, render_github, render_json, render_text
 from .rules import rule_catalog as _file_rule_catalog
 from .rules import run_rules
+from .spmd_rules import spmd_rule_catalog
 from .walker import Source, SourceError
 
 __all__ = ["main", "collect_files", "analyze_file", "analyze_paths",
@@ -64,6 +72,7 @@ __all__ = ["main", "collect_files", "analyze_file", "analyze_paths",
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _GRAPH_RULE_IDS = {r for r, _, _ in graph_rule_catalog()}
+_SPMD_RULE_IDS = {r for r, _, _ in spmd_rule_catalog()}
 
 
 def collect_files(paths: Iterable[str]) -> List[str]:
@@ -86,7 +95,8 @@ def collect_files(paths: Iterable[str]) -> List[str]:
 
 def full_rule_catalog():
     return (_file_rule_catalog() + project_rule_catalog()
-            + concurrency_rule_catalog() + graph_rule_catalog())
+            + concurrency_rule_catalog() + graph_rule_catalog()
+            + spmd_rule_catalog())
 
 
 def _read(path: str) -> str:
@@ -118,13 +128,21 @@ def _covers_package(files: Iterable[str]) -> bool:
     return any(os.path.abspath(f).startswith(prefix) for f in files)
 
 
-def _run_graph_tier(select, ignore) -> List[Finding]:
+def _load_traced():
+    """One abstract trace of the entry registry, shared by the graph
+    (DT4xx) and SPMD (DT5xx) tiers — tracing dominates both tiers'
+    cost, so sharing it keeps the cold 5-tier run inside budget."""
     from . import entries as entries_mod
     from .graph import trace_registry
-    from .graph_rules import run_graph_rules
     registry = entries_mod.load_registry()
-    traced = trace_registry(registry)
-    return run_graph_rules(traced, registry, select=select, ignore=ignore)
+    return registry, trace_registry(registry)
+
+
+def _spmd_env_sig() -> str:
+    """Env knobs that change SPMD findings/ledgers (modeled bandwidths)
+    — folded into the tier cache key so flipping them re-runs it."""
+    return ",".join(f"{k}={v}" for k, v in sorted(os.environ.items())
+                    if k.startswith("DTTPU_AXIS_BW"))
 
 
 def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
@@ -132,6 +150,7 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
                   project_pass: bool = True,
                   concurrency_pass: bool = True,
                   graph_pass: bool = True,
+                  spmd_pass: bool = True,
                   cache: Optional[cache_lib.ResultCache] = None,
                   timings: Optional[Dict[str, float]] = None
                   ) -> List[Finding]:
@@ -159,8 +178,8 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
                                           mesh_axes_for(f))
 
     # tier keys + hits (tree-hashed: any edit re-runs the whole tier)
-    proj_key = conc_key = graph_key = None
-    proj_hit = conc_hit = graph_hit = None
+    proj_key = conc_key = graph_key = spmd_key = None
+    proj_hit = conc_hit = graph_hit = spmd_hit = None
     if cache is not None:
         tree = [(f, hashes[f]) for f in files]
         pkg_tree = [(f, h) for f, h in tree
@@ -168,6 +187,10 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
         proj_key = cache.tree_key("project", tree)
         conc_key = cache.tree_key("concurrency", tree)
         graph_key = cache.tree_key("graph", pkg_tree)
+        spmd_key = cache.tree_key(
+            "spmd",
+            pkg_tree + [("__mesh__",
+                         cache.content_hash(_spmd_env_sig()))])
         proj_hit = cache.get_tier(proj_key) if project_pass else None
         conc_hit = cache.get_tier(conc_key) if concurrency_pass else None
 
@@ -252,27 +275,51 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
 
     run_graph = (graph_pass and _covers_package(files)
                  and (select is None or select & _GRAPH_RULE_IDS))
-    if run_graph:
-        if cache is not None:
+    run_spmd = (spmd_pass and _covers_package(files)
+                and (select is None or select & _SPMD_RULE_IDS))
+    if cache is not None:
+        if run_graph:
             graph_hit = cache.get_tier(graph_key)
+        if run_spmd:
+            spmd_hit = cache.get_tier(spmd_key)
+    registry = traced = None
+    if ((run_graph and graph_hit is None)
+            or (run_spmd and spmd_hit is None)):
+        registry, traced = _load_traced()
+    if run_graph:
         if graph_hit is not None:
             findings.extend(graph_hit)
         else:
-            tier = _run_graph_tier(select, ignore)
+            from .graph_rules import run_graph_rules
+            tier = run_graph_rules(traced, registry, select=select,
+                                   ignore=ignore)
             findings.extend(tier)
             if cache is not None:
                 cache.put_tier(graph_key, tier)
     t4 = time.perf_counter()
+    if run_spmd:
+        if spmd_hit is not None:
+            findings.extend(spmd_hit)
+        else:
+            from .spmd import analyze_traced
+            from .spmd_rules import run_spmd_rules
+            tier = run_spmd_rules(analyze_traced(traced), registry,
+                                  select=select, ignore=ignore)
+            findings.extend(tier)
+            if cache is not None:
+                cache.put_tier(spmd_key, tier)
+    t5 = time.perf_counter()
 
     if cache is not None:
         cache.save(live_file_keys=file_keys.values(),
                    live_tier_keys=[k for k in (proj_key, conc_key,
-                                               graph_key)
+                                               graph_key, spmd_key)
                                    if k is not None])
     if timings is not None:
         timings.update({"files": len(files), "per_file_s": t1 - t0,
                         "project_s": t2 - t1, "concurrency_s": t3 - t2,
-                        "graph_s": t4 - t3, "total_s": t4 - t0})
+                        "graph_s": t4 - t3, "spmd_s": t5 - t4,
+                        "total_s": t5 - t0})
     return findings
 
 
@@ -290,6 +337,16 @@ def _report_costs() -> int:
     from .graph import render_costs, trace_registry
     traced = trace_registry(entries_mod.load_registry())
     print(render_costs(traced))
+    return 0
+
+
+def _report_comms() -> int:
+    """``--report comms``: trace the registry, propagate shardings and
+    print the per-entry static communication ledger — the comms
+    analogue of the cost table, archived by CI next to it."""
+    from .spmd import analyze_traced, render_comms
+    _, traced = _load_traced()
+    print(render_comms(analyze_traced(traced)))
     return 0
 
 
@@ -317,11 +374,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the host-concurrency DT3xx pass")
     ap.add_argument("--no-graph", action="store_true",
                     help="skip the jaxpr graph-tier DT4xx pass")
+    ap.add_argument("--no-spmd", action="store_true",
+                    help="skip the SPMD sharding-tier DT5xx pass")
     ap.add_argument("--no-cache", action="store_true",
                     help="run cold: ignore and don't write "
                          ".dtlint-cache/ (what CI does)")
-    ap.add_argument("--report", choices=("costs",),
-                    help="print a graph-tier report instead of linting")
+    ap.add_argument("--report", choices=("costs", "comms"),
+                    help="print a traced-registry report instead of "
+                         "linting (costs: DT4xx table; comms: DT5xx "
+                         "communication ledger)")
     ap.add_argument("--timings", action="store_true",
                     help="print the per-tier timing breakdown to stderr")
     ap.add_argument("--list-rules", action="store_true")
@@ -333,6 +394,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.report == "costs":
         return _report_costs()
+    if args.report == "comms":
+        return _report_comms()
     if args.prune and not args.baseline:
         print("dtlint: error: --prune requires --baseline",
               file=sys.stderr)
@@ -353,6 +416,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  project_pass=not args.no_project,
                                  concurrency_pass=not args.no_concurrency,
                                  graph_pass=not args.no_graph,
+                                 spmd_pass=not args.no_spmd,
                                  cache=cache, timings=timings)
     except (FileNotFoundError, SourceError) as e:
         print(f"dtlint: error: {e}", file=sys.stderr)
@@ -364,6 +428,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"project (DT2xx) {timings['project_s']:.2f}s | "
               f"concurrency (DT3xx) {timings['concurrency_s']:.2f}s | "
               f"graph (DT4xx) {timings['graph_s']:.2f}s | "
+              f"spmd (DT5xx) {timings['spmd_s']:.2f}s | "
               f"total {timings['total_s']:.2f}s", file=sys.stderr)
 
     if args.write_baseline:

@@ -28,6 +28,10 @@ _REGISTRATION_MODULES = (
     "distributed_tensorflow_tpu.train.step",
     "distributed_tensorflow_tpu.serve.scheduler",
     "distributed_tensorflow_tpu.ops.pallas.paged_attention",
+    "distributed_tensorflow_tpu.parallel.data_parallel",
+    "distributed_tensorflow_tpu.parallel.pipeline",
+    "distributed_tensorflow_tpu.parallel.ring",
+    "distributed_tensorflow_tpu.parallel.ring_flash",
 )
 
 
@@ -66,8 +70,15 @@ def _bench_gpt_entry():
 def load_registry() -> Registry:
     """Import every registration module and return the populated global
     registry.  Sets ``JAX_PLATFORMS=cpu`` (if unset) BEFORE the product
-    package imports jax — linting must never grab an accelerator."""
+    package imports jax — linting must never grab an accelerator — and
+    forces 8 virtual host devices (if the backend isn't up yet) so the
+    ``parallel/`` entries trace over real multi-device meshes and the
+    DT5xx communication ledgers have nonzero collective group sizes."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import importlib
     for mod in _REGISTRATION_MODULES:
         importlib.import_module(mod)

@@ -66,6 +66,16 @@ class Target:
     small concrete scalars/arrays — only their shapes/dtypes are used).
     ``donate_argnums`` matters only for *unjitted* callables; jitted
     ones carry their donation in the traced ``pjit`` equation itself.
+
+    The SPMD tier (DT5xx, ``analysis.spmd``) reads three more fields:
+    ``in_specs`` — a (possibly prefix) pytree of ``PartitionSpec`` over
+    ``(args, kwargs)`` declaring how callers shard the inputs (the
+    propagation seed; ``None`` = unknown, the tier degrades gracefully);
+    ``mesh`` — a ``jax.sharding.Mesh`` or ``{axis: size}`` dict naming
+    the mesh the entry runs on (falls back to the first traced
+    ``shard_map`` equation's mesh); ``sharded_update_axis`` — declares
+    the entry performs a ZeRO-style sharded optimizer update over that
+    axis, arming DT503's reduce-scatter/all-gather pairing proof.
     """
     name: str
     fn: Callable
@@ -74,6 +84,9 @@ class Target:
     hbm_budget: Optional[int] = None          # bytes; None = DT404 off
     donate_argnums: Tuple[int, ...] = ()
     const_bytes_limit: Optional[int] = None   # None = DT401 default
+    in_specs: Optional[Any] = None            # PartitionSpec pytree
+    mesh: Optional[Any] = None                # Mesh | {axis: size}
+    sharded_update_axis: Optional[str] = None  # DT503 contract
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +101,9 @@ class Entry:
     const_bytes_limit: Optional[int]
     path: str                       # registration site, for findings
     line: int
+    in_specs: Optional[Any] = None            # SPMD seed (see Target)
+    mesh: Optional[Any] = None
+    sharded_update_axis: Optional[str] = None
 
 
 class Registry:
@@ -104,7 +120,10 @@ class Registry:
                     specs: Optional[tuple] = None,
                     hbm_budget: Optional[int] = None,
                     donate_argnums: Tuple[int, ...] = (),
-                    const_bytes_limit: Optional[int] = None) -> Callable:
+                    const_bytes_limit: Optional[int] = None,
+                    in_specs: Optional[Any] = None,
+                    mesh: Optional[Any] = None,
+                    sharded_update_axis: Optional[str] = None) -> Callable:
         """Register a graph-tier entry point.
 
         Decorates either the traceable function itself (pass ``specs``,
@@ -122,7 +141,9 @@ class Registry:
                           hbm_budget=hbm_budget,
                           donate_argnums=tuple(donate_argnums),
                           const_bytes_limit=const_bytes_limit,
-                          path=path, line=line)
+                          path=path, line=line, in_specs=in_specs,
+                          mesh=mesh,
+                          sharded_update_axis=sharded_update_axis)
             # idempotent by name (module reloads re-register in place)
             self.entries = [e for e in self.entries if e.name != name]
             self.entries.append(entry)
@@ -575,6 +596,64 @@ def _shape_dtype(x):
                                 getattr(x, "dtype", None))
 
 
+def _resolve_mesh_axes(mesh) -> Optional[Tuple[Tuple[str, int], ...]]:
+    """``Mesh`` or ``{axis: size}`` -> ordered ((name, size), ...)."""
+    if mesh is None:
+        return None
+    shape = getattr(mesh, "shape", mesh)
+    try:
+        return tuple((str(k), int(v)) for k, v in dict(shape).items())
+    except Exception:
+        return None
+
+
+def _flatten_in_specs(in_specs, args, kwargs) -> Optional[tuple]:
+    """Broadcast a (possibly prefix) ``PartitionSpec`` pytree over the
+    flat arg leaves — mirrors shard_map's spec-prefix semantics.
+    Returns a flat tuple aligned with ``tree_leaves((args, kwargs))``
+    (kwarg leaves pad with None = unknown), or None when the trees
+    cannot be matched — the SPMD tier then degrades to unknown
+    shardings rather than guessing."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    def is_spec(x):
+        return x is None or isinstance(x, PartitionSpec)
+
+    def expand(spec, sub) -> Optional[List[Any]]:
+        if is_spec(spec):
+            return [spec] * len(jax.tree_util.tree_leaves(sub))
+        if isinstance(spec, (tuple, list)):
+            if not isinstance(sub, (tuple, list)) or len(sub) != len(spec):
+                return None
+            out: List[Any] = []
+            for s, x in zip(spec, sub):
+                part = expand(s, x)
+                if part is None:
+                    return None
+                out.extend(part)
+            return out
+        if isinstance(spec, dict) and isinstance(sub, dict):
+            if set(spec) != set(sub):
+                return None
+            out = []
+            for k in sorted(sub):       # jax flattens dicts by sorted key
+                part = expand(spec[k], sub[k])
+                if part is None:
+                    return None
+                out.extend(part)
+            return out
+        return None
+
+    spec_tree = (tuple(in_specs) if isinstance(in_specs, (tuple, list))
+                 else in_specs)
+    flat = expand(spec_tree, tuple(args))
+    if flat is None:
+        return None
+    flat += [None] * len(jax.tree_util.tree_leaves(kwargs))
+    return tuple(flat)
+
+
 @dataclasses.dataclass
 class TracedEntry:
     """One traced target plus everything the DT4xx rules read."""
@@ -591,6 +670,10 @@ class TracedEntry:
     consts: List[Tuple[Tuple[int, ...], str, int]] = \
         dataclasses.field(default_factory=list)
     donations: List[tuple] = dataclasses.field(default_factory=list)
+    # SPMD-tier registration metadata (analysis.spmd reads these):
+    in_specs: Optional[tuple] = None    # flat PartitionSpec per invar leaf
+    mesh_axes: Optional[Tuple[Tuple[str, int], ...]] = None
+    sharded_update_axis: Optional[str] = None
 
 
 def _build_targets(entry: Entry) -> List[Target]:
@@ -599,7 +682,9 @@ def _build_targets(entry: Entry) -> List[Target]:
                        args=tuple(entry.specs),
                        hbm_budget=entry.hbm_budget,
                        donate_argnums=entry.donate_argnums,
-                       const_bytes_limit=entry.const_bytes_limit)]
+                       const_bytes_limit=entry.const_bytes_limit,
+                       in_specs=entry.in_specs, mesh=entry.mesh,
+                       sharded_update_axis=entry.sharded_update_axis)]
     built = entry.build()
     targets = [built] if isinstance(built, Target) else list(built)
     out = []
@@ -612,7 +697,13 @@ def _build_targets(entry: Entry) -> List[Target]:
             else entry.hbm_budget,
             const_bytes_limit=t.const_bytes_limit
             if t.const_bytes_limit is not None
-            else entry.const_bytes_limit))
+            else entry.const_bytes_limit,
+            in_specs=t.in_specs if t.in_specs is not None
+            else entry.in_specs,
+            mesh=t.mesh if t.mesh is not None else entry.mesh,
+            sharded_update_axis=t.sharded_update_axis
+            if t.sharded_update_axis is not None
+            else entry.sharded_update_axis))
     return out
 
 
@@ -642,7 +733,12 @@ def trace_registry(registry: Optional[Registry] = None
             te = TracedEntry(name=t.name, group=entry.group,
                              path=entry.path, line=entry.line,
                              hbm_budget=t.hbm_budget,
-                             const_bytes_limit=t.const_bytes_limit)
+                             const_bytes_limit=t.const_bytes_limit,
+                             mesh_axes=_resolve_mesh_axes(t.mesh),
+                             sharded_update_axis=t.sharded_update_axis)
+            if t.in_specs is not None:
+                te.in_specs = _flatten_in_specs(t.in_specs, t.args,
+                                                t.kwargs)
             try:
                 closed = jax.make_jaxpr(
                     lambda *a, **k: t.fn(*a, **k))(*t.args, **t.kwargs)

@@ -1,0 +1,1040 @@
+"""dtlint SPMD tier: sharding propagation + static communication ledger.
+
+The graph tier (DT4xx) prices *compute*: FLOPs, bytes, liveness peaks.
+This tier prices *distribution*: it propagates ``PartitionSpec``-style
+shardings from each registered entry's declared input specs through the
+traced ``ClosedJaxpr`` and produces a per-entry **communication
+ledger** — for every collective (``psum``, ``all_gather``,
+``reduce_scatter``, ``ppermute``, ``all_to_all``) and every implicit
+XLA resharding the propagation detects, the bytes moved per mesh axis,
+a modeled per-axis link bandwidth, and the estimated communication
+time.  ``analysis.spmd_rules`` turns the side facts into DT501–DT505
+findings; ``bench.py`` consumes the ledger through :func:`entry_comm`
+to stamp ``analytical_comm_bytes``/``analytical_comm_time_s`` next to
+measured numbers.
+
+Two value-level analyses share one recursive walk:
+
+* **auto regions** (top level, ``pjit`` bodies): every live value
+  carries a *spec* — one tuple of mesh-axis names per array dimension,
+  or UNKNOWN.  Transfer functions cover the common primitive families
+  (elementwise, broadcast/transpose/reshape, ``dot_general``,
+  reductions, gather-from-replicated, ``scan``/``cond``/``while``,
+  ``sharding_constraint``); a ``dot_general``/``reduce_sum`` that
+  contracts a *sharded* dimension yields partial sums, so the
+  partitioner must all-reduce — the ledger records that psum (this is
+  exactly the data-parallel gradient all-reduce, detected statically).
+  **Unhandled primitives degrade to UNKNOWN sharding — downstream facts
+  are simply not claimed, never guessed** (the no-false-positive
+  contract docs/ANALYSIS.md states).
+* **manual regions** (``shard_map`` bodies): every value carries the
+  set of manual mesh axes it is *replicated* over (the lattice the
+  modern API's ``check_vma`` tracks at trace time, reconstructed here
+  statically).  Collectives move the lattice (``psum``/``all_gather``
+  establish replication, ``reduce_scatter``/``all_to_all`` destroy it,
+  ``axis_index`` is born varying) and append ledger events with local
+  shard payloads; ``scan`` bodies multiply event counts by their trip
+  count (the same scan-aware accounting the DT4xx cost model uses).
+
+The boundary between the two — the ``shard_map`` equation — is where
+implicit resharding happens: an operand whose propagated spec shards an
+axis the region's ``in_names`` do not preserve must be all-gathered
+over that axis by XLA before entry (DT501's evidence).
+
+Like ``analysis.graph``, this module is stdlib-only at import time.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .graph import (TracedEntry, _CALL_PRIMS, _aval_bytes, _closed,
+                    _is_literal, _sub_jaxpr)
+
+__all__ = ["MeshModel", "CommEvent", "CommLedger", "SpmdReport",
+           "DEFAULT_AXIS_BANDWIDTH", "collective_wire_bytes",
+           "analyze_traced", "analyze_entry", "entry_comm",
+           "render_comms"]
+
+# Modeled per-axis link bandwidth (bytes/s) — an ICI-class default.
+# Override globally with DTTPU_AXIS_BW or per axis with
+# DTTPU_AXIS_BW_<AXIS> (e.g. DTTPU_AXIS_BW_DATA=2.5e10 to model a DCN
+# data axis), mirroring bench.py's DTTPU_PEAK_* knobs.
+DEFAULT_AXIS_BANDWIDTH = 9.0e10
+
+_COLLECTIVES = ("psum", "all_gather", "reduce_scatter", "ppermute",
+                "all_to_all")
+
+# whole-value "we don't know" sentinel for auto-region specs
+_UNKNOWN = object()
+
+
+# ------------------------------------------------------------ mesh model
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshModel:
+    """Axis names, sizes and modeled link bandwidths for one mesh."""
+    axes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def from_any(cls, mesh) -> Optional["MeshModel"]:
+        if mesh is None:
+            return None
+        if isinstance(mesh, MeshModel):
+            return mesh
+        shape = getattr(mesh, "shape", mesh)
+        try:
+            return cls(tuple((str(k), int(v))
+                             for k, v in dict(shape).items()))
+        except Exception:
+            return None
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    def size(self, name: str) -> int:
+        for n, s in self.axes:
+            if n == name:
+                return s
+        return 1
+
+    def group_size(self, names) -> int:
+        total = 1
+        for n in names:
+            total *= self.size(n)
+        return total
+
+    def bandwidth(self, name: str) -> float:
+        per_axis = os.environ.get(f"DTTPU_AXIS_BW_{name.upper()}")
+        if per_axis:
+            try:
+                return float(per_axis)
+            except ValueError:
+                pass
+        generic = os.environ.get("DTTPU_AXIS_BW")
+        if generic:
+            try:
+                return float(generic)
+            except ValueError:
+                pass
+        return DEFAULT_AXIS_BANDWIDTH
+
+    def group_bandwidth(self, names) -> float:
+        """A multi-axis collective is throttled by its slowest link."""
+        return min([self.bandwidth(n) for n in names]
+                   or [DEFAULT_AXIS_BANDWIDTH])
+
+
+def collective_wire_bytes(op: str, payload_bytes: float, n: int) -> float:
+    """Per-device wire bytes of one collective over a group of ``n``
+    devices with a per-device ``payload_bytes`` operand, under the
+    standard ring algorithms:
+
+    * ``psum`` (ring all-reduce): ``2·B·(n-1)/n``
+    * ``all_gather`` (B = local shard): ``B·(n-1)``
+    * ``reduce_scatter`` (B = local input): ``B·(n-1)/n``
+    * ``ppermute``: ``B`` (every device forwards its buffer once)
+    * ``all_to_all``: ``B·(n-1)/n`` (keeps 1/n locally)
+    * ``resharding``: modeled as the all-gather XLA materializes
+    """
+    if n <= 1:
+        return 0.0
+    if op == "psum":
+        return 2.0 * payload_bytes * (n - 1) / n
+    if op in ("all_gather", "resharding"):
+        return payload_bytes * (n - 1)
+    if op in ("reduce_scatter", "all_to_all"):
+        return payload_bytes * (n - 1) / n
+    if op == "ppermute":
+        return payload_bytes
+    return payload_bytes
+
+
+# ---------------------------------------------------------------- ledger
+
+
+@dataclasses.dataclass(frozen=True)
+class CommEvent:
+    """One collective (or implicit resharding) site in a traced entry."""
+    op: str                      # psum|all_gather|reduce_scatter|...
+    axes: Tuple[str, ...]        # mesh axes the group spans
+    payload_bytes: float         # per-device operand bytes, one execution
+    wire_bytes: float            # per-device wire bytes, one execution
+    count: int                   # executions (scan trips folded in)
+    time_s: float                # total modeled time: wire*count/bw
+    context: str = ""            # e.g. "scan[16]" nesting breadcrumb
+
+    @property
+    def total_bytes(self) -> float:
+        return self.wire_bytes * self.count
+
+
+@dataclasses.dataclass
+class CommLedger:
+    """Per-entry static communication ledger."""
+    mesh: Optional[MeshModel] = None
+    events: List[CommEvent] = dataclasses.field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> float:
+        return sum(e.total_bytes for e in self.events)
+
+    @property
+    def total_time_s(self) -> float:
+        return sum(e.time_s for e in self.events)
+
+    def per_axis_bytes(self) -> Dict[str, float]:
+        """Wire bytes attributed per mesh axis (multi-axis groups split
+        evenly — the table stays additive)."""
+        out: Dict[str, float] = {}
+        for e in self.events:
+            if not e.axes:
+                continue
+            share = e.total_bytes / len(e.axes)
+            for a in e.axes:
+                out[a] = out.get(a, 0.0) + share
+        return out
+
+    def count(self, op: Optional[str] = None) -> int:
+        return sum(e.count for e in self.events
+                   if op is None or e.op == op)
+
+
+@dataclasses.dataclass
+class SpmdReport:
+    """Everything the DT5xx rules (and ``--report comms``) read for one
+    traced entry.  The ``dtNNN`` lists hold preformatted evidence
+    strings; empty list = rule passes."""
+    name: str
+    group: Optional[str]
+    path: str
+    line: int
+    mesh: Optional[MeshModel] = None
+    ledger: CommLedger = dataclasses.field(default_factory=CommLedger)
+    sharded_update_axis: Optional[str] = None
+    dt501: List[str] = dataclasses.field(default_factory=list)
+    dt502: List[str] = dataclasses.field(default_factory=list)
+    dt504: List[str] = dataclasses.field(default_factory=list)
+    dt505: List[str] = dataclasses.field(default_factory=list)
+    unknown_prims: Set[str] = dataclasses.field(default_factory=set)
+
+
+# --------------------------------------------------------- spec plumbing
+
+
+def _norm_pspec(p, rank: int) -> tuple:
+    """PartitionSpec | None -> per-dim tuple of axis-name tuples."""
+    if p is None:
+        return ((),) * rank
+    dims: List[tuple] = []
+    for e in tuple(p):
+        if e is None:
+            dims.append(())
+        elif isinstance(e, (tuple, list)):
+            dims.append(tuple(str(a) for a in e))
+        else:
+            dims.append((str(e),))
+    while len(dims) < rank:
+        dims.append(())
+    return tuple(dims[:rank])
+
+
+def _names_spec(names: Dict[int, tuple], rank: int) -> tuple:
+    """shard_map ``in_names``/``out_names`` dict -> per-dim spec."""
+    return tuple(tuple(names.get(d, ())) for d in range(rank))
+
+
+def _rank(v) -> int:
+    return len(getattr(getattr(v, "aval", None), "shape", ()) or ())
+
+
+def _local_bytes(aval, spec, mesh: Optional[MeshModel]) -> float:
+    """Bytes of one device's shard of ``aval`` under ``spec``."""
+    total = float(_aval_bytes(aval))
+    if spec is _UNKNOWN or mesh is None:
+        return total
+    denom = 1
+    for dim in spec:
+        for a in dim:
+            denom *= mesh.size(a)
+    return total / max(denom, 1)
+
+
+def _spec_axes(spec) -> FrozenSet[str]:
+    if spec is _UNKNOWN:
+        return frozenset()
+    return frozenset(a for dim in spec for a in dim)
+
+
+def _fmt_spec(spec) -> str:
+    if spec is _UNKNOWN:
+        return "?"
+    return "P(" + ",".join("+".join(d) if d else "·" for d in spec) + ")"
+
+
+def _axes_of_param(value) -> Tuple[str, ...]:
+    """Normalize a collective's axis param (str | tuple) to named axes
+    only (positional/vmapped ints are not mesh axes)."""
+    if value is None:
+        return ()
+    if isinstance(value, (tuple, list)):
+        return tuple(a for a in value if isinstance(a, str))
+    return (value,) if isinstance(value, str) else ()
+
+
+_COLLECTIVE_AXIS_PARAM = {"psum": "axes", "all_gather": "axis_name",
+                          "reduce_scatter": "axis_name",
+                          "ppermute": "axis_name",
+                          "all_to_all": "axis_name"}
+
+
+# -------------------------------------------------------------- analyzer
+
+
+class _Analyzer:
+    """One entry's propagation state: the report under construction and
+    the mesh model (declared at registration, else adopted from the
+    first ``shard_map`` equation encountered)."""
+
+    def __init__(self, report: SpmdReport):
+        self.r = report
+
+    # -------------------------------------------------- mesh + events
+
+    def _note_mesh(self, mesh) -> None:
+        if self.r.mesh is None:
+            self.r.mesh = MeshModel.from_any(mesh)
+        if self.r.ledger.mesh is None:
+            self.r.ledger.mesh = self.r.mesh
+
+    def _event(self, op: str, axes: Tuple[str, ...], payload: float,
+               trips: int, ctx: str, record: bool) -> None:
+        if not record or not axes:
+            return
+        mesh = self.r.mesh
+        n = mesh.group_size(axes) if mesh is not None else 1
+        wire = collective_wire_bytes(op, payload, n)
+        bw = (mesh.group_bandwidth(axes) if mesh is not None
+              else DEFAULT_AXIS_BANDWIDTH)
+        self.r.ledger.events.append(CommEvent(
+            op=op, axes=tuple(axes), payload_bytes=payload,
+            wire_bytes=wire, count=trips,
+            time_s=wire * trips / bw if bw > 0 else 0.0, context=ctx))
+
+    # ============================================= manual (shard_map)
+
+    def _repl(self, env, v, manual: FrozenSet[str]) -> FrozenSet[str]:
+        if _is_literal(v):
+            return manual
+        return env.get(v, frozenset())
+
+    def _walk_manual(self, jaxpr, env, manual: FrozenSet[str],
+                     trips: int, ctx: str, record: bool) -> None:
+        """Replication-lattice pass over one shard_map body jaxpr.
+        ``env``: var -> frozenset of manual axes the value is replicated
+        over.  Collectives append ledger events when ``record``."""
+        for cv in jaxpr.constvars:
+            env.setdefault(cv, manual)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ins = [self._repl(env, v, manual) for v in eqn.invars]
+            meet = frozenset(manual)
+            for r in ins:
+                meet &= r
+
+            if name in _COLLECTIVES:
+                axes = _axes_of_param(
+                    eqn.params.get(_COLLECTIVE_AXIS_PARAM[name]))
+                payload = sum(_aval_bytes(v.aval) for v in eqn.invars
+                              if hasattr(v, "aval"))
+                self._event(name, axes, float(payload), trips, ctx,
+                            record)
+                if name in ("psum", "all_gather"):
+                    out = meet | (frozenset(axes) & manual)
+                elif name == "ppermute":
+                    # a permutation of identical values stays identical
+                    out = meet
+                else:   # reduce_scatter / all_to_all split data up
+                    out = meet - frozenset(axes)
+                for ov in eqn.outvars:
+                    env[ov] = out
+                continue
+            if name == "axis_index":
+                axes = _axes_of_param(eqn.params.get("axis_name"))
+                for ov in eqn.outvars:
+                    env[ov] = frozenset(manual) - frozenset(axes)
+                continue
+            if name == "iota":
+                for ov in eqn.outvars:
+                    env[ov] = frozenset(manual)
+                continue
+            if name == "scan":
+                self._scan_manual(eqn, env, manual, trips, ctx, record)
+                continue
+            if name == "while":
+                self._while_manual(eqn, env, manual, trips, ctx, record)
+                continue
+            if name == "cond":
+                self._cond_manual(eqn, env, manual, trips, ctx, record)
+                continue
+            sub = _sub_jaxpr(eqn) if name in _CALL_PRIMS else None
+            if sub is not None:
+                senv: Dict[Any, FrozenSet[str]] = {}
+                inner = sub.jaxpr
+                for iv, r in zip(inner.invars, ins[-len(inner.invars):]):
+                    senv[iv] = r
+                self._walk_manual(inner, senv, manual, trips, ctx,
+                                  record)
+                for ov, bv in zip(eqn.outvars, inner.outvars):
+                    env[ov] = self._repl(senv, bv, manual)
+                continue
+            # default: any deterministic function of replicated operands
+            # is replicated (exact, not a heuristic — collectives and
+            # axis_index, the only device-dependent primitives, are
+            # handled above)
+            for ov in eqn.outvars:
+                env[ov] = meet
+
+    def _scan_manual(self, eqn, env, manual, trips, ctx, record):
+        p = eqn.params
+        body = _closed(p["jaxpr"]).jaxpr
+        nc = int(p.get("num_consts", 0))
+        nk = int(p.get("num_carry", 0))
+        length = int(p.get("length", 1))
+        ins = [self._repl(env, v, manual) for v in eqn.invars]
+        carry = list(ins[nc:nc + nk])
+
+        def seed():
+            senv: Dict[Any, FrozenSet[str]] = {}
+            reps = ins[:nc] + carry + ins[nc + nk:]
+            for iv, r in zip(body.invars, reps):
+                senv[iv] = r
+            return senv
+
+        for _ in range(4):              # carry-replication fixpoint
+            senv = seed()
+            self._walk_manual(body, senv, manual, trips, ctx,
+                              record=False)
+            new = [self._repl(senv, bv, manual) & c
+                   for bv, c in zip(body.outvars[:nk], carry)]
+            if new == carry:
+                break
+            carry = new
+        senv = seed()
+        self._walk_manual(body, senv, manual, trips * length,
+                          (ctx + "/" if ctx else "") + f"scan[{length}]",
+                          record)
+        for ov, bv in zip(eqn.outvars, body.outvars):
+            env[ov] = self._repl(senv, bv, manual)
+        if record:
+            self._dt502(body, nc, nk, length, ctx)
+
+    def _dt502(self, body, num_consts, num_carry, length, ctx):
+        """A collective inside a scan whose input is loop-invariant and
+        whose output only accumulates (through adds) into a carry is
+        hoistable: one post-scan collective moves 1/length the bytes."""
+        if length <= 1:
+            return
+        carry_in = {v for v in body.invars[num_consts:num_consts
+                                           + num_carry]}
+        tainted = set(carry_in)
+        uses: Dict[Any, List[Any]] = {}
+        for e in body.eqns:
+            if any(not _is_literal(v) and v in tainted
+                   for v in e.invars):
+                tainted.update(e.outvars)
+            for v in e.invars:
+                if not _is_literal(v):
+                    uses.setdefault(v, []).append(e)
+        carry_out = set(body.outvars[:num_carry])
+
+        def accumulates_into_carry(v) -> bool:
+            for _ in range(8):
+                if v in carry_out:
+                    return True
+                consumers = uses.get(v, [])
+                if len(consumers) != 1:
+                    return False
+                e = consumers[0]
+                if e.primitive.name not in ("add",
+                                            "convert_element_type"):
+                    return False
+                v = e.outvars[0]
+            return False
+
+        for e in body.eqns:
+            if e.primitive.name not in ("psum", "all_gather"):
+                continue
+            if any(not _is_literal(v) and v in tainted
+                   for v in e.invars):
+                continue
+            if not all(accumulates_into_carry(ov) for ov in e.outvars):
+                continue
+            axes = _axes_of_param(
+                e.params.get(_COLLECTIVE_AXIS_PARAM[e.primitive.name]))
+            payload = sum(_aval_bytes(v.aval) for v in e.invars
+                          if hasattr(v, "aval"))
+            self.r.dt502.append(
+                f"{e.primitive.name} over {'/'.join(axes) or '?'} of "
+                f"{payload} B runs {length}x inside "
+                f"{(ctx + '/' if ctx else '')}scan[{length}] but only "
+                f"accumulates into the carry — hoist it after the scan "
+                f"to move 1/{length} of the bytes")
+
+    def _while_manual(self, eqn, env, manual, trips, ctx, record):
+        p = eqn.params
+        cond = _closed(p["cond_jaxpr"]).jaxpr
+        body = _closed(p["body_jaxpr"]).jaxpr
+        ncc = int(p.get("cond_nconsts", 0))
+        nbc = int(p.get("body_nconsts", 0))
+        ins = [self._repl(env, v, manual) for v in eqn.invars]
+        carry = list(ins[ncc + nbc:])
+        for _ in range(4):
+            senv = dict(zip(body.invars, ins[ncc:ncc + nbc] + carry))
+            self._walk_manual(body, senv, manual, trips, ctx,
+                              record=False)
+            new = [self._repl(senv, bv, manual) & c
+                   for bv, c in zip(body.outvars, carry)]
+            if new == carry:
+                break
+            carry = new
+        cenv = dict(zip(cond.invars, ins[:ncc] + carry))
+        self._walk_manual(cond, cenv, manual, trips, ctx, record)
+        senv = dict(zip(body.invars, ins[ncc:ncc + nbc] + carry))
+        # trip count is dynamic: events counted once (documented
+        # undercount, same choice as the DT4xx cost model)
+        self._walk_manual(body, senv, manual, trips,
+                          (ctx + "/" if ctx else "") + "while", record)
+        for ov, bv in zip(eqn.outvars, body.outvars):
+            env[ov] = self._repl(senv, bv, manual)
+
+    def _collective_sig(self, jaxpr, mult: int = 1) -> Tuple:
+        """Static (op, axes, count) sequence of a jaxpr — the program-
+        order collective schedule DT505 compares across branches."""
+        sig: List[Tuple[str, Tuple[str, ...], int]] = []
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _COLLECTIVES:
+                axes = _axes_of_param(
+                    eqn.params.get(_COLLECTIVE_AXIS_PARAM[name]))
+                sig.append((name, axes, mult))
+            elif name == "scan":
+                sig.extend(self._collective_sig(
+                    _closed(eqn.params["jaxpr"]).jaxpr,
+                    mult * int(eqn.params.get("length", 1))))
+            elif name == "while":
+                sig.extend(self._collective_sig(
+                    _closed(eqn.params["cond_jaxpr"]).jaxpr, mult))
+                sig.extend(self._collective_sig(
+                    _closed(eqn.params["body_jaxpr"]).jaxpr, mult))
+            elif name == "cond":
+                for br in eqn.params.get("branches", ()):
+                    sig.extend(self._collective_sig(_closed(br).jaxpr,
+                                                    mult))
+            elif name in _CALL_PRIMS:
+                sub = _sub_jaxpr(eqn)
+                if sub is not None:
+                    sig.extend(self._collective_sig(sub.jaxpr, mult))
+        return tuple(sig)
+
+    def _cond_manual(self, eqn, env, manual, trips, ctx, record):
+        branches = eqn.params.get("branches", ())
+        pred = eqn.invars[0]
+        operands = eqn.invars[1:]
+        pred_repl = self._repl(env, pred, manual)
+        ins = [self._repl(env, v, manual) for v in operands]
+
+        sigs = [self._collective_sig(_closed(br).jaxpr)
+                for br in branches]
+        varying = frozenset(manual) - pred_repl
+        if record and varying and len(set(sigs)) > 1:
+            self.r.dt505.append(
+                f"cond/switch predicate varies over mesh ax"
+                f"{'es' if len(varying) > 1 else 'is'} "
+                f"{'/'.join(sorted(varying))} but its {len(branches)} "
+                f"branches issue different collective sequences "
+                f"({', '.join(str(len(s)) + ' coll' for s in sigs)}) — "
+                f"devices disagreeing on the branch deadlock at the "
+                f"first mismatched collective")
+
+        best: Optional[Tuple[float, List[CommEvent], Dict]] = None
+        outs: Optional[List[FrozenSet[str]]] = None
+        for br in branches:
+            sub = _closed(br).jaxpr
+            senv = dict(zip(sub.invars, ins))
+            keep, self.r.ledger.events = self.r.ledger.events, []
+            self._walk_manual(sub, senv, manual, trips, ctx, record)
+            br_events = self.r.ledger.events
+            self.r.ledger.events = keep
+            br_outs = [self._repl(senv, bv, manual) & pred_repl
+                       for bv in sub.outvars]
+            outs = (br_outs if outs is None
+                    else [a & b for a, b in zip(outs, br_outs)])
+            size = sum(e.total_bytes for e in br_events)
+            if best is None or size > best[0]:
+                best = (size, br_events, {})
+        if best is not None:
+            self.r.ledger.events.extend(best[1])
+        for ov, r in zip(eqn.outvars, outs or []):
+            env[ov] = r
+
+    # ==================================================== auto region
+
+    def _spec(self, env, v):
+        if _is_literal(v):
+            return ((),) * _rank(v)
+        return env.get(v, _UNKNOWN)
+
+    def _walk_auto(self, jaxpr, env, trips: int, ctx: str) -> None:
+        for cv in jaxpr.constvars:
+            env.setdefault(cv, ((),) * _rank(cv))
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "shard_map":
+                self._enter_shard_map(eqn, env, trips, ctx)
+                continue
+            if name == "scan":
+                self._scan_auto(eqn, env, trips, ctx)
+                continue
+            if name == "while":
+                self._while_auto(eqn, env, trips, ctx)
+                continue
+            if name == "cond":
+                self._cond_auto(eqn, env, trips, ctx)
+                continue
+            if name == "sharding_constraint":
+                self._sharding_constraint(eqn, env)
+                continue
+            if name in _CALL_PRIMS:
+                sub = _sub_jaxpr(eqn)
+                if sub is not None:
+                    inner = sub.jaxpr
+                    ins = [self._spec(env, v) for v in eqn.invars]
+                    senv = dict(zip(inner.invars,
+                                    ins[-len(inner.invars):]))
+                    self._walk_auto(inner, senv, trips, ctx)
+                    for ov, bv in zip(eqn.outvars, inner.outvars):
+                        env[ov] = self._spec(senv, bv)
+                    continue
+            handler = _AUTO_TRANSFER.get(name)
+            if handler is not None:
+                handler(self, eqn, env, trips, ctx)
+                continue
+            self._default_auto(eqn, env)
+
+    def _default_auto(self, eqn, env) -> None:
+        """Elementwise family: outputs shaped like an operand inherit a
+        consistent known operand spec; anything else is UNKNOWN."""
+        known_unhandled = False
+        for ov in eqn.outvars:
+            shape = tuple(getattr(ov.aval, "shape", ()) or ())
+            cands = []
+            for v in eqn.invars:
+                if _is_literal(v) or not hasattr(v, "aval"):
+                    continue
+                s = self._spec(env, v)
+                if (s is not _UNKNOWN
+                        and tuple(v.aval.shape) == shape):
+                    cands.append(s)
+            if cands and all(c == cands[0] for c in cands):
+                env[ov] = cands[0]
+            else:
+                env[ov] = _UNKNOWN
+                if cands:
+                    known_unhandled = True
+        if known_unhandled:
+            self.r.unknown_prims.add(eqn.primitive.name)
+
+    def _sharding_constraint(self, eqn, env) -> None:
+        sharding = eqn.params.get("sharding")
+        spec = getattr(sharding, "spec", None)
+        ov = eqn.outvars[0]
+        if spec is not None:
+            self._note_mesh(getattr(sharding, "mesh", None))
+            env[ov] = _norm_pspec(spec, _rank(ov))
+        else:
+            env[ov] = self._spec(env, eqn.invars[0])
+
+    def _enter_shard_map(self, eqn, env, trips, ctx) -> None:
+        p = eqn.params
+        mesh = p.get("mesh")
+        self._note_mesh(mesh)
+        auto = frozenset(p.get("auto") or ())
+        axis_names = tuple(getattr(mesh, "axis_names", ()) or ())
+        manual = frozenset(a for a in axis_names if a not in auto)
+        in_names = p.get("in_names", ())
+        out_names = p.get("out_names", ())
+        body = _closed(p["jaxpr"]).jaxpr
+
+        # boundary: operand spec vs required in_names — a sharded axis
+        # the region does not preserve is an implicit all-gather
+        for outer, names in zip(eqn.invars, in_names):
+            spec = self._spec(env, outer)
+            if spec is _UNKNOWN or _is_literal(outer):
+                continue
+            rank = _rank(outer)
+            req = _names_spec(names, rank)
+            lost = tuple(sorted(
+                a for d in range(rank)
+                for a in (set(spec[d]) - set(req[d]))
+                if a in axis_names))
+            if lost:
+                payload = _local_bytes(outer.aval, spec, self.r.mesh)
+                self._event("resharding", lost, payload, trips, ctx,
+                            record=True)
+                self.r.dt501.append(
+                    f"operand {getattr(outer, 'aval', '?')} enters "
+                    f"shard_map sharded {_fmt_spec(spec)} but in_spec "
+                    f"{_fmt_spec(req)} drops ax"
+                    f"{'es' if len(lost) > 1 else 'is'} "
+                    f"{'/'.join(lost)} — XLA materializes a full "
+                    f"all-gather over {'/'.join(lost)} at region entry")
+
+        menv: Dict[Any, FrozenSet[str]] = {}
+        for iv, names in zip(body.invars, in_names):
+            used = {a for t in names.values() for a in t}
+            menv[iv] = manual - used
+        self._walk_manual(body, menv, manual, trips, ctx, record=True)
+
+        # outputs back into the auto world + DT504 replication audit
+        for i, (ov, bv, names) in enumerate(zip(eqn.outvars,
+                                                body.outvars,
+                                                out_names)):
+            env[ov] = _names_spec(names, _rank(ov))
+            used = {a for t in names.values() for a in t}
+            claimed = manual - used
+            got = self._repl(menv, bv, manual)
+            missing = claimed - got
+            if missing:
+                self.r.dt504.append(
+                    f"output {i} ({getattr(bv, 'aval', '?')}) out_spec "
+                    f"claims replication over "
+                    f"{'/'.join(sorted(missing))} but no collective in "
+                    f"the body establishes it — with check_vma=False "
+                    f"each device returns ITS value and XLA picks one "
+                    f"arbitrarily")
+
+    def _scan_auto(self, eqn, env, trips, ctx) -> None:
+        p = eqn.params
+        body = _closed(p["jaxpr"]).jaxpr
+        nc = int(p.get("num_consts", 0))
+        nk = int(p.get("num_carry", 0))
+        length = int(p.get("length", 1))
+        ins = [self._spec(env, v) for v in eqn.invars]
+        xs = []
+        for s in ins[nc + nk:]:
+            xs.append(_UNKNOWN if s is _UNKNOWN else tuple(s[1:]))
+        carry = list(ins[nc:nc + nk])
+
+        def seed():
+            return dict(zip(body.invars, ins[:nc] + carry + xs))
+
+        for _ in range(4):
+            senv = seed()
+            # fixpoint pass: silence events by running on a scratch list
+            keep, self.r.ledger.events = self.r.ledger.events, []
+            self._walk_auto(body, senv, trips, ctx)
+            self.r.ledger.events = keep
+            new = []
+            for bv, c in zip(body.outvars[:nk], carry):
+                s = self._spec(senv, bv)
+                new.append(c if (c is not _UNKNOWN and s == c)
+                           else _UNKNOWN if s is not c else c)
+            if new == carry:
+                break
+            carry = new
+        senv = seed()
+        self._walk_auto(body, senv, trips * length,
+                        (ctx + "/" if ctx else "") + f"scan[{length}]")
+        for ov, bv in zip(eqn.outvars, body.outvars[:nk]):
+            env[ov] = self._spec(senv, bv)
+        for ov, bv in zip(eqn.outvars[nk:], body.outvars[nk:]):
+            s = self._spec(senv, bv)
+            env[ov] = (_UNKNOWN if s is _UNKNOWN
+                       else ((),) + tuple(s))
+
+    def _while_auto(self, eqn, env, trips, ctx) -> None:
+        p = eqn.params
+        body = _closed(p["body_jaxpr"]).jaxpr
+        cond = _closed(p["cond_jaxpr"]).jaxpr
+        ncc = int(p.get("cond_nconsts", 0))
+        nbc = int(p.get("body_nconsts", 0))
+        ins = [self._spec(env, v) for v in eqn.invars]
+        carry = ins[ncc + nbc:]
+        cenv = dict(zip(cond.invars, ins[:ncc] + carry))
+        self._walk_auto(cond, cenv, trips, ctx)
+        senv = dict(zip(body.invars, ins[ncc:ncc + nbc] + carry))
+        self._walk_auto(body, senv, trips,
+                        (ctx + "/" if ctx else "") + "while")
+        for ov, bv in zip(eqn.outvars, body.outvars):
+            s = self._spec(senv, bv)
+            c = carry[body.outvars.index(bv)] if bv in body.outvars \
+                else _UNKNOWN
+            env[ov] = s if s == c else _UNKNOWN
+
+    def _cond_auto(self, eqn, env, trips, ctx) -> None:
+        branches = eqn.params.get("branches", ())
+        ins = [self._spec(env, v) for v in eqn.invars[1:]]
+        best: Optional[Tuple[float, List[CommEvent]]] = None
+        outs: Optional[List[Any]] = None
+        for br in branches:
+            sub = _closed(br).jaxpr
+            senv = dict(zip(sub.invars, ins))
+            keep, self.r.ledger.events = self.r.ledger.events, []
+            self._walk_auto(sub, senv, trips, ctx)
+            br_events = self.r.ledger.events
+            self.r.ledger.events = keep
+            br_outs = [self._spec(senv, bv) for bv in sub.outvars]
+            outs = (br_outs if outs is None
+                    else [a if a == b else _UNKNOWN
+                          for a, b in zip(outs, br_outs)])
+            size = sum(e.total_bytes for e in br_events)
+            if best is None or size > best[0]:
+                best = (size, br_events)
+        if best is not None:
+            self.r.ledger.events.extend(best[1])
+        for ov, s in zip(eqn.outvars, outs or []):
+            env[ov] = s
+
+
+# ------------------------------------------- auto transfer functions
+
+
+def _t_dot_general(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    lhs, rhs = eqn.invars[0], eqn.invars[1]
+    ls, rs = self._spec(env, lhs), self._spec(env, rhs)
+    if ls is _UNKNOWN or rs is _UNKNOWN:
+        env[eqn.outvars[0]] = _UNKNOWN
+        return
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    contract = tuple(sorted({a for d in lc for a in ls[d]}
+                            | {a for d in rc for a in rs[d]}))
+    out_dims: List[tuple] = []
+    for d in lb:
+        out_dims.append(ls[d])
+    for i in range(len(lhs.aval.shape)):
+        if i not in lc and i not in lb:
+            out_dims.append(ls[i])
+    for i in range(len(rhs.aval.shape)):
+        if i not in rc and i not in set(rb):
+            out_dims.append(rs[i])
+    out_spec = tuple(out_dims)
+    ov = eqn.outvars[0]
+    env[ov] = out_spec
+    if contract:
+        # partial sums live on every device of the contracted axes —
+        # the partitioner must all-reduce the (local) output
+        payload = _local_bytes(ov.aval, out_spec, self.r.mesh)
+        self._event("psum", contract, payload, trips, ctx, record=True)
+
+
+def _t_reduce(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    v = eqn.invars[0]
+    s = self._spec(env, v)
+    ov = eqn.outvars[0]
+    if s is _UNKNOWN:
+        env[ov] = _UNKNOWN
+        return
+    axes = set(eqn.params.get("axes", ()))
+    reduced = tuple(sorted({a for d in axes for a in s[d]}))
+    out_spec = tuple(dim for d, dim in enumerate(s) if d not in axes)
+    for o in eqn.outvars:
+        env[o] = out_spec
+    if reduced:
+        payload = _local_bytes(ov.aval, out_spec, self.r.mesh)
+        self._event("psum", reduced, payload, trips, ctx, record=True)
+
+
+def _t_broadcast_in_dim(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    v = eqn.invars[0]
+    s = self._spec(env, v)
+    ov = eqn.outvars[0]
+    if s is _UNKNOWN:
+        env[ov] = _UNKNOWN
+        return
+    bd = eqn.params["broadcast_dimensions"]
+    out_rank = len(ov.aval.shape)
+    dims = [()] * out_rank
+    for i, d in enumerate(bd):
+        if int(v.aval.shape[i]) == int(ov.aval.shape[d]):
+            dims[d] = s[i]
+    env[ov] = tuple(dims)
+
+
+def _t_transpose(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    v = eqn.invars[0]
+    s = self._spec(env, v)
+    ov = eqn.outvars[0]
+    env[ov] = (_UNKNOWN if s is _UNKNOWN else
+               tuple(s[d] for d in eqn.params["permutation"]))
+
+
+def _t_reshape(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    v = eqn.invars[0]
+    s = self._spec(env, v)
+    ov = eqn.outvars[0]
+    if s is _UNKNOWN:
+        env[ov] = _UNKNOWN
+    elif tuple(v.aval.shape) == tuple(ov.aval.shape):
+        env[ov] = s
+    elif not _spec_axes(s):
+        env[ov] = ((),) * _rank(ov)     # replicated stays replicated
+    else:
+        env[ov] = _UNKNOWN
+
+
+def _t_squeeze(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    v = eqn.invars[0]
+    s = self._spec(env, v)
+    ov = eqn.outvars[0]
+    if s is _UNKNOWN:
+        env[ov] = _UNKNOWN
+        return
+    drop = set(eqn.params.get("dimensions", ()))
+    env[ov] = tuple(dim for d, dim in enumerate(s) if d not in drop)
+
+
+def _t_gather(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    """jnp.take/embedding-lookup family, narrow exact case: gathering
+    from a fully *replicated* table routes the indices' sharding to the
+    output batch dims.  Anything else: UNKNOWN."""
+    operand, indices = eqn.invars[0], eqn.invars[1]
+    os_, is_ = self._spec(env, operand), self._spec(env, indices)
+    ov = eqn.outvars[0]
+    if os_ is _UNKNOWN or is_ is _UNKNOWN or _spec_axes(os_):
+        env[ov] = _UNKNOWN
+        return
+    dn = eqn.params.get("dimension_numbers")
+    offset = set(getattr(dn, "offset_dims", ()) or ())
+    out_rank = len(ov.aval.shape)
+    batch_specs = list(is_[:-1]) if len(is_) else []
+    dims: List[tuple] = []
+    bi = 0
+    for d in range(out_rank):
+        if d in offset:
+            dims.append(())
+        else:
+            dims.append(batch_specs[bi] if bi < len(batch_specs)
+                        else ())
+            bi += 1
+    env[ov] = tuple(dims)
+
+
+def _t_size_preserving(self: _Analyzer, eqn, env, trips, ctx) -> None:
+    """slice/pad/etc: dims whose size is unchanged keep their axes; a
+    resized *sharded* dim makes the whole value UNKNOWN."""
+    v = eqn.invars[0]
+    s = self._spec(env, v)
+    ov = eqn.outvars[0]
+    if s is _UNKNOWN or len(v.aval.shape) != len(ov.aval.shape):
+        env[ov] = _UNKNOWN
+        return
+    dims: List[tuple] = []
+    for d in range(len(s)):
+        if int(v.aval.shape[d]) == int(ov.aval.shape[d]):
+            dims.append(s[d])
+        elif not s[d]:
+            dims.append(())
+        else:
+            env[ov] = _UNKNOWN
+            return
+    env[ov] = tuple(dims)
+
+
+_AUTO_TRANSFER = {
+    "dot_general": _t_dot_general,
+    "reduce_sum": _t_reduce, "reduce_max": _t_reduce,
+    "reduce_min": _t_reduce, "reduce_prod": _t_reduce,
+    "reduce_and": _t_reduce, "reduce_or": _t_reduce,
+    "broadcast_in_dim": _t_broadcast_in_dim,
+    "transpose": _t_transpose,
+    "reshape": _t_reshape,
+    "squeeze": _t_squeeze,
+    "gather": _t_gather,
+    "slice": _t_size_preserving, "pad": _t_size_preserving,
+    "rev": _t_size_preserving,
+    "dynamic_slice": _t_size_preserving,
+}
+
+
+# ------------------------------------------------------------ entry API
+
+
+def analyze_entry(te: TracedEntry) -> SpmdReport:
+    """Propagate shardings through one traced entry and return its
+    report (ledger + DT5xx evidence)."""
+    report = SpmdReport(name=te.name, group=te.group, path=te.path,
+                        line=te.line,
+                        sharded_update_axis=te.sharded_update_axis)
+    if te.mesh_axes:
+        report.mesh = MeshModel(tuple(te.mesh_axes))
+        report.ledger.mesh = report.mesh
+    if te.closed is None:
+        return report
+    an = _Analyzer(report)
+    jaxpr = te.closed.jaxpr
+    env: Dict[Any, Any] = {}
+    specs = te.in_specs
+    if specs is not None and len(specs) != len(jaxpr.invars):
+        specs = None        # declared specs don't match: stay unknown
+    for i, iv in enumerate(jaxpr.invars):
+        env[iv] = (_norm_pspec(specs[i], _rank(iv))
+                   if specs is not None else _UNKNOWN)
+    try:
+        an._walk_auto(jaxpr, env, trips=1, ctx="")
+    except Exception:
+        # propagation must never take the linter down; partial ledgers
+        # are still reported
+        pass
+    return report
+
+
+def analyze_traced(traced: List[TracedEntry]) -> List[SpmdReport]:
+    return [analyze_entry(te) for te in traced]
+
+
+def entry_comm(fn, *args, in_specs=None, mesh=None,
+               **kwargs) -> CommLedger:
+    """bench.py's hook: trace ``fn`` abstractly and return its static
+    communication ledger (the comms analogue of ``graph.entry_cost``).
+    ``in_specs``: (prefix) PartitionSpec pytree over ``args``; ``mesh``:
+    Mesh or ``{axis: size}`` for byte/bandwidth modeling."""
+    import jax
+
+    from .graph import _flatten_in_specs, _resolve_mesh_axes
+    closed = jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(*args, **kwargs)
+    te = TracedEntry(name="<entry_comm>", group=None, path="", line=0,
+                     closed=closed,
+                     mesh_axes=_resolve_mesh_axes(mesh))
+    if in_specs is not None:
+        te.in_specs = _flatten_in_specs(in_specs, args, kwargs)
+    return analyze_entry(te).ledger
+
+
+# --------------------------------------------------------------- report
+
+
+def render_comms(reports: List[SpmdReport]) -> str:
+    """The ``--report comms`` table: one deterministic row per entry —
+    collective counts, total wire MB, per-axis split, modeled time —
+    so CI can archive it next to the DT4xx cost table and diff comm
+    drift across PRs."""
+    header = (f"{'entry':40s} {'group':10s} {'coll':>5s} {'resh':>5s} "
+              f"{'comm_mb':>10s} {'est_ms':>8s}  per-axis mb")
+    lines = [header, "-" * len(header)]
+    for r in sorted(reports, key=lambda r: r.name):
+        led = r.ledger
+        colls = sum(e.count for e in led.events
+                    if e.op != "resharding")
+        resh = sum(e.count for e in led.events if e.op == "resharding")
+        per_axis = ",".join(
+            f"{a}:{b / 1e6:.3f}"
+            for a, b in sorted(led.per_axis_bytes().items())) or "-"
+        lines.append(
+            f"{r.name:40s} {r.group or '-':10s} {colls:5d} {resh:5d} "
+            f"{led.total_bytes / 1e6:10.3f} "
+            f"{led.total_time_s * 1e3:8.3f}  {per_axis}")
+    return "\n".join(lines)

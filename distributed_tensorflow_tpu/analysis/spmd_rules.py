@@ -1,0 +1,162 @@
+"""dtlint SPMD-tier rules (DT501-DT505) over propagated shardings.
+
+``analysis.spmd`` propagates shardings through every registered entry
+and leaves per-entry evidence on its :class:`SpmdReport`; this module
+turns that evidence into findings.  Like the DT4xx tier, findings
+anchor at the *registration site* so ``# dtlint: disable=DT50x`` there
+suppresses them and baseline fingerprints survive body churn.
+
+Catalog (docs/ANALYSIS.md has the worked examples):
+
+* **DT501** (warning) — implicit full-replication resharding: an
+  operand reaches a ``shard_map`` sharded over a mesh axis its
+  ``in_specs`` drop, so XLA silently materializes an all-gather (the
+  full array on every device) at region entry.  The gathered bytes
+  also land in the comm ledger as a ``resharding`` event.
+* **DT502** (warning) — collective inside a ``scan`` whose operand is
+  loop-invariant and whose result only *accumulates* into a carry:
+  hoisting one collective after the scan moves 1/length of the bytes
+  (the unbatched per-step psum anti-pattern).
+* **DT503** (error) — sharded-update (ZeRO) audit for entries
+  registered with ``sharded_update_axis``: the body must
+  reduce-scatter gradients over that axis (otherwise optimizer state
+  is effectively replicated and the sharding is fiction), pair every
+  reduce-scatter with an all-gather (params must be rematerialized),
+  and the pairing must net to zero per-chip residency growth.
+* **DT504** (error) — a ``shard_map`` out_spec claims replication over
+  a manual axis, but no collective in the body ever establishes it.
+  With ``check_vma=False`` JAX will not catch this; each device
+  returns its own value and XLA picks one arbitrarily.
+* **DT505** (error) — ``cond``/``switch`` branches inside a manual
+  region issue *different* collective sequences while the predicate
+  varies across devices: devices that disagree on the branch deadlock
+  at the first mismatched collective.  Exact at jaxpr level, where
+  DT203's host-side heuristic could only guess.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from .graph import Registry
+from .graph_rules import _finding, _fmt_bytes
+from .report import Finding, Severity
+from .spmd import SpmdReport
+
+__all__ = ["SPMD_RULES", "spmd_rule_catalog", "run_spmd_rules"]
+
+SPMD_RULES: List[Tuple[str, str, str]] = [
+    ("DT501", Severity.WARNING,
+     "implicit full-replication resharding at shard_map entry (spec "
+     "conflict makes XLA materialize an unasked-for all-gather)"),
+    ("DT502", Severity.WARNING,
+     "loop-invariant collective inside scan: bytes don't shrink with "
+     "the trip count (hoistable per-step collective)"),
+    ("DT503", Severity.ERROR,
+     "sharded-update audit: reduce-scatter/all-gather pairing or "
+     "per-chip residency broken for a sharded_update_axis entry"),
+    ("DT504", Severity.ERROR,
+     "shard_map out_spec claims replication the body never "
+     "establishes (check_vma=False escape hatch)"),
+    ("DT505", Severity.ERROR,
+     "collective sequence differs across cond/switch branches under a "
+     "device-varying predicate (static deadlock)"),
+]
+
+
+def spmd_rule_catalog() -> List[Tuple[str, str, str]]:
+    return list(SPMD_RULES)
+
+
+def _rule_evidence(reports, attr, rule, severity, add):
+    for r in reports:
+        for msg in getattr(r, attr):
+            add(rule, severity, r.path, r.line,
+                f"entry '{r.name}': {msg}")
+
+
+def _rule_dt501(reports, registry, add):
+    _rule_evidence(reports, "dt501", "DT501", Severity.WARNING, add)
+
+
+def _rule_dt502(reports, registry, add):
+    _rule_evidence(reports, "dt502", "DT502", Severity.WARNING, add)
+
+
+def _rule_dt503(reports, registry, add):
+    for r in reports:
+        axis = r.sharded_update_axis
+        if not axis:
+            continue
+        rs = [e for e in r.ledger.events
+              if e.op == "reduce_scatter" and axis in e.axes]
+        ag = [e for e in r.ledger.events
+              if e.op == "all_gather" and axis in e.axes]
+        if not rs:
+            add("DT503", Severity.ERROR, r.path, r.line,
+                f"entry '{r.name}' declares sharded_update_axis="
+                f"'{axis}' but no reduce_scatter over '{axis}' exists "
+                f"in the traced program — gradients stay full-size and "
+                f"the optimizer state is effectively replicated (the "
+                f"ZeRO sharding is fiction)")
+            continue
+        n_rs = sum(e.count for e in rs)
+        n_ag = sum(e.count for e in ag)
+        if n_rs != n_ag:
+            add("DT503", Severity.ERROR, r.path, r.line,
+                f"entry '{r.name}': {n_rs} reduce_scatter but {n_ag} "
+                f"all_gather over axis '{axis}' — every scattered "
+                f"update must be paired with a gather that "
+                f"rematerializes the full params")
+            continue
+        if r.mesh is None:
+            continue
+        n = r.mesh.size(axis)
+        # residency: rs shrinks a full buffer to 1/n, ag grows a shard
+        # to full size.  Net per-chip growth must be <= 0: what was
+        # gathered may not exceed what was scattered away.
+        gathered = sum(e.payload_bytes * (n - 1) * e.count for e in ag)
+        scattered = sum(e.payload_bytes * (1 - 1.0 / n) * e.count
+                        for e in rs)
+        if gathered > scattered * 1.001:
+            add("DT503", Severity.ERROR, r.path, r.line,
+                f"entry '{r.name}': all_gather over '{axis}' "
+                f"rematerializes {_fmt_bytes(gathered)} per chip but "
+                f"reduce_scatter only sheds {_fmt_bytes(scattered)} — "
+                f"net per-chip residency grows; the sharded update is "
+                f"not saving memory")
+
+
+def _rule_dt504(reports, registry, add):
+    _rule_evidence(reports, "dt504", "DT504", Severity.ERROR, add)
+
+
+def _rule_dt505(reports, registry, add):
+    _rule_evidence(reports, "dt505", "DT505", Severity.ERROR, add)
+
+
+_RULE_FNS = [
+    ("DT501", _rule_dt501), ("DT502", _rule_dt502),
+    ("DT503", _rule_dt503), ("DT504", _rule_dt504),
+    ("DT505", _rule_dt505),
+]
+
+
+def run_spmd_rules(reports: List[SpmdReport],
+                   registry: Optional[Registry] = None,
+                   select: Optional[Set[str]] = None,
+                   ignore: Optional[Set[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+
+    for rule_id, fn in _RULE_FNS:
+        if select is not None and rule_id not in select:
+            continue
+        if ignore is not None and rule_id in ignore:
+            continue
+
+        def add(rule, severity, path, line, message):
+            f = _finding(rule, severity, path, line, message)
+            if f is not None:
+                findings.append(f)
+
+        fn(reports, registry, add)
+    return findings

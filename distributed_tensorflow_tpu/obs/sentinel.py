@@ -14,6 +14,14 @@ model: for one fresh bench row it runs two independent checks —
   same traced program the lint gate checks, so the gap is implementation
   quality, not model error.
 
+The ``analytical_comm_*`` fields (the DT5xx static communication
+ledger bench.py stamps per config) get a special, TIGHT tolerance:
+they are computed, not measured, so they carry zero run-to-run jitter —
+a config's static comm volume only moves when the *program* moves.
+Growth past ``DEFAULT_COMM_MAX_RATIO`` reds the gate (an accidental
+extra all-gather in a refactor), overridable per field like any other
+tolerance.
+
 Verdicts export as ``dttpu_sentinel_*`` metrics and render as a human
 report; ``scripts/perf_gate.py`` turns them into an exit code, which is
 what the CI perf-gate job runs.  Pure stdlib.
@@ -27,7 +35,8 @@ from . import ledger as ledger_lib
 
 __all__ = ["Tolerance", "Verdict", "Sentinel", "classify_field",
            "parse_tolerance_overrides", "DEFAULT_MIN_RATIO",
-           "DEFAULT_MAX_RATIO", "DEFAULT_ROOFLINE_FLOOR"]
+           "DEFAULT_MAX_RATIO", "DEFAULT_COMM_MAX_RATIO",
+           "DEFAULT_ROOFLINE_FLOOR"]
 
 # CI-jitter-sized defaults: a shared runner's smoke bench wobbles tens
 # of percent run-to-run, so the gate only fires on ~2x movements — the
@@ -37,6 +46,14 @@ __all__ = ["Tolerance", "Verdict", "Sentinel", "classify_field",
 DEFAULT_MIN_RATIO = 0.5      # higher-is-better: fail below half baseline
 DEFAULT_MAX_RATIO = 2.0      # lower-is-better: fail above twice baseline
 DEFAULT_ROOFLINE_FLOOR = 0.01  # measured mfu / analytical_mfu floor
+
+# Static (computed) fields don't jitter: the comm ledger may only grow
+# past rounding noise when the traced program itself changed.  The 1.2
+# slack tolerates a deliberately grown batch/seq in the same config row.
+DEFAULT_COMM_MAX_RATIO = 1.2
+
+# Prefix of the DT5xx static-communication fields bench.py stamps.
+_COMM_PREFIX = "analytical_comm"
 
 # Name-based direction inference: duration suffixes are matched at the
 # END of the name (a bare "_s" substring would misread "single_step_*"),
@@ -52,6 +69,8 @@ _HIGHER_TOKENS = ("per_sec", "per_chip", "tokens_s", "throughput",
 def classify_field(field: str) -> Optional[str]:
     """``"higher"`` / ``"lower"`` (is better) / ``None`` = don't gate."""
     name = field.lower()
+    if _COMM_PREFIX in name:     # static comm volume: growth is drift
+        return "lower"
     for token in _LOWER_TOKENS:
         if token in name:
             return "lower"
@@ -114,7 +133,12 @@ class Sentinel:
                 "Fields the regression sentinel flagged as regressed.")
 
     def _tol(self, field: str) -> Tolerance:
-        return self.tolerances.get(field, Tolerance())
+        tol = self.tolerances.get(field)
+        if tol is not None:
+            return tol
+        if _COMM_PREFIX in field.lower():
+            return Tolerance(max_ratio=DEFAULT_COMM_MAX_RATIO)
+        return Tolerance()
 
     # ------------------------------------------------------------- check
 
@@ -127,6 +151,7 @@ class Sentinel:
         verdicts: List[Verdict] = []
         if baseline is not None:
             verdicts.extend(self._check_history(row, baseline))
+            verdicts.extend(self._check_comm(row, baseline))
         verdicts.extend(self._check_roofline(row))
         verdicts.sort(key=lambda v: v.ok)
         if self._checks is not None:
@@ -165,6 +190,34 @@ class Sentinel:
                 detail=(f"{field}: {measured:g} vs baseline {ref:g} "
                         f"({100 * (ratio - 1):+.1f}%, {direction} is "
                         f"better, {bound})")))
+        return out
+
+    def _check_comm(self, row, baseline) -> List[Verdict]:
+        """Static comm drift: the ``analytical_comm_*`` fields live in
+        the row's *analytical* section (``PerfLedger.delta`` only walks
+        measured fields), so they get their own pass — same ratio gate,
+        but against the tight comm tolerance, because a computed number
+        that moved means the traced program's collectives moved."""
+        out: List[Verdict] = []
+        a = row.get("analytical") or {}
+        for field in sorted(a):
+            if _COMM_PREFIX not in field.lower():
+                continue
+            measured = ledger_lib.row_field(row, field)
+            ref = ledger_lib.row_field(baseline, field)
+            if measured is None or ref is None:
+                continue
+            ratio = (measured / ref if ref
+                     else (float("inf") if measured > 0 else 1.0))
+            tol = self._tol(field)
+            ok = (ratio <= tol.max_ratio) or ref == 0
+            out.append(Verdict(
+                field=field, kind="comm", measured=measured,
+                reference=ref, ratio=ratio, ok=ok,
+                detail=(f"{field}: static {measured:g} vs baseline "
+                        f"{ref:g} ({100 * (ratio - 1):+.1f}%, computed "
+                        f"— program changed if this moved, max_ratio "
+                        f"{tol.max_ratio:g})")))
         return out
 
     def _check_roofline(self, row) -> List[Verdict]:

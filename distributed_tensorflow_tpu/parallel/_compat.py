@@ -7,10 +7,66 @@ with the complementary ``auto=`` parameter (the mesh axes that STAY
 automatic) and ``check_rep=``.  This wrapper speaks the modern calling
 convention and translates when running on the legacy API, so every
 ``parallel/`` call site works on both.
+
+Two legacy landmines are papered over here:
+
+* **partial-manual**: the legacy partial-auto path (``auto=`` nonempty)
+  CHECK-fails inside XLA's SPMD partitioner (IsManualSubgroup mismatch)
+  — a fatal process abort, not an exception.  Instead of handing legacy
+  shard_map an ``auto=`` set, we lower the body *full-manual over the
+  whole mesh* with the same specs: the body only ever names the manual
+  axes, so making the auto axes manual-but-unused is semantically the
+  replicated computation the partial-auto path would have run (each
+  device along an auto axis redundantly computes its replica).  Inputs
+  sharded over an auto axis are gathered at region entry by XLA —
+  exactly the resharding the modern API performs.  Replication checking
+  cannot see through the translation, so ``check_rep`` is forced off
+  when auto axes exist.
+* **axis_index**: ``lax.axis_index`` inside a legacy manual region
+  lowers to ``partition-id`` arithmetic, which XLA:CPU's SPMD
+  partitioner rejects (``UNIMPLEMENTED``) whenever the region is
+  compiled under ``jit``/``lax.scan``.  We thread one tiny
+  ``iota(size)`` operand per manual axis into the region (in_spec
+  ``P(axis)`` — each device's shard IS its coordinate) and patch
+  ``jax.lax.axis_index`` through a thread-local map that is only active
+  while the body traces, so the body reads its coordinate from data
+  instead of from ``partition-id``.
 """
+import threading
+
 import jax
 
 __all__ = ["shard_map"]
+
+# Thread-local stack of {axis_name: index scalar} maps, pushed while a
+# legacy shard_map body is being traced.  The patched ``axis_index``
+# consults the innermost map first and falls through to the real
+# primitive for axis names it does not cover (nested shard_maps, vmapped
+# axes, the custom-vjp backward traced outside the window).
+_AXIS_IDS = threading.local()
+_PATCH_LOCK = threading.Lock()
+_PATCHED = False
+
+
+def _ensure_axis_index_patch():
+    global _PATCHED
+    if _PATCHED:
+        return
+    with _PATCH_LOCK:
+        if _PATCHED:
+            return
+        real = jax.lax.axis_index
+
+        def axis_index(axis_name):
+            for mapping in reversed(getattr(_AXIS_IDS, "stack", ())):
+                if axis_name in mapping:
+                    return mapping[axis_name]
+            return real(axis_name)
+
+        axis_index.__wrapped__ = real
+        axis_index.__doc__ = real.__doc__
+        jax.lax.axis_index = axis_index
+        _PATCHED = True
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
@@ -29,19 +85,35 @@ def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
                       out_specs=out_specs, **kwargs)
 
     from jax.experimental.shard_map import shard_map as legacy
-    auto = frozenset()
-    if axis_names is not None:
-        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
-    if auto:
-        # The legacy partial-auto path CHECK-fails inside XLA's SPMD
-        # partitioner (IsManualSubgroup mismatch) — a fatal process
-        # abort, not an exception.  Refuse up front so callers see a
-        # catchable error instead of a dead interpreter.
-        raise NotImplementedError(
-            f"partial-manual shard_map over {sorted(axis_names)} with "
-            f"auto axes {sorted(auto)} requires the modern jax.shard_map "
-            f"API; this JAX ({jax.__version__}) only ships the legacy "
-            "experimental one, whose partial-auto path aborts in the "
-            "SPMD partitioner")
-    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=check_vma)
+    from jax.sharding import PartitionSpec as P
+    import jax.numpy as jnp
+
+    wanted = (frozenset(mesh.axis_names) if axis_names is None
+              else frozenset(axis_names))
+    manual = tuple(a for a in mesh.axis_names if a in wanted)
+    auto = frozenset(mesh.axis_names) - wanted
+    _ensure_axis_index_patch()
+
+    def call(*args):
+        specs = (tuple(in_specs) if isinstance(in_specs, (tuple, list))
+                 else (in_specs,) * len(args))
+        specs += tuple(P(a) for a in manual)
+        idx_args = tuple(jnp.arange(mesh.shape[a], dtype=jnp.int32)
+                         for a in manual)
+
+        def body(*flat):
+            user, ids = flat[:len(args)], flat[len(args):]
+            mapping = {a: ids[i][0] for i, a in enumerate(manual)}
+            stack = getattr(_AXIS_IDS, "stack", ())
+            _AXIS_IDS.stack = stack + (mapping,)
+            try:
+                return f(*user)
+            finally:
+                _AXIS_IDS.stack = stack
+
+        sm = legacy(body, mesh=mesh, in_specs=specs,
+                    out_specs=out_specs,
+                    check_rep=False if auto else check_vma)
+        return sm(*args, *idx_args)
+
+    return call

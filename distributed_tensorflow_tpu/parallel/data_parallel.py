@@ -92,3 +92,37 @@ def make_psum_train_step(model, loss, optimizer: opt_lib.Optimizer,
         axis_names=frozenset({axis}),
         check_vma=False)
     return jax.jit(sharded, donate_argnums=0)
+
+
+# --------------------------------------------------- dtlint graph tier
+
+from ..analysis import graph as _graph_lib  # noqa: E402  (registration)
+
+
+@_graph_lib.trace_entry("parallel.data_parallel", hbm_budget=8 << 20)
+def _graph_entries():
+    """The psum-spelled data-parallel step on a tiny MLP, seeded with
+    the specs callers actually use (state replicated, batch sharded
+    over ``data``), so the DT5xx ledger prices THE all-reduce: one
+    grad/metric pmean over the data axis per step."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ops
+    from ..optim import adam
+    from ..train import init_train_state
+    from .mesh import make_mesh
+
+    n = min(8, len(jax.devices()))
+    mesh = make_mesh({"data": n})
+    model = ops.serial(ops.Dense(32, "relu"), ops.Dense(8, "sigmoid"))
+    optimizer = adam()
+    step = make_psum_train_step(model, "mse", optimizer, mesh)
+    state = jax.eval_shape(
+        lambda k: init_train_state(model, optimizer, k, (64,)),
+        jax.random.PRNGKey(0))
+    batch = (jax.ShapeDtypeStruct((n * 4, 64), jnp.float32),
+             jax.ShapeDtypeStruct((n * 4, 8), jnp.float32))
+    return _graph_lib.Target(
+        "make_psum_train_step", step, (state, batch),
+        in_specs=(P(), (P("data"), P("data"))), mesh=mesh)

@@ -362,3 +362,39 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jnp.ndarray],
     if with_dx:
         result += (dx,)
     return result
+
+
+# --------------------------------------------------- dtlint graph tier
+
+from ..analysis import graph as _graph_lib  # noqa: E402  (registration)
+
+
+@_graph_lib.trace_entry("parallel.pipeline", hbm_budget=8 << 20)
+def _graph_entries():
+    """The GPipe forward at registry scale: stacked stage params sharded
+    ``P('pipe')``, batch replicated.  The DT5xx ledger prices the
+    per-tick ``ppermute`` neighbor exchange inside the scan (by design:
+    activations MUST move every tick, so DT502 stays quiet) plus the
+    masked psum broadcast after it."""
+    import jax
+
+    from .mesh import make_mesh
+
+    n = min(8, len(jax.devices()))
+    mesh = make_mesh({"pipe": n})
+    d = 16
+
+    def stage(params, acts):
+        w, b = params
+        return jnp.tanh(acts @ w + b)
+
+    def fwd(stacked, x):
+        return pipeline_apply(stage, stacked, x, mesh,
+                              num_microbatches=4)
+
+    stacked = (jax.ShapeDtypeStruct((n, d, d), jnp.float32),
+               jax.ShapeDtypeStruct((n, d), jnp.float32))
+    x = jax.ShapeDtypeStruct((8, d), jnp.float32)
+    return _graph_lib.Target(
+        "pipeline_apply", fwd, (stacked, x),
+        in_specs=((P("pipe"), P("pipe")), P()), mesh=mesh)

@@ -234,3 +234,33 @@ def ring_flash_attention_sharded(q, k, v, mesh: Mesh,
                          out_specs=spec,
                          axis_names=frozenset({seq_axis}),
                          check_vma=False)(q, k, v, kv_valid)
+
+
+# --------------------------------------------------- dtlint graph tier
+
+from ..analysis import graph as _graph_lib  # noqa: E402  (registration)
+
+
+@_graph_lib.trace_entry("parallel.ring_flash", hbm_budget=8 << 20)
+def _graph_entries():
+    """The fused-kernel ring: same sharding contract as parallel.ring
+    (specs match the shard_map in_specs — no implicit resharding), the
+    kernel body opaque to propagation (degrades to unknown, per the
+    tier's contract) while the ring ppermutes around it still price."""
+    import jax
+
+    from .mesh import make_mesh
+
+    n = min(8, len(jax.devices()))
+    mesh = make_mesh({"seq": n})
+    q = jax.ShapeDtypeStruct((2, n * 8, 2, 16), jnp.float32)
+    spec = P(None, "seq", None, None)
+
+    def fwd(q, k, v):
+        return ring_flash_attention_sharded(q, k, v, mesh=mesh,
+                                            causal=True, block_q=8,
+                                            block_k=8)
+
+    return _graph_lib.Target("ring_flash_attention_sharded", fwd,
+                             (q, q, q), in_specs=(spec, spec, spec),
+                             mesh=mesh)

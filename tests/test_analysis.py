@@ -983,7 +983,8 @@ def test_rule_catalog_covers_all_families():
                    "DT107", "DT201", "DT202", "DT203", "DT204",
                    "DT301", "DT302", "DT303", "DT304", "DT305", "DT306",
                    "DT308",
-                   "DT400", "DT401", "DT402", "DT403", "DT404", "DT405"]
+                   "DT400", "DT401", "DT402", "DT403", "DT404", "DT405",
+                   "DT501", "DT502", "DT503", "DT504", "DT505"]
 
 
 def test_cli_json_output_and_exit_codes(tmp_path):
