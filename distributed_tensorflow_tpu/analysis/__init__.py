@@ -2,7 +2,7 @@
 
 Catches, *before anything is compiled or run on an accelerator*, the
 bug classes that otherwise surface as silent recompiles, HBM blowups,
-or wrong numerics on the TPU.  Four tiers share one file walk:
+or wrong numerics on the TPU.  Six tiers share one file walk:
 
 * per-module (lexical, DT101-DT107): host syncs inside jit, PRNG key
   reuse, unbound mesh axes, non-hashable static args, jit wrappers
@@ -24,7 +24,15 @@ or wrong numerics on the TPU.  Four tiers share one file walk:
   executable census (``expect_census``) pinning invariants like "the
   serve tier has exactly 3 hot executables".  The same traversal prices
   every entry (FLOPs/bytes — ``entry_cost``), which bench.py reports
-  as ``analytical_*`` fields next to measured numbers.
+  as ``analytical_*`` fields next to measured numbers;
+* SPMD (sharding propagation, DT501-DT505, ``spmd.py`` /
+  ``spmd_rules.py``): reuses the graph tier's trace to propagate
+  shardings and price every collective into a static comm ledger;
+* resource lifecycle (typestate, DT601-DT605, ``lifecycle.py`` /
+  ``lifecycle_rules.py``): declared acquire→release protocols (page
+  leases, adapter pins, locks, request handles) proven released on
+  every try/except/finally/return/raise path, with ownership-transfer
+  rules so storing/returning/handing off a resource is not a leak.
 
 Run it as a module::
 
@@ -66,6 +74,10 @@ from .graph import (REGISTRY, Cost, Registry, Target, TracedEntry,
                     trace_registry)
 from .graph_rules import (GRAPH_RULES, graph_rule_catalog,
                           run_graph_rules)
+from .leak_ledger import LedgerImbalance, ResourceLedger
+from .lifecycle import PROTOCOLS, LifecycleEvent, LifecycleModel
+from .lifecycle_rules import (LIFECYCLE_RULES, lifecycle_rule_catalog,
+                              run_lifecycle_rules)
 from .project_rules import (PROJECT_RULES, project_rule_catalog,
                             run_project_rules)
 from .race_harness import RaceHarness
@@ -79,17 +91,20 @@ rule_catalog = full_rule_catalog
 
 __all__ = [
     "CONCURRENCY_RULES", "ConcurrencyModel", "Cost", "Finding",
-    "FunctionInfo", "GRAPH_RULES", "PROJECT_RULES", "Project",
-    "ProjectDataflow", "REGISTRY", "RULES", "RaceHarness", "Registry",
-    "ResultCache", "RetraceBudgetExceeded", "RetraceGuard",
+    "FunctionInfo", "GRAPH_RULES", "LIFECYCLE_RULES", "LedgerImbalance",
+    "LifecycleEvent", "LifecycleModel", "PROJECT_RULES", "PROTOCOLS",
+    "Project", "ProjectDataflow", "REGISTRY", "RULES", "RaceHarness",
+    "Registry", "ResourceLedger", "ResultCache",
+    "RetraceBudgetExceeded", "RetraceGuard",
     "Severity", "Source", "SourceError", "Target", "TracedEntry",
     "analyze_file", "analyze_paths", "collect_files",
     "concurrency_rule_catalog", "entry_cost", "estimate_cost",
     "expect_census", "full_rule_catalog", "graph_rule_catalog",
-    "load_baseline", "main", "module_name_for", "partition",
+    "lifecycle_rule_catalog", "load_baseline", "main",
+    "module_name_for", "partition",
     "program_signature", "project_rule_catalog", "prune_baseline",
     "render_costs", "render_github", "render_json", "render_text",
     "retrace_guard", "rule_catalog", "run_concurrency_rules",
-    "run_graph_rules", "run_project_rules", "run_rules",
-    "trace_entry", "trace_registry", "write_baseline",
+    "run_graph_rules", "run_lifecycle_rules", "run_project_rules",
+    "run_rules", "trace_entry", "trace_registry", "write_baseline",
 ]

@@ -8,12 +8,16 @@
       --prune                  with --baseline: drop stale entries (ones
                                that no longer fire) from the file
       --select DT101,DT201     run only these rules
+      --rules DT601,DT5xx      run only these rules/tiers — like
+                               --select but tier wildcards (DT1xx …
+                               DT6xx) expand to every rule in the tier
       --ignore DT105           skip these rules
       --jobs N                 parallel per-file pass (0 = cpu count)
       --no-project             skip the interprocedural DT2xx pass
       --no-concurrency         skip the host-concurrency DT3xx pass
       --no-graph               skip the jaxpr graph-tier DT4xx pass
       --no-spmd                skip the SPMD sharding-tier DT5xx pass
+      --no-lifecycle           skip the resource-lifecycle DT6xx pass
       --no-cache               ignore + don't write .dtlint-cache/
                                (CI runs cold; DTLINT_CACHE_DIR moves it)
       --report costs           print the graph tier's per-entry cost
@@ -26,14 +30,15 @@
                                stderr (what scripts/lint.sh shows CI)
       --list-rules             print the rule catalog
 
-Five passes share one file walk: the per-module tier (DT1xx) runs file
+Six passes share one file walk: the per-module tier (DT1xx) runs file
 by file (parallelizable with ``--jobs``), the interprocedural tier
-(DT2xx) and the host-concurrency tier (DT3xx) each run once over the
-same parsed project, and the graph tier (DT4xx) abstractly traces the
-registered entry points (``analysis.entries``) — it only runs when the
-walk covers the package itself, so fixture runs stay jax-free.  The
-SPMD tier (DT5xx) reuses the graph tier's traced registry (one trace
-serves both) to propagate shardings and build communication ledgers.
+(DT2xx), the host-concurrency tier (DT3xx) and the resource-lifecycle
+typestate tier (DT6xx) each run once over the same parsed project, and
+the graph tier (DT4xx) abstractly traces the registered entry points
+(``analysis.entries``) — it only runs when the walk covers the package
+itself, so fixture runs stay jax-free.  The SPMD tier (DT5xx) reuses
+the graph tier's traced registry (one trace serves both) to propagate
+shardings and build communication ledgers.
 Results are memoized by content hash in ``.dtlint-cache/``
 (``analysis.cache``), so an unchanged tree re-lints in well under a
 second.
@@ -46,6 +51,7 @@ from __future__ import annotations
 import argparse
 import functools
 import os
+import re
 import sys
 import time
 from typing import Dict, Iterable, List, Optional, Set
@@ -56,6 +62,7 @@ from .callgraph import Project, module_name_for
 from .concurrency import concurrency_rule_catalog, run_concurrency_rules
 from .context import mesh_axes_for
 from .graph_rules import graph_rule_catalog
+from .lifecycle_rules import lifecycle_rule_catalog, run_lifecycle_rules
 from .project_rules import project_rule_catalog, run_project_rules
 from .report import Finding, render_github, render_json, render_text
 from .rules import rule_catalog as _file_rule_catalog
@@ -73,6 +80,7 @@ _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _GRAPH_RULE_IDS = {r for r, _, _ in graph_rule_catalog()}
 _SPMD_RULE_IDS = {r for r, _, _ in spmd_rule_catalog()}
+_LIFECYCLE_RULE_IDS = {r for r, _, _ in lifecycle_rule_catalog()}
 
 
 def collect_files(paths: Iterable[str]) -> List[str]:
@@ -96,7 +104,7 @@ def collect_files(paths: Iterable[str]) -> List[str]:
 def full_rule_catalog():
     return (_file_rule_catalog() + project_rule_catalog()
             + concurrency_rule_catalog() + graph_rule_catalog()
-            + spmd_rule_catalog())
+            + spmd_rule_catalog() + lifecycle_rule_catalog())
 
 
 def _read(path: str) -> str:
@@ -151,6 +159,7 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
                   concurrency_pass: bool = True,
                   graph_pass: bool = True,
                   spmd_pass: bool = True,
+                  lifecycle_pass: bool = True,
                   cache: Optional[cache_lib.ResultCache] = None,
                   timings: Optional[Dict[str, float]] = None
                   ) -> List[Finding]:
@@ -177,9 +186,14 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
             file_keys[f] = cache.file_key(f, hashes[f],
                                           mesh_axes_for(f))
 
+    # the lifecycle tier is select-gated like graph/spmd (a --rules
+    # DT3xx run shouldn't pay the typestate walk) but project-shaped
+    run_life = (lifecycle_pass
+                and (select is None or bool(select & _LIFECYCLE_RULE_IDS)))
+
     # tier keys + hits (tree-hashed: any edit re-runs the whole tier)
-    proj_key = conc_key = graph_key = spmd_key = None
-    proj_hit = conc_hit = graph_hit = spmd_hit = None
+    proj_key = conc_key = graph_key = spmd_key = life_key = None
+    proj_hit = conc_hit = graph_hit = spmd_hit = life_hit = None
     if cache is not None:
         tree = [(f, hashes[f]) for f in files]
         pkg_tree = [(f, h) for f, h in tree
@@ -191,11 +205,14 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
             "spmd",
             pkg_tree + [("__mesh__",
                          cache.content_hash(_spmd_env_sig()))])
+        life_key = cache.tree_key("lifecycle", tree)
         proj_hit = cache.get_tier(proj_key) if project_pass else None
         conc_hit = cache.get_tier(conc_key) if concurrency_pass else None
+        life_hit = cache.get_tier(life_key) if run_life else None
 
     need_sources = ((project_pass and proj_hit is None)
-                    or (concurrency_pass and conc_hit is None))
+                    or (concurrency_pass and conc_hit is None)
+                    or (run_life and life_hit is None))
 
     def record_source(path: str, src: Source) -> None:
         mod = _project_module(path)
@@ -272,6 +289,16 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
             if cache is not None:
                 cache.put_tier(conc_key, tier)
     t3 = time.perf_counter()
+    if run_life:
+        if life_hit is not None:
+            findings.extend(life_hit)
+        elif project is not None:
+            tier = run_lifecycle_rules(project, select=select,
+                                       ignore=ignore)
+            findings.extend(tier)
+            if cache is not None:
+                cache.put_tier(life_key, tier)
+    t3b = time.perf_counter()
 
     run_graph = (graph_pass and _covers_package(files)
                  and (select is None or select & _GRAPH_RULE_IDS))
@@ -313,12 +340,14 @@ def analyze_paths(paths: Iterable[str], select: Optional[Set[str]] = None,
     if cache is not None:
         cache.save(live_file_keys=file_keys.values(),
                    live_tier_keys=[k for k in (proj_key, conc_key,
-                                               graph_key, spmd_key)
+                                               graph_key, spmd_key,
+                                               life_key)
                                    if k is not None])
     if timings is not None:
         timings.update({"files": len(files), "per_file_s": t1 - t0,
                         "project_s": t2 - t1, "concurrency_s": t3 - t2,
-                        "graph_s": t4 - t3, "spmd_s": t5 - t4,
+                        "lifecycle_s": t3b - t3,
+                        "graph_s": t4 - t3b, "spmd_s": t5 - t4,
                         "total_s": t5 - t0})
     return findings
 
@@ -327,6 +356,40 @@ def _rule_set(spec: Optional[str]) -> Optional[Set[str]]:
     if not spec:
         return None
     return {s.strip() for s in spec.split(",") if s.strip()}
+
+
+_TIER_WILDCARD_RE = re.compile(r"^DT(\d)XX$")
+
+
+def _expand_rules(spec: Optional[str]) -> Optional[Set[str]]:
+    """Expand a ``--rules`` spec into a concrete rule-id set.
+
+    Accepts exact ids (``DT601``) and tier wildcards (``DT6xx``,
+    case-insensitive) which expand to every cataloged rule of that
+    tier.  Unknown ids/tiers raise ValueError — a typo'd rule silently
+    matching nothing would read as "clean"."""
+    if not spec:
+        return None
+    all_ids = {r for r, _, _ in full_rule_catalog()}
+    out: Set[str] = set()
+    for token in (s.strip() for s in spec.split(",")):
+        if not token:
+            continue
+        t = token.upper()
+        m = _TIER_WILDCARD_RE.match(t)
+        if m:
+            tier = {r for r in all_ids if r.startswith("DT" + m.group(1))}
+            if not tier:
+                raise ValueError(f"unknown tier '{token}' (no DT"
+                                 f"{m.group(1)}xx rules exist)")
+            out |= tier
+        elif t in all_ids:
+            out.add(t)
+        else:
+            raise ValueError(
+                f"unknown rule '{token}' (try --list-rules; tiers "
+                f"select as DT1xx..DT6xx)")
+    return out or None
 
 
 def _report_costs() -> int:
@@ -364,6 +427,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="with --baseline: remove stale entries (ones "
                          "that no longer fire) from the baseline file")
     ap.add_argument("--select", metavar="IDS")
+    ap.add_argument("--rules", metavar="IDS",
+                    help="run only these rules/tiers; like --select but "
+                         "tier wildcards expand (DT601,DT5xx runs one "
+                         "lifecycle rule plus the whole SPMD tier)")
     ap.add_argument("--ignore", metavar="IDS")
     ap.add_argument("--jobs", type=int, default=1, metavar="N",
                     help="parallel workers for the per-file pass "
@@ -376,6 +443,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="skip the jaxpr graph-tier DT4xx pass")
     ap.add_argument("--no-spmd", action="store_true",
                     help="skip the SPMD sharding-tier DT5xx pass")
+    ap.add_argument("--no-lifecycle", action="store_true",
+                    help="skip the resource-lifecycle DT6xx pass")
     ap.add_argument("--no-cache", action="store_true",
                     help="run cold: ignore and don't write "
                          ".dtlint-cache/ (what CI does)")
@@ -402,6 +471,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     select, ignore = _rule_set(args.select), _rule_set(args.ignore)
+    try:
+        rules_select = _expand_rules(args.rules)
+    except ValueError as e:
+        print(f"dtlint: error: {e}", file=sys.stderr)
+        return 2
+    if rules_select is not None:
+        select = rules_select if select is None else select | rules_select
     paths = args.paths or ["."]
     timings: Dict[str, float] = {}
     cache = None
@@ -417,6 +493,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                  concurrency_pass=not args.no_concurrency,
                                  graph_pass=not args.no_graph,
                                  spmd_pass=not args.no_spmd,
+                                 lifecycle_pass=not args.no_lifecycle,
                                  cache=cache, timings=timings)
     except (FileNotFoundError, SourceError) as e:
         print(f"dtlint: error: {e}", file=sys.stderr)
@@ -427,6 +504,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"per-file (DT1xx) {timings['per_file_s']:.2f}s | "
               f"project (DT2xx) {timings['project_s']:.2f}s | "
               f"concurrency (DT3xx) {timings['concurrency_s']:.2f}s | "
+              f"lifecycle (DT6xx) {timings['lifecycle_s']:.2f}s | "
               f"graph (DT4xx) {timings['graph_s']:.2f}s | "
               f"spmd (DT5xx) {timings['spmd_s']:.2f}s | "
               f"total {timings['total_s']:.2f}s", file=sys.stderr)

@@ -1,0 +1,294 @@
+"""Runtime resource ledger — the DT6xx tier's dynamic sibling.
+
+The lifecycle typestate tier proves release-on-all-paths for the code
+it can see statically; :class:`ResourceLedger` closes the gap at
+runtime: it instruments the real acquire/release surfaces at class
+level — ``PagePool.begin``/``release``/``handoff``,
+``AdapterTable.acquire``/``release``, goodput ``_Frame`` enter/exit,
+and the reqtrace live-span table — counts semantic transitions (an
+idempotent second ``PagePool.release`` is *not* a release; an
+``AdapterTable.release`` that finds no pin is an over-release, not a
+balance credit), and raises :class:`LedgerImbalance` at exit when
+anything acquired in its extent was never released.
+
+Opt in per test with ``@pytest.mark.resource_ledger`` (the conftest
+fixture wraps the test body) and drive it under the resilience fault
+plans: an injected decode failure or replica kill that leaks a lease
+fails the test *here*, with a per-resource imbalance table, instead of
+poisoning a later test through a shared pool.
+
+Class-level patching means every instance constructed inside the
+extent is covered — no plumbing a probe through fixtures.  The ledger
+additionally snapshots each pool/table it sees on first touch and
+checks the instance gauges (``PagePool._lease_count``,
+``AdapterTable._refs``) return to that snapshot, so pre-existing
+long-lived instances balance relative to where they started.
+
+When the body itself raises, the ledger restores the patches and
+stays silent — an imbalance report must never mask the real failure.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["LedgerImbalance", "ResourceLedger"]
+
+_SURFACES = ("pages", "adapters", "goodput", "reqtrace")
+
+
+class LedgerImbalance(AssertionError):
+    """A resource surface finished the ledger extent unbalanced."""
+
+
+class ResourceLedger:
+    """Context manager counting acquire/release transitions.
+
+    ``track`` selects surfaces (default: all four).  ``counts()``
+    exposes the raw counters for tests that want to assert exact
+    traffic, not just balance.
+    """
+
+    _active_lock = threading.Lock()
+    _active: Optional["ResourceLedger"] = None
+
+    def __init__(self, track: Sequence[str] = _SURFACES):
+        unknown = set(track) - set(_SURFACES)
+        if unknown:
+            raise ValueError(f"unknown ledger surface(s): "
+                             f"{sorted(unknown)}; valid: {_SURFACES}")
+        self.track = tuple(track)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._patches: List[Tuple[Any, str, Any]] = []
+        self._pools: Dict[int, Tuple[Any, int]] = {}
+        self._tables: Dict[int, Tuple[Any, Dict[str, int]]] = {}
+        self._live_before: Optional[set] = None
+
+    # ------------------------------------------------------- counters
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    # ------------------------------------------------------- patching
+
+    def _patch(self, owner: Any, name: str, wrapper: Any) -> None:
+        self._patches.append((owner, name, owner.__dict__[name]))
+        setattr(owner, name, wrapper)
+
+    def _instrument_pages(self) -> None:
+        from ..serve.pages import PagePool
+        ledger = self
+
+        orig_begin = PagePool.begin
+        orig_release = PagePool.release
+        orig_handoff = PagePool.handoff
+
+        def begin(pool, prompt, total_cols):
+            ledger._note_pool(pool)
+            lease = orig_begin(pool, prompt, total_cols)
+            ledger._bump("pages.begin")
+            return lease
+
+        def release(pool, lease):
+            ledger._note_pool(pool)
+            was = lease.released
+            orig_release(pool, lease)
+            if not was and lease.released:
+                ledger._bump("pages.release")
+
+        def handoff(pool, lease, context):
+            ledger._note_pool(pool)
+            published = orig_handoff(pool, lease, context)
+            ledger._bump("pages.handoff")
+            return published
+
+        self._patch(PagePool, "begin", begin)
+        self._patch(PagePool, "release", release)
+        self._patch(PagePool, "handoff", handoff)
+
+    def _note_pool(self, pool: Any) -> None:
+        with self._lock:
+            if id(pool) not in self._pools:
+                self._pools[id(pool)] = (pool, pool._lease_count)
+
+    def _instrument_adapters(self) -> None:
+        from ..serve.adapters import AdapterTable
+        ledger = self
+
+        orig_acquire = AdapterTable.acquire
+        orig_release = AdapterTable.release
+
+        def acquire(table, adapter_id):
+            ledger._note_table(table)
+            row = orig_acquire(table, adapter_id)
+            if adapter_id is not None:
+                ledger._bump("adapters.acquire")
+            return row
+
+        def release(table, adapter_id):
+            ledger._note_table(table)
+            if adapter_id is not None:
+                # a release that finds no pin silently no-ops in the
+                # table; the ledger books it as an over-release
+                had = table._refs.get(adapter_id, 0) > 0
+                orig_release(table, adapter_id)
+                ledger._bump("adapters.release" if had
+                             else "adapters.over_release")
+            else:
+                orig_release(table, adapter_id)
+
+        self._patch(AdapterTable, "acquire", acquire)
+        self._patch(AdapterTable, "release", release)
+
+    def _note_table(self, table: Any) -> None:
+        with self._lock:
+            if id(table) not in self._tables:
+                self._tables[id(table)] = (table, dict(table._refs))
+
+    def _instrument_goodput(self) -> None:
+        from ..obs.goodput import _Frame
+        ledger = self
+
+        orig_enter = _Frame.__enter__
+        orig_exit = _Frame.__exit__
+
+        def enter(frame):
+            out = orig_enter(frame)
+            ledger._bump("goodput.enter")
+            return out
+
+        def exit_(frame, *exc):
+            out = orig_exit(frame, *exc)
+            ledger._bump("goodput.exit")
+            return out
+
+        self._patch(_Frame, "__enter__", enter)
+        self._patch(_Frame, "__exit__", exit_)
+
+    def _instrument_reqtrace(self) -> None:
+        from ..obs import reqtrace
+        ledger = self
+        self._live_before = set(reqtrace.live_ids())
+
+        orig_submitted = reqtrace.submitted
+        orig_imported = reqtrace.imported
+        orig_retired = reqtrace.retired
+
+        def submitted(*a, **kw):
+            out = orig_submitted(*a, **kw)
+            ledger._bump("reqtrace.submitted")
+            return out
+
+        def imported(*a, **kw):
+            out = orig_imported(*a, **kw)
+            ledger._bump("reqtrace.imported")
+            return out
+
+        def retired(*a, **kw):
+            out = orig_retired(*a, **kw)
+            ledger._bump("reqtrace.retired")
+            return out
+
+        self._patch(reqtrace, "submitted", submitted)
+        self._patch(reqtrace, "imported", imported)
+        self._patch(reqtrace, "retired", retired)
+
+    # -------------------------------------------------------- extent
+
+    def __enter__(self) -> "ResourceLedger":
+        with ResourceLedger._active_lock:
+            if ResourceLedger._active is not None:
+                raise RuntimeError("ResourceLedger extents cannot nest "
+                                   "(class-level patches would collide)")
+            ResourceLedger._active = self
+        try:
+            if "pages" in self.track:
+                self._instrument_pages()
+            if "adapters" in self.track:
+                self._instrument_adapters()
+            if "goodput" in self.track:
+                self._instrument_goodput()
+            if "reqtrace" in self.track:
+                self._instrument_reqtrace()
+        except BaseException:
+            self._restore()
+            raise
+        return self
+
+    def _restore(self) -> None:
+        for owner, name, orig in reversed(self._patches):
+            setattr(owner, name, orig)
+        self._patches.clear()
+        with ResourceLedger._active_lock:
+            if ResourceLedger._active is self:
+                ResourceLedger._active = None
+
+    def imbalances(self) -> List[str]:
+        """Human-readable imbalance lines; empty when balanced."""
+        c = self.counts()
+        with self._lock:
+            pools = list(self._pools.values())
+            tables = list(self._tables.values())
+        out: List[str] = []
+
+        def pair(acq: str, rel: str, what: str) -> None:
+            a, r = c.get(acq, 0), c.get(rel, 0)
+            if a != r:
+                out.append(f"{what}: {a} acquired vs {r} released "
+                           f"({a - r:+d} leaked)" if a > r else
+                           f"{what}: {r} released vs {a} acquired "
+                           f"({r - a} excess releases)")
+
+        if "pages" in self.track:
+            pair("pages.begin", "pages.release", "page leases")
+            for pool, before in pools:
+                now = pool._lease_count
+                if now != before:
+                    out.append(f"PagePool {hex(id(pool))}: _lease_count "
+                               f"{before} -> {now} across the extent")
+        if "adapters" in self.track:
+            pair("adapters.acquire", "adapters.release", "adapter pins")
+            if c.get("adapters.over_release"):
+                out.append(f"adapter pins: "
+                           f"{c['adapters.over_release']} release(s) "
+                           f"found no pin (double release)")
+            for table, before in tables:
+                now = dict(table._refs)
+                if now != before:
+                    out.append(f"AdapterTable {hex(id(table))}: _refs "
+                               f"{before} -> {now} across the extent")
+        if "goodput" in self.track:
+            pair("goodput.enter", "goodput.exit", "goodput frames")
+        if "reqtrace" in self.track and self._live_before is not None:
+            from ..obs import reqtrace
+            live_now = set(reqtrace.live_ids())
+            leaked = live_now - self._live_before
+            vanished = self._live_before - live_now
+            if leaked:
+                out.append(f"reqtrace: {len(leaked)} span(s) still "
+                           f"live at exit: {sorted(leaked)[:8]}")
+            if vanished:
+                out.append(f"reqtrace: {len(vanished)} pre-existing "
+                           f"span(s) retired inside the extent: "
+                           f"{sorted(vanished)[:8]}")
+        return out
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._restore()
+        if exc_type is not None:
+            return False       # never mask the test's own failure
+        problems = self.imbalances()
+        if problems:
+            c = self.counts()
+            traffic = ", ".join(f"{k}={v}" for k, v in sorted(c.items()))
+            raise LedgerImbalance(
+                "resource ledger unbalanced at extent exit:\n  - "
+                + "\n  - ".join(problems)
+                + (f"\n  traffic: {traffic}" if traffic else ""))
+        return False

@@ -1,0 +1,102 @@
+"""dtlint lifecycle-tier rules (DT601-DT605) over typestate events.
+
+``analysis.lifecycle`` interprets every project function against the
+declared resource protocols and emits rule-tagged
+:class:`~.lifecycle.LifecycleEvent` records; this module is the thin
+findings layer — catalog, severity, select/ignore, and per-line
+suppression via the owning :class:`~.walker.Source`.
+
+Catalog (docs/ANALYSIS.md has the worked examples):
+
+* **DT601** (error) — a leak-tracked resource (page lease, adapter
+  pin) is still held when an exception edge or a return path leaves
+  the function: the acquire has no release on that path and ownership
+  never transferred (stored, returned, handed to a releasing callee,
+  or published via ``handoff``).
+* **DT602** (error) — use-after-release or double release of a
+  *non-idempotent* resource: a second ``adapters.release(aid)``
+  over-decrements the refcount and drops someone else's pin.
+  Idempotent double releases (``PagePool.release`` checks
+  ``lease.released``) are deliberately silent — they match runtime.
+* **DT603** (warning) — bare ``.acquire()`` on a lock without
+  ``.release()`` on every path.  Complements the DT3xx lock-set tier:
+  DT301/DT302 check *which* locks are held, DT603 checks they are
+  *always dropped* — ``with``/try-finally discipline.
+* **DT604** (warning) — a resource held across a ``yield`` (the
+  consumer runs arbitrary code while the resource is pinned) or
+  across an un-shimmed user callback (``on_*``/``*_callback`` call
+  outside any try-with-handlers).  ``@contextmanager`` and pytest
+  ``@fixture`` generators are exempt: there the yield *is* the
+  handoff point.
+* **DT605** (error) — protocol-order violation on an idempotent or
+  terminal protocol: ``lease.register``/``handoff`` after
+  ``release`` (the runtime silently no-ops, so the pages never
+  publish), or re-running a terminal op (``handle.cancel`` on an
+  already-terminal request handle).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from .callgraph import Project
+from .lifecycle import LifecycleModel, PROTOCOLS
+from .report import Finding, Severity
+
+__all__ = ["LIFECYCLE_RULES", "lifecycle_rule_catalog",
+           "run_lifecycle_rules"]
+
+LIFECYCLE_RULES: List[Tuple[str, str, str]] = [
+    ("DT601", Severity.ERROR,
+     "resource leaked on an exception or return path (acquire with no "
+     "release and no ownership transfer on that path)"),
+    ("DT602", Severity.ERROR,
+     "use-after-release / double release of a non-idempotent resource "
+     "(over-decrements a refcount or touches freed state)"),
+    ("DT603", Severity.WARNING,
+     "bare .acquire() without .release() on all paths — use `with` "
+     "or try/finally (DT3xx checks which locks are held; this checks "
+     "they are always dropped)"),
+    ("DT604", Severity.WARNING,
+     "resource held across a yield or an un-shimmed user callback "
+     "(arbitrary foreign code runs while the resource is pinned)"),
+    ("DT605", Severity.ERROR,
+     "protocol-order violation: an intermediate op after release/"
+     "handoff, or a terminal op repeated on a finished handle"),
+]
+
+_SEVERITY = {rule: sev for rule, sev, _ in LIFECYCLE_RULES}
+
+
+def lifecycle_rule_catalog() -> List[Tuple[str, str, str]]:
+    return list(LIFECYCLE_RULES)
+
+
+def run_lifecycle_rules(project: Project,
+                        select: Optional[Set[str]] = None,
+                        ignore: Optional[Set[str]] = None
+                        ) -> List[Finding]:
+    """Run the typestate engine and convert its events to findings.
+
+    ``select``/``ignore`` filter by rule id; per-line
+    ``# dtlint: disable=DT60x`` suppressions are honored through the
+    owning :class:`Source`.
+    """
+    model = LifecycleModel(project, PROTOCOLS)
+    by_path = {info.src.path: info.src
+               for info in project.functions.values()}
+    findings: List[Finding] = []
+    for event in model.events():
+        if select is not None and event.rule not in select:
+            continue
+        if ignore is not None and event.rule in ignore:
+            continue
+        src = by_path.get(event.path)
+        if src is not None and src.suppressed(event.rule, event.line):
+            continue
+        findings.append(Finding(
+            rule=event.rule,
+            severity=_SEVERITY.get(event.rule, Severity.WARNING),
+            path=event.path, line=event.line, col=event.col,
+            message=event.message,
+            source_line=src.line_text(event.line) if src else ""))
+    return findings

@@ -976,41 +976,48 @@ class SlotScheduler:
             # may raise AdapterTableFull and the request must requeue
             # with nothing to unwind
             req.adapter_row = self.adapters.acquire(req.adapter_id)
-        if self.paged:
-            # page lease: map any cached prefix chain read-only and
-            # allocate private pages for the rest of the request's
-            # whole footprint (context + remaining decode budget —
-            # upfront, so a mid-decode tick can never starve).  On
-            # exhaustion the adapter pin unwinds and the request
-            # requeues.
-            try:
+        try:
+            if self.paged:
+                # page lease: map any cached prefix chain read-only and
+                # allocate private pages for the rest of the request's
+                # whole footprint (context + remaining decode budget —
+                # upfront, so a mid-decode tick can never starve)
                 lease = self.pages.begin(
                     ctx, plen + req.remaining_budget - 1)
-            except pages_lib.PagePoolExhausted:
-                if req.adapter_row is not None:
-                    self.adapters.release(req.adapter_id)
-                    req.adapter_row = None
-                raise
-            req._lease = lease
-            remaining = ctx[lease.skip:]
-            n_win = -(-remaining.size // w)
+                req._lease = lease
+                remaining = ctx[lease.skip:]
+                n_win = -(-remaining.size // w)
+                padded = np.zeros((n_win * w,), np.int32)
+                padded[:remaining.size] = remaining
+                with self._lock:
+                    # window dispatches avoided by the prefix hit — the
+                    # measured TTFT/FLOPs saving, reported via stats()
+                    self._windows_skipped += -(-plen // w) - n_win
+                return [req, padded.reshape(n_win, 1, w), 0, lease]
+            n_win = -(-plen // w)
             padded = np.zeros((n_win * w,), np.int32)
-            padded[:remaining.size] = remaining
+            padded[:plen] = ctx
+            windows = padded.reshape(n_win, 1, w)
             with self._lock:
-                # window dispatches avoided by the prefix hit — the
-                # measured TTFT/FLOPs saving, reported via stats()
-                self._windows_skipped += -(-plen // w) - n_win
-            return [req, padded.reshape(n_win, 1, w), 0, lease]
-        n_win = -(-plen // w)
-        padded = np.zeros((n_win * w,), np.int32)
-        padded[:plen] = ctx
-        windows = padded.reshape(n_win, 1, w)
-        with self._lock:
-            kv = self._pf_pool.pop() if self._pf_pool else None
-        if kv is None:
-            kv = slots_lib.strip_pos(self.model.init_cache(
-                1, self.max_len))
-        return [req, windows, 0, dict(kv, pos=np.int32(0))]
+                kv = self._pf_pool.pop() if self._pf_pool else None
+            if kv is None:
+                kv = slots_lib.strip_pos(self.model.init_cache(
+                    1, self.max_len))
+            return [req, windows, 0, dict(kv, pos=np.int32(0))]
+        except BaseException:
+            # admission failed after the pin: pool exhaustion is the
+            # common case, but begin() also raises ValueError for a
+            # footprint over pages_per_slot and init_cache can fail
+            # under fault injection — every path must unwind the lease
+            # and the pin so a requeued (or propagating) request holds
+            # nothing
+            if req._lease is not None:
+                self.pages.release(req._lease)
+                req._lease = None
+            if req.adapter_row is not None and self.adapters is not None:
+                self.adapters.release(req.adapter_id)
+                req.adapter_row = None
+            raise
 
     def _adapter_args(self, req: Optional[Request] = None):
         """(table arrays, rows) for the executables — (None, None) when

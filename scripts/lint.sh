@@ -2,7 +2,8 @@
 # Repo lint gate: ruff (pyflakes + import hygiene, config in
 # pyproject.toml) then dtlint (distributed-JAX hazards, docs/ANALYSIS.md:
 # per-module DT1xx + interprocedural DT2xx + host-concurrency DT3xx +
-# jaxpr graph tier DT4xx + SPMD/comm-ledger tier DT5xx) against the
+# jaxpr graph tier DT4xx + SPMD/comm-ledger tier DT5xx +
+# resource-lifecycle typestate tier DT6xx) against the
 # committed baseline.  Results are
 # memoized in .dtlint-cache/ by content hash, so an unchanged tree
 # re-lints in well under a second; CI passes --no-cache to always run
@@ -21,8 +22,8 @@ else
 fi
 
 # --timings: per-tier breakdown (DT1xx per-file / DT2xx project /
-# DT3xx concurrency / DT4xx graph / DT5xx spmd) on stderr so CI logs
-# show where lint
+# DT3xx concurrency / DT6xx lifecycle / DT4xx graph / DT5xx spmd) on
+# stderr so CI logs show where lint
 # time goes.  Findings tee into $DTLINT_LOG when set; with
 # `set -o pipefail` the pipeline's status is dtlint's (tee's success
 # must not mask findings), captured via `|| rc=$?` because set -e would

@@ -209,6 +209,32 @@ def _race_harness_marker(request):
 
 
 # ---------------------------------------------------------------------------
+# Opt-in resource ledger (analysis/leak_ledger.py, docs/ANALYSIS.md):
+#
+#   @pytest.mark.resource_ledger                      # all four surfaces
+#   @pytest.mark.resource_ledger(track=("pages",))    # just page leases
+#
+# wraps the test in a ResourceLedger: PagePool lease, AdapterTable pin,
+# goodput frame, and reqtrace span acquire/release traffic inside the
+# test must balance exactly at teardown or the test fails with a
+# per-resource imbalance table (LedgerImbalance).  This is the runtime
+# sibling of the DT6xx lifecycle lint tier — chaos tests run under it
+# to prove release-on-injected-fault paths.  Opt-in by marker: the
+# ledger patches the serve/obs classes for its extent.
+
+@pytest.fixture(autouse=True)
+def _resource_ledger_marker(request):
+    marker = request.node.get_closest_marker("resource_ledger")
+    if marker is None:
+        yield
+        return
+    from distributed_tensorflow_tpu.analysis.leak_ledger import ResourceLedger
+    with ResourceLedger(*marker.args, **marker.kwargs) as ledger:
+        request.node.resource_ledger = ledger
+        yield
+
+
+# ---------------------------------------------------------------------------
 # Fault injection (resilience/faults.py, docs/RESILIENCE.md): chaos tests
 # activate a deterministic FaultPlan for their extent via
 #

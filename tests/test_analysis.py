@@ -984,7 +984,8 @@ def test_rule_catalog_covers_all_families():
                    "DT301", "DT302", "DT303", "DT304", "DT305", "DT306",
                    "DT308",
                    "DT400", "DT401", "DT402", "DT403", "DT404", "DT405",
-                   "DT501", "DT502", "DT503", "DT504", "DT505"]
+                   "DT501", "DT502", "DT503", "DT504", "DT505",
+                   "DT601", "DT602", "DT603", "DT604", "DT605"]
 
 
 def test_cli_json_output_and_exit_codes(tmp_path):

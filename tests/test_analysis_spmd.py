@@ -568,7 +568,9 @@ def test_cli_no_spmd_flag(tmp_path):
 
 def test_rule_catalog_includes_spmd_tier():
     ids = [rid for rid, _, _ in analysis.full_rule_catalog()]
-    assert ids[-5:] == ["DT501", "DT502", "DT503", "DT504", "DT505"]
+    # the lifecycle tier (DT6xx) now tails the catalog; the SPMD
+    # block sits just before it
+    assert ids[-10:-5] == ["DT501", "DT502", "DT503", "DT504", "DT505"]
 
 
 class TestSpmdTierCache:
