@@ -1705,6 +1705,82 @@ def bench_gpt_serve():
         f"{off_tps:,.0f} (ratio {tracing['ratio']:.3f}, "
         f"{tracing['traced_requests']} lanes in the ring)")
 
+    # ---- critical path: head-of-line interference, measured ----
+    # An ADVERSARIAL long-prompt trace under an active obs.critpath
+    # ledger: a wave of short requests with real decode budgets fills
+    # the slots first, then multi-window long prompts land mid-decode —
+    # every decode tick sharing the pump with those prefill windows is
+    # stretched, and the ledger attributes exactly that stretch to the
+    # victims' prefill_interference phase.  The interference_share_*
+    # fields are top-level (the perf ledger only lifts top-level
+    # numerics into ``measured``) so the sentinel gates their drift
+    # (up is bad — docs/OBSERVABILITY.md Critical path).
+    from distributed_tensorflow_tpu.obs import critpath as critpath_lib
+
+    rng3 = np.random.default_rng(11)
+    # leave free slots for the longs: they must ADMIT (and prefill)
+    # while the shorts are still decoding, not queue behind them
+    n_long = max(2, slots // 3)
+    n_short = max(1, slots - n_long)
+    cp_prompts = [rng3.integers(0, config.vocab_size,
+                                int(rng3.integers(4, 9))
+                                ).astype(np.int32)
+                  for _ in range(n_short)]
+    cp_prompts += [rng3.integers(0, config.vocab_size,
+                                 3 * chunk + 4).astype(np.int32)
+                   for _ in range(n_long)]
+    cp_budgets = np.array([6 * tick_steps] * n_short + [4] * n_long)
+    cp_budgets = np.clip(cp_budgets, 1, seq - (3 * chunk + 4) - 1)
+    # shorts at tick 0, longs two ticks later: the longs' windows hit
+    # slots that are already decoding
+    cp_arrivals = np.array([0] * n_short + [2] * n_long)
+    cp_tenants = ["interactive"] * n_short + ["batch"] * n_long
+    cp_ledger = critpath_lib.CritpathLedger()
+    eng_cp = make_engine()
+    with critpath_lib.activated(cp_ledger):
+        wall_cp, hs_cp = replay_engine(eng_cp, cp_prompts, cp_budgets,
+                                       cp_arrivals, cp_tenants)
+    assert all(h.done for h in hs_cp)
+    cp_rep = cp_ledger.report()
+
+    # the same vocabulary fleet-wide on virtual time: a seeded
+    # workload through the real Router over SimEngines — the sim must
+    # reproduce a NONZERO interference distribution for the
+    # decomposition to be believed at fleet scale (the >=1e6-request
+    # run lives in the slow test tier / --config=fleet_sim)
+    from distributed_tensorflow_tpu.fleet import sim as sim_lib
+    from distributed_tensorflow_tpu.fleet import workload as workload_lib
+    sim_n = 2000 if SMOKE else 20000
+    sim_cm = sim_lib.CostModel.analytic(
+        n_params=1e8, prefill_chunk=64, num_slots=8, tick_steps=16)
+    sim_tr = workload_lib.synthesize(sim_n, seed=11,
+                                     horizon_s=sim_n / 80.0)
+    sim_rep = sim_lib.FleetSim(
+        sim_tr, sim_cm, replicas=2,
+        engine={"num_slots": 8, "prefill_chunk": 64,
+                "tick_steps": 16}).run()
+    critpath = dict(
+        requests=cp_rep["requests"],
+        interference_ratio=cp_rep["interference_ratio"],
+        phase_seconds=cp_rep["phase_seconds"],
+        worst_e2e_s=round(cp_rep["worst"][0]["e2e_s"], 6)
+        if cp_rep["worst"] else 0.0,
+        sim_requests=sim_rep["simulated_requests"],
+        sim_interference_share_p50=sim_rep["interference_share_p50"],
+        sim_interference_share_p95=sim_rep["interference_share_p95"])
+    log(f"gpt_serve critpath: interference share p50 "
+        f"{cp_rep['interference_share_p50']:.3f} / p95 "
+        f"{cp_rep['interference_share_p95']:.3f} over "
+        f"{cp_rep['requests']} adversarial requests (ratio "
+        f"{cp_rep['interference_ratio']:.3f}); sim p95 "
+        f"{sim_rep['interference_share_p95']:.3f} over "
+        f"{sim_rep['simulated_requests']} virtual requests")
+    report_path = os.environ.get("DTTPU_CRITPATH_REPORT")
+    if report_path:
+        # the CI artifact: the full ledger document plus the sim leg
+        with open(report_path, "w") as f:
+            json.dump({"serve": cp_rep, "sim": sim_rep}, f, indent=2)
+
     return dict(metric="gpt_serve_tokens_per_sec_per_chip",
                 value=round(engine_tps, 1), unit="tokens/sec/chip",
                 tracing=tracing,
@@ -1719,12 +1795,19 @@ def bench_gpt_serve():
                 paged_kernel_vs_gather=round(kernel_vs_gather, 3),
                 ttft_p50_ms=round(ttft_p50 * 1e3, 3),
                 ttft_p95_ms=round(ttft_p95 * 1e3, 3),
+                interference_share_p50=cp_rep["interference_share_p50"],
+                interference_share_p95=cp_rep["interference_share_p95"],
+                sim_interference_share_p50=sim_rep[
+                    "interference_share_p50"],
+                sim_interference_share_p95=sim_rep[
+                    "interference_share_p95"],
                 requests=n_req, num_slots=slots, prefill_chunk=chunk,
                 tick_steps=tick_steps, total_new_tokens=total_tokens,
                 seq_len=seq, page_size=page_size,
                 shared_prefix=shared_prefix,
                 slots_at_fixed_mem=peak_active,
-                slots_at_fixed_mem_contiguous=slots)
+                slots_at_fixed_mem_contiguous=slots,
+                critpath=critpath)
 
 
 def bench_fleet():

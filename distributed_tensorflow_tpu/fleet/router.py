@@ -174,6 +174,14 @@ class FleetHandle:
     def tenant(self) -> str:
         return self.spec["tenant"]
 
+    @property
+    def critpath(self) -> Optional[dict]:
+        """The finished critical-path breakdown (``obs.critpath``) of
+        the placement that retired the request.  Migration carries the
+        accrual on the snapshot, so the breakdown spans every hop; None
+        while in flight or with no ledger active at submit."""
+        return self._handle.critpath if self._handle is not None else None
+
     def _attempt_stream(self, base: int):
         """An ``on_token`` shim for one placement: forwards only tokens
         the user has not seen yet, making delivery exactly-once across

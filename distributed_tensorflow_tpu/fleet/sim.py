@@ -245,7 +245,8 @@ class _SimRequest:
                  "prefix_len", "on_token", "arrival_vt", "first_vt",
                  "span_base", "span_start_vt", "emitted",
                  "windows_left", "status", "error", "deadline_vt",
-                 "trace_id")
+                 "trace_id", "enqueue_vt", "cp_queue", "cp_prefill",
+                 "cp_decode", "cp_interf", "cp_migr")
 
     def __init__(self):
         self.error: Optional[BaseException] = None
@@ -253,6 +254,15 @@ class _SimRequest:
         self.span_start_vt: Optional[float] = None
         self.status = "pending"
         self.trace_id: Optional[str] = None
+        # critical-path accrual on virtual time, mirroring the serve
+        # scheduler's obs.critpath phase vocabulary (queue wait is
+        # measured from ENGINE enqueue, not true arrival, so a migrated
+        # request never double-counts its pre-migration span)
+        self.cp_queue = 0.0
+        self.cp_prefill = 0.0
+        self.cp_decode = 0.0
+        self.cp_interf = 0.0
+        self.cp_migr = 0.0
 
     @property
     def done(self) -> bool:
@@ -268,6 +278,19 @@ class _SimRequest:
         if self.first_vt is None:
             return None
         return self.first_vt - self.arrival_vt
+
+    @property
+    def critpath(self) -> Dict[str, float]:
+        """The serve handle's breakdown surface (``FleetHandle.critpath``
+        reads this), in the obs.critpath phase vocabulary.  Backpressure
+        requeue cannot happen in the sim (queue caps reject at submit),
+        so that phase is structurally zero here."""
+        return {"queue_wait": self.cp_queue,
+                "prefill_compute": self.cp_prefill,
+                "prefill_interference": self.cp_interf,
+                "decode_compute": self.cp_decode,
+                "migration": self.cp_migr,
+                "backpressure_requeue": 0.0}
 
 
 class SimEngine:
@@ -372,6 +395,7 @@ class SimEngine:
         r.emitted = 0
         r.windows_left = 0
         now = self.clock.now if self.clock is not None else self.vt
+        r.enqueue_vt = now
         r.deadline_vt = None if deadline_s is None else now + deadline_s
         if trace_id is not None:
             self._trace_seen += 1
@@ -408,6 +432,20 @@ class SimEngine:
         if resumed > 0:
             # the caller saw the stream start on the source replica
             r.first_vt = r.arrival_vt
+        carry = getattr(snap, "critpath", None)
+        if carry:
+            # resume the source replica's phase accrual; the export ->
+            # import gap is charged to the migration phase on virtual
+            # time, exactly like the serve scheduler's carry
+            src = carry.get("phases") or {}
+            r.cp_queue = float(src.get("queue_wait", 0.0))
+            r.cp_prefill = float(src.get("prefill_compute", 0.0))
+            r.cp_interf = float(src.get("prefill_interference", 0.0))
+            r.cp_decode = float(src.get("decode_compute", 0.0))
+            r.cp_migr = float(src.get("migration", 0.0))
+            r.cp_migr += max(0.0, r.enqueue_vt
+                             - float(carry.get("exported_at",
+                                               r.enqueue_vt)))
         if snap.trace_id is not None:
             # the source's sampling verdict rides the snapshot — the
             # lane continues here, not a fresh submitted()
@@ -424,10 +462,14 @@ class SimEngine:
         if r.status != "pending":
             raise RuntimeError(f"request {r.rid} is terminal "
                                f"({r.status}); nothing to export")
+        now = self.clock.now if self.clock is not None else self.vt
+        if r in self._queue:
+            # close the open queue wait at export so the carried
+            # breakdown stays monotone across hops
+            r.cp_queue += max(0.0, now - r.enqueue_vt)
         self._forget(r)
         r.status = "exported"
         if r.trace_id:
-            now = self.clock.now if self.clock is not None else self.vt
             reqtrace.exported(r.trace_id, ts_us=now * 1e6, rid=r.rid,
                               generated=r.emitted,
                               clean=self._wedged_until is None)
@@ -437,7 +479,10 @@ class SimEngine:
             stream_offset=r.emitted, tenant=r.tenant,
             adapter_id=r.adapter_id, deadline_remaining_s=None,
             sampling=None, clean=self._wedged_until is None,
-            trace_id=r.trace_id)
+            trace_id=r.trace_id,
+            critpath={"phases": r.critpath,
+                      "elapsed_s": max(0.0, now - r.arrival_vt),
+                      "exported_at": now})
 
     def export_inflight(self, timeout_s: Optional[float] = None
                         ) -> List[RequestSnapshot]:
@@ -556,6 +601,7 @@ class SimEngine:
         while free > 0 and len(queue):
             r = queue.popleft()
             free -= 1
+            r.cp_queue += max(0.0, t0 - r.enqueue_vt)
             reused = 0
             if r.prefix_id:
                 st.prefix_lookups_total += 1
@@ -574,7 +620,8 @@ class SimEngine:
             if r.trace_id:
                 reqtrace.stage(r.trace_id, "prefill", ts_us=t0 * 1e6,
                                windows=r.windows_left)
-        dur += len(prefilling) * cm.prefill_window_s
+        prefill_wall = len(prefilling) * cm.prefill_window_s
+        dur += prefill_wall
         t1 = t0 + dur
         self.vt = t1
         metrics = self.metrics
@@ -583,8 +630,16 @@ class SimEngine:
         if active:
             tick_steps = self.tick_steps
             zeros = self._zeros
+            decode_s = cm.decode_tick_s
             still: List[_SimRequest] = []
             for r in active:
+                # head-of-line attribution, same charging rule as the
+                # serve scheduler: a slot already decoding at tick
+                # start experiences the whole prefill wall as stretch;
+                # requests admitted THIS tick sit in `prefilling`, so
+                # they are structurally exempt
+                r.cp_decode += decode_s
+                r.cp_interf += prefill_wall
                 k = r.budget - r.emitted
                 if k > tick_steps:
                     k = tick_steps
@@ -605,6 +660,7 @@ class SimEngine:
         if prefilling:
             still_p: List[_SimRequest] = []
             for r in prefilling:
+                r.cp_prefill += cm.prefill_window_s
                 r.windows_left -= 1
                 if r.windows_left > 0:
                     still_p.append(r)
@@ -691,6 +747,10 @@ class SimMetrics:
         self.slo = slo
         self.ttft = array("d")
         self.tpot = array("d")
+        # per-request interference share (cp_interf / e2e) at
+        # retirement — the fleet-wide head-of-line distribution the
+        # critpath bench leg reports (docs/OBSERVABILITY.md)
+        self.interference = array("d")
         self.completed = 0
         self.deadline_exceeded = 0
         self.cancelled = 0
@@ -722,6 +782,12 @@ class SimMetrics:
 
     def record_retire(self, r: _SimRequest, now_vt: float,
                       status: str) -> None:
+        # interference is recorded for EVERY retirement (deadline
+        # blow-ups are exactly the requests most likely to have been
+        # stretched behind other tenants' prefills)
+        e2e = now_vt - r.arrival_vt
+        if e2e > 0:
+            self.interference.append(r.cp_interf / e2e)
         if status != "ok":
             self.deadline_exceeded += 1
             return
@@ -771,6 +837,10 @@ class SimMetrics:
             "attainment_ttft": round(att_ttft, 6),
             "attainment_itl": round(att_itl, 6),
             "slo_attainment": round(min(att_ttft, att_itl), 6),
+            "interference_share_p50": round(
+                self._pct(self.interference, 50), 6),
+            "interference_share_p95": round(
+                self._pct(self.interference, 95), 6),
         }
 
 

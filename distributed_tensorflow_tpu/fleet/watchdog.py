@@ -134,6 +134,9 @@ class Watchdog:
             except KeyError:
                 continue        # raced another check()/operator action
             victims = getattr(eng, "inflight_trace_ids", lambda: [])()
+            # each victim's critical-path accrual so far (obs.critpath)
+            # — captured alongside the trace ids, for the same reason
+            snaps = getattr(eng, "inflight_critpath", lambda: {})()
             try:
                 self.router.quarantine_replica(
                     rid, reason=reason,
@@ -149,9 +152,15 @@ class Watchdog:
                                     acct.snapshot().items()}}
                      if acct is not None else {})
             for trace_id in victims:
+                # the victim's own phase budget next to the process
+                # goodput split: "this request spent 4 s behind other
+                # tenants' prefills" is the verdict's request-level face
+                cp = snaps.get(trace_id)
+                per = dict(extra, critpath=cp) if cp is not None \
+                    else extra
                 reqtrace.forensic_dump(trace_id, "watchdog_quarantine",
                                        replica=rid, verdict=reason,
-                                       **extra)
+                                       **per)
             with self._lock:
                 self.log.append((rid, reason))
             hits.append((rid, reason))

@@ -38,8 +38,9 @@ from __future__ import annotations
 import os
 from typing import Callable, Dict, Optional
 
-from . import (device, federate, goodput, http, ledger, metrics, reqtrace,
-               sentinel, trace)
+from . import (critpath, device, federate, goodput, http, ledger, metrics,
+               reqtrace, sentinel, trace)
+from .critpath import CritpathLedger
 from .federate import FederatedMetrics
 from .goodput import GoodputAccountant
 from .http import MetricsServer
@@ -50,11 +51,11 @@ from .sentinel import Sentinel
 from .trace import Tracer
 
 __all__ = ["Telemetry", "Tracer", "MetricsServer", "Registry", "REGISTRY",
-           "Counter", "Gauge", "Histogram", "FederatedMetrics",
-           "GoodputAccountant", "PerfLedger", "Sentinel",
-           "parse_exposition", "render_exposition",
-           "device", "federate", "goodput", "http", "ledger", "metrics",
-           "reqtrace", "sentinel", "trace"]
+           "Counter", "Gauge", "Histogram", "CritpathLedger",
+           "FederatedMetrics", "GoodputAccountant", "PerfLedger",
+           "Sentinel", "parse_exposition", "render_exposition",
+           "critpath", "device", "federate", "goodput", "http", "ledger",
+           "metrics", "reqtrace", "sentinel", "trace"]
 
 
 class Telemetry:

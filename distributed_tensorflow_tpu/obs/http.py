@@ -38,6 +38,7 @@ def default_statusz() -> dict:
     module-level obs sinks are currently tracking.  Lazy imports keep
     http importable standalone; every section degrades to absence, so
     the endpoint always answers."""
+    from . import critpath as critpath_lib
     from . import goodput as goodput_lib
     from . import reqtrace
     from . import trace as trace_lib
@@ -45,6 +46,11 @@ def default_statusz() -> dict:
     acct = goodput_lib.active()
     if acct is not None:
         doc["goodput"] = acct.report()
+    led = critpath_lib.active()
+    if led is not None:
+        # headline interference ratio + the top-K slow-request table
+        # (docs/OBSERVABILITY.md §Critical path)
+        doc["critpath"] = led.statusz()
     tracer = trace_lib.active_tracer()
     if tracer is not None and tracer.enabled:
         doc["trace"] = {"events": len(tracer.events()),

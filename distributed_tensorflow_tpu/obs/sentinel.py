@@ -36,7 +36,7 @@ from . import ledger as ledger_lib
 __all__ = ["Tolerance", "Verdict", "Sentinel", "classify_field",
            "parse_tolerance_overrides", "DEFAULT_MIN_RATIO",
            "DEFAULT_MAX_RATIO", "DEFAULT_COMM_MAX_RATIO",
-           "DEFAULT_ROOFLINE_FLOOR"]
+           "DEFAULT_INTERFERENCE_MAX_RATIO", "DEFAULT_ROOFLINE_FLOOR"]
 
 # CI-jitter-sized defaults: a shared runner's smoke bench wobbles tens
 # of percent run-to-run, so the gate only fires on ~2x movements — the
@@ -54,6 +54,16 @@ DEFAULT_COMM_MAX_RATIO = 1.2
 
 # Prefix of the DT5xx static-communication fields bench.py stamps.
 _COMM_PREFIX = "analytical_comm"
+
+# Critical-path interference (obs/critpath.py): the fraction of a
+# request's e2e spent stretched by OTHER requests' prefill windows —
+# regression direction is UP (a scheduling change that worsens
+# head-of-line blocking).  A share is a seeded-workload ratio, not a
+# raw latency, so it jitters less than the wall-clock fields — the gate
+# is tighter than DEFAULT_MAX_RATIO but looser than the computed comm
+# ledger's, and per-field overridable like everything else.
+_INTERFERENCE_TOKEN = "interference_share"
+DEFAULT_INTERFERENCE_MAX_RATIO = 1.5
 
 # Name-based direction inference: duration suffixes are matched at the
 # END of the name (a bare "_s" substring would misread "single_step_*"),
@@ -138,6 +148,8 @@ class Sentinel:
             return tol
         if _COMM_PREFIX in field.lower():
             return Tolerance(max_ratio=DEFAULT_COMM_MAX_RATIO)
+        if _INTERFERENCE_TOKEN in field.lower():
+            return Tolerance(max_ratio=DEFAULT_INTERFERENCE_MAX_RATIO)
         return Tolerance()
 
     # ------------------------------------------------------------- check

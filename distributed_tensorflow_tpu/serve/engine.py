@@ -39,7 +39,7 @@ existing ``/metrics`` endpoint, docs/OBSERVABILITY.md):
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional, Union
+from typing import Callable, Dict, List, Optional, Union
 
 from ..obs import metrics as metrics_lib
 from ..obs import reqtrace
@@ -253,6 +253,15 @@ class RequestHandle:
             return None
         return self._req.finish_time - self._req.first_token_time
 
+    @property
+    def critpath(self) -> Optional[Dict[str, float]]:
+        """The finished critical-path breakdown (``obs.critpath``):
+        exclusive phase seconds summing to ``e2e_s``, plus
+        ``interference_share``.  None while in flight, or when no
+        critpath ledger was active at submit."""
+        cp = self._req.critpath
+        return dict(cp) if cp is not None else None
+
     def result(self) -> List[int]:
         """Pump the engine until this request finishes; return its
         tokens.  (Synchronous engine: waiting IS driving.)"""
@@ -437,6 +446,12 @@ class Engine:
         """Trace ids of every in-flight request — the fleet watchdog's
         pre-quarantine forensics capture (``obs.reqtrace``)."""
         return self.scheduler.inflight_trace_ids()
+
+    def inflight_critpath(self) -> Dict[str, dict]:
+        """Live critical-path breakdowns keyed by trace_id
+        (``obs.critpath``) — the watchdog dumps a quarantine victim's
+        phase budget from these next to its goodput split."""
+        return self.scheduler.inflight_critpath()
 
     def step(self) -> bool:
         """One scheduler tick; False when fully idle."""

@@ -90,6 +90,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..analysis import graph as graph_lib
+from ..obs import critpath as critpath_lib
 from ..obs import reqtrace
 from ..resilience import faults as faults_lib
 from ..ops import decoding as dec
@@ -161,6 +162,22 @@ class Request:
     # door (Router.submit / Engine.submit) when a tracer is active,
     # carried across migration on the snapshot; None = tracing off
     trace_id: Optional[str] = None
+    # critical-path accounting (obs/critpath.py): ``phases`` is the
+    # live accrual dict (None = no ledger active at intake — every
+    # accrual site then reduces to one attribute check); ``critpath``
+    # is the finalized breakdown attached at retirement; ``e2e_base``
+    # carries wall time already spent on previous engines across
+    # migration; ``_cp_wait``/``_cp_t0`` are the open wait-phase
+    # stopwatch (queue_wait until the admission that starts prefill,
+    # backpressure_requeue after an admission bounce)
+    phases: Optional[Dict[str, float]] = dataclasses.field(
+        default=None, repr=False)
+    critpath: Optional[Dict[str, float]] = dataclasses.field(
+        default=None, repr=False)
+    e2e_base: float = 0.0
+    _cp_wait: Optional[str] = dataclasses.field(default="queue_wait",
+                                                repr=False)
+    _cp_t0: float = dataclasses.field(default=0.0, repr=False)
 
     @property
     def remaining_budget(self) -> int:
@@ -214,6 +231,11 @@ class RequestSnapshot:
     sampling: Optional[dict] = None          # source sampling config
     clean: bool = True                       # pump-quiesced export
     trace_id: Optional[str] = None           # the lane continues (obs/reqtrace)
+    # critical-path carry (obs/critpath.py): the source's phase accrual
+    # plus elapsed wall so far and the export instant — the importer
+    # charges the export->import gap to ``migration`` and resumes, so a
+    # migrated request neither double-counts nor loses time
+    critpath: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -726,6 +748,8 @@ class SlotScheduler:
                 f"({max_new_tokens}) exceeds max_len {self.max_len}")
         now = time.perf_counter()
         tenant = str(tenant)
+        # built OUTSIDE the state lock (lock sections stay call-free)
+        cp_phases = critpath_lib.new_phases()
         with self._lock:
             # depth + quota + enqueue + counter bump are ONE atomic
             # admission decision, however many threads submit at once
@@ -748,6 +772,8 @@ class SlotScheduler:
                           context=prompt,
                           token_cost=int(max_new_tokens),
                           trace_id=trace_id)
+            req.phases = cp_phases
+            req._cp_t0 = now
             self._next_rid += 1
             self._enqueue_locked(req)
         if req.trace_id:
@@ -882,25 +908,52 @@ class SlotScheduler:
                 # every adapter row / pool page is pinned by an
                 # in-flight request: leave the request queued (a
                 # retirement frees pins and pages, so this always
-                # drains) and stop admitting this tick
+                # drains) and stop admitting this tick; the continued
+                # wait is attributed to backpressure, not queue order
+                if req.phases is not None:
+                    self._cp_close_wait(req, time.perf_counter(),
+                                        reopen="backpressure_requeue")
                 with self._lock:
                     self._requeue(req)
                 break
+            if req.phases is not None:
+                self._cp_close_wait(req, time.perf_counter())
             if req.trace_id:
                 reqtrace.stage(req.trace_id, "prefill")
             with self._lock:
                 self._prefills.append(st)
         with self._lock:
             pending = list(self._prefills)
+        # critpath (obs/critpath.py): with a ledger active, time each
+        # prefill window and the decode dispatch so the tick's wall can
+        # be attributed per request — prefill_s totals this tick's
+        # window cost, win_by_req keys each request's OWN share (and
+        # doubles as "prefilled this tick", which exempts a request
+        # admitted mid-tick from interference: it was not yet decoding
+        # when the windows ran).  One global read when inactive.
+        cp_on = critpath_lib.active() is not None
+        prefill_s = 0.0
+        win_by_req: Dict[int, float] = {}
         if pending:
             did = True
             for st in pending:
-                self._advance_prefill(st, outbox)
+                if cp_on:
+                    w0 = time.perf_counter()
+                    self._advance_prefill(st, outbox)
+                    dt = time.perf_counter() - w0
+                    prefill_s += dt
+                    win_by_req[id(st[0])] = \
+                        win_by_req.get(id(st[0]), 0.0) + dt
+                    if st[0].phases is not None:
+                        st[0].phases["prefill_compute"] += dt
+                else:
+                    self._advance_prefill(st, outbox)
         with self._lock:
             active = any(r is not None for r in self._slots)
         if active:
             did = True
-            self._decode_tick(outbox)
+            self._decode_tick(outbox, prefill_s if cp_on else None,
+                              win_by_req)
         self._flush(outbox)
         if did:
             self._report_depth()
@@ -948,6 +1001,17 @@ class SlotScheduler:
         if stale:
             self._finished = self._finished.at[np.asarray(stale)].set(
                 True)
+
+    def _cp_close_wait(self, req: Request, now: float,
+                       reopen: Optional[str] = None) -> None:
+        """Close the request's open wait phase (queue_wait or
+        backpressure_requeue) at ``now``; ``reopen`` restarts the
+        stopwatch under a new phase (an admission bounce).  Pump-only —
+        the wait stopwatch has a single writer."""
+        if req._cp_wait is not None:
+            req.phases[req._cp_wait] += max(0.0, now - req._cp_t0)
+        req._cp_wait = reopen
+        req._cp_t0 = now
 
     def _requeue(self, req: Request) -> None:
         """Put a popped-but-unstartable request back at the FRONT of its
@@ -1150,7 +1214,19 @@ class SlotScheduler:
                 if self._page_tab is not None:
                     self._page_tab[r] = 0
 
-    def _decode_tick(self, outbox: List[tuple]) -> None:
+    def _decode_tick(self, outbox: List[tuple],
+                     prefill_s: Optional[float] = None,
+                     win_by_req: Optional[Dict[int, float]] = None
+                     ) -> None:
+        """One K-step decode dispatch.  ``prefill_s`` (critpath ledger
+        active) is this tick's total prefill-window wall time:  every
+        slot that was already decoding when those windows ran is
+        charged the FULL amount as ``prefill_interference`` — all
+        decode slots experience the stretch in parallel, which is
+        exactly how the fleet simulator prices the HOL penalty — while
+        requests in ``win_by_req`` (prefilled/admitted this same tick)
+        are exempt.  ``decode_compute`` is the dispatch-to-host-sync
+        wall, identical for every live slot in the batch."""
         with self._lock:
             slots = list(self._slots)
             # page-table snapshot for this dispatch: host mutations
@@ -1159,6 +1235,7 @@ class SlotScheduler:
             tab = (self._page_tab.copy() if self._page_tab is not None
                    else None)
         ad, ad_rows = self._adapter_args()
+        t0 = time.perf_counter() if prefill_s is not None else 0.0
         if self.paged:
             (self._cache, self._tokens, self._finished, self._remaining,
              self._key), em, mask = self._tick(
@@ -1170,6 +1247,8 @@ class SlotScheduler:
                 self.params, self._cache, self._tokens, self._finished,
                 self._remaining, self._key, ad, ad_rows)
         em = np.asarray(em)                      # [K, S]
+        decode_s = (time.perf_counter() - t0     # includes the host sync
+                    if prefill_s is not None else 0.0)
         mask = np.asarray(mask)
         fin = np.asarray(self._finished)
         for r, req in enumerate(slots):
@@ -1178,6 +1257,11 @@ class SlotScheduler:
             with self._lock:
                 if self._slots[r] is not req:
                     continue         # cancelled mid-dispatch: drop tokens
+            if prefill_s is not None and req.phases is not None:
+                ph = req.phases
+                ph["decode_compute"] += decode_s
+                if id(req) not in (win_by_req or {}):
+                    ph["prefill_interference"] += prefill_s
             toks = em[:, r][mask[:, r]]
             if toks.size:
                 outbox.append(("deliver", req, [int(t) for t in toks], r))
@@ -1252,9 +1336,13 @@ class SlotScheduler:
             self._abort(req, "deadline_exceeded")
             if req.trace_id:
                 # tail-latency forensics: snapshot the victim's span
-                # tree while the evidence is warm (bounded log)
+                # tree while the evidence is warm (bounded log), with
+                # the phase budget the deadline was spent on alongside
+                extra = ({"critpath": req.critpath}
+                         if req.critpath is not None else {})
                 reqtrace.forensic_dump(req.trace_id, "deadline_expired",
-                                       rid=req.rid, tenant=req.tenant)
+                                       rid=req.rid, tenant=req.tenant,
+                                       **extra)
         if aborts:
             self._report_depth()
 
@@ -1315,6 +1403,31 @@ class SlotScheduler:
                     + [st[0] for st in self._prefills]
                     + [r for r in self._slots if r is not None])
         return [r.trace_id for r in reqs if r.trace_id]
+
+    def inflight_critpath(self) -> Dict[str, dict]:
+        """Live critical-path breakdowns keyed by trace_id — each
+        in-flight (un-retired) request's phase accrual so far,
+        finalized against wall-now with its open wait phase included.
+        The fleet watchdog captures these BEFORE quarantining a wedged
+        replica, so a victim's phase budget lands in the forensic
+        record next to its goodput split.  Snapshot under the state
+        lock; the finalize arithmetic runs outside it."""
+        with self._lock:
+            reqs = ([r for r in self._queue]
+                    + [st[0] for st in self._prefills]
+                    + [r for r in self._slots if r is not None])
+        now = time.perf_counter()
+        out: Dict[str, dict] = {}
+        for req in reqs:
+            if req.phases is None or not req.trace_id:
+                continue
+            ph = dict(req.phases)
+            if req._cp_wait is not None:
+                ph[req._cp_wait] = ph.get(req._cp_wait, 0.0) \
+                    + max(0.0, now - req._cp_t0)
+            e2e = req.e2e_base + max(0.0, now - req.submit_time)
+            out[req.trace_id] = critpath_lib.finalize(ph, e2e)
+        return out
 
     def export(self, req: Request,
                timeout_s: Optional[float] = None) -> RequestSnapshot:
@@ -1397,6 +1510,22 @@ class SlotScheduler:
             deadline_remaining_s=(None if req.deadline is None
                                   else max(0.0, req.deadline - now)),
             sampling=dict(self._sampling), clean=clean)
+        if req.phases is not None:
+            # critpath carry: a COPY with the open wait phase closed at
+            # the export instant; the importer charges the
+            # export->import gap to ``migration`` and resumes the
+            # stopwatch on its own clock (perf_counter instants are
+            # comparable in-process, where fleet migration lives)
+            ph = dict(req.phases)
+            if req._cp_wait is not None:
+                ph[req._cp_wait] = ph.get(req._cp_wait, 0.0) \
+                    + max(0.0, now - req._cp_t0)
+            snap.critpath = {
+                "phases": ph,
+                "elapsed_s": req.e2e_base
+                + max(0.0, now - req.submit_time),
+                "exported_at": now,
+            }
         # lease handoff (serve/pages.py): publish the request's FINAL
         # full pages into the radix tree before the retirement below
         # releases them — a re-import into this engine then skips those
@@ -1484,6 +1613,24 @@ class SlotScheduler:
                 f"{self.max_len}")
         now = time.perf_counter()
         tenant = str(snap.tenant)
+        # critpath resume (outside the state lock): a snapshot carrying
+        # accrual continues it here regardless of the LOCAL ledger
+        # state — losing a migrated request's history would break the
+        # sums-to-e2e invariant the chaos property test asserts.  The
+        # export->import gap is the ``migration`` phase (clamped at 0:
+        # a cross-host import's foreign perf_counter origin contributes
+        # no gap rather than garbage).
+        carry = snap.critpath
+        cp_base = 0.0
+        if carry is not None:
+            src = carry.get("phases") or {}
+            cp_phases = {p: float(src.get(p, 0.0))
+                         for p in critpath_lib.PHASES[:-1]}
+            gap = max(0.0, now - float(carry.get("exported_at", now)))
+            cp_phases["migration"] += gap
+            cp_base = float(carry.get("elapsed_s", 0.0)) + gap
+        else:
+            cp_phases = critpath_lib.new_phases()
         with self._lock:
             if self.max_queue_depth is not None \
                     and len(self._queue) >= self.max_queue_depth:
@@ -1506,6 +1653,9 @@ class SlotScheduler:
                           token_cost=remaining,
                           trace_id=snap.trace_id)
             req.tokens = list(generated)
+            req.phases = cp_phases
+            req.e2e_base = cp_base
+            req._cp_t0 = now
             self._next_rid += 1
             self._enqueue_locked(req)
         if req.trace_id:
@@ -1571,14 +1721,38 @@ class SlotScheduler:
             self.pages.release(req._lease)
         return True
 
+    def _finalize_critpath(self, req: Request) -> None:
+        """Close the request's phase accrual into the finished
+        breakdown (obs/critpath.py), attach it to the request, and fold
+        it into the active ledger.  Runs inside the claim-once
+        retirement (so exactly once per request) with ``finish_time``
+        already stamped; ``migrated`` requests carry their accrual on
+        the snapshot instead — finalizing the hop here too would
+        double-count it on the importer."""
+        if req.phases is None or req.status == "migrated":
+            return
+        now = req.finish_time
+        if req._cp_wait is not None:
+            req.phases[req._cp_wait] += max(0.0, now - req._cp_t0)
+            req._cp_wait = None
+        e2e = req.e2e_base + max(0.0, now - req.submit_time)
+        req.critpath = critpath_lib.finalize(req.phases, e2e)
+        critpath_lib.observe(req.tenant, req.critpath,
+                             trace_id=req.trace_id)
+
     def _finish(self, req: Request) -> None:
         if not self._retire_accounting(req):
             return
         req.status = "ok"
         req.finish_time = time.perf_counter()
+        self._finalize_critpath(req)
         if req.trace_id:
-            # claim-once above guarantees exactly one terminal span
-            reqtrace.retired(req.trace_id, "ok", tokens=len(req.tokens))
+            # claim-once above guarantees exactly one terminal span;
+            # the finished breakdown rides the terminal event's args
+            extra = ({"critpath": req.critpath}
+                     if req.critpath is not None else {})
+            reqtrace.retired(req.trace_id, "ok", tokens=len(req.tokens),
+                             **extra)
         self.metrics.finished(req)
         req.done.set()
 
@@ -1589,10 +1763,13 @@ class SlotScheduler:
         req.status = status
         req.error = error
         req.finish_time = time.perf_counter()
+        self._finalize_critpath(req)
         if req.trace_id:
             # "migrated" is a no-op here: exported() owns the hop
+            extra = ({"critpath": req.critpath}
+                     if req.critpath is not None else {})
             reqtrace.retired(req.trace_id, status,
-                             tokens=len(req.tokens))
+                             tokens=len(req.tokens), **extra)
         self.metrics.aborted(req, status)
         req.done.set()
 

@@ -1,0 +1,427 @@
+"""Critical-path ledger tests: per-request latency decomposition and
+head-of-line interference attribution (obs/critpath.py).
+
+The contracts pinned here (docs/OBSERVABILITY.md §Critical path):
+  * phases sum to e2e by construction — for every request, including a
+    chaos run with kills, stalls, and migrations (no double-count, no
+    loss across engines: the ledger observes each request exactly once),
+  * the HOL charging rule: a long prompt landing mid-decode puts
+    NONZERO ``prefill_interference`` on the co-scheduled decoders, and
+    a decode-only trace (co-submitted equal prompts) measures EXACTLY
+    zero,
+  * the breakdown survives migration (export→import gap lands in the
+    ``migration`` phase; phases carried, not reset),
+  * the fleet simulator mirrors the same vocabulary on virtual time,
+  * watchdog forensics carry the victim's breakdown, /statusz gains the
+    top-K table, and the sentinel gates ``interference_share*`` drift
+    (up is bad).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_tensorflow_tpu import fleet, serve
+from distributed_tensorflow_tpu.models.gpt import gpt_tiny
+from distributed_tensorflow_tpu.obs import critpath as critpath_lib
+from distributed_tensorflow_tpu.obs import http as http_lib
+from distributed_tensorflow_tpu.obs import ledger as ledger_lib
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.obs import reqtrace
+from distributed_tensorflow_tpu.obs import sentinel as sentinel_lib
+from distributed_tensorflow_tpu.obs import trace as obs_trace
+from distributed_tensorflow_tpu.fleet import sim as sim_lib
+from distributed_tensorflow_tpu.resilience import faults
+
+
+def _model_params(seed=0, **kw):
+    model = gpt_tiny(dropout_rate=0.0, **kw)
+    return model, model.init(jax.random.PRNGKey(seed))
+
+
+def _prompt(plen, seed=1, vocab=512):
+    return np.asarray(jax.random.randint(jax.random.PRNGKey(seed),
+                                         (plen,), 0, vocab), np.int32)
+
+
+def _engine(model, params, reg=None, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_chunk", 4)
+    kw.setdefault("tick_steps", 2)
+    return serve.Engine(model, params,
+                        registry=reg or metrics_lib.Registry(), **kw)
+
+
+def _assert_sums(cp, tol_rel=0.02):
+    """The by-construction invariant: the seven exclusive phases sum to
+    the measured e2e (``other`` is the clamped remainder, so the only
+    slack is boundary clock granularity)."""
+    total = sum(cp[p] for p in critpath_lib.PHASES)
+    assert all(cp[p] >= 0.0 for p in critpath_lib.PHASES), cp
+    assert total == pytest.approx(cp["e2e_s"], rel=tol_rel, abs=1e-6), cp
+
+
+@pytest.fixture
+def req_tracer():
+    """Active host tracer + clean reqtrace state (trace ids only mint
+    while a tracer is live), torn down either way."""
+    reqtrace.reset()
+    tracer = obs_trace.activate(obs_trace.Tracer(enabled=True))
+    try:
+        yield tracer
+    finally:
+        obs_trace.deactivate(tracer)
+        reqtrace.reset()
+
+
+# ---------------------------------------------------------------------------
+# ledger unit surface
+
+
+def test_finalize_sums_other_clamped_and_share():
+    ph = critpath_lib.new_phases()
+    assert ph is None                      # nothing active: disabled path
+    with critpath_lib.activated(critpath_lib.CritpathLedger()):
+        ph = critpath_lib.new_phases()
+    assert ph == {p: 0.0 for p in critpath_lib.PHASES[:-1]}
+    ph["queue_wait"] = 0.25
+    ph["decode_compute"] = 0.5
+    ph["prefill_interference"] = 0.25
+    cp = critpath_lib.finalize(ph, 1.25)
+    assert cp["other"] == pytest.approx(0.25)
+    assert cp["interference_share"] == pytest.approx(0.2)
+    _assert_sums(cp)
+    # overshoot (boundary noise): other clamps at zero, never negative
+    cp2 = critpath_lib.finalize(ph, 0.9)
+    assert cp2["other"] == 0.0
+    # finalize COPIES — the accrual dict is untouched
+    assert "other" not in ph and "e2e_s" not in ph
+
+
+def test_activated_restores_previous_ledger():
+    a, b = critpath_lib.CritpathLedger(), critpath_lib.CritpathLedger()
+    with critpath_lib.activated(a):
+        assert critpath_lib.active() is a
+        with critpath_lib.activated(b):
+            assert critpath_lib.active() is b
+        assert critpath_lib.active() is a
+    assert critpath_lib.active() is None
+
+
+def test_ledger_worst_k_reservoir_and_metrics():
+    reg = metrics_lib.Registry()
+    led = critpath_lib.CritpathLedger(registry=reg, worst_k=2,
+                                      reservoir=4)
+    for i in range(6):
+        ph = {p: 0.0 for p in critpath_lib.PHASES[:-1]}
+        ph["decode_compute"] = 0.1 * (i + 1)
+        ph["prefill_interference"] = 0.01 * (i + 1)
+        led.observe("t%d" % (i % 2), critpath_lib.finalize(
+            ph, 0.2 * (i + 1)), trace_id="id%d" % i)
+    worst = led.worst()                    # slowest first, capped at K
+    assert [w["trace_id"] for w in worst] == ["id5", "id4"]
+    # deterministic reservoir: 6 samples into 4 slots, i % cap overwrite
+    assert len(led.interference_shares()) == 4
+    rep = led.report()
+    assert rep["requests"] == 6
+    assert rep["interference_share_p95"] > 0
+    assert set(rep["per_tenant"]) == {"t0", "t1"}
+    _assert_sums({**rep["phase_seconds"],
+                  "e2e_s": rep["e2e_seconds"]}, tol_rel=1e-9)
+    # the two exported series, per docs/OBSERVABILITY.md §Critical path
+    c = reg.get("dttpu_critpath_seconds_total",
+                labels={"phase": "decode_compute", "tenant": "t0"})
+    assert c is not None and c.value == pytest.approx(0.1 + 0.3 + 0.5)
+    g = reg.get("dttpu_critpath_interference_ratio")
+    assert g is not None and 0 < g.value < 1
+
+
+def test_statusz_includes_critpath_section():
+    led = critpath_lib.CritpathLedger()
+    ph = {p: 0.0 for p in critpath_lib.PHASES[:-1]}
+    ph["prefill_interference"] = 0.5
+    led.observe("pro", critpath_lib.finalize(ph, 1.0), trace_id="tid0")
+    with critpath_lib.activated(led):
+        doc = http_lib.default_statusz()
+    assert doc["critpath"]["requests"] == 1
+    (row,) = doc["critpath"]["slowest"]
+    assert row["trace_id"] == "tid0" and row["tenant"] == "pro"
+    assert row["interference_share"] == pytest.approx(0.5)
+    assert "critpath" not in http_lib.default_statusz()   # deactivated
+
+
+def test_sentinel_gates_interference_share_drift():
+    assert sentinel_lib.DEFAULT_INTERFERENCE_MAX_RATIO == 1.5
+    assert sentinel_lib.classify_field("interference_share_p95") == \
+        "lower"
+    base = {"measured": {"interference_share_p95": 0.10}}
+    sent = sentinel_lib.Sentinel()
+
+    def verdict(v):
+        row = {"config": "x",
+               "measured": {"interference_share_p95": v}}
+        (out,) = [x for x in sent.check(row, baseline=base)
+                  if x.field == "interference_share_p95"]
+        return out
+    assert verdict(0.14).ok                 # 1.4x drift: inside 1.5x
+    bad = verdict(0.16)                     # 1.6x drift: up is bad
+    assert not bad.ok and "max_ratio 1.5" in bad.detail
+
+
+def test_bench_row_lifts_interference_fields():
+    """The gpt_serve bench row carries the shares at TOP level because
+    row_from_bench only lifts top-level numerics into ``measured`` —
+    the nested critpath document is detail, not a gated field."""
+    row = ledger_lib.row_from_bench({
+        "config": "gpt_serve", "interference_share_p95": 0.05,
+        "sim_interference_share_p95": 0.06,
+        "critpath": {"interference_ratio": 0.04}})
+    assert row["measured"]["interference_share_p95"] == 0.05
+    assert row["measured"]["sim_interference_share_p95"] == 0.06
+    assert "critpath" not in row["measured"]
+
+
+# ---------------------------------------------------------------------------
+# serve engine: planted interference + the exactly-zero control
+
+
+def test_handle_critpath_none_without_active_ledger():
+    model, params = _model_params()
+    eng = _engine(model, params)
+    h = eng.submit(_prompt(3), 2)
+    eng.drain()
+    assert h.done and h.critpath is None    # disabled fast path
+
+
+def test_cosubmitted_decode_only_interference_exactly_zero():
+    """Two equal single-window prompts admitted in the SAME tick: both
+    are exempt from that tick's prefill wall (they ARE the prefill),
+    and no later tick mixes prefill with their decode — interference is
+    exactly 0.0, not merely small."""
+    model, params = _model_params()
+    eng = _engine(model, params)
+    with critpath_lib.activated(critpath_lib.CritpathLedger()):
+        hs = [eng.submit(_prompt(3, seed=s), 6) for s in (11, 12)]
+        eng.drain()
+    for h in hs:
+        assert h.status == "ok"
+        cp = h.critpath
+        assert cp["prefill_interference"] == 0.0
+        _assert_sums(cp)
+
+
+def test_planted_long_prompt_interferes_with_decoder():
+    """The HOL plant: A is decoding when B's multi-window prompt lands —
+    every tick that prefills B while A decodes charges A the window
+    wall.  A's interference is nonzero; B (whose own admission tick is
+    exempt, and whose decode never shares a tick with a prefill) stays
+    at exactly zero."""
+    model, params = _model_params()
+    eng = _engine(model, params)
+    led = critpath_lib.CritpathLedger()
+    with critpath_lib.activated(led):
+        a = eng.submit(_prompt(3, seed=21), 12)
+        while not a.tokens:                 # A through prefill, decoding
+            eng.step()
+        b = eng.submit(_prompt(10, seed=22), 2)   # 3 windows, mid-decode
+        eng.drain()
+    assert a.status == "ok" and b.status == "ok"
+    cp_a, cp_b = a.critpath, b.critpath
+    assert cp_a["prefill_interference"] > 0.0, cp_a
+    assert cp_b["prefill_interference"] == 0.0, cp_b
+    assert cp_a["interference_share"] > 0.0
+    for cp in (cp_a, cp_b):
+        _assert_sums(cp)
+    # both retirements reached the active ledger exactly once
+    rep = led.report()
+    assert rep["requests"] == 2
+    assert rep["interference_ratio"] > 0.0
+
+
+def test_migration_carries_phases_and_charges_the_gap():
+    """Export mid-decode, import elsewhere: accrued phases ride the
+    snapshot, the export→import wall lands in ``migration``, and the
+    ledger sees ONE retirement (the source's ``migrated`` status is not
+    a retirement)."""
+    model, params = _model_params()
+    src, dst = _engine(model, params), _engine(model, params)
+    led = critpath_lib.CritpathLedger()
+    with critpath_lib.activated(led):
+        h = src.submit(_prompt(5, seed=31), 10)
+        while len(h.tokens) < 4:
+            src.step()
+        snap = src.export_request(h)
+        assert snap.critpath is not None
+        carried = snap.critpath["phases"]
+        assert carried["decode_compute"] > 0.0
+        time.sleep(0.02)                    # a measurable transit gap
+        h2 = dst.import_request(snap)
+        dst.drain()
+    assert h.status == "migrated" and h2.status == "ok"
+    cp = h2.critpath
+    assert cp["migration"] >= 0.02
+    # source-side accrual carried, then grew on the destination
+    assert cp["decode_compute"] >= carried["decode_compute"]
+    assert cp["e2e_s"] >= snap.critpath["elapsed_s"] + cp["migration"]
+    _assert_sums(cp)
+    assert led.report()["requests"] == 1    # exactly once, final hop
+
+
+@pytest.mark.chaos
+def test_chaos_sum_invariant_no_double_count_across_engines():
+    """THE property test: kill one replica and stall another mid-run —
+    every request still retires with a breakdown whose phases sum to
+    its e2e, every phase nonnegative, the ledger observes each request
+    EXACTLY once despite exports/imports, and at least one migrated
+    request shows a positive ``migration`` phase."""
+    model, params = _model_params()
+    reg = metrics_lib.Registry()
+    engines = [_engine(model, params, reg=reg) for _ in range(3)]
+    router = fleet.Router(engines, registry=reg)
+    # warm every executable BEFORE activating the ledger: compile ticks
+    # are legitimately slow and the warmup requests must not be counted
+    ws = [eng.submit(_prompt(6, seed=50 + j), 3)
+          for j, eng in enumerate(engines)]
+    for _ in range(8):
+        for eng in engines:
+            eng.step()
+    assert all(w.done for w in ws)
+    wd = fleet.Watchdog(router, tick_deadline_s=0.25,
+                        export_timeout_s=0.1, registry=reg)
+    plan = faults.FaultPlan(
+        [{"kind": "kill_replica", "at": 5, "replica": 1},
+         {"kind": "stall_tick", "at": 6, "replica": 2, "seconds": 0.6}],
+        registry=metrics_lib.Registry())
+    led = critpath_lib.CritpathLedger(worst_k=16)
+    with critpath_lib.activated(led), faults.activated(plan):
+        hs = [router.submit(_prompt(3 + i % 3, seed=i), 8,
+                            deadline_s=120.0) for i in range(8)]
+        deadline = time.perf_counter() + 120
+        while router.busy:
+            assert time.perf_counter() < deadline, "chaos run hung"
+            router.step()
+            wd.check()
+    assert {e["kind"] for e in plan.log} == {"kill_replica",
+                                             "stall_tick"}
+    migrated = 0
+    for i, h in enumerate(hs):
+        assert h.status == "ok", (i, h.status)
+        cp = h.critpath
+        assert cp is not None, i
+        _assert_sums(cp)
+        if cp["migration"] > 0.0:
+            migrated += 1
+    assert reg.get("dttpu_migrations_total").value >= 1
+    assert migrated >= 1                    # the gap was charged
+    # exactly once per request: migrated hops retired on ONE engine
+    assert led.report()["requests"] == len(hs)
+
+
+def test_watchdog_forensics_include_victim_breakdown(req_tracer):
+    """A quarantine's forensic dumps carry each victim's critpath
+    accrual so far, captured BEFORE the export moved it away."""
+    model, params = _model_params()
+    engines = [_engine(model, params) for _ in range(2)]
+    router = fleet.Router(engines, registry=metrics_lib.Registry())
+    led = critpath_lib.CritpathLedger()
+    with critpath_lib.activated(led):
+        hs = [router.submit(_prompt(5, seed=70 + i), 8)
+              for i in range(3)]
+        while not any(len(h.tokens) >= 2 for h in hs):
+            router.step()
+        wd = fleet.Watchdog(router, tick_deadline_s=5.0,
+                            registry=metrics_lib.Registry())
+        calls = []
+
+        def forced(stats, now=None):
+            calls.append(1)
+            return "stalled: forced by test" if len(calls) == 1 else None
+
+        wd.verdict = forced
+        hits = wd.check()
+        assert hits and hits[0][0] == 0
+        dumps = reqtrace.forensics_log()
+        assert dumps
+        for d in dumps:
+            cp = d["context"]["critpath"]
+            assert set(critpath_lib.PHASES) <= set(cp)
+            assert cp["e2e_s"] > 0.0
+        while any(not h.done for h in hs):
+            router.step()
+    assert all(h.status == "ok" for h in hs)
+
+
+# ---------------------------------------------------------------------------
+# fleet simulator mirror (virtual time)
+
+
+def _sim_engine(**kw):
+    cm = sim_lib.CostModel(prefill_window_s=0.01, decode_tick_s=0.002,
+                           overhead_s=0.0)
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("prefill_chunk", 32)
+    kw.setdefault("tick_steps", 4)
+    return sim_lib.SimEngine(cm, **kw)
+
+
+def test_sim_cosubmitted_zero_staggered_nonzero():
+    # co-submitted: both prefill in the same tick — exempt, exactly 0.0
+    eng = _sim_engine()
+    r1, r2 = eng.submit(16, 5), eng.submit(16, 5)
+    assert eng.drain()
+    assert r1.cp_interf == 0.0 and r2.cp_interf == 0.0
+    assert r1.cp_prefill == pytest.approx(0.01)
+    # staggered: r1 decoding when r2's two windows run — r1 is charged
+    # exactly two window walls; r2's own decode shares no prefill tick
+    eng = _sim_engine()
+    r1 = eng.submit(16, 20)
+    eng.step()                              # r1 admitted + first token
+    assert r1.emitted == 1
+    r2 = eng.submit(64, 4)                  # 2 windows, lands mid-decode
+    assert eng.drain()
+    assert r1.cp_interf == pytest.approx(2 * 0.01)
+    assert r2.cp_interf == 0.0
+    assert r2.cp_prefill == pytest.approx(2 * 0.01)
+    # the handle surface the router's FleetHandle reads
+    assert set(critpath_lib.PHASES[:-1]) == set(r1.critpath)
+
+
+def test_sim_export_import_carries_and_charges_virtual_gap():
+    clock = sim_lib.SimClock(0.0)
+    a = _sim_engine(clock=clock)
+    b = _sim_engine(clock=clock)
+    r = a.submit(16, 12)
+    a._tick_once()
+    a._tick_once()                          # decoding
+    assert r.emitted > 1
+    pre = dict(r.critpath)
+    snap = a.export_request(r)
+    assert snap.critpath["exported_at"] == 0.0
+    clock.now = 0.5                         # half a virtual second away
+    r2 = b.import_request(snap)
+    assert b.drain()
+    assert r2.status == "ok"
+    assert r2.cp_migr == pytest.approx(0.5)
+    assert r2.cp_decode >= pre["decode_compute"]
+    assert r2.cp_prefill >= pre["prefill_compute"]  # re-prefill is real
+
+
+def test_fleet_sim_reports_deterministic_interference():
+    from distributed_tensorflow_tpu.fleet import workload
+
+    def run():
+        cm = sim_lib.CostModel.analytic(
+            n_params=1e8, prefill_chunk=64, num_slots=8, tick_steps=16)
+        tr = workload.synthesize(1500, seed=7, horizon_s=20.0)
+        return sim_lib.FleetSim(
+            tr, cm, replicas=2,
+            engine={"num_slots": 8, "prefill_chunk": 64,
+                    "tick_steps": 16}).run()
+
+    r1, r2 = run(), run()
+    assert r1["interference_share_p95"] > 0.0
+    assert r1["interference_share_p50"] == r2["interference_share_p50"]
+    assert r1["interference_share_p95"] == r2["interference_share_p95"]
