@@ -2030,7 +2030,26 @@ def bench_fleet_sim():
        SLO for less provisioned capacity.
     3. **curve** — SLO attainment vs static replica count on a clean
        subset trace (``slo_vs_replicas``), the capacity-planning curve.
-    4. **validation** — a small burst replayed against BOTH a real
+    4. **affinity ablation** — the saturated Zipf-prefix trace through
+       a 4-replica static fleet twice: prefix-affinity placement on
+       (``affinity_weight=1``) vs blind least-loaded
+       (``affinity_weight=0``), SAME seeded trace (fingerprint
+       equality asserted).  ``affinity_vs_blind`` is the tokens/s
+       ratio on virtual time and ``fleet_prefix_hit_rate`` the
+       affinity arm's fleet-wide radix hit rate — both PerfLedger
+       fields the perf gate watches.  10⁶ requests full-scale, 2k
+       under DTTPU_BENCH_SMOKE (DTTPU_BENCH_FLEET_SIM_ABLATION
+       overrides); the Zipf population scales with the request count
+       (512 per 2k requests) so the cold-landing rate — the thing
+       placement policy controls — is scale-invariant instead of
+       washing out once every replica has seen every prefix (sim
+       fingerprints never evict).
+    5. **real affinity** — the same on/off comparison on a REAL
+       2-replica CPU ``serve.Engine`` fleet (tiny GPT, shared system
+       prompts): placement quality is judged by the replicas' actual
+       radix caches, pinning that the sim conclusion transfers
+       (``DTTPU_BENCH_FLEET_AFFINITY_REAL=0`` skips).
+    6. **validation** — a small burst replayed against BOTH a real
        2-replica ``serve.Engine`` fleet and the simulator with a
        ``CostModel.calibrate``\\ d from two measured points on that
        engine; asserts sim-predicted tokens/s and TTFT p50 land within
@@ -2039,7 +2058,9 @@ def bench_fleet_sim():
 
     ``sim_wall_s`` counts legs 1-3 only (the virtual-time claim:
     >= 1e6 simulated requests under 60 s of CPU wall-clock);
-    ``simulated_requests`` is their request total."""
+    ``simulated_requests`` is their request total.  The ablation legs
+    keep their own clock (``ablation.wall_s``) so the headline claim
+    stays comparable across PRs."""
     import gc
     import numpy as np
     from distributed_tensorflow_tpu import fleet
@@ -2076,8 +2097,11 @@ def bench_fleet_sim():
     # spans — DTTPU_BENCH_TRACE_FILE carries both out for the CI merge.
     fed = federate.FederatedMetrics()
 
-    def run_leg(tr, **kw):
-        fs = sim_lib.FleetSim(tr, cm, slo=slo, engine=dict(engine_kw),
+    def run_leg(tr, cost=None, engine=None, account=True, **kw):
+        fs = sim_lib.FleetSim(tr, cost if cost is not None else cm,
+                              slo=slo,
+                              engine=dict(engine if engine is not None
+                                          else engine_kw),
                               **kw)
         fs.metrics.federation = fed
         gc.collect()
@@ -2087,8 +2111,10 @@ def bench_fleet_sim():
             rep = fs.run()
         finally:
             gc.enable()
-        sim_wall[0] += time.perf_counter() - t0
-        simulated[0] += rep["simulated_requests"]
+        rep["wall_s"] = time.perf_counter() - t0
+        if account:
+            sim_wall[0] += rep["wall_s"]
+            simulated[0] += rep["simulated_requests"]
         return rep
 
     auto_rep = run_leg(
@@ -2126,6 +2152,83 @@ def bench_fleet_sim():
     log("fleet_sim curve: " + ", ".join(
         f"{r}r {c['slo_attainment']:.3f}" for r, c in curve.items()))
 
+    # -- affinity ablation: prefix-affinity placement on vs off --------
+    # Saturated arrivals (1000 req/s against a 4-replica fleet) so
+    # virtual time is compute-bound, prefix-dominated requests (short
+    # own-suffix, small decode budget, 512 Zipf populations) so the
+    # prefill a hot landing skips is a material share of the work —
+    # the regime ROADMAP item 6 is about, where blind placement
+    # forfeits the radix win on every cold landing.
+    n_abl = int(os.environ.get("DTTPU_BENCH_FLEET_SIM_ABLATION",
+                               "2000" if SMOKE else "1000000"))
+    # Zipf population scales with the trace (512 per 2k requests =
+    # smoke-identical at smoke scale): sim fingerprints never evict,
+    # so a FIXED population saturates every replica after a few
+    # thousand requests and both arms converge to hit rate ~1 — the
+    # cold-landing rate the placement policy controls must stay
+    # scale-invariant for the 10⁶ leg to measure anything.
+    abl_pops = max(512, (n_abl * 512) // 2000)
+    abl_engine = dict(num_slots=8, prefill_chunk=16, tick_steps=8)
+    abl_cm = sim_lib.CostModel.analytic(
+        n_params=2.0e8, prefill_chunk=16, num_slots=8, tick_steps=8,
+        hw=sim_lib.HardwarePoint())
+
+    def abl_trace():
+        return workload.synthesize(
+            n_abl, seed=3, horizon_s=n_abl / 1000.0,
+            prefix_populations=abl_pops, prefix_fraction=0.9,
+            plen_mean=12.0, new_tokens_mean=4.0, bursts=0, failures=0)
+
+    abl_fp = abl_trace().fingerprint()
+
+    def abl_arm(weight):
+        # re-synthesize per arm and assert fingerprint equality: both
+        # arms provably replay the IDENTICAL workload, so the ratio
+        # below measures placement policy and nothing else
+        tr = abl_trace()
+        assert tr.fingerprint() == abl_fp, "ablation arms diverged"
+        return run_leg(tr, cost=abl_cm, engine=abl_engine, replicas=4,
+                       seed=4, affinity_weight=weight,
+                       account=False)
+
+    abl_on = abl_arm(1.0)
+    abl_off = abl_arm(0.0)
+    assert abl_on["tokens_generated"] == abl_off["tokens_generated"], (
+        "ablation arms generated different token counts")
+    tps_on = abl_on["tokens_generated"] / abl_on["virtual_time_s"]
+    tps_off = abl_off["tokens_generated"] / abl_off["virtual_time_s"]
+    affinity_vs_blind = tps_on / tps_off
+    ablation = dict(
+        requests=n_abl, replicas=4, populations=abl_pops,
+        wall_s=round(abl_on["wall_s"] + abl_off["wall_s"], 3),
+        trace_fingerprint=abl_fp,
+        affinity=dict(
+            fleet_prefix_hit_rate=abl_on["fleet_prefix_hit_rate"],
+            tokens_per_vsec=round(tps_on, 2),
+            virtual_time_s=abl_on["virtual_time_s"],
+            ttft_p50_ms=abl_on["ttft_p50_ms"],
+            ttft_p95_ms=abl_on["ttft_p95_ms"]),
+        blind=dict(
+            fleet_prefix_hit_rate=abl_off["fleet_prefix_hit_rate"],
+            tokens_per_vsec=round(tps_off, 2),
+            virtual_time_s=abl_off["virtual_time_s"],
+            ttft_p50_ms=abl_off["ttft_p50_ms"],
+            ttft_p95_ms=abl_off["ttft_p95_ms"]))
+    log(f"fleet_sim affinity ablation ({n_abl:,} req): hit rate "
+        f"{abl_on['fleet_prefix_hit_rate']:.4f} (affinity) vs "
+        f"{abl_off['fleet_prefix_hit_rate']:.4f} (blind), tokens/s "
+        f"ratio {affinity_vs_blind:.4f}")
+
+    real_affinity = None
+    if os.environ.get("DTTPU_BENCH_FLEET_AFFINITY_REAL", "1") != "0":
+        real_affinity = _fleet_affinity_real()
+        log(f"fleet affinity (real 2-replica): hit rate "
+            f"{real_affinity['affinity']['fleet_prefix_hit_rate']:.4f}"
+            f" (affinity) vs "
+            f"{real_affinity['blind']['fleet_prefix_hit_rate']:.4f} "
+            f"(blind), {real_affinity['affinity']['affinity_hits']} "
+            f"affinity placements")
+
     validation = None
     if os.environ.get("DTTPU_BENCH_FLEET_SIM_VALIDATE", "1") != "0":
         validation = _fleet_sim_validate(cm_seed=0)
@@ -2145,6 +2248,12 @@ def bench_fleet_sim():
         autoscaler=auto_rep, static=static_rep,
         autoscaler_vs_static=round(vs_static, 4),
         slo_vs_replicas=curve,
+        # top-level (measured) perf-gate fields: deterministic virtual-
+        # time numbers, gated by scripts/perf_gate.py via the committed
+        # ledger/baseline.jsonl fleet_sim row
+        affinity_vs_blind=round(affinity_vs_blind, 4),
+        fleet_prefix_hit_rate=abl_on["fleet_prefix_hit_rate"],
+        ablation=ablation,
         slo=dict(ttft_s=slo.ttft_s, itl_s=slo.itl_s),
         cost_model=dict(prefill_window_s=cm.prefill_window_s,
                         decode_tick_s=cm.decode_tick_s,
@@ -2165,9 +2274,96 @@ def bench_fleet_sim():
         f"SLO series over {result['federation']['sources']} source(s), "
         f"{result['tracing']['sampled_lanes']} sampled lanes in the "
         f"trace ring")
+    if real_affinity is not None:
+        result["real_affinity"] = real_affinity
     if validation is not None:
         result["validation"] = validation
     return result
+
+
+def _fleet_affinity_real():
+    """The affinity ablation's REAL leg: a tiny 2-replica CPU
+    ``serve.Engine`` fleet behind the Router with prefix-affinity
+    placement on vs off.  Requests share a handful of system prompts
+    (distinct unique suffixes); a seeding wave registers each prompt's
+    pages on whichever replica first serves it, then the measured wave
+    is placed by each policy and the replicas' ACTUAL radix caches
+    judge the outcome — ``fleet_prefix_hit_rate`` summed over both
+    engines' pool counters, exactly the sim leg's metric.  Wall time
+    is deliberately not compared (2 real engines timeshare one CPU);
+    this leg pins that the placement-quality conclusion transfers from
+    cost-model to hardware."""
+    import jax
+    import numpy as np
+    from distributed_tensorflow_tpu import fleet, serve
+    from distributed_tensorflow_tpu.models.gpt import GPT, GPTConfig
+    from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+    import jax.numpy as jnp
+
+    config = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                       num_heads=2, intermediate_size=256,
+                       max_position=128, dtype=jnp.float32,
+                       dropout_rate=0.0)
+    model = GPT(config)
+    params = model.init(jax.random.PRNGKey(0))
+    slots, chunk, ticks = 4, 16, 4
+    pops, followers, budget = 4, 24, 4
+    rng = np.random.default_rng(7)
+    system = [rng.integers(0, config.vocab_size, 2 * chunk)
+              .astype(np.int32) for _ in range(pops)]
+
+    def prompt(pop):
+        suffix = rng.integers(0, config.vocab_size, 5).astype(np.int32)
+        return np.concatenate([system[pop], suffix])
+
+    # one prompt set, replayed by BOTH arms — the comparison measures
+    # placement policy, not workload luck.  The follower population
+    # order is SHUFFLED: a round-robin order would parity-align with
+    # blind placement's strict alternation and hand the blind arm the
+    # holder by coincidence.
+    seed_prompts = [prompt(pop) for pop in range(pops)]
+    follower_prompts = [prompt(int(pop))
+                        for pop in rng.integers(0, pops, followers)]
+
+    def arm(weight):
+        reg = metrics_lib.Registry()
+        engines = [serve.Engine(model, params, num_slots=slots,
+                                max_len=128, prefill_chunk=chunk,
+                                tick_steps=ticks, registry=reg,
+                                paged=True)
+                   for _ in range(2)]
+        router = fleet.Router(engines, registry=reg,
+                              affinity_weight=weight)
+        # seeding wave: one request per system prompt — its admission
+        # registers the prompt's pages on the serving replica
+        for p in seed_prompts:
+            router.submit(p, budget)
+        router.drain()
+        seeded = {rid: (s.prefix_lookups_total, s.prefix_hits_total)
+                  for rid, s in router.stats().items()}
+        hs = [router.submit(p, budget) for p in follower_prompts]
+        router.drain()
+        assert all(h.status == "ok" for h in hs)
+        stats = router.stats()
+        lookups = sum(s.prefix_lookups_total - seeded[rid][0]
+                      for rid, s in stats.items())
+        hits = sum(s.prefix_hits_total - seeded[rid][1]
+                   for rid, s in stats.items())
+        return dict(
+            fleet_prefix_hit_rate=round(hits / lookups
+                                        if lookups else 0.0, 4),
+            prefix_tokens_reused=int(sum(
+                s.prefix_tokens_reused_total for s in stats.values())),
+            affinity_hits=int(reg.get(
+                "dttpu_router_affinity_hits_total").value),
+            placements=list(router.placements))
+
+    on, off = arm(1.0), arm(0.0)
+    return dict(requests=followers, populations=pops,
+                affinity=dict((k, v) for k, v in on.items()
+                              if k != "placements"),
+                blind=dict((k, v) for k, v in off.items()
+                           if k != "placements"))
 
 
 def _fleet_sim_validate(cm_seed=0):

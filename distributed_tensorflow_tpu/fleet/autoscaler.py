@@ -207,11 +207,30 @@ class Autoscaler:
         return action
 
     def _scale_in_victim(self, stats) -> Optional[int]:
-        """Drain-and-remove the least-loaded replica (ties: highest
-        id — retire the newest capacity first).  A drain that times
-        out is rolled back with ``resume_replica`` instead of failing
-        requests."""
-        victim = min(stats, key=lambda rid: (stats[rid].inflight, -rid))
+        """Drain-and-remove the replica whose hot prefix chains are
+        cheapest to lose: primary key is the cached tokens of
+        fingerprint chains held by NO other replica (migrate-based
+        scale-in preserves in-flight requests but evicts the pool, so
+        removing the fleet's only copy of a hot prefix re-prefills it
+        from scratch for every follower), then least inflight, ties by
+        highest id — retire the newest capacity first.  Fleets without
+        fingerprints (contiguous engines, cold pools) score 0
+        everywhere and keep the original least-loaded choice exactly.
+        A drain that times out is rolled back with ``resume_replica``
+        instead of failing requests."""
+        holders: dict = {}
+        for s in stats.values():
+            for key, tokens in getattr(s, "prefix_fingerprint",
+                                       {}).items():
+                holders[key] = holders.get(key, 0) + 1
+
+        def sole_hot_tokens(rid) -> int:
+            fp = getattr(stats[rid], "prefix_fingerprint", {})
+            return sum(tokens for key, tokens in fp.items()
+                       if holders.get(key, 0) <= 1)
+
+        victim = min(stats, key=lambda rid: (
+            sole_hot_tokens(rid), stats[rid].inflight, -rid))
         ok = self.router.drain_replica(
             victim, timeout_s=self.drain_timeout_s, migrate=True)
         if not ok:

@@ -4,12 +4,21 @@ One ``serve.Engine`` is one mesh; a fleet is N of them behind a
 ``Router`` façade with the same ``submit() -> handle`` surface
 (docs/SERVING.md §Fleet):
 
-* **Least-loaded placement** — each submit reads every live replica's
-  ``Engine.stats()`` snapshot (queued + prefilling + active; a cheap
-  host-side read, never a ``/metrics`` text scrape) and places on the
-  least-loaded replica, ties broken by replica id — so a replayed trace
-  reproduces its placement decisions exactly (``router.placements``,
-  pinned by tests/test_fleet.py).
+* **Prefix-affinity placement** — each submit reads every live
+  replica's ``Engine.stats()`` snapshot (a cheap host-side read, never
+  a ``/metrics`` text scrape) and scores candidates JOINTLY by load
+  and expected prefix-cache reuse: effective load = ``inflight -
+  affinity_weight * expected_pages_reused(prompt, fingerprint)``,
+  where the fingerprint is the replica's bounded hot-radix-chain
+  digest (``serve/pages.py``; mirrored by ``fleet.sim.SimEngine`` so
+  sim and real fleets score identically) and the request side is
+  :func:`expected_pages_reused` below.  Ties break by raw inflight
+  then replica id, and empty fingerprints score 0 everywhere — the
+  policy degrades EXACTLY to the original least-loaded order, so a
+  replayed trace reproduces its placement decisions bit-for-bit
+  (``router.placements``, pinned by tests/test_fleet.py and
+  tests/test_fleet_affinity.py).  ``affinity_weight=0`` turns the
+  policy off (the bench ablation's blind arm).
 * **Retry within the deadline** — a submit REJECTED by one replica
   (queue full, tenant quota) tries the others in load order before the
   rejection reaches the caller; a request whose replica dies, drains,
@@ -52,7 +61,9 @@ other way around.
 Metrics (``registry=``): ``dttpu_router_replicas`` gauge,
 ``dttpu_router_requests_total`` / ``dttpu_router_retries_total`` /
 ``dttpu_router_replica_down_total`` / ``dttpu_router_rejected_total``
-/ ``dttpu_migrations_total`` counters, and per-replica
+/ ``dttpu_migrations_total`` /
+``dttpu_router_affinity_hits_total`` counters, the
+``dttpu_router_affinity_score`` gauge, and per-replica
 ``dttpu_router_placed_total{replica=...}``.
 """
 from __future__ import annotations
@@ -65,11 +76,59 @@ from typing import (Callable, Dict, List, Optional, Protocol, Tuple,
 from ..obs import metrics as metrics_lib
 from ..obs import reqtrace
 from ..resilience import faults as faults_lib
+from ..serve import pages as pages_lib
 from ..serve.engine import (Engine, QueueFullError, RequestHandle,
                             RequestSnapshot)
 from .tenancy import QuotaExceededError
 
-__all__ = ["EngineProtocol", "FleetHandle", "NoReplicaError", "Router"]
+__all__ = ["EngineProtocol", "FleetHandle", "NoReplicaError", "Router",
+           "expected_pages_reused", "request_chain_keys"]
+
+
+def request_chain_keys(prompt, page_size: int):
+    """``(fingerprint key, tokens covered)`` pairs for a request's
+    prompt — the request-side half of the affinity scorer, dispatching
+    on what a "prompt" is in each fleet:
+
+    * a real token sequence -> the blake2b chain hashes of its full
+      ``page_size`` chunks (``serve.pages.prompt_chain_keys``);
+    * a ``fleet.sim`` prompt tuple ``(plen, prefix_id, prefix_len,
+      arrival)`` -> the prefix id itself, covering the full chunks of
+      ``prefix_len`` (``SimEngine`` fingerprints by prefix id — same
+      key space on both sides of the score);
+    * anything else (e.g. a bare int) -> no keys, affinity 0.
+    """
+    if type(prompt) is tuple:
+        plen, prefix_id, prefix_len = prompt[0], prompt[1], prompt[2]
+        covered = int(prefix_len) - int(prefix_len) % int(page_size)
+        if prefix_id and covered > 0:
+            return ((int(prefix_id), covered),)
+        return ()
+    if prompt is None or isinstance(prompt, (int, float)):
+        return ()
+    return pages_lib.prompt_chain_keys(prompt, page_size)
+
+
+def expected_pages_reused(prompt, stats) -> int:
+    """How many whole KV pages of ``prompt``'s prefix the replica
+    behind ``stats`` (an ``EngineStats``-shaped snapshot carrying
+    ``prefix_fingerprint`` + ``page_size``) would serve from its radix
+    cache — the affinity term of the placement score.  The deepest
+    fingerprint match wins; the cached length caps what a shallower
+    cached chain can give.  0 when the replica publishes no
+    fingerprint (contiguous engine, cold pool, prefix cache off) —
+    which is what makes the blind fallback exact."""
+    fp = getattr(stats, "prefix_fingerprint", None)
+    pg = int(getattr(stats, "page_size", 0) or 0)
+    if not fp or pg < 1:
+        return 0
+    best = 0
+    for key, tokens in request_chain_keys(prompt, pg):
+        cached = fp.get(key, 0)
+        got = tokens if tokens < cached else cached
+        if got > best:
+            best = got
+    return best // pg
 
 # submit errors that mean "THIS replica won't take it right now" — safe
 # to retry on another replica.  Anything else (validation, unknown
@@ -234,18 +293,28 @@ class Router:
       export_timeout_s: how long failure-path exports wait for a dead/
         quarantined replica's pump mutex before falling back to a
         forced (``clean=False``) export — the wedged-pump escape hatch.
+      affinity_weight: inflight-units of load one expected reused KV
+        page is worth when scoring placement candidates (see module
+        doc).  0 disables prefix affinity (pure least-loaded — the
+        ablation's blind arm); the default 1.0 means "prefer a replica
+        holding my prefix until it is that many requests busier".
     """
 
     def __init__(self, replicas=(), *,
                  registry: Optional[metrics_lib.Registry] = None,
                  max_retries: int = 2,
-                 export_timeout_s: float = 1.0):
+                 export_timeout_s: float = 1.0,
+                 affinity_weight: float = 1.0):
         if max_retries < 0:
             raise ValueError(f"max_retries must be >= 0; got {max_retries}")
+        if affinity_weight < 0:
+            raise ValueError(
+                f"affinity_weight must be >= 0; got {affinity_weight}")
         reg = registry if registry is not None else metrics_lib.REGISTRY
         self.registry = reg
         self.max_retries = int(max_retries)
         self.export_timeout_s = float(export_timeout_s)
+        self.affinity_weight = float(affinity_weight)
         # guards the replica table, draining set, in-flight list, and
         # placement log; never held while pumping an engine tick
         self._lock = threading.Lock()
@@ -279,6 +348,14 @@ class Router:
             "In-flight requests moved live (RequestSnapshot export -> "
             "import on a survivor) across failover, drain, removal, or "
             "quarantine.")
+        self._m_affinity_hits = reg.counter(
+            "dttpu_router_affinity_hits_total",
+            "Placements that landed on a replica already holding part "
+            "of the request's prefix (expected_pages_reused > 0).")
+        self._m_affinity_score = reg.gauge(
+            "dttpu_router_affinity_score",
+            "Expected KV pages reused by the most recent placement "
+            "(0 = blind landing).")
         self._m_placed: Dict[int, metrics_lib.Counter] = {}
         for engine in replicas:
             self.add_replica(engine)
@@ -349,9 +426,10 @@ class Router:
                deadline_s: Optional[float] = None,
                tenant: str = "default",
                adapter_id: Optional[str] = None) -> FleetHandle:
-        """Place one request on the least-loaded live replica -> handle.
-        Replicas that reject (queue full, tenant quota) are skipped for
-        the next-loaded one; if EVERY live replica rejects, the last
+        """Place one request on the best-scoring live replica (load
+        net of prefix affinity — see module doc) -> handle.  Replicas
+        that reject (queue full, tenant quota) are skipped for the
+        next-scored one; if EVERY live replica rejects, the last
         rejection propagates (fleet-wide backpressure).  ``deadline_s``
         is a FLEET deadline: retries submit with the remaining budget."""
         deadline = (None if deadline_s is None
@@ -374,29 +452,49 @@ class Router:
             self._inflight.append(fh)
         return fh
 
-    def _candidates(self) -> List[int]:
-        """Live, non-draining replica ids, least-loaded first (stats
-        snapshot inflight; ties by id — deterministic placement).
-        Called with the router lock held."""
-        return sorted(
-            (rid for rid in self._replicas if rid not in self._draining),
-            key=lambda rid: (self._replicas[rid].stats().inflight, rid))
+    def _candidates(self, fh: Optional[FleetHandle] = None
+                    ) -> Tuple[List[int], Dict[int, int]]:
+        """Live, non-draining replica ids in placement order, plus each
+        candidate's affinity score (expected pages reused; all 0 when
+        scoring is off or no prompt is given).  Order: effective load
+        ``inflight - affinity_weight * affinity`` first, ties by raw
+        inflight then replica id — with no fingerprints anywhere this
+        is EXACTLY the original least-loaded (inflight, id) order, so
+        blind-fleet placement replays unchanged.  Called with the
+        router lock held."""
+        ids = [rid for rid in self._replicas
+               if rid not in self._draining]
+        stats = {rid: self._replicas[rid].stats() for rid in ids}
+        if fh is None or not self.affinity_weight:
+            ids.sort(key=lambda rid: (stats[rid].inflight, rid))
+            return ids, {rid: 0 for rid in ids}
+        prompt = fh.spec["prompt"]
+        aff = {rid: expected_pages_reused(prompt, stats[rid])
+               for rid in ids}
+        ids.sort(key=lambda rid: (
+            stats[rid].inflight - self.affinity_weight * aff[rid],
+            stats[rid].inflight, rid))
+        return ids, aff
 
     def _place(self, fh: FleetHandle, raise_rejection: bool) -> bool:
-        """Try to place ``fh`` on each candidate replica in load order —
-        a snapshot-carrying handle is IMPORTED (progress intact), a
+        """Try to place ``fh`` on each candidate replica in score order
+        — a snapshot-carrying handle is IMPORTED (progress intact), a
         fresh one submitted.  True on placement; False when every
         candidate rejected (or none exists) and ``raise_rejection`` is
-        off.  Called with the router lock held (engine submits take the
-        engine's own state lock — lock order router -> engine, never
-        reversed)."""
+        off.  Fresh submits, rejection probing, AND migration/failover
+        re-placement all pass through here, so the affinity scorer
+        covers every path a request can take onto a replica — a
+        migrated request whose old replica published its pages via
+        ``handoff`` scores the survivor holding them.  Called with the
+        router lock held (engine submits take the engine's own state
+        lock — lock order router -> engine, never reversed)."""
         remaining = None
         if fh.deadline is not None:
             remaining = fh.deadline - time.perf_counter()
             if remaining <= 0:
                 fh._finalize("deadline_exceeded")
                 return False
-        candidates = self._candidates()
+        candidates, affinity = self._candidates(fh)
         if not candidates:
             err = NoReplicaError("no live replica available")
             if raise_rejection:
@@ -449,6 +547,10 @@ class Router:
             fh.attempts += 1
             self.placements.append((fh.rid, rid))
             self._m_placed[rid].inc()
+            score = affinity.get(rid, 0)
+            if score > 0:
+                self._m_affinity_hits.inc()
+            self._m_affinity_score.set(score)
             return True
         if raise_rejection:
             self._m_rejected.inc()

@@ -209,7 +209,8 @@ class _SimStats:
                  "prefix_lookups_total", "prefix_hits_total",
                  "prefix_tokens_reused_total", "ticks_started",
                  "ticks_completed", "last_tick_start_s",
-                 "last_tick_end_s", "last_tick_duration_s")
+                 "last_tick_end_s", "last_tick_duration_s",
+                 "page_size", "prefix_fingerprint")
 
     def __init__(self, num_slots: int):
         self.queued = 0
@@ -225,6 +226,13 @@ class _SimStats:
         self.prefix_lookups_total = 0
         self.prefix_hits_total = 0
         self.prefix_tokens_reused_total = 0
+        # prefix-affinity mirror of the real pool's fingerprint
+        # (serve/pages.py): {prefix_id: cached tokens}, keyed by the
+        # trace's prefix id — the same keys request_chain_keys yields
+        # for a sim prompt tuple, so the router scores sim and real
+        # fleets through one code path
+        self.page_size = 0
+        self.prefix_fingerprint: Dict[int, int] = {}
         self.ticks_started = 0
         self.ticks_completed = 0
         self.last_tick_start_s = 0.0
@@ -337,6 +345,10 @@ class SimEngine:
         self._prefilling: List[_SimRequest] = []
         self._active: List[_SimRequest] = []
         self._stats = _SimStats(self.num_slots)
+        # the sim's "page" is its prefill chunk: reuse is granted in
+        # whole chunks, so that is the granularity the router's
+        # affinity scorer must divide by
+        self._stats.page_size = self.prefill_chunk
         self._prefix_seen: set = set()
         self._adapters: set = set()
         self._next_rid = 0
@@ -612,6 +624,11 @@ class SimEngine:
                     st.prefix_tokens_reused_total += reused
                 else:
                     self._prefix_seen.add(r.prefix_id)
+                    # fingerprint mirror (serve/pages.py): the sim's
+                    # cache never evicts (_prefix_seen's documented
+                    # simplification), so the fingerprint only grows
+                    st.prefix_fingerprint[r.prefix_id] = (
+                        r.prefix_len - r.prefix_len % chunk)
             need = r.context - reused
             r.windows_left = (need + chunk - 1) // chunk if need > 0 else 1
             prefilling.append(r)
@@ -832,6 +849,7 @@ class SimMetrics:
             "cancelled": self.cancelled,
             "tokens_generated": self.tokens_out,
             "ttft_p50_ms": round(self._pct(self.ttft, 50) * 1e3, 4),
+            "ttft_p95_ms": round(self._pct(self.ttft, 95) * 1e3, 4),
             "ttft_p99_ms": round(self._pct(self.ttft, 99) * 1e3, 4),
             "itl_p99_ms": round(self._pct(self.tpot, 99) * 1e3, 4),
             "attainment_ttft": round(att_ttft, 6),
@@ -876,7 +894,8 @@ class FleetSim:
                  registry: Optional[metrics_lib.Registry] = None,
                  quantum_s: float = 0.05,
                  inflight_cap_per_replica: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 affinity_weight: float = 1.0):
         self.trace = trace
         self.cost_model = cost_model
         self.slo = slo or SLO()
@@ -887,7 +906,8 @@ class FleetSim:
         self.quantum_s = float(quantum_s)
         self.clock = SimClock(0.0)
         self.metrics = SimMetrics(self.slo)
-        self.router = Router(registry=self.registry)
+        self.router = Router(registry=self.registry,
+                             affinity_weight=affinity_weight)
         self.event_log: List[tuple] = []
         self._rng = np.random.default_rng(seed)
         self._engines: List[SimEngine] = []
@@ -1071,6 +1091,15 @@ class FleetSim:
             "lost": lost,
             "events": len(log),
         })
+        # fleet-wide radix reuse, summed over EVERY engine this run
+        # created (including replicas the autoscaler later retired) —
+        # the number the affinity ablation gates on
+        lookups = sum(e._stats.prefix_lookups_total
+                      for e in self._engines)
+        hits = sum(e._stats.prefix_hits_total for e in self._engines)
+        rep["fleet_prefix_lookups"] = lookups
+        rep["fleet_prefix_hit_rate"] = round(
+            hits / lookups if lookups else 0.0, 6)
         if self.replica_seconds > 0:
             rep["attainment_per_kilo_replica_second"] = round(
                 rep["slo_attainment"]

@@ -84,8 +84,21 @@ class Trace:
             events=tuple(e for e in self.events if e.at_s <= horizon),
             horizon_s=horizon, seed=self.seed)
 
+    def prefix_popularity(self) -> Tuple[Tuple[int, int], ...]:
+        """Per-prefix popularity histogram: ``(prefix_id, count)``
+        sorted by id, prefix-free requests excluded.  The Zipf
+        structure the affinity ablation's win depends on, in a form
+        two arms can compare directly."""
+        ids, counts = np.unique(self.prefix_id[self.prefix_id > 0],
+                                return_counts=True)
+        return tuple((int(i), int(c)) for i, c in zip(ids, counts))
+
     def fingerprint(self) -> str:
-        """SHA-256 over every column and event — the determinism pin."""
+        """SHA-256 over every column, event, and the per-prefix
+        popularity histogram — the determinism pin.  Folding the
+        histogram in makes fingerprint equality a direct proof that
+        two ablation arms replay the identical prefix-sharing
+        workload, not just identical per-request columns."""
         h = hashlib.sha256()
         for col in (self.arrival_s, self.plen, self.new_tokens,
                     self.tenant, self.prefix_id, self.prefix_len,
@@ -93,6 +106,7 @@ class Trace:
             h.update(np.ascontiguousarray(col).tobytes())
         h.update(repr(self.events).encode())
         h.update(repr(self.tenants).encode())
+        h.update(repr(self.prefix_popularity()).encode())
         return h.hexdigest()
 
     @property

@@ -36,7 +36,9 @@ from . import ledger as ledger_lib
 __all__ = ["Tolerance", "Verdict", "Sentinel", "classify_field",
            "parse_tolerance_overrides", "DEFAULT_MIN_RATIO",
            "DEFAULT_MAX_RATIO", "DEFAULT_COMM_MAX_RATIO",
-           "DEFAULT_INTERFERENCE_MAX_RATIO", "DEFAULT_ROOFLINE_FLOOR"]
+           "DEFAULT_INTERFERENCE_MAX_RATIO",
+           "DEFAULT_FLEET_HIT_RATE_MIN_RATIO",
+           "DEFAULT_ROOFLINE_FLOOR"]
 
 # CI-jitter-sized defaults: a shared runner's smoke bench wobbles tens
 # of percent run-to-run, so the gate only fires on ~2x movements — the
@@ -64,6 +66,16 @@ _COMM_PREFIX = "analytical_comm"
 # ledger's, and per-field overridable like everything else.
 _INTERFERENCE_TOKEN = "interference_share"
 DEFAULT_INTERFERENCE_MAX_RATIO = 1.5
+
+# Fleet-wide radix hit rate of the prefix-affinity ablation
+# (bench.py fleet_sim): a DETERMINISTIC virtual-time number — same
+# seeded trace, same placement replay — so run-to-run jitter is zero
+# and the gate can sit tight.  Direction is higher-is-better
+# ("hit_rate" already classifies so); the 0.9 floor only tolerates a
+# deliberate retuning of the ablation trace, not a placement-policy
+# regression (losing affinity drops the rate ~15%).
+_FLEET_HIT_RATE_TOKEN = "fleet_prefix_hit_rate"
+DEFAULT_FLEET_HIT_RATE_MIN_RATIO = 0.9
 
 # Name-based direction inference: duration suffixes are matched at the
 # END of the name (a bare "_s" substring would misread "single_step_*"),
@@ -150,6 +162,9 @@ class Sentinel:
             return Tolerance(max_ratio=DEFAULT_COMM_MAX_RATIO)
         if _INTERFERENCE_TOKEN in field.lower():
             return Tolerance(max_ratio=DEFAULT_INTERFERENCE_MAX_RATIO)
+        if _FLEET_HIT_RATE_TOKEN in field.lower():
+            return Tolerance(
+                min_ratio=DEFAULT_FLEET_HIT_RATE_MIN_RATIO)
         return Tolerance()
 
     # ------------------------------------------------------------- check

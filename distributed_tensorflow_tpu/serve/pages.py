@@ -43,6 +43,18 @@ evicts stale chains page by page, and only gives up —
 ``PagePoolExhausted``, the scheduler requeues the request — when every
 remaining page is pinned by an in-flight request.
 
+**Prefix fingerprint.**  The pool also maintains a BOUNDED digest of
+its hot radix chains — at most ``fingerprint_k`` entries mapping a
+chain hash (the incremental blake2b of the chunk bytes from the root,
+carried on every node) to the cached prefix length in tokens, scored
+by cached length × LRU recency.  It is updated incrementally where the
+tree itself changes (``register``/``handoff`` extend it, eviction
+removes the reclaimed chain, ``begin`` refreshes the recency of a hit
+chain) — NEVER by walking the tree — so ``stats()`` can publish it as
+a lock-cheap copy.  The fleet router scores placement candidates
+against it (``fleet.router.expected_pages_reused``): the request-side
+half of the same hash chain is :func:`prompt_chain_keys`.
+
 Thread-safety: every ``PagePool`` method takes the pool's own lock and
 never calls back out, so the scheduler may call it from ``submit``/
 ``cancel`` threads as well as the pump (lock order: scheduler state
@@ -50,13 +62,47 @@ lock -> pool lock, never the reverse).
 """
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["PageLease", "PagePool", "PagePoolExhausted", "auto_page_size",
-           "decode_paged_step", "init_paged_cache", "paged_kv_valid"]
+__all__ = ["FINGERPRINT_K", "PageLease", "PagePool", "PagePoolExhausted",
+           "auto_page_size", "decode_paged_step", "init_paged_cache",
+           "paged_kv_valid", "prompt_chain_keys"]
+
+# default bound on the hot-chain fingerprint (entries, not pages): big
+# enough for a handful of system prompts at every chunk depth, small
+# enough that copying it in stats() stays lock-cheap
+FINGERPRINT_K = 32
+
+
+def _chain_hash(parent_chain: bytes, chunk: bytes) -> bytes:
+    """One incremental step of the chain hash: H(parent || chunk).
+    blake2b-64: process-stable (placement must replay across runs,
+    unlike ``hash()``), 8 bytes because fingerprint keys are a
+    popularity digest, not a cryptographic commitment."""
+    return hashlib.blake2b(parent_chain + chunk, digest_size=8).digest()
+
+
+def prompt_chain_keys(prompt, page_size: int
+                      ) -> Tuple[Tuple[bytes, int], ...]:
+    """The request-side half of the prefix fingerprint: ``(chain hash,
+    tokens covered)`` for every full ``page_size``-token chunk prefix
+    of ``prompt`` — exactly the keys ``PagePool.register`` publishes,
+    so ``fingerprint.get(key)`` answers "how many of this prompt's
+    leading tokens does that replica already hold"."""
+    pg = int(page_size)
+    if pg < 1:
+        return ()
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    out = []
+    chain = b""
+    for j in range(prompt.size // pg):
+        chain = _chain_hash(chain, prompt[j * pg:(j + 1) * pg].tobytes())
+        out.append((chain, (j + 1) * pg))
+    return tuple(out)
 
 
 class PagePoolExhausted(RuntimeError):
@@ -159,7 +205,7 @@ class _RadixNode:
     replay deterministically)."""
 
     __slots__ = ("page", "parent", "children", "refcount", "stamp",
-                 "key")
+                 "key", "chain")
 
     def __init__(self, page: int, parent: Optional["_RadixNode"],
                  key: bytes, stamp: int):
@@ -169,6 +215,11 @@ class _RadixNode:
         self.refcount = 0
         self.stamp = stamp
         self.key = key
+        # incremental chain hash from the root — the fingerprint key
+        # for "the prefix ending at this node", paid once at node
+        # creation instead of on every fingerprint update
+        self.chain = (_chain_hash(parent.chain, key)
+                      if parent is not None else b"")
 
 
 class PageLease:
@@ -197,9 +248,13 @@ class PagePool:
     pool's own lock and never invoke callbacks or block under it."""
 
     def __init__(self, num_pages: int, page_size: int,
-                 pages_per_slot: int, prefix_cache: bool = True):
+                 pages_per_slot: int, prefix_cache: bool = True,
+                 fingerprint_k: int = FINGERPRINT_K):
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1; got {page_size}")
+        if fingerprint_k < 0:
+            raise ValueError(
+                f"fingerprint_k must be >= 0; got {fingerprint_k}")
         if num_pages < pages_per_slot + 2:
             # one trash page + at least one full slot's worth: anything
             # smaller cannot serve even a single max-length request
@@ -213,6 +268,10 @@ class PagePool:
         # or registration — the ablation arm bench.py measures the
         # reuse win against
         self.prefix_cache = bool(prefix_cache)
+        self.fingerprint_k = int(fingerprint_k)
+        # hot-chain digest: chain hash -> (cached tokens, recency
+        # stamp), bounded to fingerprint_k entries (see module doc)
+        self._fingerprint: Dict[bytes, Tuple[int, int]] = {}
         self._lock = threading.Lock()
         # page 0 is the reserved trash page — never allocated
         self._free: List[int] = list(range(1, num_pages))
@@ -293,6 +352,10 @@ class PagePool:
             if skip:
                 self.prefix_hits += 1
                 self.prefix_tokens_reused += skip
+            for j, n in enumerate(shared):
+                # refresh the hit chain's fingerprint recency at every
+                # depth — the list we just walked, never a tree walk
+                self._fp_touch_locked(n.chain, (j + 1) * pg, stamp)
             row = np.zeros((self.pages_per_slot,), np.int32)
             for j, n in enumerate(shared):
                 row[j] = n.page
@@ -324,6 +387,8 @@ class PagePool:
                 if child is not None:
                     child.stamp = stamp
                     node = child
+                    self._fp_touch_locked(child.chain, (j + 1) * pg,
+                                          stamp)
                     continue
                 page = int(lease.row[j])
                 if page not in lease.private:
@@ -334,6 +399,10 @@ class PagePool:
                 lease.private.remove(page)
                 lease.shared.append(child)
                 node = child
+                # publish EVERY depth, not just the deepest: the
+                # deepest node carries this prompt's unique suffix,
+                # while followers match at the shared shallow depths
+                self._fp_touch_locked(child.chain, (j + 1) * pg, stamp)
 
     def handoff(self, lease: PageLease, context: np.ndarray) -> int:
         """Export-path lease handoff (docs/RESILIENCE.md §migration):
@@ -405,8 +474,33 @@ class PagePool:
             return False
         del best.parent.children[best.key]
         self._free.append(best.page)
+        self._fingerprint.pop(best.chain, None)
         self.evictions += 1
         return True
+
+    # ------------------------------------------------------- fingerprint
+
+    def _fp_touch_locked(self, key: bytes, tokens: int,
+                         stamp: int) -> None:
+        """Upsert one chain into the bounded fingerprint; on overflow
+        drop the entry with the lowest cached-length × recency score
+        (ties: older stamp, then key bytes — fully deterministic)."""
+        if not self.fingerprint_k:
+            return
+        fp = self._fingerprint
+        fp[key] = (tokens, stamp)
+        if len(fp) > self.fingerprint_k:
+            drop = min(fp.items(),
+                       key=lambda kv: (kv[1][0] * kv[1][1], kv[1][1],
+                                       kv[0]))[0]
+            del fp[drop]
+
+    def fingerprint(self) -> Dict[bytes, int]:
+        """Copy of the hot-chain digest: chain hash -> cached tokens.
+        Lock-cheap (<= fingerprint_k small entries); this is the map
+        ``fleet.router.expected_pages_reused`` scores against."""
+        with self._lock:
+            return {k: v[0] for k, v in self._fingerprint.items()}
 
     # ------------------------------------------------------------- stats
 
@@ -425,4 +519,7 @@ class PagePool:
                 "prefix_tokens_reused_total": self.prefix_tokens_reused,
                 "prefix_evictions_total": self.evictions,
                 "cow_splits_total": self.cow_splits,
+                "page_size": self.page_size,
+                "prefix_fingerprint": {
+                    k: v[0] for k, v in self._fingerprint.items()},
             }

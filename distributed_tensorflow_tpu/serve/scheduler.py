@@ -263,6 +263,14 @@ class EngineStats:
     prefix_evictions_total: int = 0          # radix pages reclaimed
     cow_splits_total: int = 0                # whole-chain prompts resplit
     prefill_windows_skipped_total: int = 0   # window dispatches avoided
+    # prefix-affinity placement inputs (fleet/router.py): the pool's
+    # bounded hot-chain digest (chain hash -> cached tokens, already a
+    # copy — see PagePool.fingerprint) and the page size the router
+    # needs to chunk candidate prompts identically.  Empty/0 on a
+    # contiguous engine, which degrades the router to least-loaded
+    page_size: int = 0
+    prefix_fingerprint: Dict[bytes, int] = dataclasses.field(
+        default_factory=dict)
     # pump heartbeat (fleet/watchdog.py): tick counters + perf_counter
     # stamps bracketing the most recent tick.  started > completed with
     # a stale start stamp = a wedged pump; a completed tick whose
@@ -840,7 +848,9 @@ class SlotScheduler:
                 prefix_tokens_reused_total=p["prefix_tokens_reused_total"],
                 prefix_evictions_total=p["prefix_evictions_total"],
                 cow_splits_total=p["cow_splits_total"],
-                prefill_windows_skipped_total=skipped)
+                prefill_windows_skipped_total=skipped,
+                page_size=p["page_size"],
+                prefix_fingerprint=p["prefix_fingerprint"])
         return EngineStats(**base)
 
     def tenant_inflight(self, tenant: str) -> int:

@@ -726,6 +726,24 @@ class TestGptLong:
         assert abs(v["ttft_p50_ratio"] - 1.0) <= 0.25
         assert v["calibrated"]["decode_tick_s"] > 0
         assert r.get("retrace_warnings", 0) == 0
+        # prefix-affinity ablation (docs/SERVING.md §Fleet affinity
+        # policy): same fingerprinted Zipf trace both arms, affinity
+        # wins on throughput AND hit rate
+        abl = r["ablation"]
+        assert abl["trace_fingerprint"] and abl["requests"] >= 2000
+        assert r["affinity_vs_blind"] > 1.0
+        assert (abl["affinity"]["fleet_prefix_hit_rate"]
+                > abl["blind"]["fleet_prefix_hit_rate"])
+        assert r["fleet_prefix_hit_rate"] \
+            == abl["affinity"]["fleet_prefix_hit_rate"]
+        for arm in abl["affinity"], abl["blind"]:
+            assert 0 < arm["ttft_p50_ms"] <= arm["ttft_p95_ms"]
+        # the real 2-replica CPU leg: affinity beats blind on actual
+        # radix-cache hits, and the affinity placements really fired
+        ra = r["real_affinity"]
+        assert (ra["affinity"]["fleet_prefix_hit_rate"]
+                > ra["blind"]["fleet_prefix_hit_rate"])
+        assert ra["affinity"]["affinity_hits"] >= 1
 
     @pytest.mark.slow
     def test_fleet_sim_full_scale_acceptance(self):
@@ -742,6 +760,12 @@ class TestGptLong:
         assert r["simulated_requests"] >= 1_000_000
         assert r["sim_wall_s"] < 60.0
         assert r["autoscaler_vs_static"] >= 1.0
+        # the 10⁶-request prefix-affinity ablation at full size: the
+        # headline affinity_vs_blind > 1.0 must hold off-smoke too
+        assert r["ablation"]["requests"] >= 1_000_000
+        assert r["affinity_vs_blind"] > 1.0
+        assert (r["ablation"]["affinity"]["fleet_prefix_hit_rate"]
+                > r["ablation"]["blind"]["fleet_prefix_hit_rate"])
 
 
 class TestAnalytical:
