@@ -1828,7 +1828,10 @@ def bench_fleet():
     trace measures 0.0 (the last block admits nothing inside the
     window).  CPU mesh, single process, zero retrace_warnings
     (admission, retirement, failover, and adapter swaps never
-    recompile)."""
+    recompile).  A page-wire leg then drains a replica of long-prompt
+    requests with their KV pages shipped (fleet/pagewire.py) vs
+    re-prefilled, reporting the destination's skipped prefill windows
+    and a chunk_pages × overlap sweep (``wire`` in the JSON)."""
     import jax
     import numpy as np
     from distributed_tensorflow_tpu import fleet, serve
@@ -1987,6 +1990,87 @@ def bench_fleet():
     mig_total = sum(len(h.tokens) for h in migrated)
     preserved_ratio = preserved / mig_total if mig_total else 0.0
 
+    # -- page-wire leg (docs/RESILIENCE.md §page wire): migrate a
+    # replica's long-prompt requests with their KV pages SHIPPED over
+    # the wire vs re-prefilled from scratch.  Long UNIQUE prompts (no
+    # radix reuse between requests or arms) make the comparison clean:
+    # the no-wire arm's destination skips zero prefill windows, the
+    # wire arm's destination skips every window the shipped pages
+    # cover.  Placement is forced onto the victim by draining the
+    # survivors around the submit, so every arm migrates the same
+    # number of requests.
+    router.add_replica(engines[0])        # the kill leg's victim rejoins
+    live_rids = sorted(router.stats())
+    victim_rid, surv_rids = live_rids[0], live_rids[1:]
+    surv_engines = [router.replica(r) for r in surv_rids]
+
+    def wire_arm(wire_obj, n=4):
+        router.page_wire = wire_obj
+        for rid in surv_rids:
+            router.drain_replica(rid, migrate=False, timeout_s=600)
+        hs = []
+        for _ in range(n):
+            plen = 4 * chunk + 3          # multi-window, multi-page
+            pr = rng.integers(0, config.vocab_size,
+                              plen).astype(np.int32)
+            hs.append(router.submit(pr, mig_budget))
+        for rid in surv_rids:
+            router.resume_replica(rid)
+        for _ in range(256):              # prefill fully on the victim
+            router.step()
+            if all(len(h.tokens) >= 1 for h in hs):
+                break
+        skip0 = sum(e.stats().prefill_windows_skipped_total
+                    for e in surv_engines)
+        c0 = reg.get("dttpu_wire_chunks_total")
+        b0 = reg.get("dttpu_wire_bytes_total")
+        r0 = reg.get("dttpu_wire_chunk_retries_total")
+        c0, b0, r0 = [m.value if m is not None else 0
+                      for m in (c0, b0, r0)]
+        t0 = time.perf_counter()
+        router.drain_replica(victim_rid, migrate=True, timeout_s=600)
+        drain_ms = (time.perf_counter() - t0) * 1e3
+        router.drain()
+        # total = drain + completing the migrated requests: the
+        # re-prefill arm pays its recompute here, not in the drain
+        total_ms = (time.perf_counter() - t0) * 1e3
+        assert all(h.status == "ok" for h in hs)
+        skipped = sum(e.stats().prefill_windows_skipped_total
+                      for e in surv_engines) - skip0
+        router.resume_replica(victim_rid)
+        get = lambda name: (reg.get(name).value
+                            if reg.get(name) is not None else 0)
+        return dict(drain_migrate_ms=round(drain_ms, 3),
+                    total_ms=round(total_ms, 3),
+                    dest_windows_skipped=int(skipped),
+                    chunks=int(get("dttpu_wire_chunks_total") - c0),
+                    bytes=int(get("dttpu_wire_bytes_total") - b0),
+                    retries=int(
+                        get("dttpu_wire_chunk_retries_total") - r0))
+
+    wire = fleet.PageWire(registry=reg, chunk_pages=2, overlap=2)
+    wire_arm(wire, n=1)       # trace _wire_gather/_wire_splice once
+    nowire = wire_arm(None)
+    wired = wire_arm(wire)
+    # chunk/overlap sweep: how framing granularity and frames-in-flight
+    # trade wall clock for retry blast radius on this link
+    sweep = []
+    combos = ([(1, 1), (2, 2)] if SMOKE
+              else [(1, 1), (2, 1), (2, 2), (4, 2), (4, 4)])
+    for cp, ov in combos:
+        w = fleet.PageWire(registry=reg, chunk_pages=cp, overlap=ov)
+        arm = wire_arm(w)
+        sweep.append(dict(chunk_pages=cp, overlap=ov, **arm))
+    router.page_wire = None
+    wire_pages = int(reg.get("dttpu_wire_pages_shipped_total").value)
+    wire_transfers = int(reg.get("dttpu_wire_transfers_total").value)
+
+    log(f"fleet wire: migrate+complete {wired['total_ms']:.0f} ms "
+        f"shipping pages ({wired['dest_windows_skipped']} dest windows "
+        f"skipped) vs {nowire['total_ms']:.0f} ms re-prefill "
+        f"({nowire['dest_windows_skipped']} skipped); "
+        f"{wire_transfers} transfers, {wire_pages} pages shipped")
+
     log(f"fleet: {n_replicas} replicas {tps:,.0f} tok/s, admission "
         f"fairness {fairness:.3f} (FIFO on this trace: 0.0), per-tenant "
         "ttft p95 "
@@ -2006,6 +2090,9 @@ def bench_fleet():
                 drain_migrate_ms=round(drain_migrate_ms, 3),
                 drain_wait_ms=round(drain_wait_ms, 3),
                 tokens_preserved_ratio=round(preserved_ratio, 4),
+                wire=dict(shipped=wired, re_prefill=nowire,
+                          sweep=sweep, transfers=wire_transfers,
+                          pages_shipped=wire_pages),
                 migrations=int(
                     reg.get("dttpu_migrations_total").value),
                 replicas=n_replicas, requests=n_req,

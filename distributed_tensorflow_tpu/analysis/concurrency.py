@@ -333,6 +333,38 @@ class ConcurrencyModel:
                 self._visit(facts, child, frozenset(inner))
             return
 
+        if isinstance(node, ast.Try):
+            # Manual acquisition idiom (timed acquire is inexpressible
+            # as ``with``):
+            #     ok = self._pump_lock.acquire(timeout=...)
+            #     if not ok: return
+            #     try: <held> finally: self._pump_lock.release()
+            # A ``finally`` that releases lock L declares the try body
+            # runs under L — real code only guarantees a release while
+            # holding (an unheld release raises).  The guarded-release
+            # variant (forced export's ``if clean: ...release()``) is
+            # deliberately treated as held: its unlocked path is the
+            # documented clean=False capture, not an accident.
+            released = set()
+            for stmt in node.finalbody:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "release":
+                        lk = self._lock_key(facts, n.func.value)
+                        if lk is not None:
+                            released.add(lk)
+            if released:
+                for lk in sorted(released - set(locks)):
+                    facts.acquires.append(AcquireEvent(
+                        lk, frozenset(locks), node, facts.key))
+                inner = frozenset(set(locks) | released)
+                for child in node.body + node.handlers + node.orelse:
+                    self._visit(facts, child, inner)
+                for child in node.finalbody:
+                    self._visit(facts, child, frozenset(locks))
+                return
+
         if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]

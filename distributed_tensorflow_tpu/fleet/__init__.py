@@ -34,6 +34,17 @@ them behind one façade (docs/SERVING.md §Fleet):
   policy (scale-out on missed attainment/backlog, migrate-based
   scale-in, heal below the floor) that drives sim and real fleets
   identically; ``dttpu_autoscaler_*`` metrics.
+* ``fleet.pagewire`` — ``PageWire``: fault-tolerant cross-host KV-page
+  transport for migrations (CRC32C-checked chunks keyed by radix chain
+  hashes, bounded retry + seeded backoff, idempotent re-send, graceful
+  degradation to re-prefill); ``Router(page_wire=...)`` ships a
+  victim's cached pages so the destination skips those prefill
+  windows; ``dttpu_wire_*`` metrics.
+* ``fleet.launcher`` — ``Launcher``: supervised multi-host process
+  tree for ``parallel/cluster.py``'s env-var topology — spawn/monitor/
+  restart with Supervisor-style transient/fatal classification, seeded
+  backoff, heartbeat liveness, chief re-election on host loss;
+  ``dttpu_launcher_*`` metrics.
 
 LoRA adapter hot-swap rides the serve/model layers
 (``serve.AdapterTable``, ``GPT.init_lora``); ``Router.load_adapter``
@@ -43,8 +54,11 @@ and the router migrates — measured by ``bench.py --config=fleet``;
 ``correlated_kill`` drops K replicas inside one pump window —
 measured by ``bench.py --config=fleet_sim``.
 """
-from . import autoscaler, router, sim, tenancy, watchdog, workload
+from . import (autoscaler, launcher, pagewire, router, sim, tenancy,
+               watchdog, workload)
 from .autoscaler import SLO, Autoscaler
+from .launcher import HostSpec, Launcher
+from .pagewire import InProcessLink, PageWire, WireError
 from .router import EngineProtocol, FleetHandle, NoReplicaError, Router
 from .sim import CostModel, FleetSim, HardwarePoint, SimEngine
 from .tenancy import (DeficitFairQueue, QuotaExceededError, TenantPolicy,
@@ -54,7 +68,9 @@ from .workload import FleetEvent, Trace, synthesize
 
 __all__ = ["Autoscaler", "CostModel", "DeficitFairQueue",
            "EngineProtocol", "FleetEvent", "FleetHandle", "FleetSim",
-           "HardwarePoint", "NoReplicaError", "QuotaExceededError",
+           "HardwarePoint", "HostSpec", "InProcessLink", "Launcher",
+           "NoReplicaError", "PageWire", "QuotaExceededError",
            "Router", "SLO", "SimEngine", "TenantPolicy", "TenantQuota",
-           "Trace", "Watchdog", "autoscaler", "router", "sim",
-           "synthesize", "tenancy", "watchdog", "workload"]
+           "Trace", "Watchdog", "WireError", "autoscaler", "launcher",
+           "pagewire", "router", "sim", "synthesize", "tenancy",
+           "watchdog", "workload"]

@@ -62,12 +62,15 @@ Metrics (``registry=``): ``dttpu_router_replicas`` gauge,
 ``dttpu_router_requests_total`` / ``dttpu_router_retries_total`` /
 ``dttpu_router_replica_down_total`` / ``dttpu_router_rejected_total``
 / ``dttpu_migrations_total`` /
-``dttpu_router_affinity_hits_total`` counters, the
+``dttpu_router_affinity_hits_total`` /
+``dttpu_router_wire_migrations_total`` /
+``dttpu_router_wire_degraded_total`` counters, the
 ``dttpu_router_affinity_score`` gauge, and per-replica
 ``dttpu_router_placed_total{replica=...}``.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import (Callable, Dict, List, Optional, Protocol, Tuple,
@@ -79,7 +82,10 @@ from ..resilience import faults as faults_lib
 from ..serve import pages as pages_lib
 from ..serve.engine import (Engine, QueueFullError, RequestHandle,
                             RequestSnapshot)
+from .pagewire import WireError
 from .tenancy import QuotaExceededError
+
+log = logging.getLogger(__name__)
 
 __all__ = ["EngineProtocol", "FleetHandle", "NoReplicaError", "Router",
            "expected_pages_reused", "request_chain_keys"]
@@ -109,7 +115,7 @@ def request_chain_keys(prompt, page_size: int):
     return pages_lib.prompt_chain_keys(prompt, page_size)
 
 
-def expected_pages_reused(prompt, stats) -> int:
+def expected_pages_reused(prompt, stats, manifest=None) -> int:
     """How many whole KV pages of ``prompt``'s prefix the replica
     behind ``stats`` (an ``EngineStats``-shaped snapshot carrying
     ``prefix_fingerprint`` + ``page_size``) would serve from its radix
@@ -117,13 +123,20 @@ def expected_pages_reused(prompt, stats) -> int:
     fingerprint match wins; the cached length caps what a shallower
     cached chain can give.  0 when the replica publishes no
     fingerprint (contiguous engine, cold pool, prefix cache off) —
-    which is what makes the blind fallback exact."""
+    which is what makes the blind fallback exact.
+
+    ``manifest`` (a ``RequestSnapshot.shipped_pages`` tuple) overrides
+    the prompt-derived keys: a migrating request scores by the chains
+    its export actually handed off — prompt PLUS generated tokens —
+    so a survivor already holding them (an earlier wire transfer, a
+    shared prefix) outranks an equally-loaded cold one."""
     fp = getattr(stats, "prefix_fingerprint", None)
     pg = int(getattr(stats, "page_size", 0) or 0)
     if not fp or pg < 1:
         return 0
+    keys = manifest if manifest else request_chain_keys(prompt, pg)
     best = 0
-    for key, tokens in request_chain_keys(prompt, pg):
+    for key, tokens in keys:
         cached = fp.get(key, 0)
         got = tokens if tokens < cached else cached
         if got > best:
@@ -199,6 +212,10 @@ class FleetHandle:
         self._router = router
         self._handle: Optional[RequestHandle] = None
         self._snapshot: Optional[RequestSnapshot] = None
+        # captured page-wire records riding with an orphaned snapshot
+        # (fleet/pagewire.py): host copies of the radix pages the
+        # export handed off, shipped to whichever survivor imports
+        self._wire_records: Optional[list] = None
         self._streamed = 0                  # tokens forwarded to the user
         self._ttft: Optional[float] = None  # pinned at first placement
         self._status = "pending"
@@ -298,13 +315,22 @@ class Router:
         doc).  0 disables prefix affinity (pure least-loaded — the
         ablation's blind arm); the default 1.0 means "prefer a replica
         holding my prefix until it is that many requests busier".
+      page_wire: a ``fleet.pagewire.PageWire`` — migrations then SHIP
+        the victim's radix-cached KV pages to the destination instead
+        of re-prefilling them (export captures host copies, the wire
+        chunks/CRCs/retries, the import radix-matches the shipped
+        chain).  None (default) keeps plain re-prefill migration; any
+        unrecoverable wire failure degrades to it per-migration
+        (``dttpu_router_wire_degraded_total``), so correctness never
+        rides the wire.
     """
 
     def __init__(self, replicas=(), *,
                  registry: Optional[metrics_lib.Registry] = None,
                  max_retries: int = 2,
                  export_timeout_s: float = 1.0,
-                 affinity_weight: float = 1.0):
+                 affinity_weight: float = 1.0,
+                 page_wire=None):
         if max_retries < 0:
             raise ValueError(f"max_retries must be >= 0; got {max_retries}")
         if affinity_weight < 0:
@@ -315,6 +341,7 @@ class Router:
         self.max_retries = int(max_retries)
         self.export_timeout_s = float(export_timeout_s)
         self.affinity_weight = float(affinity_weight)
+        self.page_wire = page_wire
         # guards the replica table, draining set, in-flight list, and
         # placement log; never held while pumping an engine tick
         self._lock = threading.Lock()
@@ -356,6 +383,17 @@ class Router:
             "dttpu_router_affinity_score",
             "Expected KV pages reused by the most recent placement "
             "(0 = blind landing).")
+        self._m_wire_migrations = reg.counter(
+            "dttpu_router_wire_migrations_total",
+            "Migrations whose KV pages were shipped over the page "
+            "wire and adopted by the destination pool (the skipped "
+            "re-prefill windows show up in the destination's "
+            "EngineStats.prefill_windows_skipped_total).")
+        self._m_wire_degraded = reg.counter(
+            "dttpu_router_wire_degraded_total",
+            "Migrations that fell back to re-prefill after an "
+            "unrecoverable page-wire failure (link down, chunk "
+            "retries exhausted).")
         self._m_placed: Dict[int, metrics_lib.Counter] = {}
         for engine in replicas:
             self.add_replica(engine)
@@ -469,7 +507,9 @@ class Router:
             ids.sort(key=lambda rid: (stats[rid].inflight, rid))
             return ids, {rid: 0 for rid in ids}
         prompt = fh.spec["prompt"]
-        aff = {rid: expected_pages_reused(prompt, stats[rid])
+        manifest = getattr(fh._snapshot, "shipped_pages", None)
+        aff = {rid: expected_pages_reused(prompt, stats[rid],
+                                          manifest=manifest)
                for rid in ids}
         ids.sort(key=lambda rid: (
             stats[rid].inflight - self.affinity_weight * aff[rid],
@@ -513,6 +553,12 @@ class Router:
             eng = self._replicas[rid]
             try:
                 if snap is not None:
+                    # pre-warm: ship the exported radix pages into THIS
+                    # candidate's pool first, so the import below
+                    # radix-matches and skips the shipped prefill
+                    # windows.  Purely best-effort — every wire failure
+                    # shape ends with a plain re-prefill import.
+                    self._ship_wire_pages(fh, eng, snap)
                     h = eng.import_request(
                         snap,
                         on_token=fh._attempt_stream(snap.stream_offset))
@@ -539,6 +585,7 @@ class Router:
                 # consumed: further failovers re-export from the new
                 # replica, which now owns the freshest progress
                 fh._snapshot = None
+                fh._wire_records = None
                 fh.migrations += 1
                 fh.tokens_preserved += len(snap.generated)
                 self._m_migrations.inc()
@@ -556,6 +603,28 @@ class Router:
             self._m_rejected.inc()
             raise last
         return False                    # stays pending; retried next step
+
+    def _ship_wire_pages(self, fh: FleetHandle, eng: Engine,
+                         snap: RequestSnapshot) -> None:
+        """Ship an orphan's captured radix pages into candidate ``eng``
+        before its import (``_place``).  Outcomes: pages adopted (the
+        import skips their prefill windows), destination refused (0
+        adopted — records kept for the next candidate), or the wire
+        failed unrecoverably (``WireError`` — records dropped, this
+        migration re-prefills: ``dttpu_router_wire_degraded_total``)."""
+        if self.page_wire is None or not fh._wire_records:
+            return
+        try:
+            adopted = self.page_wire.ship(fh._wire_records, eng, snap)
+        except WireError as e:
+            log.warning("page wire failed for fleet rid %d — "
+                        "degrading to re-prefill migration: %s",
+                        fh.rid, e)
+            fh._wire_records = None
+            self._m_wire_degraded.inc()
+            return
+        if adopted:
+            self._m_wire_migrations.inc()
 
     # ----------------------------------------------------------- drive
 
@@ -734,6 +803,7 @@ class Router:
         engine's pump/state locks; order router -> engine holds)."""
         for fh, h in victims:
             snap: Optional[RequestSnapshot] = None
+            recs: Optional[list] = None
             if h is not None:
                 if h.done:
                     continue            # sweep finalizes from the handle
@@ -745,12 +815,24 @@ class Router:
                     if h.done:
                         continue        # finished during the export race
                     eng.cancel(h)       # stop the doomed attempt
+                elif self.page_wire is not None \
+                        and getattr(snap, "shipped_pages", None):
+                    # page-wire capture: host copies of the pages the
+                    # export just handed off, while the source is still
+                    # reachable.  Best-effort — a source too far gone
+                    # to read simply ships nothing (re-prefill).
+                    try:
+                        recs = eng.export_wire_pages(
+                            snap, timeout_s=timeout_s) or None
+                    except Exception:
+                        recs = None
             with self._lock:
                 if fh.done:
                     continue
                 if fh._ttft is None and h is not None:
                     fh._ttft = h.ttft_s
                 fh._snapshot = snap
+                fh._wire_records = recs
                 if error is not None:
                     fh.error = error
                 fh._handle = None       # orphaned: the sweep re-places

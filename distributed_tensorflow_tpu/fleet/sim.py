@@ -485,7 +485,7 @@ class SimEngine:
             reqtrace.exported(r.trace_id, ts_us=now * 1e6, rid=r.rid,
                               generated=r.emitted,
                               clean=self._wedged_until is None)
-        return RequestSnapshot(
+        snap = RequestSnapshot(
             rid=r.rid, prompt=r.prompt_ref,
             generated=[0] * r.emitted, max_new_tokens=r.budget,
             stream_offset=r.emitted, tenant=r.tenant,
@@ -495,6 +495,16 @@ class SimEngine:
             critpath={"phases": r.critpath,
                       "elapsed_s": max(0.0, now - r.arrival_vt),
                       "exported_at": now})
+        # page-wire manifest mirror (serve: the chains the export
+        # handed off; sim: the cached prefix id + its covered tokens).
+        # Only when this engine actually holds the prefix — a request
+        # exported before admission shipped nothing.
+        if r.prefix_id and r.prefix_id in self._prefix_seen:
+            covered = r.prefix_len - r.prefix_len % self.prefill_chunk
+            if covered > 0:
+                snap.shipped_pages = ((r.prefix_id, covered),)
+                snap.page_size = self.prefill_chunk
+        return snap
 
     def export_inflight(self, timeout_s: Optional[float] = None
                         ) -> List[RequestSnapshot]:
@@ -502,6 +512,39 @@ class SimEngine:
                    + list(self._active))
         return [self.export_request(r, timeout_s=timeout_s)
                 for r in pending]
+
+    def export_wire_pages(self, snap: RequestSnapshot,
+                          timeout_s: Optional[float] = None) -> list:
+        """Page-wire capture mirror (serve: host copies of device
+        pages; sim: payload-free records — a shipped "page" is a
+        fingerprint entry, keyed by prefix id instead of chain hash)."""
+        manifest = getattr(snap, "shipped_pages", None)
+        if not manifest:
+            return []
+        return [(j, key, {}) for j, (key, _tok) in enumerate(manifest)]
+
+    def import_wire_pages(self, snap: RequestSnapshot, records,
+                          timeout_s: Optional[float] = None) -> int:
+        """Page-wire splice mirror: adopting a shipped record marks its
+        prefix id cached here, so the subsequent ``import_request``'s
+        admission radix-hits and its re-prefill pays only the
+        uncovered windows — the sim twin of the serve pool's
+        pre-warm.  Returns prefill chunks adopted."""
+        chunk = self.prefill_chunk
+        if int(getattr(snap, "page_size", 0) or 0) != chunk \
+                or not records:
+            return 0                 # chunking differs: keys are alien
+        st = self._stats
+        adopted = 0
+        for rec in records:
+            covered = int(rec.tokens)
+            if covered < chunk or not rec.chain:
+                continue
+            self._prefix_seen.add(rec.chain)
+            if covered > st.prefix_fingerprint.get(rec.chain, 0):
+                st.prefix_fingerprint[rec.chain] = covered
+            adopted += covered // chunk
+        return adopted
 
     def cancel(self, handle: _SimRequest) -> bool:
         if handle.status != "pending":

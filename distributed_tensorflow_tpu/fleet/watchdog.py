@@ -151,6 +151,18 @@ class Watchdog:
             extra = ({"goodput_s": {k: round(v, 6) for k, v in
                                     acct.snapshot().items()}}
                      if acct is not None else {})
+            # page-wire posture at quarantine time (fleet/pagewire.py,
+            # getattr: routers predate the wire): how many of this
+            # fleet's migrations shipped pages vs degraded to
+            # re-prefill — the forensics answer to "did the victims'
+            # KV travel or get recomputed"
+            wire_m = getattr(self.router, "_m_wire_migrations", None)
+            wire_d = getattr(self.router, "_m_wire_degraded", None)
+            if wire_m is not None and wire_d is not None \
+                    and self.router.page_wire is not None:
+                extra = dict(extra, page_wire={
+                    "shipped_total": wire_m.value,
+                    "degraded_total": wire_d.value})
             for trace_id in victims:
                 # the victim's own phase budget next to the process
                 # goodput split: "this request spent 4 s behind other

@@ -41,7 +41,7 @@ from typing import Callable, Dict, Optional
 from . import (critpath, device, federate, goodput, http, ledger, metrics,
                reqtrace, sentinel, trace)
 from .critpath import CritpathLedger
-from .federate import FederatedMetrics
+from .federate import FederatedMetrics, RemoteAffinity
 from .goodput import GoodputAccountant
 from .http import MetricsServer
 from .ledger import PerfLedger
@@ -53,6 +53,7 @@ from .trace import Tracer
 __all__ = ["Telemetry", "Tracer", "MetricsServer", "Registry", "REGISTRY",
            "Counter", "Gauge", "Histogram", "CritpathLedger",
            "FederatedMetrics", "GoodputAccountant", "PerfLedger",
+           "RemoteAffinity",
            "Sentinel", "parse_exposition", "render_exposition",
            "critpath", "device", "federate", "goodput", "http", "ledger",
            "metrics", "reqtrace", "sentinel", "trace"]

@@ -37,6 +37,7 @@ exposition).
 from __future__ import annotations
 
 import collections
+import dataclasses
 import threading
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
@@ -44,7 +45,19 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import metrics as metrics_lib
 from .http import MetricsServer
 
-__all__ = ["FederatedMetrics"]
+__all__ = ["FederatedMetrics", "RemoteAffinity"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RemoteAffinity:
+    """One remote engine's prefix-affinity inputs, recovered from its
+    federated metrics: the radix chain fingerprint (chain hash ->
+    cached tokens) and the page size it chunks prompts by.  Shaped so
+    ``fleet.router.expected_pages_reused(prompt, remote)`` scores it
+    exactly like a local ``EngineStats`` — cross-HOST routers read
+    affinity from the scrape plane instead of in-process stats."""
+    page_size: int
+    prefix_fingerprint: Dict[bytes, int]
 
 # Streaming percentile state is a bounded reservoir per tenant: serving
 # percentiles care about the recent tail, and an unbounded list on a
@@ -135,12 +148,14 @@ class FederatedMetrics:
 
     def _slo_gauge(self, name: str, help_text: str,
                    tenant: str) -> metrics_lib.Gauge:
+        # under _lock: expose() and fleet_fingerprints() both land here
         key = (name, tenant)
-        g = self._gauges.get(key)
-        if g is None:
-            g = self.registry.gauge(name, help_text,
-                                    labels={"tenant": tenant})
-            self._gauges[key] = g
+        with self._lock:
+            g = self._gauges.get(key)
+            if g is None:
+                g = self.registry.gauge(name, help_text,
+                                        labels={"tenant": tenant})
+                self._gauges[key] = g
         return g
 
     def _refresh_slo(self) -> None:
@@ -228,6 +243,47 @@ class FederatedMetrics:
                     metrics_lib.parse_exposition(self.registry.expose()),
                     {})
         return metrics_lib.render_exposition(merged)
+
+    def fleet_fingerprints(self) -> Dict[Tuple[Tuple[str, str], ...],
+                                         RemoteAffinity]:
+        """Recover every source engine's prefix fingerprint from the
+        merged exposition: ``dttpu_serve_prefix_chain_tokens{chain=..}``
+        samples grouped by their non-``chain`` labels (the source
+        stamp — ``host=``/``replica=`` — plus any tenant labels), with
+        ``dttpu_serve_page_size`` matched on the same key.  Returns
+        ``{source label tuple: RemoteAffinity}``; chains rendered 0
+        (evicted on the engine) are dropped, and sources publishing no
+        page size (contiguous engines) score affinity 0 downstream.
+
+        This is the cross-host half of prefix-affinity routing
+        (fleet/router.py): the serve tier renders the pool fingerprint
+        as labeled gauges (serve/engine.py ``ServeMetrics``), the
+        federation merges them across hosts, and a router on ANY host
+        scores placements from this one scrape surface."""
+        families = metrics_lib.parse_exposition(self.expose())
+        fps: Dict[Tuple[Tuple[str, str], ...], Dict[bytes, int]] = {}
+        sizes: Dict[Tuple[Tuple[str, str], ...], int] = {}
+        fam = families.get("dttpu_serve_prefix_chain_tokens")
+        for (_sname, lbls), value in ((fam or {}).get("samples")
+                                      or {}).items():
+            chain_hex = dict(lbls).get("chain")
+            if not chain_hex or value <= 0:
+                continue          # evicted chain renders 0: not cached
+            try:
+                chain = bytes.fromhex(chain_hex)
+            except ValueError:
+                continue
+            src = tuple(sorted((k, v) for k, v in lbls
+                               if k != "chain"))
+            fps.setdefault(src, {})[chain] = int(value)
+        fam = families.get("dttpu_serve_page_size")
+        for (_sname, lbls), value in ((fam or {}).get("samples")
+                                      or {}).items():
+            src = tuple(sorted(lbls))
+            sizes[src] = int(value)
+        return {src: RemoteAffinity(page_size=sizes.get(src, 0),
+                                    prefix_fingerprint=fp)
+                for src, fp in fps.items()}
 
     def serve(self, port: int = 0, host: str = "127.0.0.1",
               health_fn=None) -> MetricsServer:

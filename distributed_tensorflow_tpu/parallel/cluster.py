@@ -35,8 +35,15 @@ from typing import List, Optional
 
 log = logging.getLogger(__name__)
 
-__all__ = ["ClusterConfig", "cluster_from_env", "initialize", "is_chief",
-           "process_index", "process_count"]
+__all__ = ["ClusterConfig", "LEGACY_PS_EXIT_CODE", "cluster_from_env",
+           "initialize", "is_chief", "process_index", "process_count"]
+
+# A legacy JOB_NAME=ps process under the fleet launcher exits with this
+# code so the launcher classifies it fatal-with-reason ("role refused")
+# instead of restart-looping a process that will never participate.
+# 64 == EX_USAGE (sysexits.h): the configuration asked for a role that
+# does not exist here.
+LEGACY_PS_EXIT_CODE = 64
 
 
 @dataclasses.dataclass
@@ -115,13 +122,27 @@ def initialize(config: Optional[ClusterConfig] = None) -> ClusterConfig:
     Single-machine (no topology in env) is a no-op, mirroring the
     reference's local fallback path (example.py:111-113).  A legacy
     ``JOB_NAME=ps`` process gets a warning and is treated as a normal
-    participant refusal: there is nothing for it to serve.
+    participant refusal: there is nothing for it to serve.  Under the
+    fleet launcher (``DTTPU_LAUNCHER`` set) the refusal must be LOUD —
+    a ps child that merely warned and returned used to exit 0 after
+    doing nothing, which the launcher read as a clean completion and
+    silently ran the job one host short — so it exits
+    ``LEGACY_PS_EXIT_CODE``, which the launcher classifies as
+    fatal-with-reason in its report (fleet/launcher.py).
     """
     global _initialized
     if config is None:
         config = cluster_from_env()
 
     if config.is_legacy_ps:
+        if os.environ.get("DTTPU_LAUNCHER"):
+            log.error(
+                "JOB_NAME=ps refused: the TPU runtime has no "
+                "parameter-server role (SURVEY.md §2d); exiting %d so "
+                "the launcher reports this host fatal instead of "
+                "counting a silent no-op as success.",
+                LEGACY_PS_EXIT_CODE)
+            raise SystemExit(LEGACY_PS_EXIT_CODE)
         log.warning(
             "JOB_NAME=ps ignored: the TPU runtime has no parameter-server "
             "role (gradient sync is an ICI all-reduce, not a variable push; "

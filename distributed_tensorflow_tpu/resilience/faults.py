@@ -62,6 +62,25 @@ correlated_kill     kill ``k`` replicas within a window of ``window``
                     tests schedule rack/PSU-style correlated failures
                     through this one kind (``times`` is ignored; ``k``
                     governs)
+drop_chunk          the page wire's ``at``-th chunk frame on wire
+                    ``replica`` vanishes in flight (the sender sees a
+                    per-chunk timeout and re-sends; fleet/pagewire.py)
+corrupt_chunk       flip one byte of the page wire's ``at``-th chunk
+                    frame on wire ``replica`` — the receiver's CRC32C
+                    check NAKs it and the sender re-sends
+stall_wire          delay delivery of the page wire's ``at``-th chunk
+                    frame on wire ``replica`` by ``seconds`` — a late
+                    frame the sender has already re-sent (the receiver
+                    dedups the duplicate by chain key)
+kill_host           raise ``ConnectionError`` at the page wire's
+                    ``at``-th chunk on wire ``replica`` (host died
+                    mid-transfer: the transfer degrades to re-prefill
+                    migration), AND/OR kill the launcher-supervised
+                    host process ``replica`` at the launcher's
+                    ``at``-th liveness poll (fleet/launcher.py
+                    restarts it).  The two sites keep separate
+                    counters (``wire:N`` vs ``host:N``); arm one fault
+                    per site when both must fire
 ==================  =========================================================
 
 Every injection is auditable: it lands in ``plan.log``, increments the
@@ -97,7 +116,8 @@ __all__ = ["Fault", "FaultPlan", "InjectedFault", "KINDS", "activate",
 
 KINDS = ("corrupt_checkpoint", "save_oserror", "poison_batch",
          "nan_grads", "kill_prefetch", "fail_decode", "kill_replica",
-         "stall_tick", "wedge_replica", "correlated_kill")
+         "stall_tick", "wedge_replica", "correlated_kill",
+         "drop_chunk", "corrupt_chunk", "stall_wire", "kill_host")
 
 
 class InjectedFault(RuntimeError):
@@ -320,6 +340,49 @@ class FaultPlan:
             raise ConnectionError(
                 f"injected fault: replica {replica} killed by correlated "
                 f"failure (victims {f.victims})")
+
+    def on_wire_chunk(self, wire: int) -> Optional[str]:
+        """The page wire's delivery of one chunk frame on wire ``wire``
+        (``InProcessLink.deliver``, fleet/pagewire.py): returns the
+        action the link applies to this frame — ``"drop"`` (vanish it),
+        ``"corrupt"`` (flip a byte; the receiver's CRC NAKs), or
+        ``None`` (deliver clean).  A stall_wire sleeps ``seconds``
+        in-line (the whole flight lands late); a kill_host raises
+        ``ConnectionError`` — the host died mid-transfer and the
+        transfer is unrecoverable."""
+        i = self._tick(f"wire:{wire}")
+        f = self._match("kill_host", i, replica=int(wire))
+        if f is not None:
+            self._record(f, wire=int(wire), chunk=i)
+            raise ConnectionError(
+                f"injected fault: host behind wire {wire} died at "
+                f"chunk #{i}")
+        f = self._match("stall_wire", i, replica=int(wire))
+        if f is not None:
+            self._record(f, wire=int(wire), chunk=i, seconds=f.seconds)
+            time.sleep(f.seconds)
+        f = self._match("drop_chunk", i, replica=int(wire))
+        if f is not None:
+            self._record(f, wire=int(wire), chunk=i)
+            return "drop"
+        f = self._match("corrupt_chunk", i, replica=int(wire))
+        if f is not None:
+            self._record(f, wire=int(wire), chunk=i)
+            return "corrupt"
+        return None
+
+    def on_host_poll(self, host: int) -> Optional[Fault]:
+        """The launcher's ``at``-th liveness poll of host ``host``
+        (fleet/launcher.py): returns the matched kill_host fault so the
+        launcher SIGKILLs the child — the supervised-restart path —
+        or ``None``.  Separate counter site from ``on_wire_chunk``
+        (``host:N`` vs ``wire:N``); arm one fault per site when a test
+        needs both the wire cut AND the process killed."""
+        i = self._tick(f"host:{host}")
+        f = self._match("kill_host", i, replica=int(host))
+        if f is not None:
+            self._record(f, host=int(host), poll=i)
+        return f
 
     def _match_correlated(self, replica: int) -> Optional[Fault]:
         """correlated_kill matching: a *global* pump counter (across all

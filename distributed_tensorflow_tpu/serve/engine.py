@@ -41,6 +41,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Union
 
+import numpy as np
+
 from ..obs import metrics as metrics_lib
 from ..obs import reqtrace
 from .adapters import AdapterTable
@@ -117,6 +119,18 @@ class ServeMetrics:
             "dttpu_serve_prefix_evictions_total",
             "Radix-cached prefix pages reclaimed by LRU eviction "
             "under allocation pressure.")
+        # prefix-affinity federation (obs/federate.py): the pool's
+        # hot-chain fingerprint rendered as labeled gauges so a
+        # cross-host router can score prefix affinity from SCRAPED
+        # stats — ``chain`` is the radix chain hash (hex; bounded by
+        # ``pages.FINGERPRINT_K``, so cardinality is a config knob, not
+        # traffic-dependent), the value is cached tokens.  Page size
+        # rides along: remote scorers must chunk prompts identically.
+        self.page_size_gauge = reg.gauge(
+            "dttpu_serve_page_size",
+            "KV page-pool page size in tokens (0 on a contiguous "
+            "engine).")
+        self._chain_gauges: dict = {}
         # counters render by delta against the stats() snapshot (the
         # exposition forbids decreasing counters; stats are monotonic)
         self._last_prefix_hits = 0
@@ -198,6 +212,23 @@ class ServeMetrics:
         for tenant, g in self._tenant_inflight.items():
             if tenant not in stats.inflight_per_tenant:
                 g.set(0)
+        self.page_size_gauge.set(stats.page_size)
+        for chain, tokens in stats.prefix_fingerprint.items():
+            key = chain.hex()
+            g = self._chain_gauges.get(key)
+            if g is None:
+                g = self._chain_gauges[key] = self.registry.gauge(
+                    "dttpu_serve_prefix_chain_tokens",
+                    "Radix-cached tokens under this chain hash — the "
+                    "pool's hot-chain fingerprint, federated for "
+                    "cross-host prefix-affinity routing.",
+                    labels={"chain": key})
+            g.set(tokens)
+        live = {c.hex() for c in stats.prefix_fingerprint}
+        for key, g in self._chain_gauges.items():
+            if key not in live:
+                g.set(0)             # evicted chain: renders 0, and the
+                #                      federation layer drops 0-chains
 
 
 class RequestHandle:
@@ -509,6 +540,55 @@ class Engine:
         """Export EVERY in-flight request (rid order), leaving the
         engine idle — the quarantine/shutdown bulk path."""
         return self.scheduler.export_all(timeout_s=timeout_s)
+
+    def export_wire_pages(self, snap: RequestSnapshot,
+                          timeout_s: Optional[float] = None) -> list:
+        """Page-wire sender capture (fleet/pagewire.py): read the
+        radix-cached KV pages behind ``snap``'s shipped-pages manifest
+        off this engine's device — ``[(chunk_index, chain_hash,
+        payload)]`` ready for ``PageWire.ship``.  Call AFTER
+        ``export_request``: the export's lease handoff published the
+        pages into the radix tree, where they stay readable (and
+        evictable — whatever was evicted since simply doesn't ship).
+        Returns ``[]`` for a snapshot without a manifest, a contiguous
+        engine, or a pump busy past ``timeout_s`` — the migration then
+        proceeds as plain re-prefill."""
+        manifest = getattr(snap, "shipped_pages", None)
+        if not manifest:
+            return []
+        prompt = snap.prompt
+        generated = [int(t) for t in snap.generated]
+        ctx = (np.concatenate([np.asarray(prompt, np.int32).reshape(-1),
+                               np.asarray(generated, np.int32)])
+               if generated
+               else np.asarray(prompt, np.int32).reshape(-1))
+        # the manifest's coverage is authoritative: ship at most the
+        # tokens the export actually handed off
+        return self.scheduler.export_chain_pages(
+            ctx[:int(manifest[-1][1])], timeout_s=timeout_s)
+
+    def import_wire_pages(self, snap: RequestSnapshot, records,
+                          timeout_s: Optional[float] = 5.0) -> int:
+        """Page-wire receiver splice: adopt shipped pages for ``snap``
+        into this engine's pool BEFORE ``import_request`` admits it, so
+        the resumed request's prefill radix-matches the shipped chain
+        and skips those windows.  Returns chunks adopted (0 = nothing
+        usable — incompatible page size/layout, pool pressure, or pump
+        busy past ``timeout_s``; the import just re-prefills).  The
+        default timeout is finite because the fleet router calls this
+        toward a POSSIBLY-unhealthy destination — a wedged pump must
+        degrade the transfer, not deadlock the router."""
+        if not getattr(snap, "page_size", 0) \
+                or snap.page_size != getattr(self.scheduler,
+                                             "page_size", 0):
+            return 0                 # chunking differs: chains alien
+        prompt = np.asarray(snap.prompt, np.int32).reshape(-1)
+        generated = [int(t) for t in snap.generated]
+        ctx = (np.concatenate([prompt,
+                               np.asarray(generated, np.int32)])
+               if generated else prompt)
+        return self.scheduler.import_wire_pages(ctx, records,
+                                                timeout_s=timeout_s)
 
     def import_request(self, snap: RequestSnapshot,
                        on_token: Optional[Callable[[List[int]], None]]

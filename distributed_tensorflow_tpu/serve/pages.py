@@ -423,6 +423,32 @@ class PagePool:
         self.release(lease)
         return published
 
+    def chain_pages(self, context: np.ndarray) -> list:
+        """Snapshot the radix chain covering ``context``'s full chunks:
+        ``[(chunk_index, page, chain_hash)]`` down the tree, stopping at
+        the first unmatched chunk (everything past a miss would need
+        re-prefill anyway).  This is the page wire's sender-side lookup
+        (fleet/pagewire.py): the caller reads the returned device pages
+        while still holding the scheduler's pump mutex — eviction only
+        runs inside ``begin``'s allocation, which the same mutex
+        serializes, so the snapshot cannot be recycled underneath the
+        read.  Empty with the prefix cache off."""
+        if not self.prefix_cache:
+            return []
+        context = np.asarray(context, np.int32).reshape(-1)
+        pg = self.page_size
+        out = []
+        with self._lock:
+            node = self._root
+            for j in range(context.size // pg):
+                child = node.children.get(
+                    context[j * pg:(j + 1) * pg].tobytes())
+                if child is None:
+                    break
+                out.append((j, int(child.page), child.chain))
+                node = child
+        return out
+
     def release(self, lease: PageLease) -> None:
         """Return a lease's holdings: shared pins drop (the chain stays
         cached, evictable once refcount-0), private pages go straight

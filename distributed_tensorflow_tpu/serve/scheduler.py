@@ -85,7 +85,7 @@ import collections
 import dataclasses
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -236,6 +236,15 @@ class RequestSnapshot:
     # charges the export->import gap to ``migration`` and resumes, so a
     # migrated request neither double-counts nor loses time
     critpath: Optional[dict] = None
+    # page-wire manifest (fleet/pagewire.py): ``(chain hash, tokens
+    # covered)`` for every full ``page_size``-token chunk the export
+    # handed off into the source radix tree — what the wire can ship
+    # so the destination's re-prefill skips those windows.  PURELY an
+    # optimization hint: correctness never depends on it (a missing or
+    # stale manifest just means full re-prefill), so the snapshot stays
+    # device-free and portable
+    shipped_pages: Optional[Tuple[Tuple[bytes, int], ...]] = None
+    page_size: int = 0                       # source pool's page size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -635,12 +644,32 @@ class SlotScheduler:
                 length=tick_steps)
             return carry, em, mask
 
+        def wire_gather(kv, idx):
+            # page-wire device read (fleet/pagewire.py): gather the
+            # pages at ``idx`` (padded to pages_per_slot — ONE shape,
+            # one trace; unused entries gather the trash page and are
+            # ignored on host) out of every pool leaf.  Not part of the
+            # serve-hot census: cold path, runs once per migration.
+            import jax.numpy as jnp
+            return {k: jnp.take(v, idx, axis=1) for k, v in kv.items()}
+
+        def wire_splice(kv, page, payload):
+            # page-wire device write: splice one shipped page's host
+            # payload into pool page ``page`` (traced scalar — one
+            # trace for any index) across every leaf.  Donated: the
+            # pool buffer is rebound to the result by the caller.
+            return {k: v.at[:, page].set(payload[k])
+                    for k, v in kv.items()}
+
         if self.paged:
             self._win_mid = jax.jit(paged_win_mid, donate_argnums=(1,))
             self._last_admit = jax.jit(paged_last_admit,
                                        donate_argnums=(1, 6, 7, 8, 9))
             self._tick = jax.jit(paged_tick,
                                  donate_argnums=(1, 3, 4, 5, 6))
+            self._wire_gather = jax.jit(wire_gather)
+            self._wire_splice = jax.jit(wire_splice,
+                                        donate_argnums=(0,))
         else:
             self._win_mid = jax.jit(win_mid, donate_argnums=(1,))
             self._last_admit = jax.jit(last_admit,
@@ -1557,7 +1586,19 @@ class SlotScheduler:
                 done = windows_done or 0
                 written = lease.skip + done * self.prefill_chunk
                 full = ctx
-            self.pages.handoff(lease, full[:written])
+            published_ctx = full[:written]
+            self.pages.handoff(lease, published_ctx)
+            # page-wire manifest: the chain keys just handed off — the
+            # fleet's wire (fleet/pagewire.py) may ship those pages so
+            # the destination skips their prefill windows.  Chains are
+            # re-verified against the live radix tree at capture time
+            # (``chain_pages``), so eviction between now and then only
+            # shrinks what ships, never corrupts it.
+            keys = pages_lib.prompt_chain_keys(published_ctx,
+                                               self.page_size)
+            if keys:
+                snap.shipped_pages = keys
+                snap.page_size = self.page_size
         if not self.cancel(req, status="migrated"):
             raise RuntimeError(
                 f"request {req.rid} finished during export")
@@ -1569,6 +1610,126 @@ class SlotScheduler:
                               generated=len(generated),
                               clean=bool(clean))
         return snap
+
+    def export_chain_pages(self, context: np.ndarray,
+                           timeout_s: Optional[float] = None) -> list:
+        """Page-wire sender capture (fleet/pagewire.py): read the radix
+        pages covering ``context``'s full chunks off the device —
+        ``[(chunk_index, chain_hash, {leaf: np.ndarray})]``, each
+        payload one ``[L, page_size, ...]`` page per pool leaf (int8
+        scale planes ride as ordinary leaves).  Runs under the pump
+        mutex: eviction lives inside ``begin``'s allocation, which the
+        same mutex serializes, so the looked-up pages cannot be
+        recycled mid-read.  Every failure shape degrades to ``[]`` —
+        pump busy past ``timeout_s``, prefix cache off, nothing cached
+        — because shipping fewer pages only costs prefill windows,
+        never correctness."""
+        import jax
+
+        if self.pages is None or not self.pages.prefix_cache:
+            return []
+        if timeout_s is None:
+            ok = self._pump_lock.acquire()
+        else:
+            ok = self._pump_lock.acquire(timeout=timeout_s)
+        if not ok:
+            return []                    # pump wedged: ship nothing
+        try:
+            entries = self.pages.chain_pages(
+                np.asarray(context, np.int32).reshape(-1))
+            if not entries:
+                return []
+            # ONE gather shape (pages_per_slot, the page-table row
+            # width): pad with the trash page so any chain length is
+            # the same traced program (RetraceGuard budget=1)
+            idx = np.zeros((self._page_tab.shape[1],), np.int32)
+            for j, (_, page, _) in enumerate(entries):
+                idx[j] = page
+            # dispatch under the mutex — stream order puts the copy
+            # ahead of any later donating tick — but WAIT for the
+            # fresh output buffers after releasing it
+            view_dev = self._wire_gather(self._cache["kv"], idx)
+        finally:
+            self._pump_lock.release()
+        view = jax.device_get(view_dev)
+        return [(chunk, chain,
+                 {k: np.asarray(v[:, j]) for k, v in view.items()})
+                for j, (chunk, _, chain) in enumerate(entries)]
+
+    def import_wire_pages(self, context: np.ndarray, records,
+                          timeout_s: Optional[float] = None) -> int:
+        """Page-wire receiver splice: adopt shipped pages for
+        ``context``'s leading full chunks into this engine's pool
+        through the SAME lease seam every request uses — ``begin`` the
+        shipped prefix (radix hits dedup chunks we already hold, which
+        makes re-delivery idempotent), write each still-missing chunk's
+        payload into its leased page, ``handoff`` to publish the chain.
+        The next ``import_snapshot`` then radix-matches and skips those
+        prefill windows.  Returns chunks now cached for the context
+        (0 = adopt nothing: wrong page size, alien leaf layout, chain
+        mismatch, pool exhausted, or pump busy past ``timeout_s`` —
+        all degrade to plain re-prefill)."""
+        if self.pages is None or not self.pages.prefix_cache \
+                or not records:
+            return 0
+        pg = self.page_size
+        ctx = np.asarray(context, np.int32).reshape(-1)
+        expected = pages_lib.prompt_chain_keys(ctx, pg)
+        if timeout_s is None:
+            ok = self._pump_lock.acquire()
+        else:
+            ok = self._pump_lock.acquire(timeout=timeout_s)
+        if not ok:
+            return 0                     # pump wedged: re-prefill
+        try:
+            # shape vetting reads _cache under the same mutex that
+            # serializes every rebind of it (ticks donate)
+            kv_host_shapes = {
+                k: (tuple(v.shape[:1]) + tuple(v.shape[2:]), v.dtype)
+                for k, v in self._cache["kv"].items()}
+            take = []
+            for j, rec in enumerate(sorted(records,
+                                           key=lambda r: r.index)):
+                if rec.index != j or j >= len(expected) \
+                        or rec.chain != expected[j][0]:
+                    break                # gap or foreign chain: stop
+                if set(rec.payload) != set(kv_host_shapes):
+                    return 0             # alien pool layout
+                bad = any(
+                    tuple(rec.payload[k].shape) != kv_host_shapes[k][0]
+                    or rec.payload[k].dtype != kv_host_shapes[k][1]
+                    for k in kv_host_shapes)
+                if bad:
+                    return 0             # page-size/dtype mismatch
+                take.append(rec)
+            if not take:
+                return 0
+            ship = ctx[:len(take) * pg]
+            try:
+                lease = self.pages.begin(ship, ship.size)
+            except pages_lib.PagePoolExhausted:
+                return 0                 # no room: re-prefill instead
+            kv = self._cache["kv"]
+            try:
+                # chunks below lease.skip are radix hits the pool
+                # already holds (free dedup; a COW'd final chunk costs
+                # one redundant page write); the rest get the shipped
+                # payload spliced into their freshly leased pages
+                for j in range(lease.skip // pg, len(take)):
+                    kv = self._wire_splice(kv,
+                                           np.int32(int(lease.row[j])),
+                                           take[j].payload)
+            except BaseException:
+                # _wire_splice donates: rebind the latest buffers so
+                # the pool is never left holding freed device memory
+                self._cache["kv"] = kv
+                self.pages.release(lease)
+                raise
+            self._cache["kv"] = kv
+            self.pages.handoff(lease, ship)
+        finally:
+            self._pump_lock.release()
+        return len(take)
 
     def import_snapshot(self, snap: RequestSnapshot,
                         on_token: Optional[Callable[[List[int]], None]]

@@ -59,6 +59,10 @@ def main() -> int:
     config = cluster.cluster_from_env()
     if FLAGS.job_name == "ps" or config.is_legacy_ps:
         print("JOB_NAME=ps: no parameter-server role on TPU. Exiting.")
+        if os.environ.get("DTTPU_LAUNCHER"):
+            # under a supervisor, exit 0 would read as "completed" —
+            # refuse loudly instead (fleet/launcher.py names the reason)
+            return cluster.LEGACY_PS_EXIT_CODE
         return 0
     if not config.distributed:
         print("Running single-machine training")

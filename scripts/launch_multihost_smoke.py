@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Supervised 2-host bring-up smoke: the fleet launcher drives a real
+multi-process topology end to end (docs/RESILIENCE.md §launcher).
+
+Parent mode (default): build ``fleet.launcher.local_topology(2, ...)``
+— the env-var convention ``parallel/cluster.py`` resolves — spawn both
+host processes under the ``Launcher`` supervisor with heartbeat
+liveness, wait for clean completion, and print the launcher's report
+as one JSON line (the CI artifact).  Exit 0 iff every host completed.
+
+Child mode (``--child``): the supervised host process.  Heartbeat,
+``cluster.initialize()`` (the loud legacy-ps refusal lives on this
+path), then a compact pipe2xdata4-style leg: 4 forced host devices per
+process form one 8-device global mesh and agree on a cross-process
+reduce.  On jaxlib builds without multi-process CPU collectives the
+collective is skipped with a warning — the smoke's contract is the
+supervised BRING-UP (topology env, distributed init, heartbeats,
+classification), not the DCN math, which tier-1 pins where supported
+(tests/test_cluster.py).
+
+A stolen coordinator port can hang the bring-up, so the parent retries
+the whole fleet on a fresh port (bounded), mirroring
+tests/test_cluster.py's idiom.
+"""
+import json
+import os
+import socket
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child() -> int:
+    sys.path.insert(0, os.environ.get("DTTPU_REPO", REPO))
+    from distributed_tensorflow_tpu.fleet import launcher
+    from distributed_tensorflow_tpu.parallel import cluster
+
+    launcher.heartbeat()
+    cfg = cluster.initialize()      # exits 64 on legacy ps + launcher
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == cfg.num_processes == 2, \
+        (jax.process_count(), cfg)
+    launcher.heartbeat()
+    n = len(jax.devices())
+    assert n == 8, f"expected 2 procs x 4 forced devices, got {n}"
+    from distributed_tensorflow_tpu import parallel
+    mesh = parallel.make_mesh({"pipe": 2, "data": 4})
+    try:
+        x = jax.make_array_from_callback(
+            (n,), NamedSharding(mesh, P(("pipe", "data"))),
+            lambda idx: np.asarray([idx[0].start], np.float32) + 1.0)
+        total = float(jax.jit(
+            lambda a: jnp.sum(a),
+            out_shardings=NamedSharding(mesh, P()))(x))
+        assert total == n * (n + 1) / 2, total
+        leg = f"psum ok (sum={total})"
+    except Exception as e:          # pragma: no cover - jaxlib-dependent
+        if "implemented" not in str(e):
+            raise
+        leg = "collective skipped (no multi-process CPU collectives)"
+    launcher.heartbeat()
+    print(f"SMOKE proc={cfg.process_id} chief={cluster.is_chief()} "
+          f"{leg}", flush=True)
+    return 0
+
+
+def parent() -> int:
+    sys.path.insert(0, REPO)
+    from distributed_tensorflow_tpu import fleet
+    from distributed_tensorflow_tpu.fleet import launcher as launcher_lib
+    from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+
+    report = {}
+    for attempt in range(3):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        with tempfile.TemporaryDirectory() as hb_dir:
+            specs = launcher_lib.local_topology(
+                2, [sys.executable, os.path.abspath(__file__),
+                    "--child"], port,
+                extra_env={
+                    "DTTPU_REPO": REPO,
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS":
+                        "--xla_force_host_platform_device_count=4",
+                },
+                heartbeat_dir=hb_dir)
+            lc = fleet.Launcher(specs,
+                                registry=metrics_lib.Registry(),
+                                max_restarts=1,
+                                heartbeat_timeout_s=120.0,
+                                heartbeat_grace_s=120.0,
+                                poll_interval_s=0.2)
+            lc.start()
+            done = lc.wait(timeout_s=300.0)
+            if not done:
+                lc.stop()           # hung bring-up: retry fresh port
+            report = {"attempt": attempt, "port": port,
+                      "completed": done, "succeeded": lc.succeeded,
+                      "report": {str(k): v
+                                 for k, v in lc.report().items()}}
+            if done and lc.succeeded:
+                break
+    print(json.dumps(report), flush=True)
+    return 0 if report.get("succeeded") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(child() if "--child" in sys.argv[1:] else parent())

@@ -205,6 +205,62 @@ def test_dt301_inherited_base_lock_counts():
     assert rules_of(findings) == ["DT301"]
 
 
+def test_dt301_manual_acquire_release_counts_as_held():
+    # timed acquisition is inexpressible as ``with`` — the scheduler's
+    # export / page-wire idiom: ``acquire(timeout=)``, guard, body in
+    # ``try`` with the release in ``finally``.  The finally-release
+    # declares the try body runs under the lock.
+    findings = lint_conc("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._pump_lock = threading.Lock()
+                self._state = 0
+
+            def step(self):
+                with self._pump_lock:
+                    self._state += 1
+
+            def probe(self, timeout_s):
+                ok = self._pump_lock.acquire(timeout=timeout_s)
+                if not ok:
+                    return None
+                try:
+                    self._state += 1
+                    return self._state
+                finally:
+                    self._pump_lock.release()
+    """, select="DT301")
+    assert findings == []
+
+
+def test_dt301_try_without_finally_release_still_flags():
+    # a bare try/finally earns no lockset — only a finally that
+    # releases the contended lock does
+    findings = lint_conc("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._pump_lock = threading.Lock()
+                self._state = 0
+
+            def step(self):
+                with self._pump_lock:
+                    self._state += 1
+
+            def probe(self):
+                try:
+                    self._state += 1
+                    return self._state
+                finally:
+                    pass
+    """, select="DT301")
+    assert rules_of(findings) == ["DT301"]
+    assert "_state" in findings[0].message
+
+
 def test_dt301_suppression():
     findings = lint_conc(RACY_CLASS.replace(
         "return self._jobs.pop()      # no lock: races add()",

@@ -1,4 +1,6 @@
 """Cluster bootstrap tests (reference example.py:59-68,108-143 capability)."""
+import pytest
+
 from distributed_tensorflow_tpu.parallel import cluster
 
 
@@ -45,6 +47,22 @@ def test_legacy_ps_refused():
     assert cfg.is_legacy_ps
     out = cluster.initialize(cfg)  # must not try to start anything
     assert out is cfg
+
+
+def test_legacy_ps_under_launcher_exits_loud(monkeypatch):
+    """Under the fleet launcher the ps refusal must NOT read as a clean
+    exit 0 (the launcher would count the fleet one host short as
+    success): it exits LEGACY_PS_EXIT_CODE, which the launcher
+    classifies fatal-with-reason (fleet/launcher.py)."""
+    monkeypatch.setenv("DTTPU_LAUNCHER", "1")
+    cfg = cluster.cluster_from_env(environ={
+        "JOB_NAME": "ps",
+        "TASK_INDEX": "0",
+        "WORKER_HOSTS": "w0:2222",
+    })
+    with pytest.raises(SystemExit) as ei:
+        cluster.initialize(cfg)
+    assert ei.value.code == cluster.LEGACY_PS_EXIT_CODE == 64
 
 
 def test_bad_int_env_falls_back():

@@ -1,0 +1,435 @@
+"""Supervised multi-host launcher (fleet/launcher.py).
+
+The contracts pinned here (docs/RESILIENCE.md §launcher):
+
+  * exit-code classification — 0 is done, ``LEGACY_PS_EXIT_CODE`` (64)
+    is fatal WITH the misconfiguration named in the report (the old
+    silent ps no-op ran fleets one host short), signal deaths and
+    listed codes are transient, anything else is fatal;
+  * transient exits restart with bounded seeded backoff: a host in
+    backoff is NOT respawned before its due time, the budget exhausts
+    into fatal, and the audit trail (``restart_log``, exit histories,
+    ``dttpu_launcher_*``) records every decision;
+  * chief re-election — the chief is the lowest-id live host; host 0's
+    death moves the title and counts the election;
+  * heartbeat liveness — a child whose heartbeat file goes stale past
+    the timeout is killed (alive-but-stuck) and the kill classifies as
+    a transient restart;
+  * ``kill_host`` chaos (host site) SIGKILLs a supervised child at a
+    deterministic poll index and the launcher restarts it;
+  * the real-subprocess smoke: ``local_topology`` assembles the
+    env-var topology ``parallel/cluster.py`` resolves, and a 2-host
+    python child tree runs to clean completion under real
+    ``subprocess.Popen``.
+"""
+import os
+import sys
+import time
+
+import pytest
+
+from distributed_tensorflow_tpu import fleet
+from distributed_tensorflow_tpu.fleet import launcher as launcher_lib
+from distributed_tensorflow_tpu.obs import metrics as metrics_lib
+from distributed_tensorflow_tpu.parallel import cluster
+from distributed_tensorflow_tpu.resilience import faults
+
+
+class _FakeProc:
+    """One fake child: returns None for ``polls_alive`` polls, then its
+    exit code.  ``kill()`` forces a signal death immediately."""
+
+    def __init__(self, rc=0, polls_alive=0):
+        self._rc = rc
+        self._alive = polls_alive
+        self.killed = False
+
+    def poll(self):
+        if self.killed:
+            return self._rc
+        if self._alive > 0:
+            self._alive -= 1
+            return None
+        return self._rc
+
+    def kill(self):
+        self.killed = True
+        self._rc = -9
+
+    def wait(self, timeout=None):
+        return self.poll()
+
+
+_FOREVER = 10 ** 9
+
+
+class _Backend:
+    """Injectable popen: per-host list of fake procs, consumed one per
+    spawn (a missing entry runs forever)."""
+
+    def __init__(self, script):
+        self.script = {hid: list(procs) for hid, procs in script.items()}
+        self.spawns = {hid: 0 for hid in script}
+
+    def __call__(self, spec):
+        hid = spec.host_id
+        self.spawns[hid] = self.spawns.get(hid, 0) + 1
+        seq = self.script.get(hid, [])
+        if self.spawns[hid] <= len(seq):
+            return seq[self.spawns[hid] - 1]
+        return _FakeProc(rc=0, polls_alive=_FOREVER)
+
+
+class _FakeTime:
+    def __init__(self):
+        self.now = 0.0
+
+    def clock(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+def _specs(n=2, env=None):
+    return [fleet.HostSpec(host_id=i, argv=("true",), env=dict(env or {}))
+            for i in range(n)]
+
+
+def _launcher(backend, hosts=None, reg=None, **kw):
+    ft = _FakeTime()
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("poll_interval_s", 0.01)
+    lc = fleet.Launcher(hosts if hosts is not None else _specs(),
+                        registry=reg or metrics_lib.Registry(),
+                        popen=backend, sleep=ft.sleep, clock=ft.clock,
+                        **kw)
+    return lc, ft
+
+
+# ---------------------------------------------------------------------------
+# classification
+
+
+def test_clean_completion():
+    reg = metrics_lib.Registry()
+    lc, _ = _launcher(_Backend({0: [_FakeProc(0)], 1: [_FakeProc(0)]}),
+                      reg=reg)
+    lc.start()
+    assert lc.chief_id == 0
+    assert lc.wait(timeout_s=10.0) is True
+    assert lc.succeeded
+    rep = lc.report()
+    assert rep[0]["status"] == "done" and rep[1]["status"] == "done"
+    assert rep[0]["reason"] == "completed"
+    assert lc.elections == []    # draining to done is not an election
+    assert reg.get("dttpu_launcher_restarts_total").value == 0
+    assert reg.get("dttpu_launcher_fatal_total").value == 0
+
+
+def test_legacy_ps_exit_is_fatal_with_reason():
+    """Satellite: a legacy JOB_NAME=ps child exits 64 under the
+    launcher (parallel/cluster.py) and the report NAMES the
+    misconfiguration instead of counting a silent no-op as success."""
+    reg = metrics_lib.Registry()
+    lc, _ = _launcher(_Backend({
+        0: [_FakeProc(0)],
+        1: [_FakeProc(cluster.LEGACY_PS_EXIT_CODE)],
+    }), reg=reg)
+    lc.start()
+    assert lc.wait(timeout_s=10.0) is True
+    assert not lc.succeeded
+    rep = lc.report()
+    assert rep[1]["status"] == "fatal"
+    assert "JOB_NAME=ps" in rep[1]["reason"]
+    assert rep[1]["exit_history"] == [cluster.LEGACY_PS_EXIT_CODE]
+    assert rep[1]["restarts"] == 0           # no restart-looping a
+    #                                          role that cannot exist
+    assert reg.get("dttpu_launcher_fatal_total").value == 1
+
+
+def test_unknown_exit_code_is_fatal():
+    lc, _ = _launcher(_Backend({0: [_FakeProc(3)], 1: [_FakeProc(0)]}))
+    lc.start()
+    assert lc.wait(timeout_s=10.0) is True
+    rep = lc.report()
+    assert rep[0]["status"] == "fatal"
+    assert "exit code 3" in rep[0]["reason"]
+
+
+def test_listed_transient_code_restarts():
+    lc, _ = _launcher(
+        _Backend({0: [_FakeProc(75), _FakeProc(0)], 1: [_FakeProc(0)]}),
+        transient_exit_codes=(75,))
+    lc.start()
+    assert lc.wait(timeout_s=10.0) is True
+    assert lc.succeeded
+    assert lc.report()[0]["exit_history"] == [75, 0]
+
+
+# ---------------------------------------------------------------------------
+# restart discipline
+
+
+def test_signal_death_restarts_with_backoff():
+    """Two signal deaths, then success: each restart waits out its
+    backoff (no respawn before due time), the audit trail records
+    both, and the exit history is complete."""
+    reg = metrics_lib.Registry()
+    backend = _Backend({
+        0: [_FakeProc(-9), _FakeProc(-15), _FakeProc(0)],
+        1: [_FakeProc(0)],
+    })
+    lc, ft = _launcher(backend, reg=reg, backoff_base_s=1.0,
+                       backoff_factor=2.0)
+    lc.start()
+    lc.poll()                                # classify the -9 death
+    rep = lc.report()
+    assert rep[0]["status"] == "backoff"
+    assert rep[0]["restarts"] == 1
+    assert backend.spawns[0] == 1            # in backoff, NOT respawned
+    lc.poll()
+    assert backend.spawns[0] == 1            # still before due time
+    ft.now += 1.0                            # backoff_base elapses
+    lc.poll()
+    assert backend.spawns[0] == 2            # respawned on schedule
+    assert lc.wait(timeout_s=60.0) is True
+    assert lc.succeeded
+    rep = lc.report()
+    assert rep[0]["exit_history"] == [-9, -15, 0]
+    assert rep[0]["restarts"] == 2
+    assert [(h, a) for h, a, _ in rep[-1]["restart_log"]] == \
+        [(0, 1), (0, 2)]
+    assert reg.get("dttpu_launcher_restarts_total").value == 2
+
+
+def test_restart_budget_exhausts_into_fatal():
+    reg = metrics_lib.Registry()
+    lc, _ = _launcher(
+        _Backend({0: [_FakeProc(-9), _FakeProc(-9)], 1: [_FakeProc(0)]}),
+        reg=reg, max_restarts=1)
+    lc.start()
+    assert lc.wait(timeout_s=10.0) is True
+    rep = lc.report()
+    assert rep[0]["status"] == "fatal"
+    assert "restart budget exhausted" in rep[0]["reason"]
+    assert rep[0]["restarts"] == 1
+    assert reg.get("dttpu_launcher_fatal_total").value == 1
+
+
+# ---------------------------------------------------------------------------
+# chief election
+
+
+def test_chief_reelection_on_host0_loss():
+    reg = metrics_lib.Registry()
+    lc, _ = _launcher(_Backend({
+        0: [_FakeProc(1)],                   # fatal: chief dies
+        1: [_FakeProc(0, polls_alive=_FOREVER)],
+    }), reg=reg)
+    lc.start()
+    assert lc.chief_id == 0
+    lc.poll()
+    assert lc.chief_id == 1
+    assert lc.elections == [(0, 1)]
+    assert reg.get("dttpu_launcher_chief_elections_total").value == 1
+    assert lc.report()[-1]["chief"] == 1
+    lc.stop()
+
+
+def test_restarting_chief_keeps_title():
+    """A chief in backoff is still the fleet's host 0 (the topology
+    env pins PROCESS_ID): its transient death is NOT an election."""
+    lc, _ = _launcher(_Backend({
+        0: [_FakeProc(-9), _FakeProc(0, polls_alive=_FOREVER)],
+        1: [_FakeProc(0, polls_alive=_FOREVER)],
+    }))
+    lc.start()
+    lc.poll()                                # host 0 into backoff
+    assert lc.chief_id == 0 and lc.elections == []
+    lc.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat liveness
+
+
+def test_stale_heartbeat_kills_and_restarts(tmp_path):
+    hb = tmp_path / "host0.hb"
+    hb.write_text("")
+    stale = time.time() - 100.0
+    os.utime(hb, (stale, stale))
+    reg = metrics_lib.Registry()
+    hosts = [fleet.HostSpec(host_id=0, argv=("true",),
+                            env={"DTTPU_HEARTBEAT_FILE": str(hb)})]
+    backend = _Backend({0: [_FakeProc(0, polls_alive=_FOREVER),
+                            _FakeProc(0, polls_alive=_FOREVER)]})
+    lc, _ = _launcher(backend, hosts=hosts, reg=reg,
+                      heartbeat_timeout_s=5.0)
+    lc.start()
+    lc.poll()                                # stale -> kill -> backoff
+    assert reg.get("dttpu_launcher_heartbeat_missed_total").value == 1
+    rep = lc.report()
+    assert rep[0]["restarts"] == 1 and rep[0]["exit_history"] == [-9]
+    os.utime(hb, None)                       # child ticks again
+    lc._hosts[0].due_at = 0.0                # backoff due immediately
+    lc.poll()                                # respawn
+    assert backend.spawns[0] == 2
+    lc.poll()                                # fresh heartbeat: healthy
+    assert reg.get("dttpu_launcher_heartbeat_missed_total").value == 1
+    lc.stop()
+
+
+def test_missing_heartbeat_file_gets_grace(tmp_path):
+    """No file yet (slow-starting child): the spawn-anchored grace
+    window applies before the kill."""
+    hb = tmp_path / "never.hb"
+    hosts = [fleet.HostSpec(host_id=0, argv=("true",),
+                            env={"DTTPU_HEARTBEAT_FILE": str(hb)})]
+    lc, ft = _launcher(_Backend({0: [_FakeProc(0,
+                                               polls_alive=_FOREVER)]}),
+                       hosts=hosts, heartbeat_timeout_s=1.0,
+                       heartbeat_grace_s=5.0)
+    lc.start()
+    lc.poll()
+    assert lc.report()[0]["restarts"] == 0   # inside the grace window
+    ft.now += 10.0                           # grace + timeout blown
+    lc.poll()
+    assert lc.report()[0]["restarts"] == 1
+    lc.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: kill_host at the launcher site
+
+
+@pytest.mark.chaos
+def test_kill_host_chaos_restarts_supervised_child():
+    reg = metrics_lib.Registry()
+    backend = _Backend({
+        0: [_FakeProc(0, polls_alive=_FOREVER),
+            _FakeProc(0, polls_alive=_FOREVER)],
+        1: [_FakeProc(0, polls_alive=_FOREVER)],
+    })
+    lc, ft = _launcher(backend, reg=reg)
+    plan = faults.FaultPlan(
+        [{"kind": "kill_host", "at": 2, "replica": 0}],
+        registry=metrics_lib.Registry())
+    with faults.activated(plan):
+        lc.start()
+        for _ in range(3):                   # host:0 polls 0,1,2
+            lc.poll()
+        assert plan.log == [{"kind": "kill_host", "at": 2, "host": 0,
+                             "poll": 2}]
+        rep = lc.report()
+        assert rep[0]["restarts"] == 1
+        assert rep[0]["exit_history"] == [-9]
+        assert rep[1]["restarts"] == 0       # only the targeted host
+        ft.now += 1.0
+        lc.poll()                            # backoff due: respawn
+        assert backend.spawns[0] == 2
+    assert reg.get("dttpu_launcher_restarts_total").value == 1
+    lc.stop()                                # teardown reads as done
+    assert all(d["status"] == "done"
+               for h, d in lc.report().items() if h >= 0)
+
+
+# ---------------------------------------------------------------------------
+# topology + validation
+
+
+def test_local_topology_env_assembly(tmp_path):
+    specs = launcher_lib.local_topology(
+        2, [sys.executable, "-c", "pass"], 12345,
+        extra_env={"JAX_PLATFORMS": "cpu"},
+        heartbeat_dir=str(tmp_path))
+    assert [s.host_id for s in specs] == [0, 1]
+    for hid, s in enumerate(specs):
+        assert s.env["COORDINATOR_ADDRESS"] == "localhost:12345"
+        assert s.env["NUM_PROCESSES"] == "2"
+        assert s.env["PROCESS_ID"] == str(hid)
+        assert s.env["DTTPU_LAUNCHER"] == "1"
+        assert s.env["JAX_PLATFORMS"] == "cpu"
+        assert s.env["DTTPU_HEARTBEAT_FILE"].endswith(f"host{hid}.hb")
+    # the assembled env resolves to the topology cluster_from_env reads
+    cfg = cluster.cluster_from_env(environ=specs[1].env)
+    assert cfg.distributed and cfg.process_id == 1
+    assert cfg.num_processes == 2
+
+
+def test_empty_and_duplicate_hosts_raise():
+    with pytest.raises(ValueError, match="at least one"):
+        fleet.Launcher([])
+    with pytest.raises(ValueError, match="duplicate host ids"):
+        fleet.Launcher([fleet.HostSpec(0, ("true",)),
+                        fleet.HostSpec(0, ("true",))])
+
+
+def test_heartbeat_helper_touches_file(tmp_path):
+    hb = tmp_path / "h.hb"
+    launcher_lib.heartbeat(environ={})       # unset: no-op, no file
+    assert not hb.exists()
+    launcher_lib.heartbeat(environ={"DTTPU_HEARTBEAT_FILE": str(hb)})
+    assert hb.exists()
+    old = time.time() - 50.0
+    os.utime(hb, (old, old))
+    launcher_lib.heartbeat(environ={"DTTPU_HEARTBEAT_FILE": str(hb)})
+    assert time.time() - os.path.getmtime(hb) < 10.0
+
+
+# ---------------------------------------------------------------------------
+# real subprocesses
+
+
+def test_real_two_host_tree_completes():
+    """Real ``subprocess.Popen`` smoke: two python children read the
+    launcher-assembled topology env, heartbeat once, and exit clean —
+    the supervised bring-up the CI smoke job scales up."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child = (
+        "import os, sys; sys.path.insert(0, os.environ['DTTPU_REPO']); "
+        "from distributed_tensorflow_tpu.fleet import launcher; "
+        "assert os.environ['DTTPU_LAUNCHER'] == '1'; "
+        "launcher.heartbeat(); "
+        "sys.exit(int(os.environ['PROCESS_ID']) * 0)")
+    specs = launcher_lib.local_topology(
+        2, [sys.executable, "-c", child], 23456,
+        extra_env={"DTTPU_REPO": repo, "JAX_PLATFORMS": "cpu"})
+    lc = fleet.Launcher(specs, registry=metrics_lib.Registry(),
+                        poll_interval_s=0.02)
+    lc.start()
+    try:
+        assert lc.wait(timeout_s=60.0) is True
+    finally:
+        lc.stop()
+    assert lc.succeeded, lc.report()
+
+
+def test_real_child_killed_by_signal_restarts():
+    """A child that SIGKILLs itself is a transient death under real
+    Popen; the respawned incarnation completes."""
+    marker_env = "DTTPU_TEST_MARKER_DIR"
+    child = (
+        "import os, signal; "
+        "d = os.environ['%s']; "
+        "p = os.path.join(d, 'spawned' + os.environ['PROCESS_ID']); "
+        "n = int(open(p).read()) if os.path.exists(p) else 0; "
+        "open(p, 'w').write(str(n + 1)); "
+        "os.kill(os.getpid(), signal.SIGKILL) if n == 0 else None"
+        % marker_env)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        specs = launcher_lib.local_topology(
+            1, [sys.executable, "-c", child], 34567,
+            extra_env={marker_env: d})
+        lc = fleet.Launcher(specs, registry=metrics_lib.Registry(),
+                            backoff_base_s=0.02, poll_interval_s=0.02)
+        lc.start()
+        try:
+            assert lc.wait(timeout_s=60.0) is True
+        finally:
+            lc.stop()
+        assert lc.succeeded, lc.report()
+        assert lc.report()[0]["restarts"] == 1
+        assert open(os.path.join(d, "spawned0")).read() == "2"
